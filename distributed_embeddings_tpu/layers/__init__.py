@@ -1,0 +1,3 @@
+"""Single-device embedding layers."""
+
+from distributed_embeddings_tpu.layers.embedding import Embedding, ConcatOneHotEmbedding

@@ -1,0 +1,199 @@
+"""Single-device embedding layers.
+
+TPU-native re-design of the reference Keras layers
+(`/root/reference/distributed_embeddings/python/layers/embedding.py:41-180`).
+Layers here are *functional*: a layer object holds static configuration and
+exposes ``init(rng) -> params`` / ``apply(params, inputs) -> out`` pure
+functions, the idiomatic JAX shape (parameters live in pytrees the caller
+owns, so `jit`/`grad`/`pjit` compose without framework state).
+
+The reference's ``CPUInitializer`` (embedding.py:28-38, one-time init forced
+onto host to dodge GPU OOM) has no direct analog: ``init`` is a pure function
+the caller may run on any backend (`jax.jit(layer.init, backend='cpu')`), and
+terabyte tables stream in through the checkpoint path instead
+(parallel/checkpoint.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_embeddings_tpu.ops.embedding_lookup import embedding_lookup
+from distributed_embeddings_tpu.ops.ragged import RaggedBatch, SparseIds
+from distributed_embeddings_tpu.parallel.planner import TableConfig
+
+Initializer = Callable[[jax.Array, tuple, Any], jax.Array]
+
+
+def uniform_initializer(minval=-0.05, maxval=0.05) -> Initializer:
+  """Keras-default 'uniform' (RandomUniform(-0.05, 0.05))."""
+
+  def init(key, shape, dtype=jnp.float32):
+    return jax.random.uniform(key, shape, dtype, minval, maxval)
+
+  return init
+
+
+def scaled_uniform_initializer() -> Initializer:
+  """Uniform(+-1/sqrt(rows)): the DLRM table initializer
+  (reference `examples/dlrm/utils.py:27-41`, ``DLRMInitializer``)."""
+
+  def init(key, shape, dtype=jnp.float32):
+    maxval = 1.0 / math.sqrt(shape[0])
+    return jax.random.uniform(key, shape, dtype, -maxval, maxval)
+
+  return init
+
+
+_INITIALIZERS: Dict[str, Callable[[], Initializer]] = {
+    'uniform': uniform_initializer,
+    'scaled_uniform': scaled_uniform_initializer,
+    'zeros': lambda: (lambda key, shape, dtype=jnp.float32: jnp.zeros(
+        shape, dtype)),
+    'ones': lambda: (lambda key, shape, dtype=jnp.float32: jnp.ones(
+        shape, dtype)),
+    'normal': lambda: (lambda key, shape, dtype=jnp.float32: 0.05 * jax.random
+                       .normal(key, shape, dtype)),
+}
+
+
+def get_initializer(spec: Union[None, str, Initializer]) -> Initializer:
+  """Resolve an initializer spec: name, callable, or None (-> 'uniform')."""
+  if spec is None:
+    return uniform_initializer()
+  if callable(spec):
+    return spec
+  if spec in _INITIALIZERS:
+    return _INITIALIZERS[spec]()
+  raise ValueError(f'Unknown initializer {spec!r}')
+
+
+@dataclasses.dataclass
+class Embedding:
+  """Turns indices into vectors of fixed size.
+
+  API parity with the reference ``Embedding`` layer
+  (`embedding.py:41-152`): one table ``[input_dim, output_dim]``; supported
+  inputs and output shapes (reference docstring, embedding.py:55-59):
+
+  - N-D dense int array ``(d1,...,dn)``: combiner None ->
+    ``(d1,...,dn,output_dim)``; combiner 'sum'/'mean' ->
+    ``(d1,...,dn-1,output_dim)`` (reduced over the last axis);
+  - ``RaggedBatch`` (static CSR) with combiner -> ``(batch, output_dim)``;
+  - ``SparseIds`` (static COO) with combiner -> ``(batch, output_dim)``.
+
+  Out-of-vocabulary ids are clipped to the last row (no runtime bounds
+  error can surface from inside jit).
+  """
+  input_dim: int
+  output_dim: int
+  embeddings_initializer: Union[None, str, Initializer] = 'uniform'
+  combiner: Optional[str] = None
+  dtype: Any = jnp.float32
+  name: Optional[str] = None
+
+  def __post_init__(self):
+    if self.input_dim <= 0 or self.output_dim <= 0:
+      raise ValueError(
+          f'Both input_dim and output_dim should be positive, found '
+          f'{self.input_dim} and {self.output_dim}')
+    if self.combiner not in (None, 'sum', 'mean'):
+      raise ValueError(f'Unsupported combiner {self.combiner}')
+
+  def init(self, rng: jax.Array) -> jax.Array:
+    """Create the ``[input_dim, output_dim]`` table."""
+    initializer = get_initializer(self.embeddings_initializer)
+    return jnp.asarray(
+        initializer(rng, (self.input_dim, self.output_dim), self.dtype))
+
+  def apply(self, params: jax.Array, inputs) -> jax.Array:
+    """Look up ``inputs`` in ``params`` (reference ``call``,
+    embedding.py:108-130)."""
+    if isinstance(inputs, (RaggedBatch, SparseIds)):
+      return embedding_lookup(params, inputs, combiner=self.combiner)
+    inputs = jnp.asarray(inputs)
+    if inputs.ndim == 1 and self.combiner is not None:
+      raise ValueError(
+          '1D input with combiner is ambiguous. Please create batch dimension.')
+    return embedding_lookup(params, inputs, combiner=self.combiner)
+
+  __call__ = apply
+
+  def table_config(self) -> TableConfig:
+    """This layer as a planner ``TableConfig`` (the distributed wrapper's
+    unit of planning)."""
+    return TableConfig(input_dim=self.input_dim,
+                       output_dim=self.output_dim,
+                       combiner=self.combiner,
+                       initializer=get_initializer(
+                           self.embeddings_initializer),
+                       name=self.name)
+
+  def get_config(self) -> Dict[str, Any]:
+    """Serializable config (reference ``get_config``, embedding.py:132-143)."""
+    init = self.embeddings_initializer
+    return {
+        'input_dim': self.input_dim,
+        'output_dim': self.output_dim,
+        'embeddings_initializer': init if isinstance(init, str) else None,
+        'combiner': self.combiner,
+        'name': self.name,
+    }
+
+  @classmethod
+  def from_config(cls, config: Dict[str, Any]) -> 'Embedding':
+    """Build from a config dict; tolerates stock-Keras-style extra keys
+    (reference ``from_config``, embedding.py:145-152)."""
+    config = dict(config)
+    for stale in ('mask_zero', 'input_length', 'dtype', 'trainable',
+                  'embeddings_regularizer', 'activity_regularizer',
+                  'embeddings_constraint'):
+      config.pop(stale, None)
+    init = config.pop('embeddings_initializer', 'uniform')
+    return cls(embeddings_initializer=init or 'uniform', **config)
+
+
+@dataclasses.dataclass
+class ConcatOneHotEmbedding:
+  """Many one-hot tables of equal width stored as one concatenated table.
+
+  Parity with reference ``ConcatOneHotEmbedding`` (`embedding.py:155-180`):
+  lookup is ``inputs + row_offsets`` followed by a single gather.
+
+  Args:
+    feature_sizes: rows of each member table.
+    embedding_width: shared embedding width.
+  """
+  feature_sizes: list
+  embedding_width: int
+  dtype: Any = jnp.float32
+
+  def __post_init__(self):
+    self._offsets = np.concatenate([[0], np.cumsum(self.feature_sizes)])
+
+  @property
+  def total_rows(self) -> int:
+    return int(self._offsets[-1])
+
+  def init(self, rng: jax.Array) -> jax.Array:
+    return uniform_initializer()(rng, (self.total_rows, self.embedding_width),
+                                 self.dtype)
+
+  def apply(self, params: jax.Array, inputs) -> jax.Array:
+    """``inputs``: ``[batch, num_tables]`` one-hot ids ->
+    ``[batch, num_tables, width]``."""
+    inputs = jnp.asarray(inputs)
+    if inputs.ndim != 2 or inputs.shape[1] != len(self.feature_sizes):
+      raise ValueError(
+          f'Expected [batch, {len(self.feature_sizes)}] input, '
+          f'got {inputs.shape}')
+    offset_ids = inputs + jnp.asarray(self._offsets[:-1], inputs.dtype)
+    return jnp.take(params, offset_ids, axis=0)
+
+  __call__ = apply

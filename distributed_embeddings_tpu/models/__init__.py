@@ -1,0 +1,1 @@
+"""Model zoo: DLRM and synthetic recommender benchmark models."""

@@ -1,0 +1,1 @@
+"""Embedding lookup ops: XLA fallback paths and Pallas TPU kernels."""

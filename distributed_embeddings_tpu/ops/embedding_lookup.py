@@ -1,0 +1,132 @@
+"""Embedding lookup dispatcher: dense / ragged / sparse x {None, sum, mean}.
+
+TPU-native re-design of the reference dispatcher
+(`/root/reference/distributed_embeddings/python/ops/embedding_lookup_ops.py:37-102`).
+The reference routes between `tf.nn.embedding_lookup` and a custom CUDA op;
+here every path lowers to XLA gather / segment-sum (static shapes, fusible),
+with an optional Pallas fused kernel for the CSR hot path
+(`ops/pallas_lookup.py`).  The reference's ``ReadVariableNoCopy``
+(`cc/kernels/embedding_lookup_kernels.cc:28-45`) has no TPU equivalent by
+design: JAX arrays are immutable, so copy-on-read never happens
+(SURVEY.md §2.2 item 4, intentionally dropped).
+
+Gradients: plain JAX autodiff yields a scatter-add into a table-shaped
+buffer, the shape-static analog of the reference's dynamic
+``IndexedSlices`` grad (`embedding_lookup_ops.py:105-122`); XLA fuses it
+into the optimizer update.  A capacity-bounded sparse-gradient path for
+very large tables lives with the Pallas kernels.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from distributed_embeddings_tpu.ops.ragged import RaggedBatch, SparseIds
+
+Ids = Union[jax.Array, RaggedBatch, SparseIds]
+
+_ACCUM_DTYPE = jnp.float32
+
+
+def _combine_accum_dtype(param_dtype):
+  """Accumulate reductions in fp32 when the table is stored low-precision."""
+  if param_dtype in (jnp.bfloat16, jnp.float16):
+    return _ACCUM_DTYPE
+  return param_dtype
+
+
+def embedding_lookup(param: jax.Array,
+                     ids: Ids,
+                     combiner: Optional[str] = None) -> jax.Array:
+  """Looks up embeddings for ``ids`` in the table ``param``.
+
+  API parity with reference ``embedding_lookup``
+  (`embedding_lookup_ops.py:37-102`):
+
+  - dense int array, ``combiner=None``: returns ``ids.shape + (width,)``;
+  - dense ``[batch, hot]``, combiner 'sum'/'mean': reduced to
+    ``[batch, width]``;
+  - ``RaggedBatch`` (static CSR), combiner 'sum'/'mean': ``[batch, width]``
+    with true variable row lengths (mean divides by real hotness);
+  - ``SparseIds`` (static COO): converted via ``row_to_split`` then the
+    ragged path (reference `embedding_lookup_ops.py:81-96`).
+
+  Divergence from the reference: with ``combiner=None`` and ragged/sparse
+  input the reference returns a RaggedTensor gather; static shapes make that
+  impossible, so here it returns the padded value gather ``[nnz_cap, width]``
+  with zero rows at padding positions.
+
+  Args:
+    param: ``[vocab, width]`` embedding table.
+    ids: dense int array, ``RaggedBatch`` or ``SparseIds``.
+    combiner: ``None``, 'sum' or 'mean'.
+
+  Returns:
+    Looked-up (and optionally combined) embeddings.
+  """
+  if combiner not in (None, 'sum', 'mean'):
+    raise ValueError(f'Unsupported combiner {combiner}')
+  if param.ndim != 2:
+    raise ValueError(f'param must be 2D [vocab, width], got {param.shape}')
+
+  if isinstance(ids, SparseIds):
+    if combiner is None:
+      return _masked_gather(param, ids.values,
+                            ids.row_indices < ids.nrows_static)
+    return _ragged_combine(param, ids.to_ragged(), combiner)
+  if isinstance(ids, RaggedBatch):
+    if combiner is None:
+      return _masked_gather(param, ids.values, ids.valid_mask())
+    return _ragged_combine(param, ids, combiner)
+
+  ids = jnp.asarray(ids)
+  if not jnp.issubdtype(ids.dtype, jnp.integer):
+    raise ValueError(f'ids must be integer, got {ids.dtype}')
+  if combiner is None:
+    return jnp.take(param, ids, axis=0)
+  if ids.ndim < 2:
+    raise ValueError(
+        '1D input with combiner is ambiguous. Please create batch dimension.')
+  gathered = jnp.take(param, ids, axis=0)
+  acc = _combine_accum_dtype(param.dtype)
+  if combiner == 'sum':
+    out = jnp.sum(gathered.astype(acc), axis=-2)
+  else:
+    out = jnp.mean(gathered.astype(acc), axis=-2)
+  return out.astype(param.dtype)
+
+
+def _masked_gather(param, values, mask):
+  rows = jnp.take(param, jnp.clip(values, 0, param.shape[0] - 1), axis=0)
+  return jnp.where(mask[:, None], rows, 0).astype(param.dtype)
+
+
+def _ragged_combine(param: jax.Array, ids: RaggedBatch,
+                    combiner: str) -> jax.Array:
+  """Fused-semantics CSR lookup+combine via gather + segment-sum.
+
+  XLA-fallback equivalent of the reference CUDA kernel
+  ``EmbeddingLookUpVariableHot`` (`embedding_lookup_kernels.cu:175-336`,
+  SURVEY.md C2): instead of per-sample cooperative tiles, rows are gathered
+  ``[nnz_cap, width]`` and segment-summed into ``[batch, width]``; XLA fuses
+  the mask/scale elementwise work into the gather.  The Pallas kernel in
+  ``ops/pallas_lookup.py`` implements the single-pass version.
+  """
+  acc = _combine_accum_dtype(param.dtype)
+  nrows = ids.nrows
+  rowids = ids.row_ids()
+  mask = ids.valid_mask()
+  safe_values = jnp.clip(ids.values, 0, param.shape[0] - 1)
+  rows = jnp.take(param, safe_values, axis=0).astype(acc)
+  rows = jnp.where(mask[:, None], rows, 0)
+  # Padding positions carry rowid == nrows which scatter-drops.
+  segment_ids = jnp.where(mask, rowids, nrows)
+  out = jax.ops.segment_sum(rows, segment_ids, num_segments=nrows)
+  if combiner == 'mean':
+    lengths = ids.row_lengths().astype(acc)
+    out = out / jnp.maximum(lengths, 1)[:, None]
+  return out.astype(param.dtype)

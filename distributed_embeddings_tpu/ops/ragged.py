@@ -1,0 +1,174 @@
+"""Static-shape ragged/sparse id containers for TPU.
+
+The reference consumes `tf.RaggedTensor` / `tf.SparseTensor` with dynamic
+nnz (`embedding_lookup_ops.py:68-96`).  XLA on TPU wants static shapes
+(SURVEY.md §7 "Hard parts" 1), so variable hotness is represented as
+*capacity-padded CSR*: a fixed-size ``values`` buffer plus ``row_splits``;
+entries at positions >= ``row_splits[-1]`` are padding.  All shapes are
+static; only the split values are data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class RaggedBatch:
+  """Capacity-padded CSR batch of lookup ids.
+
+  Equivalent of the reference's 2-D ``RaggedTensor`` input
+  (`embedding_lookup_ops.py:55-57`: "values and row_splits are col_index and
+  row_index of CSR format hotness matrix").
+
+  Attributes:
+    values: ``[nnz_cap]`` int array of ids; positions past the true nnz
+      (``row_splits[-1]``) are padding and ignored.
+    row_splits: ``[batch + 1]`` int array, monotonically non-decreasing,
+      ``row_splits[0] == 0``.  Row ``i`` owns
+      ``values[row_splits[i]:row_splits[i+1]]``.
+  """
+  values: jax.Array
+  row_splits: jax.Array
+
+  @property
+  def nrows(self) -> int:
+    return self.row_splits.shape[0] - 1
+
+  @property
+  def nnz_cap(self) -> int:
+    return self.values.shape[0]
+
+  def row_ids(self) -> jax.Array:
+    """Row index of each value position (padding positions map to ``nrows``)."""
+    pos = jnp.arange(self.nnz_cap, dtype=self.row_splits.dtype)
+    return jnp.searchsorted(self.row_splits, pos, side='right') - 1
+
+  def row_lengths(self) -> jax.Array:
+    return self.row_splits[1:] - self.row_splits[:-1]
+
+  def valid_mask(self) -> jax.Array:
+    """``[nnz_cap]`` bool: True at real (non-padding) positions."""
+    pos = jnp.arange(self.nnz_cap, dtype=self.row_splits.dtype)
+    return pos < self.row_splits[-1]
+
+  @classmethod
+  def from_row_lengths(cls, values, row_lengths) -> 'RaggedBatch':
+    lengths = jnp.asarray(row_lengths)
+    splits = jnp.concatenate(
+        [jnp.zeros((1,), lengths.dtype),
+         jnp.cumsum(lengths)])
+    return cls(values=jnp.asarray(values), row_splits=splits)
+
+  @classmethod
+  def from_lists(cls, rows: Sequence[Sequence[int]], nnz_cap=None,
+                 dtype=jnp.int32) -> 'RaggedBatch':
+    """Build from Python lists (host side, for tests and data pipelines)."""
+    flat = [v for row in rows for v in row]
+    if nnz_cap is None:
+      nnz_cap = len(flat)
+    if len(flat) > nnz_cap:
+      raise ValueError(f'nnz {len(flat)} exceeds capacity {nnz_cap}')
+    values = np.zeros((nnz_cap,), dtype=np.int32)
+    values[:len(flat)] = flat
+    splits = np.zeros((len(rows) + 1,), dtype=np.int32)
+    np.cumsum([len(r) for r in rows], out=splits[1:])
+    return cls(values=jnp.asarray(values, dtype),
+               row_splits=jnp.asarray(splits, dtype))
+
+  def to_padded_dense(self, hot_cap: int, pad_value: int = -1) -> jax.Array:
+    """``[batch, hot_cap]`` dense ids with ``pad_value`` at padding positions.
+
+    Canonical densification used by the distributed runtime, which routes
+    fixed-capacity buffers through all-to-all (see parallel/dist_embedding.py).
+    """
+    rowids = self.row_ids()
+    pos = jnp.arange(self.nnz_cap, dtype=self.row_splits.dtype)
+    col = pos - self.row_splits[jnp.clip(rowids, 0, self.nrows - 1)]
+    valid = self.valid_mask() & (col < hot_cap)
+    out = jnp.full((self.nrows, hot_cap), pad_value, dtype=self.values.dtype)
+    # Route invalid positions out of bounds so mode='drop' discards them
+    # (clamping them to (0, 0) would overwrite a real id).
+    rows_safe = jnp.where(valid, rowids, self.nrows)
+    cols_safe = jnp.where(valid, col, 0)
+    return out.at[rows_safe, cols_safe].set(
+        self.values, mode='drop', unique_indices=False)
+
+  def tree_flatten(self):
+    return (self.values, self.row_splits), None
+
+  @classmethod
+  def tree_unflatten(cls, aux, children):
+    del aux
+    return cls(*children)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class SparseIds:
+  """Capacity-padded COO batch, row-major sorted (reference ``SparseTensor``
+  input path, `embedding_lookup_ops.py:81-96`).
+
+  Attributes:
+    row_indices: ``[nnz_cap]`` int row of each value; padding rows must hold
+      a sentinel >= ``nrows_static`` (use ``nrows_static``).
+    values: ``[nnz_cap]`` int ids.
+    nrows_static: static batch size.
+  """
+  row_indices: jax.Array
+  values: jax.Array
+  nrows_static: int
+
+  @property
+  def nnz_cap(self) -> int:
+    return self.values.shape[0]
+
+  @classmethod
+  def from_lists(cls, rows: Sequence[Sequence[int]], nnz_cap=None,
+                 dtype=jnp.int32) -> 'SparseIds':
+    flat, rid = [], []
+    for i, row in enumerate(rows):
+      flat.extend(row)
+      rid.extend([i] * len(row))
+    if nnz_cap is None:
+      nnz_cap = len(flat)
+    if len(flat) > nnz_cap:
+      raise ValueError(f'nnz {len(flat)} exceeds capacity {nnz_cap}')
+    values = np.zeros((nnz_cap,), dtype=np.int32)
+    values[:len(flat)] = flat
+    row_indices = np.full((nnz_cap,), len(rows), dtype=np.int32)
+    row_indices[:len(rid)] = rid
+    return cls(row_indices=jnp.asarray(row_indices, dtype),
+               values=jnp.asarray(values, dtype),
+               nrows_static=len(rows))
+
+  def to_ragged(self) -> RaggedBatch:
+    splits = row_to_split(self.row_indices, self.nrows_static)
+    return RaggedBatch(values=self.values, row_splits=splits)
+
+  def tree_flatten(self):
+    return (self.row_indices, self.values), self.nrows_static
+
+  @classmethod
+  def tree_unflatten(cls, aux, children):
+    return cls(children[0], children[1], aux)
+
+
+def row_to_split(row_indices: jax.Array, nrows: int) -> jax.Array:
+  """COO row indices (sorted) -> CSR row_splits.
+
+  TPU-native equivalent of the reference's ``RowToSplit`` CUDA kernel
+  (`cc/kernels/embedding_lookup_kernels.cu:337-356`, SURVEY.md C5): the CUDA
+  version runs one binary search per output row; here a single vectorised
+  ``searchsorted`` compiles to the same work under XLA with no host round-trip.
+  Padding positions must carry row index >= ``nrows``.
+  """
+  targets = jnp.arange(nrows + 1, dtype=row_indices.dtype)
+  return jnp.searchsorted(row_indices, targets, side='left').astype(
+      row_indices.dtype)

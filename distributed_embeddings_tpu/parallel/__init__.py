@@ -1,0 +1,12 @@
+"""Model-parallel planning, runtime, collectives and checkpointing."""
+
+from distributed_embeddings_tpu.parallel.planner import (
+    TableConfig,
+    ShardingPlan,
+    GroupSpec,
+    Request,
+    LocalTable,
+    slice_table_column,
+    auto_column_slice_threshold,
+    apply_strategy,
+)

@@ -1,0 +1,500 @@
+"""Sharding planner: table slicing, placement, fusion, and the SPMD plan.
+
+TPU-native re-design of the reference planner
+(`/root/reference/distributed_embeddings/python/layers/dist_model_parallel.py:40-305`,
+class ``DistEmbeddingStrategy``).  The planning *semantics* match the reference:
+
+- column slicing of oversized tables into power-of-2 slice counts
+  (reference ``maybe_slice_table_column``, dist_model_parallel.py:138-169),
+- automatic threshold selection when there are fewer tables than workers
+  (reference ``create_sliced_configs``, dist_model_parallel.py:171-205),
+- ``basic`` / ``memory_balanced`` / ``memory_optimized`` placement
+  (reference ``apply_stragety``, dist_model_parallel.py:208-244),
+- re-merge of same-table slices landing on one device
+  (reference ``_merge_slices``, dist_model_parallel.py:290-305),
+- same-device fusion of equal-(width, combiner) tables into one tall table
+  (reference ``_create_concat``, dist_model_parallel.py:249-287).
+
+The *output* of planning is different by design.  The reference is MPMD: each
+Horovod rank materialises only its own Keras layers, and per-rank differences
+live in Python control flow.  A JAX/XLA TPU program is SPMD: one traced program
+runs on every device of the mesh, so per-device differences must live in *data*
+(uniformly shaped, padded arrays), never in code structure.  The plan therefore
+describes, for every fusion-group signature ``(width, combiner)``:
+
+- a fused parameter array of shape ``[num_devices, rows_cap, width]`` (rows
+  padded per device to the max over devices) sharded over the mesh axis,
+- a request table: each (input, column-slice) pair becomes a *request* routed
+  to one (device, group, slot), with padded slot capacity ``n_cap`` so the
+  all-to-all send buffer ``[num_devices, n_cap, local_batch, hot_cap]`` has the
+  same static shape on every device,
+- row offsets of each request inside the fused table, carried as a
+  ``[num_devices, n_cap]`` array (sharded data, not code).
+
+Checkpoint layout contract (reference dist_model_parallel.py:452-645): each
+table's global weight is column-partitioned over the devices holding its
+slices, in device order, with contiguous column ranges; the plan records that
+mapping exactly so save/load can reshard to any world size.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class TableConfig:
+  """Configuration of one logical embedding table.
+
+  Mirrors the information the reference carries in Keras layer config dicts
+  (`embedding.py:132-143`): vocabulary size, embedding width, combiner and
+  initializer.
+
+  Attributes:
+    input_dim: vocabulary size (number of rows).
+    output_dim: embedding width (number of columns).
+    combiner: ``None``, ``'sum'`` or ``'mean'``.  ``None`` means no reduction
+      (valid for hotness-1 / dense lookups).
+    initializer: optional callable ``(key, shape, dtype) -> array`` used to
+      initialise this table.  ``None`` selects scaled uniform(-1/sqrt(rows)).
+    name: optional table name (for checkpoints and debugging).
+  """
+  input_dim: int
+  output_dim: int
+  combiner: Optional[str] = None
+  initializer: Optional[Callable] = None
+  name: Optional[str] = None
+
+  def __post_init__(self):
+    if self.input_dim <= 0 or self.output_dim <= 0:
+      raise ValueError(
+          f'Both input_dim and output_dim should be positive, found '
+          f'{self.input_dim} and {self.output_dim}')
+    if self.combiner not in (None, 'sum', 'mean'):
+      raise ValueError(f'Unsupported combiner {self.combiner}')
+
+  @property
+  def size(self) -> int:
+    return self.input_dim * self.output_dim
+
+
+@dataclasses.dataclass
+class LocalTable:
+  """One (possibly column-sliced, possibly slice-merged) table shard placed on
+  a device.  Columns ``[col_start, col_end)`` of global table ``table_id``."""
+  table_id: int
+  input_dim: int
+  col_start: int
+  col_end: int
+
+  @property
+  def width(self) -> int:
+    return self.col_end - self.col_start
+
+
+@dataclasses.dataclass
+class Request:
+  """One (input, column-slice) lookup routed to a (device, group, slot).
+
+  ``input_id`` indexes the user's input list; the request consumes that input's
+  ids, adds ``row_offset`` (position of its table inside the fused group
+  parameter) and produces ``width`` output columns ``[col_start, col_end)`` of
+  the input's logical output.
+  """
+  input_id: int
+  table_id: int
+  device: int
+  group_key: Tuple[int, Optional[str]]
+  slot: int
+  row_offset: int
+  col_start: int
+  col_end: int
+
+  @property
+  def width(self) -> int:
+    return self.col_end - self.col_start
+
+
+@dataclasses.dataclass
+class GroupSpec:
+  """A fusion-group signature shared by all devices: every device owns one
+  fused parameter shard ``[rows_cap, width]`` for this signature (zero-row
+  devices get padding-only shards).
+
+  Attributes:
+    key: ``(width, combiner)`` signature.
+    width: embedding width of every member table.
+    combiner: shared combiner of member tables.
+    rows: per-device fused row counts (before padding), length ``num_devices``.
+    rows_cap: max over devices, padded to a multiple of 8 (TPU sublane).
+    n_cap: max number of requests any device has in this group (slot count of
+      the padded all-to-all buffers).
+    requests: per-device request lists, length ``num_devices``.
+    member_tables: per-device ``LocalTable`` lists (fusion members in order;
+      row offsets are cumulative input_dims, reference
+      dist_model_parallel.py:257-259).
+  """
+  key: Tuple[int, Optional[str]]
+  width: int
+  combiner: Optional[str]
+  rows: List[int]
+  rows_cap: int
+  n_cap: int
+  requests: List[List[Request]]
+  member_tables: List[List[LocalTable]]
+
+
+def _round_up(x: int, m: int) -> int:
+  return -(-x // m) * m
+
+
+def slice_table_column(config: TableConfig, column_slice_threshold,
+                       world_size: int) -> List[int]:
+  """Split a table's width into power-of-2 many slices each below threshold.
+
+  Semantics of reference ``maybe_slice_table_column``
+  (dist_model_parallel.py:138-169): N = smallest power of 2 such that
+  ``size / N <= threshold``, capped at ``min(N, world_size, output_dim)``;
+  columns divided evenly with the remainder spread over the first slices.
+
+  Returns:
+    List of slice widths (length = number of slices, sum = output_dim).
+  """
+  if column_slice_threshold is None:
+    column_slice_threshold = float('inf')
+  table_size = config.size
+  num_slices = 1
+  while table_size > column_slice_threshold:
+    num_slices *= 2
+    table_size /= 2
+  if num_slices == 1:
+    return [config.output_dim]
+  num_slices = min(num_slices, world_size, config.output_dim)
+  cols_per_slice, remainder = divmod(config.output_dim, num_slices)
+  return [
+      cols_per_slice + (1 if i < remainder else 0) for i in range(num_slices)
+  ]
+
+
+def auto_column_slice_threshold(table_sizes: Sequence[int],
+                                world_size: int) -> Optional[int]:
+  """Pick a threshold so every worker receives at least one slice.
+
+  Reference ``create_sliced_configs`` auto path
+  (dist_model_parallel.py:186-192): while there are fewer (virtual) tables than
+  workers, repeatedly halve the largest table, remembering ``largest - 1`` as
+  the running threshold.
+  """
+  if len(table_sizes) >= world_size:
+    return None
+  sizes = list(table_sizes)
+  threshold = None
+  while world_size > len(sizes):
+    sizes.sort()
+    threshold = sizes[-1] - 1
+    largest = sizes.pop(-1)
+    sizes += [largest // 2, largest // 2]
+  return threshold
+
+
+def apply_strategy(mode: str, world_size: int, global_ids: Sequence[int],
+                   slice_sizes: Sequence[int]) -> List[List[int]]:
+  """Distribute flattened slice ids onto devices.
+
+  Exact placement semantics of reference ``apply_stragety``
+  (dist_model_parallel.py:208-244), including its lexicographic tie-breaking
+  in ``memory_optimized`` (the reference sorts ``[total_size, id_list]`` pairs
+  as Python lists).
+
+  Args:
+    mode: 'basic' | 'memory_balanced' | 'memory_optimized'.
+    world_size: number of devices.
+    global_ids: table id of each slice, flattened in table order.
+    slice_sizes: element count of each slice, same order.
+
+  Returns:
+    Per-device lists of positions into ``global_ids`` (slice indices).
+  """
+  positions = list(range(len(global_ids)))
+  if mode == 'basic':
+    return [positions[i::world_size] for i in range(world_size)]
+  if mode == 'memory_balanced':
+    # Size-sorted snake/zigzag pairing: biggest i-th with smallest i-th.
+    order = [
+        p for _, _, p in sorted(((slice_sizes[p], global_ids[p], p)
+                                 for p in positions), reverse=True)
+    ]
+    return [
+        order[i::2 * world_size] + order[(2 * world_size - 1 - i)::2 * world_size]
+        for i in range(world_size)
+    ]
+  if mode == 'memory_optimized':
+    # Greedy: biggest-first onto the least-loaded device; ties broken by
+    # comparing accumulated id lists, as the reference's list sort does.
+    sorted_pairs = sorted(zip(slice_sizes, global_ids, positions))
+    bins: List[List[Any]] = [[0, [], []] for _ in range(world_size)]
+    while sorted_pairs:
+      size, gid, pos = sorted_pairs.pop()
+      bins[0][0] += size
+      bins[0][1].append(gid)
+      bins[0][2].append(pos)
+      bins.sort(key=lambda b: (b[0], b[1]))
+    return [b[2] for b in bins]
+  raise ValueError(f'Unsupported strategy {mode}')
+
+
+class ShardingPlan:
+  """Global, deterministic sharding plan. Every host computes the identical
+  plan from the same inputs (replacing the reference's every-rank-computes-
+  the-global-plan loop, dist_model_parallel.py:99-123); no communication is
+  involved in planning.
+
+  Args:
+    table_configs: list of ``TableConfig`` for every logical table.
+    world_size: number of mesh devices tables are distributed over.
+    strategy: 'basic' | 'memory_balanced' | 'memory_optimized'.
+    input_table_map: ``input[i]`` looks up ``table[input_table_map[i]]``;
+      ``None`` means identity (reference dist_model_parallel.py:80-81).
+    column_slice_threshold: see ``slice_table_column``; ``None`` enables the
+      automatic fewer-tables-than-workers slicing only.
+  """
+
+  def __init__(self,
+               table_configs: Sequence[TableConfig],
+               world_size: int,
+               strategy: str = 'basic',
+               input_table_map: Optional[Sequence[int]] = None,
+               column_slice_threshold: Optional[int] = None):
+    if strategy not in ('basic', 'memory_balanced', 'memory_optimized'):
+      raise ValueError(f'Unsupported shard strategy {strategy}')
+    # Single-process case may skip collectives; mirror the reference's
+    # normalisation (dist_model_parallel.py:73).
+    self.strategy = 'basic' if world_size == 1 else strategy
+    self.world_size = world_size
+    self.table_configs = list(table_configs)
+    if input_table_map is None:
+      input_table_map = list(range(len(self.table_configs)))
+    if any(t < 0 or t >= len(self.table_configs) for t in input_table_map):
+      raise ValueError('input_table_map entries must index table_configs')
+    self.input_table_map = list(input_table_map)
+    self.column_slice_threshold = column_slice_threshold
+
+    # --- 1. column slicing (C11) -----------------------------------------
+    threshold = column_slice_threshold
+    if threshold is None:
+      threshold = auto_column_slice_threshold(
+          [c.size for c in self.table_configs], world_size)
+    # slice widths per table, and flattened slice list in table order
+    self.slice_widths: List[List[int]] = [
+        slice_table_column(c, threshold, world_size)
+        for c in self.table_configs
+    ]
+    flat_ids: List[int] = []
+    flat_sizes: List[int] = []
+    for tid, widths in enumerate(self.slice_widths):
+      for w in widths:
+        flat_ids.append(tid)
+        flat_sizes.append(self.table_configs[tid].input_dim * w)
+
+    # Ranges of inputs whose outputs must be re-concatenated because their
+    # table was sliced (reference sliced_out_ranges, :199-205). Updated below
+    # when slices re-merge on one device.
+    self._num_slices_after_merge = [len(w) for w in self.slice_widths]
+
+    # --- 2. placement (C12) ----------------------------------------------
+    placed = apply_strategy(self.strategy, world_size, flat_ids, flat_sizes)
+
+    # --- 3. per-device slice claim + same-device merge (C13) -------------
+    # Slices of one table are claimed left-to-right in device order; merged
+    # slices on one device become a single contiguous column range. This
+    # reproduces the contiguous rank-ordered column layout the reference's
+    # checkpoint math assumes (dist_model_parallel.py:477-492).
+    next_slice_of_table = [0] * len(self.table_configs)
+    col_cursor = [0] * len(self.table_configs)
+    # device -> list of LocalTable (merged)
+    self.local_tables: List[List[LocalTable]] = [[] for _ in range(world_size)]
+    # table -> list of (device, LocalTable) in claim (device) order
+    self.table_shards: List[List[Tuple[int, LocalTable]]] = [
+        [] for _ in self.table_configs
+    ]
+    for dev in range(world_size):
+      merged: Dict[int, LocalTable] = {}
+      for pos in placed[dev]:
+        tid = flat_ids[pos]
+        w = self.slice_widths[tid][next_slice_of_table[tid]]
+        next_slice_of_table[tid] += 1
+        start = col_cursor[tid]
+        col_cursor[tid] += w
+        if tid in merged:
+          # merge with earlier shard on this device (must be contiguous:
+          # guaranteed because claims are processed in device order and a
+          # device's claims are consecutive pops)
+          lt = merged[tid]
+          if lt.col_end != start:
+            raise AssertionError('non-contiguous slice merge')
+          lt.col_end = start + w
+          self._num_slices_after_merge[tid] -= 1
+        else:
+          lt = LocalTable(table_id=tid,
+                          input_dim=self.table_configs[tid].input_dim,
+                          col_start=start,
+                          col_end=start + w)
+          merged[tid] = lt
+          self.local_tables[dev].append(lt)
+          self.table_shards[tid].append((dev, lt))
+    if world_size > 1 and not all(self.local_tables):
+      raise ValueError(
+          'Not enough table after slicing to run on all worker. '
+          'Try decrease column_slice_threshold or decrease worker count')
+
+    # --- 4. fusion groups (C14) ------------------------------------------
+    # Group same-device tables by (width, combiner) (reference
+    # _create_concat, :249-265). Keys are global so the SPMD program sees one
+    # uniform parameter pytree; deterministic key order.
+    group_members: Dict[Tuple[int, Optional[str]], List[List[LocalTable]]] = {}
+    for dev in range(world_size):
+      for lt in self.local_tables[dev]:
+        key = (lt.width, self.table_configs[lt.table_id].combiner)
+        group_members.setdefault(key, [[] for _ in range(world_size)])
+        group_members[key][dev].append(lt)
+
+    # inputs mapped to each table, in input order
+    inputs_of_table: List[List[int]] = [[] for _ in self.table_configs]
+    for inp, tid in enumerate(self.input_table_map):
+      inputs_of_table[tid].append(inp)
+
+    self.groups: List[GroupSpec] = []
+    self.requests: List[Request] = []
+    # (input_id) -> list of Request in device order, for output assembly
+    self.input_requests: List[List[Request]] = [
+        [] for _ in self.input_table_map
+    ]
+    for key in sorted(group_members, key=lambda k: (k[0], str(k[1]))):
+      members = group_members[key]
+      width, combiner = key
+      rows = []
+      reqs: List[List[Request]] = []
+      for dev in range(world_size):
+        row_offset = 0
+        dev_reqs = []
+        for lt in members[dev]:
+          for inp in inputs_of_table[lt.table_id]:
+            dev_reqs.append(
+                Request(input_id=inp,
+                        table_id=lt.table_id,
+                        device=dev,
+                        group_key=key,
+                        slot=len(dev_reqs),
+                        row_offset=row_offset,
+                        col_start=lt.col_start,
+                        col_end=lt.col_end))
+          row_offset += lt.input_dim
+        rows.append(row_offset)
+        reqs.append(dev_reqs)
+      spec = GroupSpec(key=key,
+                       width=width,
+                       combiner=combiner,
+                       rows=rows,
+                       rows_cap=max(8, _round_up(max(rows), 8)),
+                       n_cap=max(len(r) for r in reqs),
+                       requests=reqs,
+                       member_tables=members)
+      self.groups.append(spec)
+      for dev_reqs in reqs:
+        self.requests.extend(dev_reqs)
+        for r in dev_reqs:
+          self.input_requests[r.input_id].append(r)
+
+    # Output slices of each input arrive in device order; their column ranges
+    # must tile [0, output_dim) exactly.
+    for inp, rs in enumerate(self.input_requests):
+      rs.sort(key=lambda r: r.col_start)
+      expect = 0
+      for r in rs:
+        if r.col_start != expect:
+          raise AssertionError(f'input {inp}: non-tiling column slices')
+        expect = r.col_end
+      if expect != self.table_configs[self.input_table_map[inp]].output_dim:
+        raise AssertionError(f'input {inp}: column slices do not cover table')
+
+  # ---- parity / introspection views (reference attribute contracts) -----
+
+  @property
+  def table_ids(self) -> List[List[int]]:
+    """Per-device table ids in local order (reference ``strategy.table_ids``,
+    dist_model_parallel.py:97-103)."""
+    return [[lt.table_id for lt in dev] for dev in self.local_tables]
+
+  @property
+  def input_ids_list(self) -> List[List[int]]:
+    """Per-device input ids in local-table order (reference
+    ``strategy.input_ids_list``, dist_model_parallel.py:106-111)."""
+    result = []
+    for dev in range(self.world_size):
+      ids = []
+      for lt in self.local_tables[dev]:
+        for inp, tid in enumerate(self.input_table_map):
+          if tid == lt.table_id:
+            ids.append(inp)
+      result.append(ids)
+    return result
+
+  @property
+  def sliced_out_ranges(self) -> List[List[int]]:
+    """[output_pos, num_remaining_slices] per sliced input (reference
+    ``strategy.sliced_out_ranges``, dist_model_parallel.py:199-205,299-301)."""
+    ranges = []
+    for inp, tid in enumerate(self.input_table_map):
+      n = self._num_slices_after_merge[tid]
+      if n > 1:
+        ranges.append([inp, inp + n])
+    return ranges
+
+  @property
+  def widths_list_flat(self) -> List[int]:
+    """All output widths before slice re-merge, in device order (reference
+    ``strategy.widths_list_flat``, dist_model_parallel.py:127-129)."""
+    widths = []
+    for dev in range(self.world_size):
+      for lt in self.local_tables[dev]:
+        for inp, tid in enumerate(self.input_table_map):
+          if tid == lt.table_id:
+            widths.append(lt.width)
+    return widths
+
+  @property
+  def rev_global_input_ids(self) -> List[int]:
+    """Permutation restoring device-ordered outputs to input order (reference
+    ``strategy.rev_global_input_ids``, dist_model_parallel.py:132-136)."""
+    worker_order = [i for dev in self.input_ids_list for i in dev]
+    return [idx for _, idx in sorted(zip(worker_order, range(len(worker_order))))]
+
+  def device_memory_elements(self) -> List[int]:
+    """Total fused-table elements per device (before rows_cap padding)."""
+    out = [0] * self.world_size
+    for g in self.groups:
+      for dev in range(self.world_size):
+        out[dev] += g.rows[dev] * g.width
+    return out
+
+  def padded_memory_elements(self) -> int:
+    """Per-device elements after padding (what actually gets allocated)."""
+    return sum(g.rows_cap * g.width for g in self.groups)
+
+  def describe(self) -> str:
+    """Human-readable plan summary."""
+    lines = [
+        f'ShardingPlan: {len(self.table_configs)} tables, '
+        f'{len(self.input_table_map)} inputs, world_size={self.world_size}, '
+        f'strategy={self.strategy}'
+    ]
+    for g in self.groups:
+      lines.append(
+          f'  group {g.key}: rows={g.rows} rows_cap={g.rows_cap} '
+          f'n_cap={g.n_cap} requests/dev={[len(r) for r in g.requests]}')
+    mem = self.device_memory_elements()
+    lines.append(f'  elements/device: min={min(mem)} max={max(mem)} '
+                 f'padded={self.padded_memory_elements()}')
+    return '\n'.join(lines)

@@ -1,0 +1,1 @@
+"""Utilities: datasets, LR schedules, metrics."""

@@ -1,0 +1,23 @@
+"""Test configuration: fake an 8-device CPU mesh before JAX initialises.
+
+The reference's distributed tests need real `horovodrun -np N` processes
+(`/root/reference/tests/dist_model_parallel_test.py`); JAX lets us fake an
+N-device mesh in-process on CPU instead, which covers the same collective
+choreography single-machine (SURVEY.md §4).
+"""
+
+import os
+
+# Must be set before the first JAX backend initialisation.
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+  os.environ['XLA_FLAGS'] = (_flags +
+                             ' --xla_force_host_platform_device_count=8')
+os.environ['JAX_ENABLE_X64'] = '0'
+
+import jax  # noqa: E402
+
+# The session environment may pin JAX_PLATFORMS at a remote TPU tunnel whose
+# plugin re-asserts itself over the env var; the config knob wins.  Tests run
+# on the fake 8-device CPU mesh regardless of attached hardware.
+jax.config.update('jax_platforms', 'cpu')

@@ -1,0 +1,173 @@
+"""Tests for the Embedding layer (SURVEY.md C9, C10).
+
+Ported test strategy from the reference layer tests
+(`/root/reference/distributed_embeddings/python/layers/embedding_test.py`):
+hand-computed expectations for dense N-D x combiner cases, oracle comparison
+for ragged/sparse, gradient + one-optimizer-step equivalence, and a
+ConcatOneHotEmbedding smoke test.
+"""
+
+import numpy as np
+import optax
+import pytest
+import jax
+import jax.numpy as jnp
+
+from distributed_embeddings_tpu.layers import Embedding, ConcatOneHotEmbedding
+from distributed_embeddings_tpu.ops.ragged import RaggedBatch, SparseIds
+
+
+def identity_like_table(vocab, width):
+  """Table whose row i is [i, i+0.5, ...] so expectations are hand-computable."""
+  base = np.arange(vocab, dtype=np.float32)[:, None]
+  frac = np.arange(width, dtype=np.float32)[None, :] / (2 * width)
+  return jnp.asarray(base + frac)
+
+
+class TestDenseShapes:
+
+  @pytest.mark.parametrize('combiner,shape,expected', [
+      (None, (5,), (5, 4)),
+      (None, (5, 3), (5, 3, 4)),
+      (None, (5, 3, 2), (5, 3, 2, 4)),
+      ('sum', (5, 3), (5, 4)),
+      ('mean', (5, 3, 2), (5, 3, 4)),
+  ])
+  def test_output_shapes(self, combiner, shape, expected):
+    layer = Embedding(input_dim=10, output_dim=4, combiner=combiner)
+    params = layer.init(jax.random.key(0))
+    out = layer.apply(params, jnp.zeros(shape, jnp.int32))
+    assert out.shape == expected
+
+  def test_hand_computed_sum(self):
+    layer = Embedding(input_dim=6, output_dim=2, combiner='sum')
+    params = identity_like_table(6, 2)
+    out = layer.apply(params, jnp.array([[1, 2], [3, 3]]))
+    np.testing.assert_allclose(out, [[3.0, 3.5], [6.0, 6.5]], rtol=1e-6)
+
+  def test_hand_computed_mean(self):
+    layer = Embedding(input_dim=6, output_dim=2, combiner='mean')
+    params = identity_like_table(6, 2)
+    out = layer.apply(params, jnp.array([[1, 3]]))
+    np.testing.assert_allclose(out, [[2.0, 2.25]], rtol=1e-6)
+
+  def test_1d_with_combiner_raises(self):
+    layer = Embedding(input_dim=10, output_dim=4, combiner='sum')
+    params = layer.init(jax.random.key(0))
+    with pytest.raises(ValueError):
+      layer.apply(params, jnp.array([1, 2, 3]))
+
+  def test_invalid_dims_raise(self):
+    with pytest.raises(ValueError):
+      Embedding(input_dim=0, output_dim=4)
+    with pytest.raises(ValueError):
+      Embedding(input_dim=4, output_dim=-1)
+
+
+class TestRaggedSparse:
+
+  @pytest.mark.parametrize('combiner', ['sum', 'mean'])
+  def test_ragged_vs_dense_oracle(self, combiner):
+    rng = np.random.default_rng(3)
+    vocab, width = 40, 8
+    layer = Embedding(input_dim=vocab, output_dim=width, combiner=combiner)
+    params = layer.init(jax.random.key(1))
+    rows = [list(rng.integers(0, vocab, size=rng.integers(1, 6)))
+            for _ in range(10)]
+    out = layer.apply(params, RaggedBatch.from_lists(rows, nnz_cap=64))
+    p = np.asarray(params)
+    expected = np.stack([
+        p[r].sum(0) if combiner == 'sum' else p[r].mean(0) for r in rows
+    ])
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+
+  def test_sparse_input(self):
+    layer = Embedding(input_dim=10, output_dim=2, combiner='sum')
+    params = identity_like_table(10, 2)
+    sparse = SparseIds.from_lists([[1, 2], [5]], nnz_cap=8)
+    out = layer.apply(params, sparse)
+    np.testing.assert_allclose(out, [[3.0, 3.5], [5.0, 5.25]], rtol=1e-6)
+
+
+class TestGradientAndUpdate:
+
+  def test_one_adagrad_step_matches_oracle(self):
+    """Gradient + optimizer-update equivalence (reference
+    embedding_test.py:133-181 uses Adagrad the same way)."""
+    vocab, width = 20, 4
+    layer = Embedding(input_dim=vocab, output_dim=width, combiner='sum')
+    params = layer.init(jax.random.key(2))
+    rows = [[1, 2, 3], [2, 4]]
+    ragged = RaggedBatch.from_lists(rows, nnz_cap=16)
+    targets = jnp.ones((2, width))
+
+    def loss_ragged(p):
+      return jnp.mean((layer.apply(p, ragged) - targets)**2)
+
+    def loss_oracle(p):
+      out = jnp.stack([p[jnp.array(r)].sum(0) for r in rows])
+      return jnp.mean((out - targets)**2)
+
+    opt = optax.adagrad(0.1)
+
+    def step(loss_fn, p):
+      g = jax.grad(loss_fn)(p)
+      state = opt.init(p)
+      updates, _ = opt.update(g, state, p)
+      return optax.apply_updates(p, updates)
+
+    np.testing.assert_allclose(step(loss_ragged, params),
+                               step(loss_oracle, params),
+                               rtol=1e-5, atol=1e-6)
+
+
+class TestConfigRoundTrip:
+
+  def test_from_config_accepts_keras_style_config(self):
+    config = {
+        'input_dim': 12,
+        'output_dim': 3,
+        'combiner': 'mean',
+        'name': 'table0',
+        'mask_zero': False,       # stock-keras keys are tolerated
+        'input_length': None,
+    }
+    layer = Embedding.from_config(config)
+    assert (layer.input_dim, layer.output_dim, layer.combiner) == (12, 3,
+                                                                   'mean')
+
+  def test_round_trip(self):
+    layer = Embedding(input_dim=5, output_dim=7, combiner='sum',
+                      name='t')
+    clone = Embedding.from_config(layer.get_config())
+    assert clone == layer
+
+
+class TestConcatOneHot:
+
+  def test_lookup_with_offsets(self):
+    """Reference ConcatOneHotEmbedding smoke test
+    (embedding_test.py in-package :184-191)."""
+    layer = ConcatOneHotEmbedding(feature_sizes=[3, 4, 5], embedding_width=2)
+    params = identity_like_table(12, 2)
+    # table offsets: 0, 3, 7
+    out = layer.apply(params, jnp.array([[1, 2, 0], [0, 0, 4]]))
+    np.testing.assert_allclose(
+        out,
+        [[[1.0, 1.25], [5.0, 5.25], [7.0, 7.25]],
+         [[0.0, 0.25], [3.0, 3.25], [11.0, 11.25]]], rtol=1e-6)
+
+  def test_bad_shape_raises(self):
+    layer = ConcatOneHotEmbedding(feature_sizes=[3, 4], embedding_width=2)
+    params = layer.init(jax.random.key(0))
+    with pytest.raises(ValueError):
+      layer.apply(params, jnp.zeros((2, 3), jnp.int32))
+
+
+class TestPaddedDense:
+
+  def test_to_padded_dense_preserves_first_row(self):
+    # regression: padding scatter must not clobber out[0, 0]
+    ragged = RaggedBatch.from_lists([[7, 8], [9]], nnz_cap=6)
+    dense = ragged.to_padded_dense(hot_cap=2)
+    np.testing.assert_array_equal(dense, [[7, 8], [9, -1]])

@@ -1,0 +1,231 @@
+"""Unit tests for the sharding planner (SURVEY.md C11-C15).
+
+The reference only exercises its planner indirectly through multi-process
+integration tests (`tests/dist_model_parallel_test.py`); here the planner is
+pure Python and device-free, so its semantics are tested directly.
+"""
+
+import pytest
+
+from distributed_embeddings_tpu.parallel.planner import (
+    TableConfig, ShardingPlan, slice_table_column, auto_column_slice_threshold,
+    apply_strategy)
+
+
+def make_configs(sizes, width=4, combiner=None):
+  return [TableConfig(input_dim=s, output_dim=width, combiner=combiner)
+          for s in sizes]
+
+
+class TestSliceTableColumn:
+
+  def test_no_slice_below_threshold(self):
+    c = TableConfig(input_dim=10, output_dim=8)
+    assert slice_table_column(c, 1000, 8) == [8]
+
+  def test_power_of_two_slices(self):
+    # size 80 with threshold 25 -> need 4 slices (80/2=40>25, 80/4=20<=25)
+    c = TableConfig(input_dim=10, output_dim=8)
+    assert slice_table_column(c, 25, 8) == [2, 2, 2, 2]
+
+  def test_capped_by_world_size(self):
+    c = TableConfig(input_dim=1000, output_dim=8)
+    # would want many slices, capped at world=2
+    assert slice_table_column(c, 10, 2) == [4, 4]
+
+  def test_capped_by_output_dim(self):
+    c = TableConfig(input_dim=1000, output_dim=3)
+    assert slice_table_column(c, 10, 16) == [1, 1, 1]
+
+  def test_remainder_spread_to_first_slices(self):
+    c = TableConfig(input_dim=100, output_dim=7)
+    widths = slice_table_column(c, 200, 4)
+    assert widths == [2, 2, 2, 1]
+    assert sum(widths) == 7
+
+  def test_none_threshold_means_no_slice(self):
+    c = TableConfig(input_dim=1 << 20, output_dim=512)
+    assert slice_table_column(c, None, 64) == [512]
+
+
+class TestAutoThreshold:
+
+  def test_enough_tables_no_threshold(self):
+    assert auto_column_slice_threshold([100, 100], 2) is None
+
+  def test_fewer_tables_than_workers(self):
+    # 1 table of 64 elements over 4 workers: halve until >= 4 virtual tables
+    thr = auto_column_slice_threshold([64], 4)
+    assert thr is not None
+    # 64 -> [32,32] -> [16,16,32]: threshold ends at 32-1
+    assert thr == 31
+
+  def test_threshold_slices_reach_all_workers(self):
+    sizes = [1024]
+    world = 8
+    thr = auto_column_slice_threshold(sizes, world)
+    c = TableConfig(input_dim=32, output_dim=32)  # 1024 elements
+    widths = slice_table_column(c, thr, world)
+    assert len(widths) >= world
+
+
+class TestApplyStrategy:
+
+  def test_basic_round_robin(self):
+    ids = [0, 1, 2, 3, 4]
+    out = apply_strategy('basic', 2, ids, [10] * 5)
+    assert out == [[0, 2, 4], [1, 3]]
+
+  def test_memory_balanced_pairs_large_with_small(self):
+    sizes = [1, 2, 3, 4, 5, 6, 7, 8]
+    ids = list(range(8))
+    out = apply_strategy('memory_balanced', 2, ids, sizes)
+    loads = [sum(sizes[p] for p in dev) for dev in out]
+    # snake pairing gives perfectly balanced 18/18 here
+    assert loads == [18, 18]
+
+  def test_memory_optimized_greedy_balance(self):
+    sizes = [10, 1, 1, 1, 1, 10]
+    ids = list(range(6))
+    out = apply_strategy('memory_optimized', 2, ids, sizes)
+    loads = sorted(sum(sizes[p] for p in dev) for dev in out)
+    assert loads == [12, 12]
+
+  def test_all_positions_assigned_once(self):
+    for mode in ('basic', 'memory_balanced', 'memory_optimized'):
+      out = apply_strategy(mode, 3, list(range(7)), [5, 3, 8, 1, 9, 2, 7])
+      flat = sorted(p for dev in out for p in dev)
+      assert flat == list(range(7)), mode
+
+  def test_unknown_strategy_raises(self):
+    with pytest.raises(ValueError):
+      apply_strategy('bogus', 2, [0], [1])
+
+
+class TestShardingPlan:
+
+  def test_basic_placement_covers_all_tables(self):
+    plan = ShardingPlan(make_configs([10, 20, 30, 40]), world_size=2)
+    all_ids = sorted(t for dev in plan.table_ids for t in dev)
+    assert all_ids == [0, 1, 2, 3]
+
+  def test_single_device_plan(self):
+    plan = ShardingPlan(make_configs([10, 20]), world_size=1)
+    assert plan.table_ids == [[0, 1]]
+    assert plan.rev_global_input_ids == [0, 1]
+
+  def test_column_slice_threshold_splits_table(self):
+    # table 1 has 160 elements; threshold 50 -> 4 slices over 4 devices
+    configs = make_configs([10, 40, 10, 10], width=4)
+    plan = ShardingPlan(configs, world_size=4, column_slice_threshold=50)
+    shards = plan.table_shards[1]
+    assert len(shards) == 4
+    # contiguous, tiling column ranges
+    cols = sorted((lt.col_start, lt.col_end) for _, lt in shards)
+    assert cols == [(0, 1), (1, 2), (2, 3), (3, 4)]
+
+  def test_slice_merge_on_same_device(self):
+    # 1 big table, world 2, slicing into 4 -> each device merges 2 slices
+    configs = make_configs([100], width=8)
+    plan = ShardingPlan(configs, world_size=2, column_slice_threshold=250)
+    for dev in range(2):
+      assert len(plan.local_tables[dev]) == 1
+      assert plan.local_tables[dev][0].width == 4
+    # merged back to 2 remaining slices -> one sliced_out_range of len 2
+    assert plan.sliced_out_ranges == [[0, 2]]
+
+  def test_auto_slice_fewer_tables_than_workers(self):
+    configs = make_configs([64], width=64)
+    plan = ShardingPlan(configs, world_size=4)
+    # every worker must receive at least one slice
+    assert all(plan.local_tables[d] for d in range(4))
+
+  def test_fusion_groups_same_width_combiner(self):
+    # 8 tables width 2 on 1 device: all fuse into one group (reference
+    # test_8table_width2_auto_concat, dist_model_parallel_test.py:326-337)
+    configs = make_configs([8, 9, 10, 11, 12, 13, 14, 15], width=2,
+                           combiner='sum')
+    plan = ShardingPlan(configs, world_size=1)
+    assert len(plan.groups) == 1
+    g = plan.groups[0]
+    assert g.rows == [8 + 9 + 10 + 11 + 12 + 13 + 14 + 15]
+    # row offsets are cumulative input_dims
+    offsets = [r.row_offset for r in g.requests[0]]
+    assert offsets == [0, 8, 17, 27, 38, 50, 63, 77]
+
+  def test_no_fusion_across_combiner(self):
+    configs = (make_configs([8, 8], width=2, combiner='sum') +
+               make_configs([8, 8], width=2, combiner='mean'))
+    plan = ShardingPlan(configs, world_size=1)
+    assert len(plan.groups) == 2
+
+  def test_shared_table_input_map(self):
+    # two inputs share table 0 (reference input_table_map tests)
+    configs = make_configs([10, 20], width=4)
+    plan = ShardingPlan(configs, world_size=2, input_table_map=[0, 0, 1])
+    assert len(plan.input_requests) == 3
+    # inputs 0 and 1 hit the same table shard
+    r0, r1 = plan.input_requests[0][0], plan.input_requests[1][0]
+    assert (r0.device, r0.table_id, r0.row_offset) == \
+           (r1.device, r1.table_id, r1.row_offset)
+
+  def test_rev_global_input_ids_is_inverse_permutation(self):
+    configs = make_configs([10, 20, 30, 40, 50], width=4)
+    plan = ShardingPlan(configs, world_size=2, strategy='memory_balanced')
+    worker_order = [i for dev in plan.input_ids_list for i in dev]
+    rev = plan.rev_global_input_ids
+    restored = [worker_order[r] for r in rev]
+    assert restored == list(range(5))
+
+  def test_memory_balanced_loads(self):
+    sizes = [100, 90, 80, 70, 10, 20, 30, 40]
+    plan = ShardingPlan(make_configs(sizes), world_size=4,
+                        strategy='memory_balanced')
+    loads = plan.device_memory_elements()
+    assert max(loads) - min(loads) <= 4 * 30  # elements (width 4)
+    counts = [len(t) for t in plan.table_ids]
+    assert all(c == 2 for c in counts)
+
+  def test_memory_optimized_loads(self):
+    sizes = [100, 1, 1, 1, 1, 96]
+    plan = ShardingPlan(make_configs(sizes), world_size=2,
+                        strategy='memory_optimized')
+    loads = sorted(plan.device_memory_elements())
+    assert loads == [4 * 100, 4 * 100]
+
+  def test_world_size_normalizes_strategy(self):
+    plan = ShardingPlan(make_configs([10]), world_size=1,
+                        strategy='memory_balanced')
+    assert plan.strategy == 'basic'
+
+  def test_too_many_workers_raises(self):
+    # 1 table, width 1: cannot slice to 4 workers
+    configs = [TableConfig(input_dim=100, output_dim=1)]
+    with pytest.raises(ValueError):
+      ShardingPlan(configs, world_size=4)
+
+  def test_invalid_strategy_raises(self):
+    with pytest.raises(ValueError):
+      ShardingPlan(make_configs([10]), 2, strategy='nope')
+
+  def test_invalid_input_table_map_raises(self):
+    with pytest.raises(ValueError):
+      ShardingPlan(make_configs([10]), 1, input_table_map=[1])
+
+  def test_groups_uniform_across_devices(self):
+    # SPMD contract: every group exists on every device with identical caps
+    configs = make_configs([64, 32, 16, 8], width=8, combiner='sum') + \
+              make_configs([64, 32], width=16, combiner='mean')
+    plan = ShardingPlan(configs, world_size=4, strategy='memory_optimized')
+    for g in plan.groups:
+      assert len(g.rows) == 4
+      assert len(g.requests) == 4
+      assert g.rows_cap >= max(g.rows)
+      assert g.rows_cap % 8 == 0
+      assert g.n_cap == max(len(r) for r in g.requests)
+
+  def test_widths_list_flat_matches_requests(self):
+    configs = make_configs([30, 20, 10], width=4)
+    plan = ShardingPlan(configs, world_size=2)
+    assert len(plan.widths_list_flat) == 3
+    assert all(w == 4 for w in plan.widths_list_flat)
