@@ -10,3 +10,14 @@ from distributed_embeddings_tpu.parallel.planner import (
     auto_column_slice_threshold,
     apply_strategy,
 )
+from distributed_embeddings_tpu.parallel.dist_embedding import DistributedEmbedding
+from distributed_embeddings_tpu.parallel.checkpoint import (get_weights,
+                                                            set_weights,
+                                                            save_npz,
+                                                            load_npz)
+from distributed_embeddings_tpu.parallel.grad import (broadcast_variables,
+                                                      DistributedGradientTape,
+                                                      TrainState,
+                                                      make_train_step,
+                                                      init_train_state)
+from distributed_embeddings_tpu.parallel.mesh import create_mesh

@@ -1,0 +1,473 @@
+"""DistributedEmbedding: hybrid-parallel embedding over a TPU mesh.
+
+TPU-native re-design of the reference runtime wrapper
+(`/root/reference/distributed_embeddings/python/layers/dist_model_parallel.py:308-674`,
+class ``DistributedEmbedding``).  Same job — model-parallel tables behind a
+data-parallel interface, with the two all-to-alls gluing them together — but
+restructured for XLA SPMD instead of Horovod MPMD:
+
+- The reference runs *different Python* per rank (each rank owns different
+  Keras layers) and moves data with ``hvd.alltoall`` carrying *variable*
+  splits (dist_model_parallel.py:395-440).  Under `jax.shard_map` one traced
+  program runs on every device, so per-device structure is data: lookups are
+  routed through capacity-padded canonical buffers
+  ``[num_devices, n_cap, local_batch, hot_cap]`` with a ``-1`` sentinel in
+  padding, and `jax.lax.all_to_all` does the dp<->mp redistribution with
+  *equal* splits.
+- The backward all-to-all the reference gets from Horovod's registered
+  gradient (SURVEY.md §2.4) falls out of JAX autodiff: the transpose of
+  ``all_to_all`` is ``all_to_all``.
+- Embedding parameters are stacked per fusion group as
+  ``[num_devices, rows_cap, width]`` arrays sharded over the mesh axis, so
+  a parameter pytree stays an ordinary pytree under `jit`/`grad`/optax.
+
+Variable hotness in the distributed path is expressed as dense ids padded
+with ``-1`` (see `ops/ragged.py:RaggedBatch.to_padded_dense`), keeping every
+shape static (SURVEY.md §7 "Hard parts" 1-2).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_embeddings_tpu.ops.ragged import RaggedBatch
+from distributed_embeddings_tpu.parallel import mesh as mesh_lib
+from distributed_embeddings_tpu.parallel.planner import (GroupSpec,
+                                                         ShardingPlan,
+                                                         TableConfig)
+from distributed_embeddings_tpu.utils.initializers import get_initializer
+
+_SENTINEL = -1
+
+
+def _as_table_configs(embeddings) -> List[TableConfig]:
+  # function-level import: layers.embedding imports the planner, so a
+  # module-level import here would be circular
+  from distributed_embeddings_tpu.layers.embedding import Embedding
+  configs = []
+  for e in embeddings:
+    if isinstance(e, TableConfig):
+      configs.append(e)
+    elif isinstance(e, Embedding):
+      configs.append(e.table_config())
+    else:
+      raise TypeError(
+          f'embeddings must be Embedding layers or TableConfigs, got {type(e)}')
+  return configs
+
+
+class DistributedEmbedding:
+  """Distributed embedding wrapper (API parity with reference
+  ``DistributedEmbedding``, dist_model_parallel.py:308-340).
+
+  Args:
+    embeddings: list of ``Embedding`` layers or ``TableConfig``s to
+      distribute.
+    strategy: 'basic' | 'memory_balanced' | 'memory_optimized'.
+    column_slice_threshold: slice tables with more elements than this along
+      the width dimension; ``None`` slices only when there are fewer tables
+      than devices (reference docstring, dist_model_parallel.py:319-323).
+    row_slice: not implemented (parity: reference raises too,
+      dist_model_parallel.py:345-346).
+    dp_input: if True inputs are data-parallel ``[global_batch(, hot)]``
+      arrays sharded over the mesh; otherwise model-parallel canonical
+      inputs (see ``apply``).
+    input_table_map: ``input[i]`` uses ``table[input_table_map[i]]``.
+    mesh: `jax.sharding.Mesh` with ``axis_name``; defaults to a 1-D mesh
+      over all devices.
+    axis_name: mesh axis tables are distributed over.
+    param_dtype: table storage dtype (bfloat16 halves HBM; accumulation is
+      always fp32).
+    compute_dtype: dtype of returned activations (default ``param_dtype``).
+  """
+
+  def __init__(self,
+               embeddings: Sequence[Union[Embedding, TableConfig]],
+               strategy: str = 'basic',
+               column_slice_threshold: Optional[int] = None,
+               row_slice=None,
+               dp_input: bool = True,
+               input_table_map: Optional[Sequence[int]] = None,
+               mesh: Optional[Mesh] = None,
+               axis_name: str = mesh_lib.DEFAULT_AXIS,
+               param_dtype: Any = jnp.float32,
+               compute_dtype: Any = None):
+    if row_slice is not None:
+      raise NotImplementedError('Row slicing embedding is not supported yet!')
+    self.mesh = mesh if mesh is not None else mesh_lib.create_mesh(
+        axis_name=axis_name)
+    self.axis_name = axis_name
+    if axis_name not in self.mesh.shape:
+      raise ValueError(f'mesh has no axis {axis_name!r}')
+    self.world_size = self.mesh.shape[axis_name]
+    self.dp_input = dp_input
+    self.param_dtype = jnp.dtype(param_dtype)
+    self.compute_dtype = jnp.dtype(compute_dtype or param_dtype)
+
+    self.table_configs = _as_table_configs(embeddings)
+    self.plan = ShardingPlan(self.table_configs,
+                             world_size=self.world_size,
+                             strategy=strategy,
+                             input_table_map=input_table_map,
+                             column_slice_threshold=column_slice_threshold)
+    self.num_inputs = len(self.plan.input_table_map)
+
+    # Static per-group routing tables, carried as sharded *data* (the SPMD
+    # replacement for the reference's per-rank Python structure).
+    self._group_offsets: List[jax.Array] = []   # [D, n_cap] fused row offsets
+    self._group_vocabs: List[jax.Array] = []    # [D, n_cap] per-slot vocab
+    for g in self.plan.groups:
+      offs = np.zeros((self.world_size, g.n_cap), np.int32)
+      vocab = np.ones((self.world_size, g.n_cap), np.int32)
+      for dev, reqs in enumerate(g.requests):
+        for r in reqs:
+          offs[dev, r.slot] = r.row_offset
+          vocab[dev, r.slot] = self.table_configs[r.table_id].input_dim
+      spec = NamedSharding(self.mesh, P(self.axis_name, None))
+      self._group_offsets.append(jax.device_put(jnp.asarray(offs), spec))
+      self._group_vocabs.append(jax.device_put(jnp.asarray(vocab), spec))
+
+  # ------------------------------------------------------------------ init
+
+  def init(self, rng: Union[int, jax.Array]) -> Dict[str, jax.Array]:
+    """Create sharded fused tables ``{group_i: [D, rows_cap, width]}``.
+
+    Each member table slice is initialised with its own initializer at its
+    sliced shape, preserving the per-table init distribution the reference
+    keeps through ``ConcatInitializer`` (dist_model_parallel.py:26-37,
+    276-283).  Shards are materialised per device via
+    ``jax.make_array_from_callback`` (host CPU), so no device ever holds
+    another device's tables — the analog of the reference's CPU-forced init
+    (embedding.py:28-38).
+    """
+    if isinstance(rng, int):
+      rng = jax.random.key(rng)
+    host_cpu = jax.local_devices(backend='cpu')[0]
+    rng = jax.device_put(rng, host_cpu)
+
+    params = {}
+    for gi, g in enumerate(self.plan.groups):
+      shape = (self.world_size, g.rows_cap, g.width)
+      sharding = NamedSharding(self.mesh, P(self.axis_name, None, None))
+
+      def make_shard(index, g=g):
+        dev = index[0].start if index[0].start is not None else 0
+        with jax.default_device(host_cpu):
+          chunks = []
+          for lt in g.member_tables[dev]:
+            cfg = self.table_configs[lt.table_id]
+            init = get_initializer(cfg.initializer)
+            key = jax.random.fold_in(
+                jax.random.fold_in(rng, lt.table_id), lt.col_start)
+            chunks.append(
+                np.asarray(init(key, (lt.input_dim, lt.width),
+                                self.param_dtype)))
+          pad_rows = g.rows_cap - g.rows[dev]
+          if pad_rows or not chunks:
+            chunks.append(np.zeros((pad_rows, g.width), self.param_dtype))
+          return np.concatenate(chunks, axis=0)[None]
+
+      params[f'group_{gi}'] = jax.make_array_from_callback(
+          shape, sharding, make_shard)
+    return params
+
+  # --------------------------------------------------------------- forward
+
+  def _input_hotness(self, inputs) -> List[int]:
+    hot = []
+    for i, x in enumerate(inputs):
+      if x.ndim == 1:
+        hot.append(1)
+      elif x.ndim == 2:
+        hot.append(x.shape[1])
+      else:
+        raise ValueError(f'input {i}: expected 1D or 2D ids, got {x.shape}')
+    return hot
+
+  def _check_combiner_hotness(self, hotness: List[int]):
+    for i, (tid, h) in enumerate(zip(self.plan.input_table_map, hotness)):
+      if self.table_configs[tid].combiner is None and h != 1:
+        raise ValueError(
+            f'input {i}: combiner=None supports only hotness 1 in the '
+            f'distributed path, got hotness {h}')
+
+  def apply(self, params: Dict[str, jax.Array], inputs) -> List[jax.Array]:
+    """Forward pass (reference ``_call_base`` + ``call``,
+    dist_model_parallel.py:382-450,670-674).
+
+    Args:
+      params: pytree from ``init`` (or the same structure under an optimizer).
+      inputs: with ``dp_input=True`` a list of ``num_inputs`` int arrays
+        ``[global_batch]`` or ``[global_batch, hot]``; variable hotness is
+        expressed by ``-1`` padding, or pass ``RaggedBatch`` (densified at
+        trace time).  With ``dp_input=False`` a list in *worker order* (the
+        flattened ``plan.input_ids_list``) of ``[global_batch(, hot)]``
+        arrays holding model-parallel inputs at global batch size.
+
+    Returns:
+      List of ``[global_batch, output_dim]`` arrays in input order, batch-
+      sharded over the mesh.
+    """
+    inputs = list(inputs)
+    if self.dp_input:
+      if len(inputs) != self.num_inputs:
+        raise ValueError(
+            f'Expect {self.num_inputs} inputs, got {len(inputs)}.')
+      inputs = [
+          x.to_padded_dense(self._ragged_cap(x)) if isinstance(
+              x, RaggedBatch) else jnp.asarray(x) for x in inputs
+      ]
+      batch = inputs[0].shape[0]
+      if any(x.shape[0] != batch for x in inputs):
+        raise ValueError('All input need to have same batchsize. got ' +
+                         str({x.shape[0] for x in inputs}))
+      if batch % self.world_size:
+        raise ValueError(
+            f'Global batchsize {batch} not divisible workers count '
+            f'{self.world_size}.')
+      hotness = self._input_hotness(inputs)
+      self._check_combiner_hotness(hotness)
+      fwd = self._build_dp_forward(batch, tuple(hotness))
+      return list(fwd(params, self._group_offsets, self._group_vocabs,
+                      *inputs))
+
+    # model-parallel input path
+    flat_ids = [i for dev in self.plan.input_ids_list for i in dev]
+    if len(inputs) != len(flat_ids):
+      raise ValueError(
+          f'Expect {len(flat_ids)} worker-order inputs, got {len(inputs)}.')
+    inputs = [jnp.asarray(x) for x in inputs]
+    batch = inputs[0].shape[0]
+    if batch % self.world_size:
+      raise ValueError(
+          f'Global batchsize {batch} not divisible workers count '
+          f'{self.world_size}.')
+    hot_by_input = {}
+    for wid, inp in zip(flat_ids, inputs):
+      h = 1 if inp.ndim == 1 else inp.shape[1]
+      hot_by_input.setdefault(wid, h)
+    hotness = [hot_by_input.get(i, 1) for i in range(self.num_inputs)]
+    self._check_combiner_hotness(hotness)
+    fwd = self._build_mp_forward(batch, tuple(hotness))
+    return list(fwd(params, self._group_offsets, self._group_vocabs,
+                    *inputs))
+
+  __call__ = apply
+
+  def _ragged_cap(self, ragged: RaggedBatch) -> int:
+    # densification capacity: average capacity per row, at least 1
+    return max(1, -(-ragged.nnz_cap // ragged.nrows))
+
+  def _group_hot_cap(self, g: GroupSpec, hotness) -> int:
+    hots = [
+        hotness[r.input_id] for reqs in g.requests for r in reqs
+    ]
+    return max(hots) if hots else 1
+
+  @functools.lru_cache(maxsize=32)
+  def _build_dp_forward(self, global_batch: int, hotness: tuple):
+    """Trace-and-cache the shard_map'd dp-input forward for one signature."""
+    D = self.world_size
+    local_batch = global_batch // D
+    groups = self.plan.groups
+    hot_caps = [self._group_hot_cap(g, hotness) for g in groups]
+    group_index = {g.key: gi for gi, g in enumerate(groups)}
+
+    def local_fn(params, offsets, vocabs, *inputs):
+      # inputs: per-input local ids [B(, h)]; params[f'group_i']:
+      # [1, rows_cap, w]; offsets/vocabs: [1, n_cap] each.
+      group_recv = []
+      for gi, g in enumerate(groups):
+        h_cap = hot_caps[gi]
+        # --- build canonical send buffer [D, n_cap, B, h_cap] ------------
+        slots = []
+        for dev in range(D):
+          reqs = g.requests[dev]
+          for slot in range(g.n_cap):
+            if slot < len(reqs):
+              x = inputs[reqs[slot].input_id]
+              if x.ndim == 1:
+                x = x[:, None]
+              if x.shape[1] < h_cap:
+                x = jnp.pad(x, ((0, 0), (0, h_cap - x.shape[1])),
+                            constant_values=_SENTINEL)
+              slots.append(x.astype(jnp.int32))
+            else:
+              slots.append(
+                  jnp.full((local_batch, h_cap), _SENTINEL, jnp.int32))
+        send = jnp.stack(slots).reshape(D, g.n_cap, local_batch, h_cap)
+        # --- dp -> mp all_to_all (reference hvd.alltoall 'inp_dp_to_mp',
+        # dist_model_parallel.py:404) --------------------------------------
+        if D > 1:
+          recv = jax.lax.all_to_all(send, self.axis_name, 0, 0)
+        else:
+          recv = send
+        # [n_cap, D*B, h_cap], global batch in source-major order (the
+        # reference's [world_size * local] reshape, :405-410)
+        ids = recv.transpose(1, 0, 2, 3).reshape(g.n_cap, global_batch,
+                                                 h_cap)
+        group_recv.append(ids)
+
+      group_back = []
+      for gi, g in enumerate(groups):
+        ids = group_recv[gi]
+        table = params[f'group_{gi}'][0]
+        offs = offsets[gi][0]
+        vocab = vocabs[gi][0]
+        out = _fused_lookup(table, ids, offs, vocab, g.combiner,
+                            self.compute_dtype)
+        # --- mp -> dp all_to_all (reference 'out_mp_to_dp', :434) ---------
+        back = out.reshape(g.n_cap, D, local_batch, g.width).transpose(
+            1, 0, 2, 3)
+        if D > 1:
+          back = jax.lax.all_to_all(back, self.axis_name, 0, 0)
+        group_back.append(back)
+
+      # --- assemble outputs in input order (reference reorder + column
+      # slice re-concat, :443,446-450) ------------------------------------
+      outs = []
+      for reqs in self.plan.input_requests:
+        pieces = [
+            group_back[group_index[r.group_key]][r.device, r.slot]
+            for r in reqs
+        ]
+        outs.append(pieces[0] if len(pieces) == 1 else jnp.concatenate(
+            pieces, axis=-1))
+      return tuple(outs)
+
+    in_specs = (
+        {f'group_{gi}': P(self.axis_name, None, None)
+         for gi in range(len(groups))},
+        [P(self.axis_name, None)] * len(groups),
+        [P(self.axis_name, None)] * len(groups),
+    ) + tuple(
+        P(self.axis_name) if h == 1 else P(self.axis_name, None)
+        for h in hotness)
+    out_specs = tuple(P(self.axis_name, None) for _ in range(self.num_inputs))
+    return jax.jit(
+        jax.shard_map(local_fn,
+                      mesh=self.mesh,
+                      in_specs=in_specs,
+                      out_specs=out_specs,
+                      check_vma=False))
+
+  @functools.lru_cache(maxsize=32)
+  def _build_mp_forward(self, global_batch: int, hotness: tuple):
+    """Model-parallel-input forward: inputs already live at global batch on
+    their owning device (reference ``dp_input=False`` path,
+    dist_model_parallel.py:388,411-413): no input all_to_all."""
+    D = self.world_size
+    local_batch = global_batch // D
+    groups = self.plan.groups
+    hot_caps = [self._group_hot_cap(g, hotness) for g in groups]
+    group_index = {g.key: gi for gi, g in enumerate(groups)}
+    flat_ids = [i for dev in self.plan.input_ids_list for i in dev]
+    # worker-order position of (device, input_id)
+    pos_of = {}
+    k = 0
+    for dev, dev_inputs in enumerate(self.plan.input_ids_list):
+      for i in dev_inputs:
+        pos_of[(dev, i)] = k
+        k += 1
+
+    def build_canonical(gi, g, inputs):
+      """[D, n_cap, GB, h_cap] canonical mp input, sharded on axis 0."""
+      h_cap = hot_caps[gi]
+      slots = []
+      for dev in range(D):
+        reqs = g.requests[dev]
+        for slot in range(g.n_cap):
+          if slot < len(reqs):
+            x = inputs[pos_of[(dev, reqs[slot].input_id)]]
+            if x.ndim == 1:
+              x = x[:, None]
+            if x.shape[1] < h_cap:
+              x = jnp.pad(x, ((0, 0), (0, h_cap - x.shape[1])),
+                          constant_values=_SENTINEL)
+            slots.append(x.astype(jnp.int32))
+          else:
+            slots.append(
+                jnp.full((global_batch, h_cap), _SENTINEL, jnp.int32))
+      stacked = jnp.stack(slots).reshape(D, g.n_cap, global_batch, h_cap)
+      return jax.lax.with_sharding_constraint(
+          stacked, NamedSharding(self.mesh, P(self.axis_name)))
+
+    def local_fn(params, offsets, vocabs, *canonicals):
+      outs_back = []
+      for gi, g in enumerate(groups):
+        ids = canonicals[gi][0]  # [n_cap, GB, h_cap]
+        table = params[f'group_{gi}'][0]
+        out = _fused_lookup(table, ids, offsets[gi][0], vocabs[gi][0],
+                            g.combiner, self.compute_dtype)
+        back = out.reshape(g.n_cap, D, local_batch, g.width).transpose(
+            1, 0, 2, 3)
+        if D > 1:
+          back = jax.lax.all_to_all(back, self.axis_name, 0, 0)
+        outs_back.append(back)
+      outs = []
+      for reqs in self.plan.input_requests:
+        pieces = [
+            outs_back[group_index[r.group_key]][r.device, r.slot]
+            for r in reqs
+        ]
+        outs.append(pieces[0] if len(pieces) == 1 else jnp.concatenate(
+            pieces, axis=-1))
+      return tuple(outs)
+
+    sharded = jax.shard_map(
+        local_fn,
+        mesh=self.mesh,
+        in_specs=(
+            {f'group_{gi}': P(self.axis_name, None, None)
+             for gi in range(len(groups))},
+            [P(self.axis_name, None)] * len(groups),
+            [P(self.axis_name, None)] * len(groups),
+        ) + tuple(P(self.axis_name, None, None, None) for _ in groups),
+        out_specs=tuple(
+            P(self.axis_name, None) for _ in range(self.num_inputs)),
+        check_vma=False)
+
+    def fwd(params, offsets, vocabs, *inputs):
+      canonicals = [
+          build_canonical(gi, g, inputs) for gi, g in enumerate(groups)
+      ]
+      return sharded(params, offsets, vocabs, *canonicals)
+
+    return jax.jit(fwd)
+
+
+def _fused_lookup(table: jax.Array, ids: jax.Array, offsets: jax.Array,
+                  vocab: jax.Array, combiner: Optional[str],
+                  compute_dtype) -> jax.Array:
+  """Lookup+combine all slots of one fusion group on one device.
+
+  ``table``: [rows_cap, w] fused local table; ``ids``: [n_cap, GB, h_cap]
+  with -1 sentinel padding; ``offsets``/``vocab``: [n_cap] per-slot fused row
+  offsets and vocabulary sizes.  XLA-fallback equivalent of the reference
+  CUDA fused kernel (SURVEY.md C2); sees the same data layout the Pallas
+  kernel consumes.
+  """
+  mask = ids >= 0
+  # clip inside the slot's own table segment so bad ids can't read a
+  # neighbouring fused table's rows
+  clipped = jnp.clip(ids, 0, vocab[:, None, None] - 1)
+  fused = jnp.where(mask, clipped + offsets[:, None, None], 0)
+  rows = jnp.take(table, fused, axis=0)  # [n_cap, GB, h_cap, w]
+  acc = jnp.float32 if table.dtype in (jnp.bfloat16, jnp.float16) \
+      else table.dtype
+  rows = rows.astype(acc)
+  if combiner is None:
+    out = rows[:, :, 0, :]
+  else:
+    rows = jnp.where(mask[..., None], rows, 0)
+    out = jnp.sum(rows, axis=2)
+    if combiner == 'mean':
+      counts = jnp.sum(mask, axis=2).astype(acc)
+      out = out / jnp.maximum(counts, 1)[..., None]
+  return out.astype(compute_dtype)

@@ -1,0 +1,45 @@
+"""Mesh helpers: the TPU-native replacement for Horovod process topology.
+
+The reference gets its world from `hvd.init()/size()/rank()`
+(`dist_model_parallel.py:350-353`); here the world is a
+`jax.sharding.Mesh` with a single ``'data'`` axis used both for
+data-parallel batch sharding and model-parallel table placement (the
+reference likewise equates DP ranks and MP ranks,
+dist_model_parallel.py:348-349).  Multi-slice (DCN) extensions add an outer
+axis later without changing the runtime contract.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DEFAULT_AXIS = 'data'
+
+
+def create_mesh(devices: Optional[Sequence] = None,
+                axis_name: str = DEFAULT_AXIS) -> Mesh:
+  """One-axis mesh over all (or the given) devices."""
+  if devices is None:
+    devices = jax.devices()
+  return Mesh(np.asarray(devices), (axis_name,))
+
+
+def batch_sharding(mesh: Mesh, axis_name: str = DEFAULT_AXIS,
+                   ndim: int = 2) -> NamedSharding:
+  """Sharding for activations/inputs: batch dim split over the mesh axis."""
+  return NamedSharding(mesh, P(axis_name, *([None] * (ndim - 1))))
+
+
+def table_sharding(mesh: Mesh, axis_name: str = DEFAULT_AXIS,
+                   ndim: int = 3) -> NamedSharding:
+  """Sharding for stacked per-device tables ``[D, rows_cap, width]``."""
+  return NamedSharding(mesh, P(axis_name, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+  """Replicated sharding (dense/data-parallel parameters)."""
+  return NamedSharding(mesh, P())

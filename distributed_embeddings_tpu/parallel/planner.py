@@ -471,6 +471,26 @@ class ShardingPlan:
     worker_order = [i for dev in self.input_ids_list for i in dev]
     return [idx for _, idx in sorted(zip(worker_order, range(len(worker_order))))]
 
+  def shard_layout(self):
+    """Per-table physical layout: list (over tables) of shard records
+    ``(device, group_key, fused_row_offset, col_start, col_end)`` in device
+    (claim) order.  This is the global-canonical-layout contract the
+    checkpoint reshard path relies on (reference
+    dist_model_parallel.py:452-645): shards of a table hold contiguous,
+    device-ordered column ranges of the full ``[rows, width]`` weight.
+    """
+    layout = [[] for _ in self.table_configs]
+    for g in self.groups:
+      for dev in range(self.world_size):
+        row_offset = 0
+        for lt in g.member_tables[dev]:
+          layout[lt.table_id].append(
+              (dev, g.key, row_offset, lt.col_start, lt.col_end))
+          row_offset += lt.input_dim
+    for shards in layout:
+      shards.sort(key=lambda s: s[3])
+    return layout
+
   def device_memory_elements(self) -> List[int]:
     """Total fused-table elements per device (before rows_cap padding)."""
     out = [0] * self.world_size

@@ -1,0 +1,59 @@
+"""Initializer registry shared by layers and the distributed runtime.
+
+Replaces the reference's Keras initializer (de)serialization
+(`embedding.py:85-86,136`) and the DLRM table initializer
+(`examples/dlrm/utils.py:27-41`).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Union
+
+import jax
+import jax.numpy as jnp
+
+Initializer = Callable[[jax.Array, tuple, Any], jax.Array]
+
+
+def uniform_initializer(minval=-0.05, maxval=0.05) -> Initializer:
+  """Keras-default 'uniform' (RandomUniform(-0.05, 0.05))."""
+
+  def init(key, shape, dtype=jnp.float32):
+    return jax.random.uniform(key, shape, dtype, minval, maxval)
+
+  return init
+
+
+def scaled_uniform_initializer() -> Initializer:
+  """Uniform(+-1/sqrt(rows)): the DLRM table initializer
+  (reference `examples/dlrm/utils.py:27-41`, ``DLRMInitializer``)."""
+
+  def init(key, shape, dtype=jnp.float32):
+    maxval = 1.0 / math.sqrt(shape[0])
+    return jax.random.uniform(key, shape, dtype, -maxval, maxval)
+
+  return init
+
+
+_INITIALIZERS: Dict[str, Callable[[], Initializer]] = {
+    'uniform': uniform_initializer,
+    'scaled_uniform': scaled_uniform_initializer,
+    'zeros': lambda: (lambda key, shape, dtype=jnp.float32: jnp.zeros(
+        shape, dtype)),
+    'ones': lambda: (lambda key, shape, dtype=jnp.float32: jnp.ones(
+        shape, dtype)),
+    'normal': lambda: (lambda key, shape, dtype=jnp.float32: 0.05 * jax.random
+                       .normal(key, shape, dtype)),
+}
+
+
+def get_initializer(spec: Union[None, str, Initializer]) -> Initializer:
+  """Resolve an initializer spec: name, callable, or None (-> 'uniform')."""
+  if spec is None:
+    return uniform_initializer()
+  if callable(spec):
+    return spec
+  if spec in _INITIALIZERS:
+    return _INITIALIZERS[spec]()
+  raise ValueError(f'Unknown initializer {spec!r}')
