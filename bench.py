@@ -1,0 +1,117 @@
+"""Benchmark: synthetic 'tiny' model train-step time vs the reference's
+published 1xA100 number.
+
+Reference baseline: Tiny V3 (55 tables, 4.2 GiB), global batch 65536,
+Adagrad — 24.433 ms/step on one A100
+(`/root/reference/examples/benchmarks/synthetic_models/README.md:71`,
+BASELINE.md).  This script runs the same model/batch/optimizer on the
+available TPU device(s) and prints one JSON line; ``vs_baseline`` > 1 means
+faster than the baseline.
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main():
+  parser = argparse.ArgumentParser()
+  parser.add_argument('--model', default='tiny')
+  parser.add_argument('--batch_size', type=int, default=65536)
+  parser.add_argument('--steps', type=int, default=20)
+  parser.add_argument('--warmup', type=int, default=4)
+  parser.add_argument('--alpha', type=float, default=1.05,
+                      help='power-law exponent for ids (0=uniform)')
+  parser.add_argument('--param_dtype', default='float32',
+                      choices=['float32', 'bfloat16'])
+  args = parser.parse_args()
+
+  import jax
+  import jax.numpy as jnp
+  import optax
+  from distributed_embeddings_tpu.models.synthetic import (SYNTHETIC_MODELS,
+                                                           InputGenerator,
+                                                           SyntheticModel)
+  from distributed_embeddings_tpu.models.dlrm import bce_with_logits
+  from distributed_embeddings_tpu.parallel import (create_mesh,
+                                                   init_train_state,
+                                                   make_train_step)
+
+  # published 1-GPU (A100) step times, ms (synthetic_models/README.md:69-75)
+  baselines_1gpu_ms = {'tiny': 24.433, 'small': 67.355}
+
+  mesh = create_mesh()
+  config = SYNTHETIC_MODELS[args.model]
+  model = SyntheticModel(config,
+                         mesh=mesh,
+                         dp_input=True,
+                         param_dtype=jnp.dtype(args.param_dtype))
+  params = model.init(0)
+
+  gen = InputGenerator(config, args.batch_size, alpha=args.alpha,
+                       num_batches=2, seed=0)
+
+  def loss_fn(p, batch):
+    (numerical, cats), labels = batch
+    logits = model.apply(p, numerical, list(cats))
+    return bce_with_logits(logits, labels)
+
+  optimizer = optax.adagrad(0.01)
+  state = init_train_state(params, optimizer)
+
+  # Steps run under one jitted lax.scan so remote-dispatch overhead is
+  # amortised; batches cycle through the generated pool as scan xs (distinct
+  # per step, so nothing hoists out of the loop).
+  def make_scan(n_steps):
+    def body(state, batch):
+      loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+      updates, opt_state = optimizer.update(grads, state.opt_state,
+                                            state.params)
+      new_params = jax.tree.map(lambda p, u: p + u.astype(p.dtype),
+                                state.params, updates)
+      from distributed_embeddings_tpu.parallel import TrainState
+      return TrainState(new_params, opt_state, state.step + 1), loss
+
+    def run(state, xs):
+      return jax.lax.scan(body, state, xs)
+
+    return jax.jit(run, donate_argnums=(0,))
+
+  def stack_batches(n):
+    picks = [gen.pool[i % len(gen.pool)] for i in range(n)]
+    num = jnp.stack([jnp.asarray(p[0][0]) for p in picks])
+    cats = tuple(
+        jnp.stack([jnp.asarray(p[0][1][k]) for p in picks])
+        for k in range(len(gen.pool[0][0][1])))
+    labels = jnp.stack([jnp.asarray(p[1]) for p in picks])
+    return ((num, cats), labels)
+
+  warm = make_scan(args.warmup)
+  state, losses = warm(state, stack_batches(args.warmup))
+  float(losses[-1])  # force full sync (block_until_ready is unreliable here)
+
+  run = make_scan(args.steps)
+  xs = stack_batches(args.steps)
+  start = time.perf_counter()
+  state, losses = run(state, xs)
+  float(losses[-1])
+  elapsed = time.perf_counter() - start
+
+  step_ms = elapsed / args.steps * 1000
+  n_dev = len(jax.devices())
+  baseline = baselines_1gpu_ms.get(args.model)
+  result = {
+      'metric': (f'synthetic-{args.model} train step time, global batch '
+                 f'{args.batch_size}, Adagrad, {n_dev} TPU chip(s) '
+                 f'(baseline: 1xA100 {baseline} ms)'),
+      'value': round(step_ms, 3),
+      'unit': 'ms/step',
+      'vs_baseline': round(baseline / step_ms, 4) if baseline else None,
+  }
+  print(json.dumps(result))
+
+
+if __name__ == '__main__':
+  main()

@@ -1,0 +1,188 @@
+"""DLRM: deep learning recommendation model, hybrid-parallel on a TPU mesh.
+
+TPU-native re-design of the reference example model
+(`/root/reference/examples/dlrm/main.py:76-147` and
+`examples/dlrm/utils.py:92-113`): bottom MLP over dense features, one
+embedding per categorical feature behind ``DistributedEmbedding``, pairwise
+dot-feature interaction, top MLP to a single logit.
+
+MXU notes: MLP matmuls run in the caller-chosen ``compute_dtype``
+(bfloat16 recommended) with fp32 params; ``dot_interact``'s batched
+``x @ x^T`` is expressed with ``preferred_element_type=float32`` so the MXU
+accumulates in fp32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from distributed_embeddings_tpu.parallel.dist_embedding import DistributedEmbedding
+from distributed_embeddings_tpu.parallel.planner import TableConfig
+from distributed_embeddings_tpu.utils.initializers import scaled_uniform_initializer
+
+
+def dot_interact(emb_outs: Sequence[jax.Array],
+                 bottom_mlp_out: jax.Array) -> jax.Array:
+  """Pairwise dot interaction with the bottom-MLP re-concat
+  (reference ``dot_interact``, `examples/dlrm/utils.py:92-113`).
+
+  Args:
+    emb_outs: ``num_tables`` arrays ``[batch, dim]``.
+    bottom_mlp_out: ``[batch, dim]``.
+
+  Returns:
+    ``[batch, n*(n-1)/2 + dim]`` where ``n = num_tables + 1``.
+  """
+  features = jnp.stack([bottom_mlp_out] + list(emb_outs), axis=1)
+  # [B, n, n] pairwise dots on the MXU, fp32 accumulation
+  interactions = jax.lax.dot_general(
+      features, features,
+      dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+      preferred_element_type=jnp.float32)
+  n = features.shape[1]
+  # strictly-lower-triangular entries, row-major — same order as the
+  # reference's boolean_mask over the lower-tri mask (utils.py:104-108)
+  rows, cols = jnp.tril_indices(n, k=-1)
+  activations = interactions[:, rows, cols].astype(bottom_mlp_out.dtype)
+  return jnp.concatenate([activations, bottom_mlp_out], axis=1)
+
+
+def _glorot_normal(key, shape, dtype):
+  fan_in, fan_out = shape
+  std = math.sqrt(2.0 / (fan_in + fan_out))
+  return std * jax.random.normal(key, shape, dtype)
+
+
+@dataclasses.dataclass
+class MLP:
+  """Plain MLP with the reference DLRM's initialisation: GlorotNormal
+  kernels, RandomNormal(stddev=1/sqrt(dim)) biases, relu on all but
+  (optionally) the last layer (reference `examples/dlrm/main.py:123-147`)."""
+  dims: List[int]
+  last_linear: bool = False
+  param_dtype: Any = jnp.float32
+
+  def init(self, rng, input_dim: int) -> List[Dict[str, jax.Array]]:
+    params = []
+    fan_in = input_dim
+    for i, dim in enumerate(self.dims):
+      kkey, bkey = jax.random.split(jax.random.fold_in(rng, i))
+      params.append({
+          'kernel': _glorot_normal(kkey, (fan_in, dim), self.param_dtype),
+          'bias': (1.0 / math.sqrt(dim)) * jax.random.normal(
+              bkey, (dim,), self.param_dtype),
+      })
+      fan_in = dim
+    return params
+
+  def apply(self, params, x: jax.Array) -> jax.Array:
+    for i, layer in enumerate(params):
+      x = jax.lax.dot_general(
+          x, layer['kernel'].astype(x.dtype),
+          dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+          preferred_element_type=jnp.float32).astype(x.dtype)
+      x = x + layer['bias'].astype(x.dtype)
+      if not (self.last_linear and i == len(params) - 1):
+        x = jax.nn.relu(x)
+    return x
+
+
+@dataclasses.dataclass
+class DLRM:
+  """DLRM with hybrid-parallel embeddings.
+
+  Args:
+    table_sizes: vocabulary size per categorical feature.
+    embedding_dim: shared embedding width (MLPerf config: 128).
+    bottom_mlp_dims / top_mlp_dims: reference defaults
+      (`examples/dlrm/main.py:38-39`).
+    num_numerical_features: dense feature count (Criteo: 13).
+    mesh: mesh for the distributed embedding; None uses all devices.
+    dist_strategy: table placement strategy.
+    column_slice_threshold: forwarded to the planner.
+    dp_input: data-parallel categorical inputs (see DistributedEmbedding).
+    compute_dtype: activation dtype (bfloat16 for the AMP-equivalent path,
+      reference `examples/dlrm/README.md:8`).
+  """
+  table_sizes: Sequence[int]
+  embedding_dim: int = 128
+  bottom_mlp_dims: Sequence[int] = (512, 256, 128)
+  top_mlp_dims: Sequence[int] = (1024, 1024, 512, 256, 1)
+  num_numerical_features: int = 13
+  mesh: Optional[Mesh] = None
+  dist_strategy: str = 'memory_balanced'
+  column_slice_threshold: Optional[int] = None
+  dp_input: bool = True
+  param_dtype: Any = jnp.float32
+  compute_dtype: Any = jnp.float32
+
+  def __post_init__(self):
+    if self.bottom_mlp_dims[-1] != self.embedding_dim:
+      raise ValueError(
+          f'bottom MLP must end at embedding_dim ({self.embedding_dim}), '
+          f'got {self.bottom_mlp_dims}')
+    self.bottom_mlp = MLP(list(self.bottom_mlp_dims),
+                          param_dtype=self.param_dtype)
+    self.top_mlp = MLP(list(self.top_mlp_dims), last_linear=True,
+                       param_dtype=self.param_dtype)
+    configs = [
+        TableConfig(input_dim=size,
+                    output_dim=self.embedding_dim,
+                    combiner=None,
+                    initializer=scaled_uniform_initializer(),
+                    name=f'table_{i}')
+        for i, size in enumerate(self.table_sizes)
+    ]
+    self.dist_embedding = DistributedEmbedding(
+        configs,
+        strategy=self.dist_strategy,
+        column_slice_threshold=self.column_slice_threshold,
+        dp_input=self.dp_input,
+        mesh=self.mesh,
+        param_dtype=self.param_dtype,
+        compute_dtype=self.compute_dtype)
+
+  @property
+  def num_interaction_features(self) -> int:
+    n = len(self.table_sizes) + 1
+    return n * (n - 1) // 2 + self.embedding_dim
+
+  def init(self, rng) -> Dict[str, Any]:
+    if isinstance(rng, int):
+      rng = jax.random.key(rng)
+    return {
+        'bottom_mlp': self.bottom_mlp.init(
+            jax.random.fold_in(rng, 0), self.num_numerical_features),
+        'top_mlp': self.top_mlp.init(
+            jax.random.fold_in(rng, 1), self.num_interaction_features),
+        'embedding': self.dist_embedding.init(jax.random.fold_in(rng, 2)),
+    }
+
+  def apply(self, params: Dict[str, Any], numerical: jax.Array,
+            categorical) -> jax.Array:
+    """Forward to logits ``[batch, 1]`` (reference ``DLRM.call``,
+    `examples/dlrm/main.py:91-102`)."""
+    x = self.bottom_mlp.apply(params['bottom_mlp'],
+                              numerical.astype(self.compute_dtype))
+    emb_outs = self.dist_embedding.apply(params['embedding'], categorical)
+    emb_outs = [e.astype(self.compute_dtype) for e in emb_outs]
+    out = dot_interact(emb_outs, x)
+    return self.top_mlp.apply(params['top_mlp'], out).astype(jnp.float32)
+
+  __call__ = apply
+
+
+def bce_with_logits(logits: jax.Array, labels: jax.Array) -> jax.Array:
+  """Mean binary cross-entropy from logits (reference uses
+  ``BinaryCrossentropy(from_logits=True)``, `examples/dlrm/main.py:198-199`)."""
+  logits = logits.reshape(-1)
+  labels = labels.reshape(-1).astype(jnp.float32)
+  return jnp.mean(
+      jnp.maximum(logits, 0) - logits * labels +
+      jnp.log1p(jnp.exp(-jnp.abs(logits))))

@@ -1,0 +1,291 @@
+"""Synthetic recommender benchmark models.
+
+Port of the reference synthetic benchmark suite
+(`/root/reference/examples/benchmarks/synthetic_models/config_v3.py:21-142`,
+`synthetic_models.py:31-243`): seven model scales (tiny -> colossal, 4 GiB ->
+22 TiB of embedding tables) with shared multi-hot tables, a power-law id
+generator, an optional bandwidth-limited average-pool "interaction", and an
+MLP head.  Step times for these configs on DGX-A100 are the published
+baseline this framework benchmarks against (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from distributed_embeddings_tpu.models.dlrm import MLP
+from distributed_embeddings_tpu.parallel.dist_embedding import DistributedEmbedding
+from distributed_embeddings_tpu.parallel.planner import TableConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class EmbeddingConfig:
+  """One block of identical tables (reference ``EmbeddingConfig``,
+  config_v3.py:21-22).  ``nnz`` lists the hotness of each input; more than
+  one entry means the inputs *share* one table (``shared=True``)."""
+  num_tables: int
+  nnz: Tuple[int, ...]
+  num_rows: int
+  width: int
+  shared: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+  """Reference ``ModelConfig`` (config_v3.py:26-28); the final
+  project-to-1 MLP layer is implied."""
+  name: str
+  embedding_configs: Tuple[EmbeddingConfig, ...]
+  mlp_sizes: Tuple[int, ...]
+  num_numerical_features: int
+  interact_stride: Optional[int]
+
+
+def _cfg(name, embs, mlp, num, stride):
+  return ModelConfig(name, tuple(EmbeddingConfig(n, tuple(z), r, w, s)
+                                 for n, z, r, w, s in embs),
+                     tuple(mlp), num, stride)
+
+
+# Exact port of the reference's seven configs (config_v3.py:30-142).
+SYNTHETIC_MODELS: Dict[str, ModelConfig] = {
+    'tiny': _cfg('Tiny V3',
+                 [(1, [1, 10], 10000, 8, True),
+                  (1, [1, 10], 1000000, 16, True),
+                  (1, [1, 10], 25000000, 16, True),
+                  (1, [1], 25000000, 16, False),
+                  (16, [1], 10, 8, False),
+                  (10, [1], 1000, 8, False),
+                  (4, [1], 10000, 8, False),
+                  (2, [1], 100000, 16, False),
+                  (19, [1], 1000000, 16, False)],
+                 [256, 128], 10, None),
+    'small': _cfg('Small V3',
+                  [(5, [1, 30], 10000, 16, True),
+                   (3, [1, 30], 4000000, 32, True),
+                   (1, [1, 30], 50000000, 32, True),
+                   (1, [1], 50000000, 32, False),
+                   (30, [1], 10, 16, False),
+                   (30, [1], 1000, 16, False),
+                   (5, [1], 10000, 16, False),
+                   (5, [1], 100000, 32, False),
+                   (27, [1], 4000000, 32, False)],
+                  [512, 256, 128], 10, None),
+    'medium': _cfg('Medium v3',
+                   [(20, [1, 50], 100000, 64, True),
+                    (5, [1, 50], 10000000, 64, True),
+                    (1, [1, 50], 100000000, 128, True),
+                    (1, [1], 100000000, 128, False),
+                    (80, [1], 10, 32, False),
+                    (60, [1], 1000, 32, False),
+                    (80, [1], 100000, 64, False),
+                    (24, [1], 200000, 64, False),
+                    (40, [1], 10000000, 64, False)],
+                   [1024, 512, 256, 128], 25, 7),
+    'large': _cfg('Large v3',
+                  [(40, [1, 100], 100000, 64, True),
+                   (16, [1, 100], 15000000, 64, True),
+                   (1, [1, 100], 200000000, 128, True),
+                   (1, [1], 200000000, 128, False),
+                   (100, [1], 10, 32, False),
+                   (100, [1], 10000, 32, False),
+                   (160, [1], 100000, 64, False),
+                   (50, [1], 500000, 64, False),
+                   (144, [1], 15000000, 64, False)],
+                  [2048, 1024, 512, 256], 100, 8),
+    'jumbo': _cfg('Jumbo v3',
+                  [(50, [1, 200], 100000, 128, True),
+                   (24, [1, 200], 20000000, 128, True),
+                   (1, [1, 200], 400000000, 256, True),
+                   (1, [1], 400000000, 256, False),
+                   (100, [1], 10, 32, False),
+                   (200, [1], 10000, 64, False),
+                   (350, [1], 100000, 128, False),
+                   (80, [1], 1000000, 128, False),
+                   (216, [1], 20000000, 128, False)],
+                  [2048, 1024, 512, 256], 200, 20),
+    'colossal': _cfg('Colossal v3',
+                     [(100, [1, 300], 100000, 128, True),
+                      (50, [1, 300], 40000000, 256, True),
+                      (1, [1, 300], 2000000000, 256, True),
+                      (1, [1], 1000000000, 256, False),
+                      (100, [1], 10, 32, False),
+                      (400, [1], 10000, 128, False),
+                      (100, [1], 100000, 128, False),
+                      (800, [1], 1000000, 128, False),
+                      (450, [1], 40000000, 256, False)],
+                     [4096, 2048, 1024, 512, 256], 500, 30),
+    'criteo': _cfg('Criteo-dlrm-like',
+                   [(26, [1], 100000, 128, False)],
+                   [512, 256, 128], 13, None),
+}
+
+
+def expand_tables(config: ModelConfig):
+  """Expand block configs into per-table configs + input->table map
+  (reference synthetic_models.py:130-148)."""
+  tables: List[TableConfig] = []
+  input_table_map: List[int] = []
+  hotness: List[int] = []
+  for block in config.embedding_configs:
+    if len(block.nnz) > 1 and not block.shared:
+      raise NotImplementedError(
+          'Nonshared multihot embedding is not implemented yet')
+    for _ in range(block.num_tables):
+      tables.append(
+          TableConfig(input_dim=block.num_rows, output_dim=block.width,
+                      combiner='sum'))
+      for h in block.nnz:
+        input_table_map.append(len(tables) - 1)
+        hotness.append(h)
+  return tables, input_table_map, hotness
+
+
+def power_law(k_min, k_max, alpha, r) -> np.ndarray:
+  """Uniform -> power-law transform (reference synthetic_models.py:31-35)."""
+  gamma = 1 - alpha
+  y = (r * (k_max**gamma - k_min**gamma) + k_min**gamma)**(1.0 / gamma)
+  return y.astype(np.int64)
+
+
+def gen_power_law_data(rng, batch_size, hotness, num_rows,
+                       alpha) -> np.ndarray:
+  """Power-law distributed ids with repetition (reference
+  synthetic_models.py:38-45)."""
+  y = power_law(1, num_rows + 1, alpha,
+                rng.random(batch_size * hotness)) - 1
+  return y.reshape(batch_size, hotness).astype(np.int32)
+
+
+class InputGenerator:
+  """Synthetic categorical/numerical input pool (reference
+  ``InputGenerator``, synthetic_models.py:51-113).
+
+  Args:
+    config: model config.
+    global_batch_size: global batch.
+    alpha: power-law exponent, 0 = uniform.
+    mp_input_ids: worker-order input ids for model-parallel input; None
+      means data-parallel input.
+    num_batches: size of the generated pool.
+    seed: numpy seed.
+  """
+
+  def __init__(self, config: ModelConfig, global_batch_size: int,
+               alpha: float = 0.0, mp_input_ids: Optional[List[int]] = None,
+               num_batches: int = 4, seed: int = 0):
+    _, input_table_map, hotness = expand_tables(config)
+    tables, _, _ = expand_tables(config)
+    rng = np.random.default_rng(seed)
+    cat_batch = global_batch_size
+    self.pool = []
+    input_ids = (mp_input_ids if mp_input_ids is not None
+                 else list(range(len(input_table_map))))
+    for _ in range(num_batches):
+      cats = []
+      for input_id in input_ids:
+        rows = tables[input_table_map[input_id]].input_dim
+        h = hotness[input_id]
+        if alpha == 0:
+          ids = rng.integers(0, rows, size=(cat_batch, h)).astype(np.int32)
+        else:
+          ids = gen_power_law_data(rng, cat_batch, h, rows, alpha)
+        cats.append(ids)
+      numerical = rng.uniform(0, 100, size=(
+          global_batch_size, config.num_numerical_features)).astype(
+              np.float32)
+      labels = rng.integers(0, 2, size=(global_batch_size, 1)).astype(
+          np.float32)
+      self.pool.append(((numerical, cats), labels))
+
+  def __len__(self):
+    return len(self.pool)
+
+  def __getitem__(self, idx):
+    return self.pool[idx]
+
+
+def _same_avg_pool_1d(x: jax.Array, stride: int) -> jax.Array:
+  """AveragePooling1D(pool=stride, stride=stride, padding='same') over the
+  feature axis of ``[batch, features]`` (reference interact emulation,
+  synthetic_models.py:151-155,228-230): averages count only valid elements."""
+  b, f = x.shape
+  out_f = -(-f // stride)
+  pad = out_f * stride - f
+  sums = jnp.pad(x, ((0, 0), (0, pad))).reshape(b, out_f, stride).sum(-1)
+  counts = jnp.pad(jnp.ones((f,), x.dtype),
+                   (0, pad)).reshape(out_f, stride).sum(-1)
+  return sums / counts
+
+
+@dataclasses.dataclass
+class SyntheticModel:
+  """Distributed synthetic model (reference ``SyntheticModelTFDE``,
+  synthetic_models.py:116-175): DistributedEmbedding + pool/concat
+  interaction + MLP head projecting to 1.
+
+  Args:
+    config: one of ``SYNTHETIC_MODELS``.
+    mesh: device mesh.
+    column_slice_threshold: forwarded to the planner.
+    dp_input: data-parallel input (reference benchmark default is False).
+    param_dtype / compute_dtype: storage and activation dtypes.
+  """
+  config: ModelConfig
+  mesh: Optional[Mesh] = None
+  column_slice_threshold: Optional[int] = None
+  dp_input: bool = False
+  strategy: str = 'memory_balanced'
+  param_dtype: Any = jnp.float32
+  compute_dtype: Any = jnp.float32
+
+  def __post_init__(self):
+    tables, input_table_map, hotness = expand_tables(self.config)
+    self.input_table_map = input_table_map
+    self.hotness = hotness
+    self.dist_embedding = DistributedEmbedding(
+        tables,
+        strategy=self.strategy,
+        column_slice_threshold=self.column_slice_threshold,
+        dp_input=self.dp_input,
+        input_table_map=input_table_map,
+        mesh=self.mesh,
+        param_dtype=self.param_dtype,
+        compute_dtype=self.compute_dtype)
+    total_width = sum(
+        tables[t].output_dim for t in input_table_map)
+    if self.config.interact_stride is not None:
+      total_width = -(-total_width // self.config.interact_stride)
+    self.mlp = MLP(list(self.config.mlp_sizes) + [1], last_linear=True,
+                   param_dtype=self.param_dtype)
+    self._mlp_input_dim = total_width + self.config.num_numerical_features
+
+  def init(self, rng) -> Dict[str, Any]:
+    if isinstance(rng, int):
+      rng = jax.random.key(rng)
+    return {
+        'embedding': self.dist_embedding.init(jax.random.fold_in(rng, 0)),
+        'mlp': self.mlp.init(jax.random.fold_in(rng, 1),
+                             self._mlp_input_dim),
+    }
+
+  def apply(self, params, numerical: jax.Array, categorical) -> jax.Array:
+    outs = self.dist_embedding.apply(params['embedding'], categorical)
+    x = jnp.concatenate([o.astype(self.compute_dtype) for o in outs], axis=1)
+    if self.config.interact_stride is not None:
+      x = _same_avg_pool_1d(x, self.config.interact_stride)
+    x = jnp.concatenate([x, numerical.astype(self.compute_dtype)], axis=1)
+    return self.mlp.apply(params['mlp'], x).astype(jnp.float32)
+
+  __call__ = apply
+
+  def total_table_gib(self) -> float:
+    tables, _, _ = expand_tables(self.config)
+    bytes_per = jnp.dtype(self.param_dtype).itemsize
+    return sum(t.size for t in tables) * bytes_per / 2**30

@@ -28,6 +28,7 @@ shape static (SURVEY.md §7 "Hard parts" 1-2).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -118,20 +119,6 @@ class DistributedEmbedding:
                              column_slice_threshold=column_slice_threshold)
     self.num_inputs = len(self.plan.input_table_map)
 
-    # Static per-group routing tables, carried as sharded *data* (the SPMD
-    # replacement for the reference's per-rank Python structure).
-    self._group_offsets: List[jax.Array] = []   # [D, n_cap] fused row offsets
-    self._group_vocabs: List[jax.Array] = []    # [D, n_cap] per-slot vocab
-    for g in self.plan.groups:
-      offs = np.zeros((self.world_size, g.n_cap), np.int32)
-      vocab = np.ones((self.world_size, g.n_cap), np.int32)
-      for dev, reqs in enumerate(g.requests):
-        for r in reqs:
-          offs[dev, r.slot] = r.row_offset
-          vocab[dev, r.slot] = self.table_configs[r.table_id].input_dim
-      spec = NamedSharding(self.mesh, P(self.axis_name, None))
-      self._group_offsets.append(jax.device_put(jnp.asarray(offs), spec))
-      self._group_vocabs.append(jax.device_put(jnp.asarray(vocab), spec))
 
   # ------------------------------------------------------------------ init
 
@@ -141,40 +128,43 @@ class DistributedEmbedding:
     Each member table slice is initialised with its own initializer at its
     sliced shape, preserving the per-table init distribution the reference
     keeps through ``ConcatInitializer`` (dist_model_parallel.py:26-37,
-    276-283).  Shards are materialised per device via
-    ``jax.make_array_from_callback`` (host CPU), so no device ever holds
-    another device's tables — the analog of the reference's CPU-forced init
-    (embedding.py:28-38).
+    276-283).  Each device generates *its own* shard on-device (no host
+    materialisation, no transfer) — the TPU-native answer to the
+    reference's CPU-forced init against GPU OOM (embedding.py:28-38):
+    terabyte aggregate tables initialise at HBM speed with per-device peak
+    memory equal to one shard.
     """
     if isinstance(rng, int):
       rng = jax.random.key(rng)
-    host_cpu = jax.local_devices(backend='cpu')[0]
-    rng = jax.device_put(rng, host_cpu)
 
     params = {}
     for gi, g in enumerate(self.plan.groups):
       shape = (self.world_size, g.rows_cap, g.width)
       sharding = NamedSharding(self.mesh, P(self.axis_name, None, None))
 
-      def make_shard(index, g=g):
-        dev = index[0].start if index[0].start is not None else 0
-        with jax.default_device(host_cpu):
-          chunks = []
-          for lt in g.member_tables[dev]:
-            cfg = self.table_configs[lt.table_id]
-            init = get_initializer(cfg.initializer)
-            key = jax.random.fold_in(
-                jax.random.fold_in(rng, lt.table_id), lt.col_start)
-            chunks.append(
-                np.asarray(init(key, (lt.input_dim, lt.width),
-                                self.param_dtype)))
-          pad_rows = g.rows_cap - g.rows[dev]
-          if pad_rows or not chunks:
-            chunks.append(np.zeros((pad_rows, g.width), self.param_dtype))
-          return np.concatenate(chunks, axis=0)[None]
+      def make_shard(key, dev, g=g):
+        chunks = []
+        for lt in g.member_tables[dev]:
+          cfg = self.table_configs[lt.table_id]
+          init = get_initializer(cfg.initializer)
+          sub = jax.random.fold_in(
+              jax.random.fold_in(key, lt.table_id), lt.col_start)
+          chunks.append(
+              init(sub, (lt.input_dim, lt.width),
+                   self.param_dtype).astype(self.param_dtype))
+        pad_rows = g.rows_cap - g.rows[dev]
+        if pad_rows or not chunks:
+          chunks.append(jnp.zeros((pad_rows, g.width), self.param_dtype))
+        return jnp.concatenate(chunks, axis=0)[None]
 
-      params[f'group_{gi}'] = jax.make_array_from_callback(
-          shape, sharding, make_shard)
+      index_map = sharding.addressable_devices_indices_map(shape)
+      shards = []
+      for device, index in index_map.items():
+        dev = index[0].start if index[0].start is not None else 0
+        with jax.default_device(device):
+          shards.append(jax.jit(make_shard, static_argnums=(1,))(rng, dev))
+      params[f'group_{gi}'] = jax.make_array_from_single_device_arrays(
+          shape, sharding, shards)
     return params
 
   # --------------------------------------------------------------- forward
@@ -234,8 +224,7 @@ class DistributedEmbedding:
       hotness = self._input_hotness(inputs)
       self._check_combiner_hotness(hotness)
       fwd = self._build_dp_forward(batch, tuple(hotness))
-      return list(fwd(params, self._group_offsets, self._group_vocabs,
-                      *inputs))
+      return list(fwd(params, *inputs))
 
     # model-parallel input path
     flat_ids = [i for dev in self.plan.input_ids_list for i in dev]
@@ -255,8 +244,7 @@ class DistributedEmbedding:
     hotness = [hot_by_input.get(i, 1) for i in range(self.num_inputs)]
     self._check_combiner_hotness(hotness)
     fwd = self._build_mp_forward(batch, tuple(hotness))
-    return list(fwd(params, self._group_offsets, self._group_vocabs,
-                    *inputs))
+    return list(fwd(params, *inputs))
 
   __call__ = apply
 
@@ -264,88 +252,105 @@ class DistributedEmbedding:
     # densification capacity: average capacity per row, at least 1
     return max(1, -(-ragged.nnz_cap // ragged.nrows))
 
-  def _group_hot_cap(self, g: GroupSpec, hotness) -> int:
-    hots = [
-        hotness[r.input_id] for reqs in g.requests for r in reqs
-    ]
-    return max(hots) if hots else 1
+  def _subgroups(self, hotness: tuple) -> List['_SubGroup']:
+    """Partition each fusion group's requests by input hotness.
+
+    The all-to-all buffers are padded to uniform shapes; padding every
+    request to the group's max hotness would multiply gather volume for
+    mixed-hotness groups (e.g. the synthetic models mix hotness 1 and 10+
+    at the same width, config_v3.py:32-40), so each (group, hotness) class
+    gets its own exactly-sized canonical buffer.
+    """
+    subs = []
+    for gi, g in enumerate(self.plan.groups):
+      hots = sorted({hotness[r.input_id] for reqs in g.requests
+                     for r in reqs})
+      for h in hots:
+        per_dev = [[r for r in reqs if hotness[r.input_id] == h]
+                   for reqs in g.requests]
+        n_cap = max(len(rs) for rs in per_dev)
+        offs = np.zeros((self.world_size, n_cap), np.int32)
+        vocab = np.ones((self.world_size, n_cap), np.int32)
+        for dev, rs in enumerate(per_dev):
+          for s, r in enumerate(rs):
+            offs[dev, s] = r.row_offset
+            vocab[dev, s] = self.table_configs[r.table_id].input_dim
+        subs.append(_SubGroup(gi=gi, group=g, hotness=h, n_cap=n_cap,
+                              requests=per_dev, offsets=offs, vocab=vocab))
+    return subs
+
+  def _assemble(self, subs, sub_back):
+    """Gather output pieces back to input order (reference reorder + column
+    slice re-concat, dist_model_parallel.py:443,446-450).
+
+    ``sub_back[si]``: [D, n_cap, B, w] received outputs of subgroup si.
+    """
+    # (device, group_key, plan slot) -> (subgroup index, subslot)
+    locate = {}
+    for si, sub in enumerate(subs):
+      for dev, rs in enumerate(sub.requests):
+        for s, r in enumerate(rs):
+          locate[(dev, r.group_key, r.slot)] = (si, s)
+    outs = []
+    for reqs in self.plan.input_requests:
+      pieces = []
+      for r in reqs:
+        si, s = locate[(r.device, r.group_key, r.slot)]
+        pieces.append(sub_back[si][r.device, s])
+      outs.append(pieces[0] if len(pieces) == 1 else jnp.concatenate(
+          pieces, axis=-1))
+    return tuple(outs)
 
   @functools.lru_cache(maxsize=32)
   def _build_dp_forward(self, global_batch: int, hotness: tuple):
     """Trace-and-cache the shard_map'd dp-input forward for one signature."""
     D = self.world_size
     local_batch = global_batch // D
-    groups = self.plan.groups
-    hot_caps = [self._group_hot_cap(g, hotness) for g in groups]
-    group_index = {g.key: gi for gi, g in enumerate(groups)}
+    subs = self._subgroups(hotness)
 
-    def local_fn(params, offsets, vocabs, *inputs):
+    def local_fn(params, *inputs):
       # inputs: per-input local ids [B(, h)]; params[f'group_i']:
-      # [1, rows_cap, w]; offsets/vocabs: [1, n_cap] each.
-      group_recv = []
-      for gi, g in enumerate(groups):
-        h_cap = hot_caps[gi]
-        # --- build canonical send buffer [D, n_cap, B, h_cap] ------------
+      # [1, rows_cap, w].  Per-device routing constants are selected by
+      # axis_index from closed-over [D, n_cap] arrays.
+      me = jax.lax.axis_index(self.axis_name)
+      sub_back = []
+      for sub in subs:
+        h = sub.hotness
+        # --- canonical send buffer [D, n_cap, B, h]: slot (dev, s) holds
+        # the ids destined for device dev's s-th request of this class ----
         slots = []
         for dev in range(D):
-          reqs = g.requests[dev]
-          for slot in range(g.n_cap):
-            if slot < len(reqs):
-              x = inputs[reqs[slot].input_id]
-              if x.ndim == 1:
-                x = x[:, None]
-              if x.shape[1] < h_cap:
-                x = jnp.pad(x, ((0, 0), (0, h_cap - x.shape[1])),
-                            constant_values=_SENTINEL)
+          rs = sub.requests[dev]
+          for s in range(sub.n_cap):
+            if s < len(rs):
+              x = inputs[rs[s].input_id]
+              x = x[:, None] if x.ndim == 1 else x
               slots.append(x.astype(jnp.int32))
             else:
-              slots.append(
-                  jnp.full((local_batch, h_cap), _SENTINEL, jnp.int32))
-        send = jnp.stack(slots).reshape(D, g.n_cap, local_batch, h_cap)
+              slots.append(jnp.full((local_batch, h), _SENTINEL, jnp.int32))
+        send = jnp.stack(slots).reshape(D, sub.n_cap, local_batch, h)
         # --- dp -> mp all_to_all (reference hvd.alltoall 'inp_dp_to_mp',
-        # dist_model_parallel.py:404) --------------------------------------
-        if D > 1:
-          recv = jax.lax.all_to_all(send, self.axis_name, 0, 0)
-        else:
-          recv = send
-        # [n_cap, D*B, h_cap], global batch in source-major order (the
+        # dist_model_parallel.py:404) -------------------------------------
+        recv = (jax.lax.all_to_all(send, self.axis_name, 0, 0)
+                if D > 1 else send)
+        # [n_cap, D*B, h]: global batch in source-major order (the
         # reference's [world_size * local] reshape, :405-410)
-        ids = recv.transpose(1, 0, 2, 3).reshape(g.n_cap, global_batch,
-                                                 h_cap)
-        group_recv.append(ids)
-
-      group_back = []
-      for gi, g in enumerate(groups):
-        ids = group_recv[gi]
-        table = params[f'group_{gi}'][0]
-        offs = offsets[gi][0]
-        vocab = vocabs[gi][0]
-        out = _fused_lookup(table, ids, offs, vocab, g.combiner,
-                            self.compute_dtype)
-        # --- mp -> dp all_to_all (reference 'out_mp_to_dp', :434) ---------
-        back = out.reshape(g.n_cap, D, local_batch, g.width).transpose(
-            1, 0, 2, 3)
+        ids = recv.transpose(1, 0, 2, 3).reshape(sub.n_cap, global_batch, h)
+        out = _fused_lookup(params[f'group_{sub.gi}'][0], ids,
+                            jnp.asarray(sub.offsets)[me],
+                            jnp.asarray(sub.vocab)[me],
+                            sub.group.combiner, self.compute_dtype)
+        # --- mp -> dp all_to_all (reference 'out_mp_to_dp', :434) --------
+        back = out.reshape(sub.n_cap, D, local_batch,
+                           sub.group.width).transpose(1, 0, 2, 3)
         if D > 1:
           back = jax.lax.all_to_all(back, self.axis_name, 0, 0)
-        group_back.append(back)
-
-      # --- assemble outputs in input order (reference reorder + column
-      # slice re-concat, :443,446-450) ------------------------------------
-      outs = []
-      for reqs in self.plan.input_requests:
-        pieces = [
-            group_back[group_index[r.group_key]][r.device, r.slot]
-            for r in reqs
-        ]
-        outs.append(pieces[0] if len(pieces) == 1 else jnp.concatenate(
-            pieces, axis=-1))
-      return tuple(outs)
+        sub_back.append(back)
+      return self._assemble(subs, sub_back)
 
     in_specs = (
         {f'group_{gi}': P(self.axis_name, None, None)
-         for gi in range(len(groups))},
-        [P(self.axis_name, None)] * len(groups),
-        [P(self.axis_name, None)] * len(groups),
+         for gi in range(len(self.plan.groups))},
     ) + tuple(
         P(self.axis_name) if h == 1 else P(self.axis_name, None)
         for h in hotness)
@@ -364,10 +369,7 @@ class DistributedEmbedding:
     dist_model_parallel.py:388,411-413): no input all_to_all."""
     D = self.world_size
     local_batch = global_batch // D
-    groups = self.plan.groups
-    hot_caps = [self._group_hot_cap(g, hotness) for g in groups]
-    group_index = {g.key: gi for gi, g in enumerate(groups)}
-    flat_ids = [i for dev in self.plan.input_ids_list for i in dev]
+    subs = self._subgroups(hotness)
     # worker-order position of (device, input_id)
     pos_of = {}
     k = 0
@@ -376,89 +378,87 @@ class DistributedEmbedding:
         pos_of[(dev, i)] = k
         k += 1
 
-    def build_canonical(gi, g, inputs):
-      """[D, n_cap, GB, h_cap] canonical mp input, sharded on axis 0."""
-      h_cap = hot_caps[gi]
+    def build_canonical(sub, inputs):
+      """[D, n_cap, GB, h] canonical mp input, sharded on axis 0."""
       slots = []
       for dev in range(D):
-        reqs = g.requests[dev]
-        for slot in range(g.n_cap):
-          if slot < len(reqs):
-            x = inputs[pos_of[(dev, reqs[slot].input_id)]]
-            if x.ndim == 1:
-              x = x[:, None]
-            if x.shape[1] < h_cap:
-              x = jnp.pad(x, ((0, 0), (0, h_cap - x.shape[1])),
-                          constant_values=_SENTINEL)
+        rs = sub.requests[dev]
+        for s in range(sub.n_cap):
+          if s < len(rs):
+            x = inputs[pos_of[(dev, rs[s].input_id)]]
+            x = x[:, None] if x.ndim == 1 else x
             slots.append(x.astype(jnp.int32))
           else:
             slots.append(
-                jnp.full((global_batch, h_cap), _SENTINEL, jnp.int32))
-      stacked = jnp.stack(slots).reshape(D, g.n_cap, global_batch, h_cap)
+                jnp.full((global_batch, sub.hotness), _SENTINEL, jnp.int32))
+      stacked = jnp.stack(slots).reshape(D, sub.n_cap, global_batch,
+                                         sub.hotness)
       return jax.lax.with_sharding_constraint(
           stacked, NamedSharding(self.mesh, P(self.axis_name)))
 
-    def local_fn(params, offsets, vocabs, *canonicals):
-      outs_back = []
-      for gi, g in enumerate(groups):
-        ids = canonicals[gi][0]  # [n_cap, GB, h_cap]
-        table = params[f'group_{gi}'][0]
-        out = _fused_lookup(table, ids, offsets[gi][0], vocabs[gi][0],
-                            g.combiner, self.compute_dtype)
-        back = out.reshape(g.n_cap, D, local_batch, g.width).transpose(
-            1, 0, 2, 3)
+    def local_fn(params, *canonicals):
+      me = jax.lax.axis_index(self.axis_name)
+      sub_back = []
+      for sub, canon in zip(subs, canonicals):
+        ids = canon[0]  # [n_cap, GB, h]
+        out = _fused_lookup(params[f'group_{sub.gi}'][0], ids,
+                            jnp.asarray(sub.offsets)[me],
+                            jnp.asarray(sub.vocab)[me],
+                            sub.group.combiner, self.compute_dtype)
+        back = out.reshape(sub.n_cap, D, local_batch,
+                           sub.group.width).transpose(1, 0, 2, 3)
         if D > 1:
           back = jax.lax.all_to_all(back, self.axis_name, 0, 0)
-        outs_back.append(back)
-      outs = []
-      for reqs in self.plan.input_requests:
-        pieces = [
-            outs_back[group_index[r.group_key]][r.device, r.slot]
-            for r in reqs
-        ]
-        outs.append(pieces[0] if len(pieces) == 1 else jnp.concatenate(
-            pieces, axis=-1))
-      return tuple(outs)
+        sub_back.append(back)
+      return self._assemble(subs, sub_back)
 
     sharded = jax.shard_map(
         local_fn,
         mesh=self.mesh,
         in_specs=(
             {f'group_{gi}': P(self.axis_name, None, None)
-             for gi in range(len(groups))},
-            [P(self.axis_name, None)] * len(groups),
-            [P(self.axis_name, None)] * len(groups),
-        ) + tuple(P(self.axis_name, None, None, None) for _ in groups),
+             for gi in range(len(self.plan.groups))},
+        ) + tuple(P(self.axis_name, None, None, None) for _ in subs),
         out_specs=tuple(
             P(self.axis_name, None) for _ in range(self.num_inputs)),
         check_vma=False)
 
-    def fwd(params, offsets, vocabs, *inputs):
-      canonicals = [
-          build_canonical(gi, g, inputs) for gi, g in enumerate(groups)
-      ]
-      return sharded(params, offsets, vocabs, *canonicals)
+    def fwd(params, *inputs):
+      canonicals = [build_canonical(sub, inputs) for sub in subs]
+      return sharded(params, *canonicals)
 
     return jax.jit(fwd)
+
+
+@dataclasses.dataclass
+class _SubGroup:
+  """One (fusion group, hotness) class: the unit of canonical buffering."""
+  gi: int
+  group: GroupSpec
+  hotness: int
+  n_cap: int
+  requests: List[List['Request']]
+  offsets: np.ndarray  # [D, n_cap] fused row offsets
+  vocab: np.ndarray    # [D, n_cap] per-slot vocabulary sizes
 
 
 def _fused_lookup(table: jax.Array, ids: jax.Array, offsets: jax.Array,
                   vocab: jax.Array, combiner: Optional[str],
                   compute_dtype) -> jax.Array:
-  """Lookup+combine all slots of one fusion group on one device.
+  """Lookup+combine all slots of one subgroup on one device.
 
-  ``table``: [rows_cap, w] fused local table; ``ids``: [n_cap, GB, h_cap]
+  ``table``: [rows_cap, w] fused local table; ``ids``: [n_cap, GB, h]
   with -1 sentinel padding; ``offsets``/``vocab``: [n_cap] per-slot fused row
   offsets and vocabulary sizes.  XLA-fallback equivalent of the reference
   CUDA fused kernel (SURVEY.md C2); sees the same data layout the Pallas
-  kernel consumes.
+  kernel consumes (ops/pallas_lookup.py).
   """
   mask = ids >= 0
   # clip inside the slot's own table segment so bad ids can't read a
   # neighbouring fused table's rows
   clipped = jnp.clip(ids, 0, vocab[:, None, None] - 1)
   fused = jnp.where(mask, clipped + offsets[:, None, None], 0)
-  rows = jnp.take(table, fused, axis=0)  # [n_cap, GB, h_cap, w]
+  rows = jnp.take(table, fused, axis=0)  # [n_cap, GB, h, w]
   acc = jnp.float32 if table.dtype in (jnp.bfloat16, jnp.float16) \
       else table.dtype
   rows = rows.astype(acc)

@@ -1,0 +1,245 @@
+"""Datasets: dummy benchmark data and the raw-binary Criteo loader.
+
+Port of the reference data utilities
+(`/root/reference/examples/dlrm/utils.py:126-307`): ``DummyDataset`` for
+benchmarking and ``RawBinaryDataset``, a ``pread``-based loader over the
+split Criteo binary format (``label.bin`` bool, ``numerical.bin`` fp16,
+``cat_<i>.bin`` int8/16/32 chosen per vocabulary size) with a thread-pool
+prefetch queue.  Arrays come back as NumPy; the training loop feeds them to
+`jax.device_put` with the right shardings.
+
+A C++ fast path for batch assembly lives in ``utils/fastloader`` (same file
+format, used automatically when built).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import math
+import os
+import queue
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def get_categorical_feature_type(size: int):
+  """Smallest int dtype holding ``size`` categories (reference
+  `examples/dlrm/utils.py:116-123`)."""
+  types = (np.int8, np.int16, np.int32)
+  for numpy_type in types:
+    if size < np.iinfo(numpy_type).max:
+      return numpy_type
+  raise RuntimeError(
+      f'Categorical feature of size {size} is too big for defined types')
+
+
+class DummyDataset:
+  """Constant batches for benchmarking (reference ``DummyDataset``,
+  `examples/dlrm/utils.py:126-154`)."""
+
+  def __init__(self, batch_size: int, num_numerical_features: int,
+               num_tables: int, num_batches: int, num_workers: int = 1,
+               dp_input: bool = True):
+    local_batch = batch_size // num_workers
+    self.numerical_features = np.zeros(
+        (local_batch if dp_input else batch_size, num_numerical_features),
+        np.float32)
+    cat_batch = local_batch if dp_input else batch_size
+    self.categorical_features = [
+        np.zeros((cat_batch,), np.int32) for _ in range(num_tables)
+    ]
+    self.labels = np.ones((local_batch if dp_input else batch_size, 1),
+                          np.float32)
+    self.num_batches = num_batches
+
+  def __len__(self):
+    return self.num_batches
+
+  def __getitem__(self, idx):
+    if idx >= self.num_batches:
+      raise IndexError()
+    return self.numerical_features, self.categorical_features, self.labels
+
+  def __iter__(self):
+    for i in range(self.num_batches):
+      yield self[i]
+
+
+class RawBinaryDataset:
+  """Split-binary Criteo dataset reader (reference ``RawBinaryDataset``,
+  `examples/dlrm/utils.py:157-307`).
+
+  Args:
+    data_path: directory containing ``train/``/``test`` subdirs with
+      ``label.bin``, ``numerical.bin`` and ``cat_<i>.bin``.
+    batch_size: global batch size (one file batch).
+    numerical_features: how many dense features to read (0 = skip file).
+    categorical_features: feature ids this worker reads (model-parallel
+      input reads only the local tables' files,
+      reference `examples/dlrm/main.py:162-176`).
+    categorical_feature_sizes: global vocab sizes (defines file dtypes).
+    prefetch_depth: read-ahead depth on the background thread.
+    drop_last_batch: drop the trailing partial batch.
+    valid: read the test split.
+    offset/lbs: data-parallel slice ``[offset : offset+lbs]`` applied to
+      labels/numerical (and categoricals when ``dp_input``).
+    dp_input: slice categorical features per worker too.
+  """
+
+  def __init__(self,
+               data_path: str,
+               batch_size: int = 1,
+               numerical_features: int = 0,
+               categorical_features: Optional[Sequence[int]] = None,
+               categorical_feature_sizes: Optional[Sequence[int]] = None,
+               prefetch_depth: int = 10,
+               drop_last_batch: bool = False,
+               valid: bool = False,
+               offset: int = -1,
+               lbs: int = -1,
+               dp_input: bool = False):
+    suffix = 'test' if valid else 'train'
+    data_path = os.path.join(data_path, suffix)
+    self._label_bytes_per_batch = np.dtype(np.bool_).itemsize * batch_size
+    self._numerical_bytes_per_batch = (
+        numerical_features * np.dtype(np.float16).itemsize * batch_size)
+    self._numerical_features = numerical_features
+    self._batch_size = batch_size
+
+    self._categorical_feature_types = [
+        get_categorical_feature_type(size)
+        for size in (categorical_feature_sizes or [])
+    ]
+    self._categorical_bytes_per_batch = [
+        np.dtype(t).itemsize * batch_size
+        for t in self._categorical_feature_types
+    ]
+    self._categorical_features = list(categorical_features or [])
+
+    self._label_file = os.open(os.path.join(data_path, 'label.bin'),
+                               os.O_RDONLY)
+    rounder = math.floor if drop_last_batch else math.ceil
+    self._num_entries = int(
+        rounder(os.fstat(self._label_file).st_size /
+                self._label_bytes_per_batch))
+
+    if numerical_features > 0:
+      self._numerical_features_file = os.open(
+          os.path.join(data_path, 'numerical.bin'), os.O_RDONLY)
+      batches = int(
+          rounder(os.fstat(self._numerical_features_file).st_size /
+                  self._numerical_bytes_per_batch))
+      if batches != self._num_entries:
+        raise ValueError(f'Size mismatch in data files. Expected: '
+                         f'{self._num_entries}, got: {batches}')
+    else:
+      self._numerical_features_file = None
+
+    self._categorical_features_files = []
+    for cat_id in self._categorical_features:
+      cat_file = os.open(os.path.join(data_path, f'cat_{cat_id}.bin'),
+                         os.O_RDONLY)
+      cat_bytes = self._categorical_bytes_per_batch[cat_id]
+      batches = int(rounder(os.fstat(cat_file).st_size / cat_bytes))
+      if batches != self._num_entries:
+        raise ValueError(f'Size mismatch in data files. Expected: '
+                         f'{self._num_entries}, got: {batches}')
+      self._categorical_features_files.append(cat_file)
+
+    self._prefetch_depth = min(prefetch_depth, self._num_entries)
+    self._prefetch_queue = queue.Queue()
+    self._executor = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+    self.offset = offset
+    self.lbs = lbs
+    self.valid = valid
+    self.dp_input = dp_input
+
+  def __len__(self):
+    return self._num_entries
+
+  def __getitem__(self, idx: int):
+    if idx >= self._num_entries:
+      raise IndexError()
+    if self._prefetch_depth <= 1:
+      return self._get_item(idx)
+    if idx == 0:
+      for i in range(self._prefetch_depth):
+        self._prefetch_queue.put(self._executor.submit(self._get_item, i))
+    if idx < self._num_entries - self._prefetch_depth:
+      self._prefetch_queue.put(
+          self._executor.submit(self._get_item, idx + self._prefetch_depth))
+    return self._prefetch_queue.get().result()
+
+  def __iter__(self):
+    for i in range(len(self)):
+      yield self[i]
+
+  def _get_item(self, idx: int):
+    click = self._get_label(idx)
+    numerical_features = self._get_numerical_features(idx)
+    categorical_features = self._get_categorical_features(idx)
+    if self.offset >= 0:
+      sl = slice(self.offset, self.offset + self.lbs)
+      if not self.valid:
+        click = click[sl]
+      if numerical_features is not None:
+        numerical_features = numerical_features[sl]
+      if self.dp_input and categorical_features is not None:
+        categorical_features = [f[sl] for f in categorical_features]
+    return numerical_features, categorical_features, click
+
+  def _get_label(self, idx: int) -> np.ndarray:
+    raw = os.pread(self._label_file, self._label_bytes_per_batch,
+                   idx * self._label_bytes_per_batch)
+    return np.frombuffer(raw, dtype=np.bool_).astype(np.float32)[:, None]
+
+  def _get_numerical_features(self, idx: int) -> Optional[np.ndarray]:
+    if self._numerical_features_file is None:
+      return None
+    raw = os.pread(self._numerical_features_file,
+                   self._numerical_bytes_per_batch,
+                   idx * self._numerical_bytes_per_batch)
+    array = np.frombuffer(raw, dtype=np.float16)
+    return array.reshape(-1, self._numerical_features).astype(np.float32)
+
+  def _get_categorical_features(self, idx: int) -> Optional[List[np.ndarray]]:
+    if not self._categorical_features_files:
+      return None
+    features = []
+    for cat_id, cat_file in zip(self._categorical_features,
+                                self._categorical_features_files):
+      cat_bytes = self._categorical_bytes_per_batch[cat_id]
+      cat_type = self._categorical_feature_types[cat_id]
+      raw = os.pread(cat_file, cat_bytes, idx * cat_bytes)
+      features.append(np.frombuffer(raw, dtype=cat_type).astype(np.int32))
+    return features
+
+  def __del__(self):
+    data_files = [self._label_file, self._numerical_features_file]
+    data_files += self._categorical_features_files or []
+    for f in data_files:
+      if f is not None:
+        try:
+          os.close(f)
+        except OSError:
+          pass
+
+
+def write_raw_binary_dataset(data_path: str, split: str,
+                             labels: np.ndarray,
+                             numerical: Optional[np.ndarray],
+                             categoricals: Sequence[np.ndarray],
+                             categorical_feature_sizes: Sequence[int]):
+  """Write the split-binary format (inverse of ``RawBinaryDataset``; the
+  reference ships no writer — used for tests and synthetic data prep)."""
+  out = os.path.join(data_path, split)
+  os.makedirs(out, exist_ok=True)
+  np.asarray(labels, np.bool_).tofile(os.path.join(out, 'label.bin'))
+  if numerical is not None:
+    np.asarray(numerical, np.float16).tofile(
+        os.path.join(out, 'numerical.bin'))
+  for i, (cat, size) in enumerate(zip(categoricals,
+                                      categorical_feature_sizes)):
+    np.asarray(cat, get_categorical_feature_type(size)).tofile(
+        os.path.join(out, f'cat_{i}.bin'))

@@ -1,0 +1,41 @@
+"""Learning-rate schedules.
+
+Port of the reference DLRM scheduler semantics
+(`/root/reference/examples/dlrm/utils.py:45-88`): linear warmup, constant
+plateau, then polynomial (power 2) decay.  The reference mutates
+``optimizer.lr`` from a CPU-pinned step variable each call; the JAX shape is
+a pure ``step -> lr`` schedule passed to optax, traced into the train step
+(no host round-trip).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def warmup_poly_decay_schedule(base_lr: float,
+                               warmup_steps: int,
+                               decay_start_step: int,
+                               decay_steps: int,
+                               poly_power: int = 2):
+  """Reference ``LearningRateScheduler.__call__`` (utils.py:62-88) as an
+  optax-compatible schedule.
+
+  - steps < warmup_steps: ``base_lr * (1 - (warmup_steps - step)/warmup_steps)``
+  - warmup <= step < decay_start: ``base_lr``
+  - decay_start <= step: ``base_lr * ((decay_end - step)/decay_steps)^power``,
+    clamped at 0 after decay_end.
+  """
+  decay_end_step = decay_start_step + decay_steps
+
+  def schedule(step):
+    step = jnp.asarray(step, jnp.float32)
+    warmup_factor = 1.0 - (warmup_steps - step) / warmup_steps
+    decay_factor = jnp.clip(
+        (decay_end_step - step) / decay_steps, 0.0, 1.0)**poly_power
+    factor = jnp.where(
+        step < warmup_steps, warmup_factor,
+        jnp.where(step < decay_start_step, 1.0, decay_factor))
+    return base_lr * factor
+
+  return schedule

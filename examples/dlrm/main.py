@@ -1,0 +1,156 @@
+"""DLRM training example on TPU.
+
+Port of the reference example (`/root/reference/examples/dlrm/main.py`):
+MLPerf-configuration DLRM over Criteo (raw binary format) or synthetic
+dummy data, hybrid data+model parallel over the TPU mesh, SGD with
+warmup+poly-decay LR, AUC evaluation.
+
+Run (synthetic):  python examples/dlrm/main.py --num_batches 100
+Run (Criteo):     python examples/dlrm/main.py --dataset_path /data/criteo
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+
+def parse_args():
+  parser = argparse.ArgumentParser(description='DLRM on TPU')
+  parser.add_argument('--dataset_path', default=None,
+                      help='path to Criteo split-binary dataset '
+                           '(with model_size.json)')
+  parser.add_argument('--learning_rate', type=float, default=24)
+  parser.add_argument('--batch_size', type=int, default=64 * 1024)
+  parser.add_argument('--top_mlp_dims', default='1024,1024,512,256,1')
+  parser.add_argument('--bottom_mlp_dims', default='512,256,128')
+  parser.add_argument('--num_numerical_features', type=int, default=13)
+  parser.add_argument('--num_batches', type=int, default=340)
+  parser.add_argument('--table_sizes', default=','.join(['1000'] * 26))
+  parser.add_argument('--embedding_dim', type=int, default=128)
+  parser.add_argument('--dp_input', action='store_true')
+  parser.add_argument('--dist_strategy', default='memory_balanced')
+  parser.add_argument('--column_slice_threshold', type=int, default=None)
+  parser.add_argument('--compute_dtype', default='float32',
+                      choices=['float32', 'bfloat16'])
+  parser.add_argument('--eval', action='store_true',
+                      help='run AUC evaluation after training')
+  parser.add_argument('--save_weights', default=None,
+                      help='npz path for final embedding weights')
+  return parser.parse_args()
+
+
+def main():
+  args = parse_args()
+
+  import jax
+  import jax.numpy as jnp
+  import optax
+  from distributed_embeddings_tpu.models.dlrm import DLRM, bce_with_logits
+  from distributed_embeddings_tpu.parallel import (create_mesh, get_weights,
+                                                   init_train_state,
+                                                   make_train_step, save_npz)
+  from distributed_embeddings_tpu.utils.data import (DummyDataset,
+                                                     RawBinaryDataset)
+  from distributed_embeddings_tpu.utils.metrics import StreamingAUC
+  from distributed_embeddings_tpu.utils.schedules import warmup_poly_decay_schedule
+
+  table_sizes = [int(s) for s in args.table_sizes.split(',')]
+  if args.dataset_path is not None:
+    # table sizes come from the dataset (reference main.py:68-73)
+    with open(os.path.join(args.dataset_path, 'model_size.json'),
+              encoding='utf-8') as f:
+      table_sizes = [s + 1 for s in json.load(f).values()]
+
+  mesh = create_mesh()
+  world = len(mesh.devices.ravel())
+  model = DLRM(table_sizes=table_sizes,
+               embedding_dim=args.embedding_dim,
+               bottom_mlp_dims=[int(d) for d in args.bottom_mlp_dims.split(',')],
+               top_mlp_dims=[int(d) for d in args.top_mlp_dims.split(',')],
+               num_numerical_features=args.num_numerical_features,
+               mesh=mesh,
+               dist_strategy=args.dist_strategy,
+               column_slice_threshold=args.column_slice_threshold,
+               dp_input=args.dp_input,
+               compute_dtype=jnp.dtype(args.compute_dtype))
+  params = model.init(0)
+
+  if args.dp_input:
+    table_ids = list(range(len(table_sizes)))
+  else:
+    table_ids = [
+        i for dev in model.dist_embedding.plan.input_ids_list for i in dev
+    ]
+
+  if args.dataset_path is not None:
+    common = dict(data_path=args.dataset_path,
+                  batch_size=args.batch_size,
+                  numerical_features=args.num_numerical_features,
+                  categorical_features=table_ids,
+                  categorical_feature_sizes=table_sizes,
+                  prefetch_depth=10,
+                  drop_last_batch=True,
+                  offset=0,
+                  lbs=args.batch_size,
+                  dp_input=args.dp_input)
+    train_dataset = RawBinaryDataset(**common)
+    eval_dataset = RawBinaryDataset(valid=True, **common)
+  else:
+    train_dataset = DummyDataset(args.batch_size,
+                                 args.num_numerical_features,
+                                 len(table_ids), args.num_batches)
+    eval_dataset = DummyDataset(args.batch_size,
+                                args.num_numerical_features,
+                                len(table_ids), 10)
+
+  schedule = warmup_poly_decay_schedule(base_lr=args.learning_rate,
+                                        warmup_steps=8000,
+                                        decay_start_step=48000,
+                                        decay_steps=24000)
+  optimizer = optax.sgd(schedule)
+
+  def loss_fn(p, batch):
+    numerical, cats, labels = batch
+    return bce_with_logits(model.apply(p, numerical, list(cats)), labels)
+
+  step = make_train_step(loss_fn, optimizer)
+  state = init_train_state(params, optimizer)
+
+  start = time.perf_counter()
+  samples = 0
+  for i, (numerical, cats, labels) in enumerate(train_dataset):
+    batch = (jnp.asarray(numerical),
+             tuple(jnp.asarray(c) for c in cats), jnp.asarray(labels))
+    state, loss = step(state, batch)
+    samples += args.batch_size
+    if i % 1000 == 0:
+      print(f'step: {i}  loss: {float(loss):.5f}')
+  jax.block_until_ready(loss)
+  elapsed = time.perf_counter() - start
+  print(f'trained {samples} samples in {elapsed:.1f}s '
+        f'({samples / elapsed:,.0f} samples/s on {world} chip(s))')
+
+  if args.eval:
+    auc_metric = StreamingAUC(num_thresholds=8000)
+    fwd = jax.jit(lambda p, n, c: jax.nn.sigmoid(
+        model.apply(p, n, list(c))))
+    for numerical, cats, labels in eval_dataset:
+      preds = fwd(state.params, jnp.asarray(numerical),
+                  tuple(jnp.asarray(c) for c in cats))
+      auc_metric.update(np.asarray(labels), np.asarray(preds))
+    print(f'Evaluation completed, AUC: {auc_metric.result():.5f}')
+
+  if args.save_weights:
+    weights = get_weights(model.dist_embedding, state.params['embedding'])
+    save_npz(args.save_weights, weights)
+    print(f'saved embedding weights to {args.save_weights}')
+
+
+if __name__ == '__main__':
+  main()

@@ -1,0 +1,160 @@
+"""DLRM model tests (SURVEY.md C19, C20).
+
+The reference has no unit tests for its example model; here the model is
+part of the framework (models/dlrm.py), so dot_interact gets an oracle test
+and the full model gets shape + learning tests on the fake mesh.
+"""
+
+import numpy as np
+import optax
+import pytest
+import jax
+import jax.numpy as jnp
+
+from distributed_embeddings_tpu.models.dlrm import (DLRM, MLP, bce_with_logits,
+                                                    dot_interact)
+from distributed_embeddings_tpu.parallel import (create_mesh,
+                                                 init_train_state,
+                                                 make_train_step)
+
+TABLE_SIZES = [30, 20, 50, 10, 40, 25, 15, 35]
+
+
+def small_dlrm(mesh, **kw):
+  return DLRM(table_sizes=TABLE_SIZES,
+              embedding_dim=8,
+              bottom_mlp_dims=[16, 8],
+              top_mlp_dims=[16, 1],
+              num_numerical_features=4,
+              mesh=mesh,
+              **kw)
+
+
+class TestDotInteract:
+
+  def test_vs_manual(self):
+    rng = np.random.default_rng(0)
+    batch, dim, n_emb = 4, 3, 2
+    mlp_out = jnp.asarray(rng.normal(size=(batch, dim)).astype(np.float32))
+    embs = [
+        jnp.asarray(rng.normal(size=(batch, dim)).astype(np.float32))
+        for _ in range(n_emb)
+    ]
+    out = dot_interact(embs, mlp_out)
+    # manual: features [mlp, e0, e1]; strictly-lower-tri dots + mlp concat
+    feats = np.stack([np.asarray(mlp_out)] + [np.asarray(e) for e in embs],
+                     axis=1)
+    inter = np.einsum('bnd,bmd->bnm', feats, feats)
+    tril = [inter[:, i, j] for i in range(3) for j in range(i)]
+    expected = np.concatenate(
+        [np.stack(tril, axis=1), np.asarray(mlp_out)], axis=1)
+    assert out.shape == (batch, 3 * 2 // 2 + dim)
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+  def test_output_dim_formula(self):
+    mesh = create_mesh(jax.devices()[:4])
+    model = small_dlrm(mesh)
+    n = len(TABLE_SIZES) + 1
+    assert model.num_interaction_features == n * (n - 1) // 2 + 8
+
+
+class TestBCE:
+
+  def test_vs_manual(self):
+    logits = jnp.array([0.5, -1.0, 2.0])
+    labels = jnp.array([1.0, 0.0, 1.0])
+    p = jax.nn.sigmoid(logits)
+    expected = -jnp.mean(labels * jnp.log(p) + (1 - labels) * jnp.log(1 - p))
+    np.testing.assert_allclose(bce_with_logits(logits, labels), expected,
+                               rtol=1e-6)
+
+  def test_extreme_logits_finite(self):
+    out = bce_with_logits(jnp.array([100.0, -100.0]), jnp.array([0.0, 1.0]))
+    assert np.isfinite(np.asarray(out))
+
+
+class TestMLP:
+
+  def test_shapes_and_relu(self):
+    mlp = MLP([8, 4])
+    params = mlp.init(jax.random.key(0), 6)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(5, 6)),
+                    jnp.float32)
+    out = mlp.apply(params, x)
+    assert out.shape == (5, 4)
+    assert (np.asarray(out) >= 0).all()  # relu on last layer by default
+
+  def test_last_linear(self):
+    mlp = MLP([8, 1], last_linear=True)
+    params = mlp.init(jax.random.key(0), 6)
+    outs = np.asarray(
+        mlp.apply(params,
+                  jnp.asarray(np.random.default_rng(1).normal(size=(50, 6)),
+                              jnp.float32)))
+    assert (outs < 0).any()  # linear output can go negative
+
+
+class TestDLRMModel:
+
+  def test_forward_shape(self):
+    mesh = create_mesh(jax.devices()[:8])
+    model = small_dlrm(mesh)
+    params = model.init(0)
+    batch = 16
+    rng = np.random.default_rng(2)
+    numerical = jnp.asarray(rng.normal(size=(batch, 4)).astype(np.float32))
+    cats = [
+        jnp.asarray(rng.integers(0, s, size=(batch,)).astype(np.int32))
+        for s in TABLE_SIZES
+    ]
+    out = model.apply(params, numerical, cats)
+    assert out.shape == (batch, 1)
+    assert np.isfinite(np.asarray(out)).all()
+
+  def test_bottom_mlp_must_end_at_embedding_dim(self):
+    with pytest.raises(ValueError, match='embedding_dim'):
+      DLRM(table_sizes=[10], embedding_dim=8, bottom_mlp_dims=[16, 4],
+           top_mlp_dims=[1], num_numerical_features=2,
+           mesh=create_mesh(jax.devices()[:2]))
+
+  def test_training_learns(self):
+    """A few SGD steps reduce loss on a learnable synthetic rule."""
+    mesh = create_mesh(jax.devices()[:8])
+    model = small_dlrm(mesh)
+    params = model.init(0)
+    batch = 32
+    rng = np.random.default_rng(3)
+    numerical = jnp.asarray(rng.normal(size=(batch, 4)).astype(np.float32))
+    cats = [
+        jnp.asarray(rng.integers(0, s, size=(batch,)).astype(np.int32))
+        for s in TABLE_SIZES
+    ]
+    # learnable rule: label depends on first categorical parity
+    labels = jnp.asarray((np.asarray(cats[0]) % 2 == 0).astype(np.float32))
+
+    def loss_fn(p, batch_data):
+      numerical, cats, labels = batch_data
+      return bce_with_logits(model.apply(p, numerical, cats), labels)
+
+    optimizer = optax.sgd(0.1)
+    step = make_train_step(loss_fn, optimizer)
+    state = init_train_state(params, optimizer)
+    losses = []
+    for _ in range(30):
+      state, loss = step(state, (numerical, cats, labels))
+      losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, losses[::10]
+
+  def test_bf16_compute(self):
+    mesh = create_mesh(jax.devices()[:4])
+    model = small_dlrm(mesh, compute_dtype=jnp.bfloat16)
+    params = model.init(0)
+    rng = np.random.default_rng(4)
+    numerical = jnp.asarray(rng.normal(size=(8, 4)).astype(np.float32))
+    cats = [
+        jnp.asarray(rng.integers(0, s, size=(8,)).astype(np.int32))
+        for s in TABLE_SIZES
+    ]
+    out = model.apply(params, numerical, cats)
+    assert out.dtype == jnp.float32  # logits come back fp32
+    assert np.isfinite(np.asarray(out)).all()

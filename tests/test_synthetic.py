@@ -1,0 +1,123 @@
+"""Synthetic benchmark model tests (SURVEY.md C21)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from distributed_embeddings_tpu.models.synthetic import (SYNTHETIC_MODELS,
+                                                         InputGenerator,
+                                                         ModelConfig,
+                                                         SyntheticModel,
+                                                         _same_avg_pool_1d,
+                                                         expand_tables,
+                                                         gen_power_law_data)
+from distributed_embeddings_tpu.parallel import create_mesh
+
+
+class TestConfigs:
+
+  def test_all_scales_present(self):
+    assert set(SYNTHETIC_MODELS) == {
+        'tiny', 'small', 'medium', 'large', 'jumbo', 'colossal', 'criteo'
+    }
+
+  def test_tiny_table_count_and_size(self):
+    """Reference model-size table: Tiny = 55 tables, 4.2 GiB
+    (synthetic_models/README.md:9-16)."""
+    tables, input_map, hotness = expand_tables(SYNTHETIC_MODELS['tiny'])
+    assert len(tables) == 55
+    gib = sum(t.size for t in tables) * 4 / 2**30
+    assert abs(gib - 4.2) < 0.1
+    # 3 shared tables contribute 2 inputs each
+    assert len(input_map) == 55 + 3
+
+  def test_published_table_counts(self):
+    expected = {'tiny': 55, 'small': 107, 'medium': 311, 'large': 612,
+                'jumbo': 1022, 'colossal': 2002}
+    for name, count in expected.items():
+      tables, _, _ = expand_tables(SYNTHETIC_MODELS[name])
+      assert len(tables) == count, name
+
+  def test_nonshared_multihot_rejected(self):
+    from distributed_embeddings_tpu.models.synthetic import EmbeddingConfig
+    bad = ModelConfig('bad', (EmbeddingConfig(2, (1, 5), 100, 8, False),),
+                      (32,), 4, None)
+    with pytest.raises(NotImplementedError):
+      expand_tables(bad)
+
+
+class TestPowerLaw:
+
+  def test_range_and_skew(self):
+    rng = np.random.default_rng(0)
+    ids = gen_power_law_data(rng, 1000, 4, 10000, alpha=1.1)
+    assert ids.min() >= 0 and ids.max() < 10000
+    # power law skews toward small ids
+    assert (ids < 100).mean() > 0.3
+
+
+class TestAvgPool:
+
+  def test_same_padding_counts_valid_only(self):
+    x = jnp.asarray(np.arange(10, dtype=np.float32)[None, :])
+    out = _same_avg_pool_1d(x, 4)
+    # windows: [0..3]=1.5, [4..7]=5.5, [8,9]=8.5 (2 valid elements)
+    np.testing.assert_allclose(out, [[1.5, 5.5, 8.5]], rtol=1e-6)
+
+
+class TestSyntheticModel:
+
+  def small_config(self):
+    from distributed_embeddings_tpu.models.synthetic import EmbeddingConfig
+    return ModelConfig('test', (
+        EmbeddingConfig(1, (1, 3), 50, 8, True),
+        EmbeddingConfig(4, (1,), 30, 8, False),
+        EmbeddingConfig(3, (2,), 40, 4, False),
+    ), (32, 16), 6, None)
+
+  @pytest.mark.parametrize('dp_input', [True, False])
+  def test_forward_and_step(self, dp_input):
+    import optax
+    from distributed_embeddings_tpu.models.dlrm import bce_with_logits
+    from distributed_embeddings_tpu.parallel import (init_train_state,
+                                                     make_train_step)
+    config = self.small_config()
+    mesh = create_mesh(jax.devices()[:8])
+    model = SyntheticModel(config, mesh=mesh, dp_input=dp_input)
+    params = model.init(0)
+    mp_ids = (None if dp_input else
+              [i for dev in model.dist_embedding.plan.input_ids_list
+               for i in dev])
+    gen = InputGenerator(config, 16, alpha=1.05, mp_input_ids=mp_ids,
+                         num_batches=2)
+    (numerical, cats), labels = gen[0]
+    out = model.apply(params, jnp.asarray(numerical),
+                      [jnp.asarray(c) for c in cats])
+    assert out.shape == (16, 1)
+    assert np.isfinite(np.asarray(out)).all()
+
+    def loss_fn(p, batch):
+      (num, cats), labels = batch
+      return bce_with_logits(model.apply(p, num, list(cats)), labels)
+
+    optimizer = optax.adagrad(0.05)
+    step = make_train_step(loss_fn, optimizer)
+    state = init_train_state(params, optimizer)
+    batch = ((jnp.asarray(numerical), tuple(jnp.asarray(c) for c in cats)),
+             jnp.asarray(labels))
+    state, loss = step(state, batch)
+    assert np.isfinite(float(loss))
+
+  def test_interact_stride_model(self):
+    from distributed_embeddings_tpu.models.synthetic import EmbeddingConfig
+    config = ModelConfig('pool', (EmbeddingConfig(4, (1,), 30, 8, False),),
+                         (16,), 4, 3)
+    mesh = create_mesh(jax.devices()[:4])
+    model = SyntheticModel(config, mesh=mesh, dp_input=True)
+    params = model.init(0)
+    gen = InputGenerator(config, 8, num_batches=1)
+    (numerical, cats), _ = gen[0]
+    out = model.apply(params, jnp.asarray(numerical),
+                      [jnp.asarray(c) for c in cats])
+    assert out.shape == (8, 1)

@@ -1,0 +1,151 @@
+"""Tests for schedules, metrics, and datasets (SURVEY.md C20)."""
+
+import numpy as np
+import pytest
+
+from distributed_embeddings_tpu.utils.data import (DummyDataset,
+                                                   RawBinaryDataset,
+                                                   get_categorical_feature_type,
+                                                   write_raw_binary_dataset)
+from distributed_embeddings_tpu.utils.metrics import StreamingAUC, exact_auc
+from distributed_embeddings_tpu.utils.schedules import warmup_poly_decay_schedule
+
+
+class TestSchedule:
+  """Reference scheduler semantics (`examples/dlrm/utils.py:62-88`)."""
+
+  def setup_method(self):
+    self.sched = warmup_poly_decay_schedule(base_lr=24.0, warmup_steps=100,
+                                            decay_start_step=200,
+                                            decay_steps=100)
+
+  def test_warmup_ramp(self):
+    np.testing.assert_allclose(self.sched(0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(self.sched(50), 12.0, rtol=1e-5)
+    np.testing.assert_allclose(self.sched(100), 24.0, rtol=1e-5)
+
+  def test_constant_plateau(self):
+    np.testing.assert_allclose(self.sched(150), 24.0, rtol=1e-5)
+
+  def test_poly_decay(self):
+    # step 250: factor ((300-250)/100)^2 = 0.25
+    np.testing.assert_allclose(self.sched(250), 6.0, rtol=1e-5)
+
+  def test_after_decay_end_zero(self):
+    np.testing.assert_allclose(self.sched(400), 0.0, atol=1e-6)
+
+
+class TestAUC:
+
+  def test_matches_exact_on_random(self):
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 2, size=5000)
+    preds = np.clip(
+        rng.normal(loc=0.3 + 0.4 * labels, scale=0.2), 0, 1)
+    auc = StreamingAUC(num_thresholds=8000)
+    # stream in chunks
+    for i in range(0, 5000, 1000):
+      auc.update(labels[i:i + 1000], preds[i:i + 1000])
+    np.testing.assert_allclose(auc.result(),
+                               exact_auc(labels, preds), atol=2e-3)
+
+  def test_perfect_classifier(self):
+    auc = StreamingAUC(100)
+    auc.update([0, 0, 1, 1], [0.1, 0.2, 0.8, 0.9])
+    np.testing.assert_allclose(auc.result(), 1.0, atol=1e-2)
+
+  def test_random_classifier_half(self):
+    rng = np.random.default_rng(1)
+    auc = StreamingAUC(1000)
+    auc.update(rng.integers(0, 2, 10000), rng.uniform(size=10000))
+    np.testing.assert_allclose(auc.result(), 0.5, atol=2e-2)
+
+  def test_degenerate_labels(self):
+    auc = StreamingAUC(100)
+    auc.update([1, 1], [0.5, 0.6])
+    assert auc.result() == 0.0
+
+
+class TestFeatureTypes:
+
+  def test_dtype_selection(self):
+    assert get_categorical_feature_type(100) == np.int8
+    assert get_categorical_feature_type(1000) == np.int16
+    assert get_categorical_feature_type(100000) == np.int32
+
+  def test_too_big_raises(self):
+    with pytest.raises(RuntimeError):
+      get_categorical_feature_type(2**40)
+
+
+class TestDummyDataset:
+
+  def test_shapes(self):
+    ds = DummyDataset(batch_size=64, num_numerical_features=13,
+                      num_tables=4, num_batches=3, num_workers=8)
+    num, cats, labels = ds[0]
+    assert num.shape == (8, 13)
+    assert len(cats) == 4 and cats[0].shape == (8,)
+    assert labels.shape == (8, 1)
+    assert len(list(ds)) == 3
+
+
+class TestRawBinaryDataset:
+
+  @pytest.fixture
+  def dataset_dir(self, tmp_path):
+    rng = np.random.default_rng(5)
+    n = 256
+    sizes = [100, 1000, 100000]  # int8, int16, int32 files
+    labels = rng.integers(0, 2, n).astype(np.bool_)
+    numerical = rng.normal(size=(n, 4)).astype(np.float16)
+    cats = [rng.integers(0, s, n) for s in sizes]
+    write_raw_binary_dataset(str(tmp_path), 'train', labels, numerical, cats,
+                             sizes)
+    return str(tmp_path), labels, numerical, cats, sizes
+
+  def test_round_trip(self, dataset_dir):
+    path, labels, numerical, cats, sizes = dataset_dir
+    ds = RawBinaryDataset(path, batch_size=64, numerical_features=4,
+                          categorical_features=[0, 1, 2],
+                          categorical_feature_sizes=sizes,
+                          prefetch_depth=2)
+    assert len(ds) == 4
+    num, cat_out, click = ds[0]
+    np.testing.assert_allclose(num, numerical[:64].astype(np.float32),
+                               rtol=1e-3)
+    for c, ref in zip(cat_out, cats):
+      np.testing.assert_array_equal(c, ref[:64])
+    np.testing.assert_array_equal(click[:, 0], labels[:64])
+
+  def test_dp_slicing(self, dataset_dir):
+    path, labels, numerical, cats, sizes = dataset_dir
+    # worker 1 of 4: offset 16, local batch 16
+    ds = RawBinaryDataset(path, batch_size=64, numerical_features=4,
+                          categorical_features=[0, 1, 2],
+                          categorical_feature_sizes=sizes,
+                          offset=16, lbs=16, dp_input=True,
+                          prefetch_depth=0)
+    num, cat_out, click = ds[1]
+    np.testing.assert_allclose(num, numerical[64 + 16:64 + 32], rtol=1e-3)
+    np.testing.assert_array_equal(cat_out[0], cats[0][64 + 16:64 + 32])
+
+  def test_mp_reads_only_selected_tables(self, dataset_dir):
+    path, labels, numerical, cats, sizes = dataset_dir
+    ds = RawBinaryDataset(path, batch_size=64, numerical_features=4,
+                          categorical_features=[2],
+                          categorical_feature_sizes=sizes,
+                          prefetch_depth=0)
+    _, cat_out, _ = ds[0]
+    assert len(cat_out) == 1
+    np.testing.assert_array_equal(cat_out[0], cats[2][:64])
+
+  def test_size_mismatch_raises(self, dataset_dir, tmp_path):
+    path, labels, numerical, cats, sizes = dataset_dir
+    # truncate one categorical file
+    with open(f'{path}/train/cat_0.bin', 'r+b') as f:
+      f.truncate(10)
+    with pytest.raises(ValueError, match='Size mismatch'):
+      RawBinaryDataset(path, batch_size=64, numerical_features=4,
+                       categorical_features=[0],
+                       categorical_feature_sizes=sizes)
