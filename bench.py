@@ -26,6 +26,11 @@ def main():
                       help='power-law exponent for ids (0=uniform)')
   parser.add_argument('--param_dtype', default='float32',
                       choices=['float32', 'bfloat16'])
+  parser.add_argument('--trainer', default='sparse',
+                      choices=['sparse', 'dense'],
+                      help='sparse = O(nnz) row-wise embedding updates '
+                      '(parallel/sparse.py, matching the reference '
+                      'IndexedSlices path); dense = autodiff + optax')
   args = parser.parse_args()
 
   import jax
@@ -35,8 +40,11 @@ def main():
                                                            InputGenerator,
                                                            SyntheticModel)
   from distributed_embeddings_tpu.models.dlrm import bce_with_logits
-  from distributed_embeddings_tpu.parallel import (create_mesh,
+  from distributed_embeddings_tpu.parallel import (SparseAdagrad, TrainState,
+                                                   create_mesh,
+                                                   init_hybrid_train_state,
                                                    init_train_state,
+                                                   make_hybrid_train_step,
                                                    make_train_step)
 
   # published 1-GPU (A100) step times, ms (synthetic_models/README.md:69-75)
@@ -58,20 +66,35 @@ def main():
     logits = model.apply(p, numerical, list(cats))
     return bce_with_logits(logits, labels)
 
-  optimizer = optax.adagrad(0.01)
-  state = init_train_state(params, optimizer)
+  def head_loss_fn(dense_params, emb_outs, batch):
+    numerical, labels = batch
+    logits = model.head(dense_params, numerical, emb_outs)
+    return bce_with_logits(logits, labels)
+
+  # keras Adagrad defaults (reference synthetic_models/main.py:105)
+  optimizer = optax.adagrad(0.01, initial_accumulator_value=0.1, eps=1e-7)
+  emb_opt = SparseAdagrad(learning_rate=0.01)
+  if args.trainer == 'sparse':
+    state = init_hybrid_train_state(model.dist_embedding, params, optimizer,
+                                    emb_opt)
+    raw_step = make_hybrid_train_step(model.dist_embedding, head_loss_fn,
+                                      optimizer, emb_opt, jit=False)
+  else:
+    state = init_train_state(params, optimizer)
 
   # Steps run under one jitted lax.scan so remote-dispatch overhead is
   # amortised; batches cycle through the generated pool as scan xs (distinct
   # per step, so nothing hoists out of the loop).
   def make_scan(n_steps):
     def body(state, batch):
+      if args.trainer == 'sparse':
+        (numerical, cats), labels = batch
+        return raw_step(state, list(cats), (numerical, labels))
       loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
       updates, opt_state = optimizer.update(grads, state.opt_state,
                                             state.params)
       new_params = jax.tree.map(lambda p, u: p + u.astype(p.dtype),
                                 state.params, updates)
-      from distributed_embeddings_tpu.parallel import TrainState
       return TrainState(new_params, opt_state, state.step + 1), loss
 
     def run(state, xs):

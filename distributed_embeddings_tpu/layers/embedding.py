@@ -152,6 +152,6 @@ class ConcatOneHotEmbedding:
           f'Expected [batch, {len(self.feature_sizes)}] input, '
           f'got {inputs.shape}')
     offset_ids = inputs + jnp.asarray(self._offsets[:-1], inputs.dtype)
-    return jnp.take(params, offset_ids, axis=0)
+    return jnp.take(params, offset_ids, axis=0, mode='clip')
 
   __call__ = apply

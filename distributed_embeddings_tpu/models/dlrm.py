@@ -168,14 +168,23 @@ class DLRM:
             categorical) -> jax.Array:
     """Forward to logits ``[batch, 1]`` (reference ``DLRM.call``,
     `examples/dlrm/main.py:91-102`)."""
-    x = self.bottom_mlp.apply(params['bottom_mlp'],
-                              numerical.astype(self.compute_dtype))
     emb_outs = self.dist_embedding.apply(params['embedding'], categorical)
-    emb_outs = [e.astype(self.compute_dtype) for e in emb_outs]
-    out = dot_interact(emb_outs, x)
-    return self.top_mlp.apply(params['top_mlp'], out).astype(jnp.float32)
+    dense = {k: v for k, v in params.items() if k != 'embedding'}
+    return self.head(dense, numerical, emb_outs)
 
   __call__ = apply
+
+  def head(self, dense_params: Dict[str, Any], numerical: jax.Array,
+           emb_outs) -> jax.Array:
+    """Everything downstream of the embeddings (bottom MLP, interaction,
+    top MLP) — the dense half the sparse train step differentiates with
+    ``jax.vjp`` (parallel/sparse.py:make_hybrid_train_step)."""
+    x = self.bottom_mlp.apply(dense_params['bottom_mlp'],
+                              numerical.astype(self.compute_dtype))
+    emb_outs = [e.astype(self.compute_dtype) for e in emb_outs]
+    out = dot_interact(emb_outs, x)
+    return self.top_mlp.apply(dense_params['top_mlp'],
+                              out).astype(jnp.float32)
 
 
 def bce_with_logits(logits: jax.Array, labels: jax.Array) -> jax.Array:

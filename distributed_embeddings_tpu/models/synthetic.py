@@ -277,13 +277,20 @@ class SyntheticModel:
 
   def apply(self, params, numerical: jax.Array, categorical) -> jax.Array:
     outs = self.dist_embedding.apply(params['embedding'], categorical)
-    x = jnp.concatenate([o.astype(self.compute_dtype) for o in outs], axis=1)
+    dense = {k: v for k, v in params.items() if k != 'embedding'}
+    return self.head(dense, numerical, outs)
+
+  __call__ = apply
+
+  def head(self, dense_params, numerical: jax.Array, emb_outs) -> jax.Array:
+    """Dense half (pool interaction + MLP) for the sparse train step
+    (parallel/sparse.py:make_hybrid_train_step)."""
+    x = jnp.concatenate([o.astype(self.compute_dtype) for o in emb_outs],
+                        axis=1)
     if self.config.interact_stride is not None:
       x = _same_avg_pool_1d(x, self.config.interact_stride)
     x = jnp.concatenate([x, numerical.astype(self.compute_dtype)], axis=1)
-    return self.mlp.apply(params['mlp'], x).astype(jnp.float32)
-
-  __call__ = apply
+    return self.mlp.apply(dense_params['mlp'], x).astype(jnp.float32)
 
   def total_table_gib(self) -> float:
     tables, _, _ = expand_tables(self.config)

@@ -87,16 +87,21 @@ def embedding_lookup(param: jax.Array,
   if not jnp.issubdtype(ids.dtype, jnp.integer):
     raise ValueError(f'ids must be integer, got {ids.dtype}')
   if combiner is None:
-    return jnp.take(param, ids, axis=0)
+    return jnp.take(param, ids, axis=0, mode='clip')
   if ids.ndim < 2:
     raise ValueError(
         '1D input with combiner is ambiguous. Please create batch dimension.')
-  gathered = jnp.take(param, ids, axis=0)
+  # -1 ids are hotness padding (the repo-wide dense convention,
+  # RaggedBatch.to_padded_dense) and are masked out; ids past the vocabulary
+  # clip to the last row.
+  mask = ids >= 0
+  gathered = jnp.take(param, jnp.where(mask, ids, 0), axis=0, mode='clip')
   acc = _combine_accum_dtype(param.dtype)
-  if combiner == 'sum':
-    out = jnp.sum(gathered.astype(acc), axis=-2)
-  else:
-    out = jnp.mean(gathered.astype(acc), axis=-2)
+  gathered = jnp.where(mask[..., None], gathered.astype(acc), 0)
+  out = jnp.sum(gathered, axis=-2)
+  if combiner == 'mean':
+    counts = jnp.sum(mask, axis=-1).astype(acc)
+    out = out / jnp.maximum(counts, 1)[..., None]
   return out.astype(param.dtype)
 
 

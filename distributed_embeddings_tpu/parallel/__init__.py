@@ -21,3 +21,12 @@ from distributed_embeddings_tpu.parallel.grad import (broadcast_variables,
                                                       make_train_step,
                                                       init_train_state)
 from distributed_embeddings_tpu.parallel.mesh import create_mesh
+from distributed_embeddings_tpu.parallel.sparse import (
+    SparseSGD,
+    SparseAdagrad,
+    SparseAdam,
+    dedup_rows,
+    make_hybrid_train_step,
+    init_hybrid_train_state,
+    sparse_apply_updates,
+)

@@ -29,7 +29,7 @@ shape static (SURVEY.md §7 "Hard parts" 1-2).
 from __future__ import annotations
 
 import dataclasses
-import functools
+
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import jax
@@ -118,6 +118,9 @@ class DistributedEmbedding:
                              input_table_map=input_table_map,
                              column_slice_threshold=column_slice_threshold)
     self.num_inputs = len(self.plan.input_table_map)
+    # compiled-function cache, keyed by shape signature; lives on the
+    # instance so dropping the layer frees its traced executables
+    self._fn_cache: Dict[Any, Any] = {}
 
 
   # ------------------------------------------------------------------ init
@@ -204,6 +207,22 @@ class DistributedEmbedding:
       List of ``[global_batch, output_dim]`` arrays in input order, batch-
       sharded over the mesh.
     """
+    inputs, batch, hotness = self._prepare_inputs(inputs)
+    if self.dp_input:
+      fwd = self._build_dp_forward(batch, hotness)
+    else:
+      fwd = self._build_mp_forward(batch, hotness)
+    return list(fwd(params, *inputs))
+
+  __call__ = apply
+
+  def _prepare_inputs(self, inputs):
+    """Shared input validation/densification for both forward entry points.
+
+    Returns ``(inputs, global_batch, hotness)`` with ``hotness`` a tuple of
+    per-*input* hotness (dp) or per-input hotness recovered from worker
+    order (mp).
+    """
     inputs = list(inputs)
     if self.dp_input:
       if len(inputs) != self.num_inputs:
@@ -223,8 +242,7 @@ class DistributedEmbedding:
             f'{self.world_size}.')
       hotness = self._input_hotness(inputs)
       self._check_combiner_hotness(hotness)
-      fwd = self._build_dp_forward(batch, tuple(hotness))
-      return list(fwd(params, *inputs))
+      return inputs, batch, tuple(hotness)
 
     # model-parallel input path
     flat_ids = [i for dev in self.plan.input_ids_list for i in dev]
@@ -233,6 +251,9 @@ class DistributedEmbedding:
           f'Expect {len(flat_ids)} worker-order inputs, got {len(inputs)}.')
     inputs = [jnp.asarray(x) for x in inputs]
     batch = inputs[0].shape[0]
+    if any(x.shape[0] != batch for x in inputs):
+      raise ValueError('All input need to have same batchsize. got ' +
+                       str({x.shape[0] for x in inputs}))
     if batch % self.world_size:
       raise ValueError(
           f'Global batchsize {batch} not divisible workers count '
@@ -243,10 +264,7 @@ class DistributedEmbedding:
       hot_by_input.setdefault(wid, h)
     hotness = [hot_by_input.get(i, 1) for i in range(self.num_inputs)]
     self._check_combiner_hotness(hotness)
-    fwd = self._build_mp_forward(batch, tuple(hotness))
-    return list(fwd(params, *inputs))
-
-  __call__ = apply
+    return inputs, batch, tuple(hotness)
 
   def _ragged_cap(self, ragged: RaggedBatch) -> int:
     # densification capacity: average capacity per row, at least 1
@@ -301,9 +319,19 @@ class DistributedEmbedding:
           pieces, axis=-1))
     return tuple(outs)
 
-  @functools.lru_cache(maxsize=32)
-  def _build_dp_forward(self, global_batch: int, hotness: tuple):
-    """Trace-and-cache the shard_map'd dp-input forward for one signature."""
+  def _build_dp_forward(self, global_batch: int, hotness: tuple,
+                        with_residuals: bool = False):
+    """Trace-and-cache the shard_map'd dp-input forward for one signature.
+
+    With ``with_residuals`` the function also returns, per subgroup, the
+    routed fused-space ids ``[D, n_cap, GB, h]`` (sentinel ``rows_cap`` at
+    padding positions) — the residual the sparse backward needs
+    (parallel/sparse.py, the static-shape analog of the reference keeping
+    ids alive for its ``IndexedSlices`` grad, embedding_lookup_ops.py:105-122).
+    """
+    key = ('dp_fwd', global_batch, hotness, with_residuals)
+    if key in self._fn_cache:
+      return self._fn_cache[key]
     D = self.world_size
     local_batch = global_batch // D
     subs = self._subgroups(hotness)
@@ -314,6 +342,7 @@ class DistributedEmbedding:
       # axis_index from closed-over [D, n_cap] arrays.
       me = jax.lax.axis_index(self.axis_name)
       sub_back = []
+      residuals = []
       for sub in subs:
         h = sub.hotness
         # --- canonical send buffer [D, n_cap, B, h]: slot (dev, s) holds
@@ -336,17 +365,22 @@ class DistributedEmbedding:
         # [n_cap, D*B, h]: global batch in source-major order (the
         # reference's [world_size * local] reshape, :405-410)
         ids = recv.transpose(1, 0, 2, 3).reshape(sub.n_cap, global_batch, h)
-        out = _fused_lookup(params[f'group_{sub.gi}'][0], ids,
-                            jnp.asarray(sub.offsets)[me],
-                            jnp.asarray(sub.vocab)[me],
+        rows_cap = self.plan.groups[sub.gi].rows_cap
+        routed = _route_ids(ids, jnp.asarray(sub.offsets)[me],
+                            jnp.asarray(sub.vocab)[me], rows_cap)
+        out = _fused_lookup(params[f'group_{sub.gi}'][0], routed,
                             sub.group.combiner, self.compute_dtype)
+        residuals.append(routed[None])
         # --- mp -> dp all_to_all (reference 'out_mp_to_dp', :434) --------
         back = out.reshape(sub.n_cap, D, local_batch,
                            sub.group.width).transpose(1, 0, 2, 3)
         if D > 1:
           back = jax.lax.all_to_all(back, self.axis_name, 0, 0)
         sub_back.append(back)
-      return self._assemble(subs, sub_back)
+      outs = self._assemble(subs, sub_back)
+      if with_residuals:
+        return outs + tuple(residuals)
+      return outs
 
     in_specs = (
         {f'group_{gi}': P(self.axis_name, None, None)
@@ -355,18 +389,26 @@ class DistributedEmbedding:
         P(self.axis_name) if h == 1 else P(self.axis_name, None)
         for h in hotness)
     out_specs = tuple(P(self.axis_name, None) for _ in range(self.num_inputs))
-    return jax.jit(
+    if with_residuals:
+      out_specs = out_specs + tuple(
+          P(self.axis_name, None, None, None) for _ in subs)
+    fn = jax.jit(
         jax.shard_map(local_fn,
                       mesh=self.mesh,
                       in_specs=in_specs,
                       out_specs=out_specs,
                       check_vma=False))
+    self._fn_cache[key] = fn
+    return fn
 
-  @functools.lru_cache(maxsize=32)
-  def _build_mp_forward(self, global_batch: int, hotness: tuple):
+  def _build_mp_forward(self, global_batch: int, hotness: tuple,
+                        with_residuals: bool = False):
     """Model-parallel-input forward: inputs already live at global batch on
     their owning device (reference ``dp_input=False`` path,
     dist_model_parallel.py:388,411-413): no input all_to_all."""
+    key = ('mp_fwd', global_batch, hotness, with_residuals)
+    if key in self._fn_cache:
+      return self._fn_cache[key]
     D = self.world_size
     local_batch = global_batch // D
     subs = self._subgroups(hotness)
@@ -399,19 +441,29 @@ class DistributedEmbedding:
     def local_fn(params, *canonicals):
       me = jax.lax.axis_index(self.axis_name)
       sub_back = []
+      residuals = []
       for sub, canon in zip(subs, canonicals):
         ids = canon[0]  # [n_cap, GB, h]
-        out = _fused_lookup(params[f'group_{sub.gi}'][0], ids,
-                            jnp.asarray(sub.offsets)[me],
-                            jnp.asarray(sub.vocab)[me],
+        rows_cap = self.plan.groups[sub.gi].rows_cap
+        routed = _route_ids(ids, jnp.asarray(sub.offsets)[me],
+                            jnp.asarray(sub.vocab)[me], rows_cap)
+        out = _fused_lookup(params[f'group_{sub.gi}'][0], routed,
                             sub.group.combiner, self.compute_dtype)
+        residuals.append(routed[None])
         back = out.reshape(sub.n_cap, D, local_batch,
                            sub.group.width).transpose(1, 0, 2, 3)
         if D > 1:
           back = jax.lax.all_to_all(back, self.axis_name, 0, 0)
         sub_back.append(back)
-      return self._assemble(subs, sub_back)
+      outs = self._assemble(subs, sub_back)
+      if with_residuals:
+        return outs + tuple(residuals)
+      return outs
 
+    out_specs = tuple(P(self.axis_name, None) for _ in range(self.num_inputs))
+    if with_residuals:
+      out_specs = out_specs + tuple(
+          P(self.axis_name, None, None, None) for _ in subs)
     sharded = jax.shard_map(
         local_fn,
         mesh=self.mesh,
@@ -419,15 +471,102 @@ class DistributedEmbedding:
             {f'group_{gi}': P(self.axis_name, None, None)
              for gi in range(len(self.plan.groups))},
         ) + tuple(P(self.axis_name, None, None, None) for _ in subs),
-        out_specs=tuple(
-            P(self.axis_name, None) for _ in range(self.num_inputs)),
+        out_specs=out_specs,
         check_vma=False)
 
     def fwd(params, *inputs):
       canonicals = [build_canonical(sub, inputs) for sub in subs]
       return sharded(params, *canonicals)
 
-    return jax.jit(fwd)
+    fn = jax.jit(fwd)
+    self._fn_cache[key] = fn
+    return fn
+
+  # ------------------------------------------------- sparse training hooks
+
+  def forward_with_residuals(self, params, inputs):
+    """Forward that also returns the routed lookup ids, for the sparse
+    (O(nnz)) training path (parallel/sparse.py).
+
+    Returns:
+      ``(outputs, residuals, (global_batch, hotness))``: outputs as in
+      ``apply``; residuals a tuple of per-subgroup fused-space id arrays
+      ``[D, n_cap, GB, h]`` (sharded over the mesh axis) where values
+      ``>= rows_cap`` mark padding; the last element is the forward's shape
+      signature, to be passed to ``backward_to_mp`` /
+      ``sparse_apply_updates``.
+    """
+    inputs, batch, hotness = self._prepare_inputs(inputs)
+    if self.dp_input:
+      fwd = self._build_dp_forward(batch, hotness, with_residuals=True)
+    else:
+      fwd = self._build_mp_forward(batch, hotness, with_residuals=True)
+    flat = fwd(params, *inputs)
+    outs = list(flat[:self.num_inputs])
+    residuals = tuple(flat[self.num_inputs:])
+    return outs, residuals, (batch, hotness)
+
+  def backward_to_mp(self, d_outs, global_batch: int, hotness: tuple):
+    """Transpose output cotangents back to per-subgroup mp-side grads.
+
+    The manual transpose of the forward's output path (mp->dp all_to_all +
+    reorder + column re-concat): what JAX autodiff derives for ``apply``,
+    exposed directly so the sparse path can stop the chain before a dense
+    table-shaped gradient materialises (the reference gets the same effect
+    from Horovod's registered alltoall gradient + ``IndexedSlices``,
+    SURVEY.md §3.2-3.3).
+
+    Args:
+      d_outs: per-input cotangents ``[GB, out_dim_i]`` (batch-sharded).
+      global_batch / hotness: the forward call's signature.
+
+    Returns:
+      Tuple of per-subgroup ``[D, n_cap, GB, w]`` grads, mesh-sharded on
+      axis 0, aligned with ``forward_with_residuals``'s residuals.
+    """
+    bwd = self._build_backward(global_batch, tuple(hotness))
+    return bwd(*d_outs)
+
+  def _build_backward(self, global_batch: int, hotness: tuple):
+    key = ('bwd', global_batch, hotness)
+    if key in self._fn_cache:
+      return self._fn_cache[key]
+    D = self.world_size
+    local_batch = global_batch // D
+    subs = self._subgroups(hotness)
+
+    def local_fn(*d_outs):
+      gsubs = []
+      for sub in subs:
+        w = sub.group.width
+        slots = []
+        for dev in range(D):
+          rs = sub.requests[dev]
+          for s in range(sub.n_cap):
+            if s < len(rs):
+              r = rs[s]
+              slots.append(d_outs[r.input_id][:, r.col_start:r.col_end])
+            else:
+              slots.append(jnp.zeros((local_batch, w), d_outs[0].dtype))
+        # cotangent of the received buffer; all_to_all is self-transpose
+        drecv = jnp.stack(slots).reshape(D, sub.n_cap, local_batch, w)
+        if D > 1:
+          drecv = jax.lax.all_to_all(drecv, self.axis_name, 0, 0)
+        g = drecv.transpose(1, 0, 2, 3).reshape(sub.n_cap, global_batch, w)
+        gsubs.append(g[None])
+      return tuple(gsubs)
+
+    fn = jax.jit(
+        jax.shard_map(
+            local_fn,
+            mesh=self.mesh,
+            in_specs=tuple(
+                P(self.axis_name, None) for _ in range(self.num_inputs)),
+            out_specs=tuple(
+                P(self.axis_name, None, None, None) for _ in subs),
+            check_vma=False))
+    self._fn_cache[key] = fn
+    return fn
 
 
 @dataclasses.dataclass
@@ -442,28 +581,40 @@ class _SubGroup:
   vocab: np.ndarray    # [D, n_cap] per-slot vocabulary sizes
 
 
-def _fused_lookup(table: jax.Array, ids: jax.Array, offsets: jax.Array,
-                  vocab: jax.Array, combiner: Optional[str],
-                  compute_dtype) -> jax.Array:
-  """Lookup+combine all slots of one subgroup on one device.
+def _route_ids(ids: jax.Array, offsets: jax.Array, vocab: jax.Array,
+               rows_cap: int) -> jax.Array:
+  """Map raw slot ids into fused-table row space.
 
-  ``table``: [rows_cap, w] fused local table; ``ids``: [n_cap, GB, h]
-  with -1 sentinel padding; ``offsets``/``vocab``: [n_cap] per-slot fused row
-  offsets and vocabulary sizes.  XLA-fallback equivalent of the reference
-  CUDA fused kernel (SURVEY.md C2); sees the same data layout the Pallas
-  kernel consumes (ops/pallas_lookup.py).
+  ``ids``: [n_cap, GB, h] with -1 sentinel padding; ``offsets``/``vocab``:
+  [n_cap] per-slot fused row offsets and vocabulary sizes.  Ids are clipped
+  inside the slot's own table segment so bad ids can't read a neighbouring
+  fused table's rows; padding positions map to ``rows_cap`` (one past the
+  fused table), which both the lookup and the sparse scatter drop.
   """
   mask = ids >= 0
-  # clip inside the slot's own table segment so bad ids can't read a
-  # neighbouring fused table's rows
   clipped = jnp.clip(ids, 0, vocab[:, None, None] - 1)
-  fused = jnp.where(mask, clipped + offsets[:, None, None], 0)
-  rows = jnp.take(table, fused, axis=0)  # [n_cap, GB, h, w]
+  return jnp.where(mask, clipped + offsets[:, None, None], rows_cap)
+
+
+def _fused_lookup(table: jax.Array, routed: jax.Array,
+                  combiner: Optional[str], compute_dtype) -> jax.Array:
+  """Lookup+combine all slots of one subgroup on one device.
+
+  ``table``: [rows_cap, w] fused local table; ``routed``: [n_cap, GB, h]
+  fused row ids from ``_route_ids`` (``>= rows_cap`` marks padding).
+  XLA-fallback equivalent of the reference CUDA fused kernel (SURVEY.md C2);
+  sees the same data layout the Pallas kernel consumes
+  (ops/pallas_lookup.py).
+  """
+  rows_cap = table.shape[0]
+  mask = routed < rows_cap
+  safe = jnp.where(mask, routed, 0)
+  rows = jnp.take(table, safe, axis=0)  # [n_cap, GB, h, w]
   acc = jnp.float32 if table.dtype in (jnp.bfloat16, jnp.float16) \
       else table.dtype
   rows = rows.astype(acc)
   if combiner is None:
-    out = rows[:, :, 0, :]
+    out = jnp.where(mask[:, :, 0, None], rows[:, :, 0, :], 0)
   else:
     rows = jnp.where(mask[..., None], rows, 0)
     out = jnp.sum(rows, axis=2)
