@@ -21,7 +21,11 @@ def main():
   parser.add_argument('--model', default='tiny')
   parser.add_argument('--batch_size', type=int, default=65536)
   parser.add_argument('--steps', type=int, default=20)
-  parser.add_argument('--warmup', type=int, default=4)
+  parser.add_argument('--warmup', type=int, default=4,
+                      help='requested warmup steps; the harness always runs '
+                      'ceil(max(warmup,1)/steps) >= 1 untimed rounds of the '
+                      'timed scan program (one round minimum, to compile '
+                      'it), so effective warmup is that many x --steps')
   parser.add_argument('--alpha', type=float, default=1.05,
                       help='power-law exponent for ids (0=uniform)')
   parser.add_argument('--param_dtype', default='float32',
@@ -111,12 +115,15 @@ def main():
     labels = jnp.stack([jnp.asarray(p[1]) for p in picks])
     return ((num, cats), labels)
 
-  warm = make_scan(args.warmup)
-  state, losses = warm(state, stack_batches(args.warmup))
-  float(losses[-1])  # force full sync (block_until_ready is unreliable here)
-
+  # Warm up the *same* compiled scan that gets timed (a different scan
+  # length would be a different program and push compilation into the
+  # timed region).
   run = make_scan(args.steps)
   xs = stack_batches(args.steps)
+  for _ in range(max(1, -(-args.warmup // args.steps))):
+    state, losses = run(state, xs)
+  float(losses[-1])  # force full sync (block_until_ready is unreliable here)
+
   start = time.perf_counter()
   state, losses = run(state, xs)
   float(losses[-1])

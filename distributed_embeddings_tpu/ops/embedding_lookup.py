@@ -3,9 +3,11 @@
 TPU-native re-design of the reference dispatcher
 (`/root/reference/distributed_embeddings/python/ops/embedding_lookup_ops.py:37-102`).
 The reference routes between `tf.nn.embedding_lookup` and a custom CUDA op;
-here every path lowers to XLA gather / segment-sum (static shapes, fusible),
-with an optional Pallas fused kernel for the CSR hot path
-(`ops/pallas_lookup.py`).  The reference's ``ReadVariableNoCopy``
+here every path lowers to XLA gather / segment-sum (static shapes, fusible).
+The distributed runtime's dense-padded hot path has a Pallas fused kernel
+(`ops/pallas_lookup.py`); this single-table CSR path stays on XLA, whose
+fused gather+segment-sum handles dynamic per-row ranges well.  The
+reference's ``ReadVariableNoCopy``
 (`cc/kernels/embedding_lookup_kernels.cc:28-45`) has no TPU equivalent by
 design: JAX arrays are immutable, so copy-on-read never happens
 (SURVEY.md §2.2 item 4, intentionally dropped).
@@ -118,8 +120,9 @@ def _ragged_combine(param: jax.Array, ids: RaggedBatch,
   ``EmbeddingLookUpVariableHot`` (`embedding_lookup_kernels.cu:175-336`,
   SURVEY.md C2): instead of per-sample cooperative tiles, rows are gathered
   ``[nnz_cap, width]`` and segment-summed into ``[batch, width]``; XLA fuses
-  the mask/scale elementwise work into the gather.  The Pallas kernel in
-  ``ops/pallas_lookup.py`` implements the single-pass version.
+  the mask/scale elementwise work into the gather.  The distributed runtime
+  dispatches to the Pallas single-pass kernel (``ops/pallas_lookup.py``)
+  for its dense-padded hot path.
   """
   acc = _combine_accum_dtype(param.dtype)
   nrows = ids.nrows

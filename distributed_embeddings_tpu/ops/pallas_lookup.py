@@ -1,0 +1,247 @@
+"""Pallas TPU kernel: fused gather-accumulate embedding lookup.
+
+TPU-native re-design of the reference's fused CUDA forward kernels
+``EmbeddingLookUpVariableHot[Wide]``
+(`/root/reference/distributed_embeddings/cc/kernels/embedding_lookup_kernels.cu:175-336`,
+SURVEY.md C2): one pass over the id stream, embedding rows streamed
+HBM->VMEM by a multi-buffered DMA pipeline and accumulated into a
+per-batch-tile VMEM accumulator, so the combined ``[batch, width]`` output
+is the only thing written back to HBM.  The XLA fallback
+(`parallel/dist_embedding.py:_fused_lookup`) instead materialises the
+``[positions, width]`` gather before reducing; this kernel removes that
+intermediate round-trip.
+
+The kernel consumes the *dense padded layout* the distributed runtime
+routes through its all-to-alls: ``ids[M, h]`` with out-of-range sentinel
+padding (``-1`` or ``>= vocab``), one output row per input row.  Per grid
+step, one ``[tile_m, h]`` id block lands in SMEM (a few KB — SMEM-safe by
+construction; scalar control flow reads ids from there to steer the DMA
+queue), while the table stays in HBM and is touched one row per position.
+Where the CUDA version picks among 11 width-template instantiations and a
+tile heuristic (`embedding_lookup_kernels.cu:383-401`), the analogous knobs
+here are ``tile_m`` (output rows per grid step, shrunk for very hot inputs
+to bound the SMEM block) and ``NBUF`` (DMA pipeline depth); the width
+dimension maps directly onto VPU lanes.
+
+The static-CSR ``RaggedBatch`` path of ``ops/embedding_lookup`` keeps the
+XLA gather+segment-sum lowering: its per-row position ranges are dynamic,
+which fits XLA's fused scatter pipeline better than a Pallas grid; the
+distributed runtime densifies to fixed hotness before routing anyway
+(`ops/ragged.py:RaggedBatch.to_padded_dense`).
+
+Backward: gradient w.r.t. the table is a scatter-add of (scaled) output
+cotangent rows — expressed with XLA ``segment_sum`` (shape-static analog of
+the reference's sort->unique->reduce CUDA pipeline, SURVEY.md C3).  The
+sparse O(nnz) training path (`parallel/sparse.py`) bypasses table autodiff
+entirely, so the custom VJP here only serves the dense/optax path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Pipeline depth of the HBM->VMEM row DMA queue.  Eight in-flight row
+# fetches cover typical HBM latency; raising it costs VMEM (NBUF rows).
+NBUF = 8
+# Default output rows per grid step (accumulator block height).
+TILE_M = 128
+# Cap on ids per grid step: bounds the SMEM id block (4 bytes each).
+_MAX_IDS_PER_TILE = 4096
+
+
+def _tile_m_for(h: int) -> int:
+  """Output-tile height: TILE_M, shrunk when hotness is large so the SMEM
+  id block stays at most _MAX_IDS_PER_TILE ids.  ``supported`` rejects
+  hotness beyond _MAX_IDS_PER_TILE, so this is always >= 1."""
+  return max(1, min(TILE_M, _MAX_IDS_PER_TILE // max(h, 1)))
+
+
+def _dense_lookup_kernel(ids_ref, table_ref, out_ref, rowbuf, acc, sems, *,
+                         num_rows, tile_m, h, out_dtype):
+  """One output tile: stream its tile_m*h ids, DMA-pipeline table rows,
+  accumulate position k into output row k // h."""
+  n = tile_m * h
+  acc[:] = jnp.zeros_like(acc)
+
+  def dma(k, slot):
+    rid = jnp.clip(ids_ref[k], 0, num_rows - 1)
+    return pltpu.make_async_copy(table_ref.at[pl.ds(rid, 1), :],
+                                 rowbuf.at[slot], sems.at[slot])
+
+  for slot in range(min(NBUF, n)):
+    dma(slot, slot).start()
+
+  def body(k, _):
+    slot = jax.lax.rem(k, NBUF)
+    dma(k, slot).wait()
+    valid = (ids_ref[k] >= 0) & (ids_ref[k] < num_rows)
+    r = k // h
+
+    @pl.when(valid)
+    def _():
+      acc[pl.ds(r, 1), :] += rowbuf[slot].astype(jnp.float32)
+
+    nxt = k + NBUF
+
+    @pl.when(nxt < n)
+    def _():
+      dma(nxt, slot).start()
+
+    return 0
+
+  jax.lax.fori_loop(0, n, body, 0)
+  out_ref[:] = acc[:].astype(out_dtype)
+
+
+@functools.partial(jax.jit, static_argnames=('interpret',))
+def _dense_lookup_sum(table: jax.Array, ids: jax.Array,
+                      interpret: bool = False) -> jax.Array:
+  """Sum-combine ``table[ids[m, :]]`` -> ``[M, width]`` f32; invalid ids
+  (negative or >= vocab) contribute nothing.  ``M`` must be a multiple of
+  the tile height ``_tile_m_for(h)``."""
+  num_rows, width = table.shape
+  m, h = ids.shape
+  tile_m = _tile_m_for(h)
+  if width % 128 != 0:
+    raise ValueError(f'width must be a multiple of 128, got {width}')
+  if m % tile_m != 0:
+    raise ValueError(f'M ({m}) must be a multiple of tile_m ({tile_m})')
+
+  kernel = functools.partial(_dense_lookup_kernel,
+                             num_rows=num_rows,
+                             tile_m=tile_m,
+                             h=h,
+                             out_dtype=jnp.float32)
+  return pl.pallas_call(
+      kernel,
+      grid=(m // tile_m,),
+      in_specs=[
+          pl.BlockSpec((tile_m * h,), lambda t: (t,),
+                       memory_space=pltpu.SMEM),
+          pl.BlockSpec(memory_space=pl.ANY),
+      ],
+      out_specs=pl.BlockSpec((tile_m, width), lambda t: (t, 0),
+                             memory_space=pltpu.VMEM),
+      scratch_shapes=[
+          pltpu.VMEM((NBUF, 1, width), table.dtype),
+          pltpu.VMEM((tile_m, width), jnp.float32),
+          pltpu.SemaphoreType.DMA((NBUF,)),
+      ],
+      out_shape=jax.ShapeDtypeStruct((m, width), jnp.float32),
+      compiler_params=pltpu.CompilerParams(
+          dimension_semantics=('arbitrary',)),
+      interpret=interpret,
+  )(ids.reshape(-1).astype(jnp.int32), table)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _dense_lookup_vjp(table, ids, interpret):
+  return _dense_lookup_sum(table, ids, interpret=interpret)
+
+
+def _dl_fwd(table, ids, interpret):
+  return _dense_lookup_sum(table, ids, interpret=interpret), (table, ids)
+
+
+def _dl_bwd(interpret, res, g):
+  """d(table) = scatter-add of cotangent rows at the looked-up ids.
+
+  Shape-static XLA segment-sum; the analog of the reference backward
+  (`embedding_lookup_kernels.cu:463-635`) without the dynamic
+  ``num_unique`` output (SURVEY.md §2.2 item 2).
+  """
+  del interpret
+  table, ids = res
+  vocab = table.shape[0]
+  m, h = ids.shape
+  grows = jnp.repeat(g, h, axis=0)  # position k gets cotangent of row k//h
+  flat = ids.reshape(-1)
+  valid = (flat >= 0) & (flat < vocab)
+  seg = jnp.where(valid, flat, vocab)
+  dtable = jax.ops.segment_sum(
+      jnp.where(valid[:, None], grows, 0), seg,
+      num_segments=vocab + 1)[:-1]
+  return (dtable.astype(table.dtype), None)
+
+
+_dense_lookup_vjp.defvjp(_dl_fwd, _dl_bwd)
+
+
+def supported(table: jax.Array, combiner: Optional[str],
+              hotness: int = 1) -> bool:
+  """Whether the Pallas path applies (else callers use the XLA fallback).
+
+  ``combiner=None`` qualifies only at hotness 1, where pass-through equals
+  a sum over one element.
+  """
+  if combiner is None and hotness != 1:
+    return False
+  if hotness > _MAX_IDS_PER_TILE:  # SMEM id block would exceed its budget
+    return False
+  return (combiner in (None, 'sum', 'mean') and table.ndim == 2 and
+          table.shape[1] % 128 == 0 and
+          table.dtype in (jnp.float32, jnp.bfloat16))
+
+
+def dense_lookup(table: jax.Array,
+                 ids: jax.Array,
+                 combiner: Optional[str],
+                 out_dtype=None,
+                 interpret: bool = False) -> jax.Array:
+  """Fused lookup+combine over the dense padded layout.
+
+  Args:
+    table: ``[vocab, width]`` (``width % 128 == 0``, f32/bf16).
+    ids: ``[M, h]`` int; ids outside ``[0, vocab)`` are padding.
+    combiner: 'sum' | 'mean' | None (None requires ``h == 1``).
+    out_dtype: output dtype (default ``table.dtype``).
+    interpret: run the Pallas interpreter (CPU tests).
+
+  Returns:
+    ``[M, width]`` combined embeddings; rows with no valid id are zero.
+  """
+  if not supported(table, combiner, ids.shape[1]):
+    raise ValueError(
+        f'pallas dense_lookup unsupported: width {table.shape[1]}, '
+        f'dtype {table.dtype}, combiner {combiner}, hotness {ids.shape[1]}')
+  out_dtype = out_dtype or table.dtype
+  m, h = ids.shape
+  tile_m = _tile_m_for(h)
+  m_pad = -(-m // tile_m) * tile_m
+  if m_pad != m:
+    ids = jnp.pad(ids, ((0, m_pad - m), (0, 0)), constant_values=-1)
+  out = _dense_lookup_vjp(table, ids, interpret)[:m]
+  if combiner == 'mean':
+    counts = jnp.sum((ids[:m] >= 0) & (ids[:m] < table.shape[0]),
+                     axis=1).astype(jnp.float32)
+    out = out / jnp.maximum(counts, 1)[:, None]
+  return out.astype(out_dtype)
+
+
+def fused_lookup(table: jax.Array,
+                 routed: jax.Array,
+                 combiner: Optional[str],
+                 compute_dtype,
+                 interpret: bool = False) -> jax.Array:
+  """Pallas drop-in for the runtime's ``_fused_lookup`` hot path.
+
+  ``table``: ``[rows_cap, w]`` fused local table; ``routed``:
+  ``[n_cap, GB, h]`` fused row ids (``>= rows_cap`` marks padding, see
+  `parallel/dist_embedding.py:_route_ids`).  Returns ``[n_cap, GB, w]``.
+  """
+  n_cap, gb, h = routed.shape
+  if combiner is None and h != 1:
+    # _fused_lookup's combiner=None contract is hotness-1 pass-through
+    # (parallel/dist_embedding.py:_check_combiner_hotness); summing h>1
+    # rows here would silently diverge from it.
+    raise ValueError(f'combiner=None requires hotness 1, got {h}')
+  out = dense_lookup(table, routed.reshape(n_cap * gb, h),
+                     'sum' if combiner is None else combiner,
+                     out_dtype=compute_dtype, interpret=interpret)
+  return out.reshape(n_cap, gb, -1)

@@ -98,9 +98,13 @@ class DistributedEmbedding:
                mesh: Optional[Mesh] = None,
                axis_name: str = mesh_lib.DEFAULT_AXIS,
                param_dtype: Any = jnp.float32,
-               compute_dtype: Any = None):
+               compute_dtype: Any = None,
+               lookup_impl: str = 'auto'):
     if row_slice is not None:
       raise NotImplementedError('Row slicing embedding is not supported yet!')
+    if lookup_impl not in ('auto', 'xla', 'pallas'):
+      raise ValueError(f'Unknown lookup_impl {lookup_impl!r}')
+    self.lookup_impl = lookup_impl
     self.mesh = mesh if mesh is not None else mesh_lib.create_mesh(
         axis_name=axis_name)
     self.axis_name = axis_name
@@ -121,6 +125,32 @@ class DistributedEmbedding:
     # compiled-function cache, keyed by shape signature; lives on the
     # instance so dropping the layer frees its traced executables
     self._fn_cache: Dict[Any, Any] = {}
+
+  def _lookup(self, table: jax.Array, routed: jax.Array,
+              combiner: Optional[str]) -> jax.Array:
+    """Fused lookup+combine for one subgroup, XLA or Pallas.
+
+    'auto' takes the Pallas single-pass kernel (ops/pallas_lookup.py, the
+    analog of the reference CUDA hot path, SURVEY.md C2) on TPU backends
+    when the shape/dtype qualify, else the XLA gather+segment-sum
+    fallback — mirroring the reference's own native-op vs tf.nn dispatch
+    (embedding_lookup_ops.py:67-102).
+    """
+    from distributed_embeddings_tpu.ops import pallas_lookup
+    impl = self.lookup_impl
+    hotness = routed.shape[2]
+    ok = pallas_lookup.supported(table, combiner, hotness)
+    if impl == 'auto':
+      on_tpu = jax.default_backend() == 'tpu'
+      impl = 'pallas' if on_tpu and ok else 'xla'
+    if impl == 'pallas':
+      if not ok:
+        raise ValueError(
+            f'lookup_impl=pallas unsupported for width {table.shape[1]} '
+            f'dtype {table.dtype} combiner {combiner} hotness {hotness}')
+      return pallas_lookup.fused_lookup(table, routed, combiner,
+                                        self.compute_dtype)
+    return _fused_lookup(table, routed, combiner, self.compute_dtype)
 
 
   # ------------------------------------------------------------------ init
@@ -368,8 +398,8 @@ class DistributedEmbedding:
         rows_cap = self.plan.groups[sub.gi].rows_cap
         routed = _route_ids(ids, jnp.asarray(sub.offsets)[me],
                             jnp.asarray(sub.vocab)[me], rows_cap)
-        out = _fused_lookup(params[f'group_{sub.gi}'][0], routed,
-                            sub.group.combiner, self.compute_dtype)
+        out = self._lookup(params[f'group_{sub.gi}'][0], routed,
+                           sub.group.combiner)
         residuals.append(routed[None])
         # --- mp -> dp all_to_all (reference 'out_mp_to_dp', :434) --------
         back = out.reshape(sub.n_cap, D, local_batch,
@@ -447,8 +477,8 @@ class DistributedEmbedding:
         rows_cap = self.plan.groups[sub.gi].rows_cap
         routed = _route_ids(ids, jnp.asarray(sub.offsets)[me],
                             jnp.asarray(sub.vocab)[me], rows_cap)
-        out = _fused_lookup(params[f'group_{sub.gi}'][0], routed,
-                            sub.group.combiner, self.compute_dtype)
+        out = self._lookup(params[f'group_{sub.gi}'][0], routed,
+                           sub.group.combiner)
         residuals.append(routed[None])
         back = out.reshape(sub.n_cap, D, local_batch,
                            sub.group.width).transpose(1, 0, 2, 3)
