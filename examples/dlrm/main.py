@@ -55,8 +55,9 @@ def main():
   from distributed_embeddings_tpu.parallel import (create_mesh, get_weights,
                                                    init_train_state,
                                                    make_train_step, save_npz)
-  from distributed_embeddings_tpu.utils.data import (DummyDataset,
-                                                     RawBinaryDataset)
+  from distributed_embeddings_tpu.utils.data import DummyDataset
+  from distributed_embeddings_tpu.utils.fastloader import (
+      open_raw_binary_dataset)
   from distributed_embeddings_tpu.utils.metrics import StreamingAUC
   from distributed_embeddings_tpu.utils.schedules import warmup_poly_decay_schedule
 
@@ -99,8 +100,8 @@ def main():
                   offset=0,
                   lbs=args.batch_size,
                   dp_input=args.dp_input)
-    train_dataset = RawBinaryDataset(**common)
-    eval_dataset = RawBinaryDataset(valid=True, **common)
+    train_dataset = open_raw_binary_dataset(**common)
+    eval_dataset = open_raw_binary_dataset(valid=True, **common)
   else:
     train_dataset = DummyDataset(args.batch_size,
                                  args.num_numerical_features,

@@ -1,0 +1,112 @@
+"""Native C++ raw-binary loader vs the pure-Python reference loader.
+
+Oracle pattern (SURVEY.md §4): the optimized native path must return
+byte-identical batches to ``RawBinaryDataset`` across slicing modes,
+splits, short final batches, and access orders.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_embeddings_tpu.utils import fastloader
+from distributed_embeddings_tpu.utils.data import (RawBinaryDataset,
+                                                   write_raw_binary_dataset)
+
+SIZES = [100, 40000, 3]  # int8, int16, int8 dtypes
+N_ROWS = 333
+BATCH = 64  # 333 = 5*64 + 13 -> short final batch
+
+
+@pytest.fixture(scope='module')
+def dataset_dir(tmp_path_factory):
+  root = tmp_path_factory.mktemp('raw_binary')
+  rng = np.random.default_rng(0)
+  for split, n in [('train', N_ROWS), ('test', 130)]:
+    labels = rng.integers(0, 2, size=(n,)).astype(bool)
+    numerical = rng.normal(size=(n, 13)).astype(np.float16)
+    cats = [rng.integers(0, s, size=(n,)) for s in SIZES]
+    write_raw_binary_dataset(str(root), split, labels, numerical, cats, SIZES)
+  return str(root)
+
+
+@pytest.fixture(scope='module')
+def built():
+  if not fastloader.available() and not fastloader.build():
+    pytest.skip('native fastloader build failed')
+  return True
+
+
+def _kwargs(**over):
+  kw = dict(batch_size=BATCH,
+            numerical_features=13,
+            categorical_features=[0, 1, 2],
+            categorical_feature_sizes=SIZES,
+            prefetch_depth=4)
+  kw.update(over)
+  return kw
+
+
+def _assert_batches_equal(got, want):
+  gn, gc, gl = got
+  wn, wc, wl = want
+  np.testing.assert_array_equal(np.asarray(gl), np.asarray(wl))
+  if wn is None:
+    assert gn is None or gn.size == 0
+  else:
+    np.testing.assert_allclose(gn, wn, rtol=0, atol=0)
+  if wc is None:
+    assert gc is None
+  else:
+    assert len(gc) == len(wc)
+    for g, w in zip(gc, wc):
+      np.testing.assert_array_equal(g, np.asarray(w))
+
+
+@pytest.mark.parametrize('mode', ['plain', 'dp_slice', 'mp_slice', 'valid',
+                                  'drop_last'])
+def test_matches_python_loader(dataset_dir, built, mode):
+  over = {}
+  if mode == 'dp_slice':
+    over = dict(offset=16, lbs=16, dp_input=True)
+  elif mode == 'mp_slice':
+    over = dict(offset=32, lbs=16, dp_input=False)
+  elif mode == 'valid':
+    over = dict(valid=True, offset=16, lbs=16, dp_input=True)
+  elif mode == 'drop_last':
+    over = dict(drop_last_batch=True)
+  ref = RawBinaryDataset(dataset_dir, **_kwargs(**over))
+  fast = fastloader.FastRawBinaryDataset(dataset_dir, **_kwargs(**over))
+  assert len(fast) == len(ref)
+  for i in range(len(ref)):
+    _assert_batches_equal(fast[i], ref[i])
+
+
+def test_random_access(dataset_dir, built):
+  ref = RawBinaryDataset(dataset_dir, **_kwargs(prefetch_depth=1))
+  fast = fastloader.FastRawBinaryDataset(dataset_dir, **_kwargs())
+  for i in [3, 0, 5, 2, 2]:
+    _assert_batches_equal(fast[i], ref[i])
+
+
+def test_no_numerical_no_cats(dataset_dir, built):
+  kw = _kwargs(numerical_features=0, categorical_features=[],
+               categorical_feature_sizes=[])
+  ref = RawBinaryDataset(dataset_dir, **kw)
+  fast = fastloader.FastRawBinaryDataset(dataset_dir, **kw)
+  for i in range(len(ref)):
+    _assert_batches_equal(fast[i], ref[i])
+
+
+def test_factory_fallback(dataset_dir, built):
+  ds = fastloader.open_raw_binary_dataset(dataset_dir, **_kwargs())
+  assert isinstance(ds, fastloader.FastRawBinaryDataset)
+  ds2 = fastloader.open_raw_binary_dataset(dataset_dir, native='never',
+                                           **_kwargs())
+  assert isinstance(ds2, RawBinaryDataset)
+  _assert_batches_equal(ds[0], ds2[0])
+
+
+def test_index_error(dataset_dir, built):
+  fast = fastloader.FastRawBinaryDataset(dataset_dir, **_kwargs())
+  with pytest.raises(IndexError):
+    fast[len(fast)]
