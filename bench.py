@@ -1,24 +1,101 @@
-"""Benchmark: synthetic 'tiny' model train-step time vs the reference's
-published 1xA100 number.
+"""Benchmark: synthetic-model train-step time vs the reference's published
+DGX-A100 numbers.
 
-Reference baseline: Tiny V3 (55 tables, 4.2 GiB), global batch 65536,
-Adagrad — 24.433 ms/step on one A100
-(`/root/reference/examples/benchmarks/synthetic_models/README.md:71`,
-BASELINE.md).  This script runs the same model/batch/optimizer on the
-available TPU device(s) and prints one JSON line; ``vs_baseline`` > 1 means
-faster than the baseline.
+Reference baselines (`/root/reference/examples/benchmarks/synthetic_models/
+README.md:69-75`, BASELINE.md): step time in ms at global batch 65536 with
+Adagrad, per device count.  This script runs the same model/batch/optimizer
+on the available TPU device(s) and prints ONE JSON line; ``vs_baseline`` > 1
+means faster than the baseline at the nearest published device count.
+
+Robustness contract (VERDICT.md round 1): the script always prints a valid
+JSON line, even when the backend is unavailable — backend init is retried
+with backoff, and any failure is reported structurally instead of a
+traceback, so the driver's artifact never ends up unparseable.
 """
 
 import argparse
 import json
 import time
+import traceback
 
 import numpy as np
+
+# Published step times, ms, by model -> device count
+# (synthetic_models/README.md:69-75).
+BASELINES_MS = {
+    'tiny': {1: 24.433, 8: 5.537, 16: 4.867},
+    'small': {1: 67.355, 8: 17.203, 16: 12.461, 32: 11.839},
+    'medium': {8: 63.393, 16: 46.636, 32: 37.732, 128: 27.329},
+    'large': {32: 67.57, 128: 37.934},
+    'jumbo': {128: 124.3},
+    'colossal': {},
+    'criteo': {},
+}
+
+
+def pick_baseline(model: str, n_devices: int):
+  """Baseline at this device count; otherwise round UP to the smallest
+  published count >= ours (more devices = faster baseline = harder target,
+  so vs_baseline is never overstated), falling back to the largest published
+  count when we exceed them all."""
+  table = BASELINES_MS.get(model, {})
+  if not table:
+    return None, None
+  if n_devices in table:
+    return table[n_devices], n_devices
+  at_least = [n for n in table if n >= n_devices]
+  n = min(at_least) if at_least else max(table)
+  return table[n], n
+
+
+def init_backend(max_tries: int = 2, delay_s: float = 15.0,
+                 probe_timeout_s: float = 180.0):
+  """Initialise a JAX backend; fall back to CPU so a perf artifact (clearly
+  labelled) always exists.
+
+  A downed TPU tunnel makes ``jax.devices()`` HANG rather than raise
+  (observed round 1/2), so availability is probed in a subprocess with a
+  hard timeout before the in-process backend is touched.  The CPU fallback
+  uses the ``jax.config`` platform knob — the env var alone does not stop
+  the tunnel plugin from grabbing the backend (tests/conftest.py).
+  """
+  import os
+  import subprocess
+  import sys
+  if os.environ.get('DET_BENCH_FORCE_CPU'):
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    return jax, jax.devices(), 'DET_BENCH_FORCE_CPU set'
+  last = None
+  for attempt in range(max_tries):
+    try:
+      probe = subprocess.run(
+          [sys.executable, '-c',
+           'import jax; d = jax.devices(); print(d[0].platform, len(d))'],
+          capture_output=True, text=True, timeout=probe_timeout_s)
+      if probe.returncode == 0:
+        import jax
+        return jax, jax.devices(), None
+      last = RuntimeError(probe.stderr.strip().splitlines()[-1]
+                          if probe.stderr.strip() else
+                          f'probe rc={probe.returncode}')
+    except subprocess.TimeoutExpired:
+      last = RuntimeError(f'backend probe hung > {probe_timeout_s}s '
+                          '(TPU tunnel unreachable)')
+    if attempt + 1 < max_tries:
+      time.sleep(delay_s * (attempt + 1))
+  import jax
+  jax.config.update('jax_platforms', 'cpu')
+  return jax, jax.devices(), f'backend unavailable, fell back to CPU: {last}'
+
+
+def emit(result):
+  print(json.dumps(result))
 
 
 def main():
   parser = argparse.ArgumentParser()
-  parser.add_argument('--model', default='tiny')
+  parser.add_argument('--model', default='tiny', choices=sorted(BASELINES_MS))
   parser.add_argument('--batch_size', type=int, default=65536)
   parser.add_argument('--steps', type=int, default=20)
   parser.add_argument('--warmup', type=int, default=4,
@@ -30,6 +107,9 @@ def main():
                       help='power-law exponent for ids (0=uniform)')
   parser.add_argument('--param_dtype', default='float32',
                       choices=['float32', 'bfloat16'])
+  parser.add_argument('--compute_dtype', default=None,
+                      choices=['float32', 'bfloat16'],
+                      help='activation dtype (default: param_dtype)')
   parser.add_argument('--trainer', default='sparse',
                       choices=['sparse', 'dense'],
                       help='sparse = O(nnz) row-wise embedding updates '
@@ -37,7 +117,22 @@ def main():
                       'IndexedSlices path); dense = autodiff + optax')
   args = parser.parse_args()
 
-  import jax
+  jax, devices, backend_note = init_backend()
+  on_cpu = devices[0].platform == 'cpu'
+  if on_cpu:
+    # A CPU step time means nothing against an A100 baseline; shrink the
+    # workload so the artifact at least exists and runs fast, and refuse
+    # models whose tables (plus optimizer accumulators) would OOM host RAM.
+    args.batch_size = min(args.batch_size, 4096)
+    if args.model not in ('tiny', 'criteo'):
+      emit({
+          'metric': (f'synthetic-{args.model} skipped: tables too large for '
+                     'the CPU-fallback host'),
+          'value': None,
+          'unit': 'ms/step',
+          'vs_baseline': None,
+      })
+      return
   import jax.numpy as jnp
   import optax
   from distributed_embeddings_tpu.models.synthetic import (SYNTHETIC_MODELS,
@@ -48,18 +143,16 @@ def main():
                                                    create_mesh,
                                                    init_hybrid_train_state,
                                                    init_train_state,
-                                                   make_hybrid_train_step,
-                                                   make_train_step)
+                                                   make_hybrid_train_step)
 
-  # published 1-GPU (A100) step times, ms (synthetic_models/README.md:69-75)
-  baselines_1gpu_ms = {'tiny': 24.433, 'small': 67.355}
-
-  mesh = create_mesh()
+  mesh = create_mesh(devices)
   config = SYNTHETIC_MODELS[args.model]
+  compute_dtype = jnp.dtype(args.compute_dtype or args.param_dtype)
   model = SyntheticModel(config,
                          mesh=mesh,
                          dp_input=True,
-                         param_dtype=jnp.dtype(args.param_dtype))
+                         param_dtype=jnp.dtype(args.param_dtype),
+                         compute_dtype=compute_dtype)
   params = model.init(0)
 
   gen = InputGenerator(config, args.batch_size, alpha=args.alpha,
@@ -130,18 +223,34 @@ def main():
   elapsed = time.perf_counter() - start
 
   step_ms = elapsed / args.steps * 1000
-  n_dev = len(jax.devices())
-  baseline = baselines_1gpu_ms.get(args.model)
-  result = {
-      'metric': (f'synthetic-{args.model} train step time, global batch '
-                 f'{args.batch_size}, Adagrad, {n_dev} TPU chip(s) '
-                 f'(baseline: 1xA100 {baseline} ms)'),
+  n_dev = len(devices)
+  backend = devices[0].platform
+  baseline, baseline_ndev = pick_baseline(args.model, n_dev)
+  metric = (f'synthetic-{args.model} train step time, global batch '
+            f'{args.batch_size}, Adagrad, {n_dev} {backend} chip(s)')
+  if baseline is not None:
+    metric += f' (baseline: {baseline_ndev}xA100 {baseline} ms)'
+  if backend_note:
+    metric += f' [{backend_note}]'
+  emit({
+      'metric': metric,
       'value': round(step_ms, 3),
       'unit': 'ms/step',
-      'vs_baseline': round(baseline / step_ms, 4) if baseline else None,
-  }
-  print(json.dumps(result))
+      'vs_baseline': (round(baseline / step_ms, 4)
+                      if baseline and not on_cpu else None),
+  })
 
 
 if __name__ == '__main__':
-  main()
+  try:
+    main()
+  except Exception as e:
+    emit({
+        'metric': 'benchmark failed',
+        'value': None,
+        'unit': 'ms/step',
+        'vs_baseline': None,
+        'error': f'{type(e).__name__}: {e}',
+        'trace_tail': traceback.format_exc()[-1500:],
+    })
+    raise SystemExit(0)
