@@ -13,4 +13,4 @@ Top-level API parity with the reference package
 from distributed_embeddings_tpu.ops.embedding_lookup import embedding_lookup
 from distributed_embeddings_tpu.ops.ragged import RaggedBatch, SparseIds, row_to_split
 
-__version__ = '0.1.0'
+__version__ = '0.2.0'

@@ -1,0 +1,1 @@
+"""Native (C++) components: the raw-binary fastloader (see fastloader.cc)."""

@@ -90,6 +90,10 @@ struct Loader {
   std::condition_variable cv_ready, cv_space;
   std::deque<DecodedBatch> ring;
   int64_t next_to_read = 0;   // next idx the worker will decode
+  // Bumped on every ring.clear() (random seek); the worker drops results
+  // claimed under an older generation so a stale in-flight batch can never
+  // land after the clear and break the ring's monotonic order.
+  int64_t generation = 0;
   std::atomic<bool> stop{false};
 
   ~Loader() {
@@ -105,16 +109,20 @@ struct Loader {
     return idx == num_batches - 1 ? last_batch_rows : batch_size;
   }
 
+  // Strict: every request must be fully in-bounds (file sizes are
+  // cross-validated at open, and the final batch's row count already
+  // accounts for the short tail), so a short read means truncation or
+  // mismatch and is an error rather than silent zero-fill.
   bool ReadRaw(int fd, void* dst, int64_t bytes, int64_t off) const {
     auto* p = static_cast<uint8_t*>(dst);
     int64_t got = 0;
     while (got < bytes) {
       ssize_t n = pread(fd, p + got, bytes - got, off + got);
       if (n < 0) return false;
-      if (n == 0) break;  // short final batch
+      if (n == 0) break;
       got += n;
     }
-    return true;
+    return got == bytes;
   }
 
   bool Decode(int64_t idx, DecodedBatch* out) {
@@ -192,7 +200,7 @@ struct Loader {
 
   void WorkerLoop() {
     while (!stop.load()) {
-      int64_t idx;
+      int64_t idx, gen;
       {
         std::unique_lock<std::mutex> lk(mu);
         cv_space.wait(lk, [&] {
@@ -202,11 +210,13 @@ struct Loader {
         if (stop.load()) return;
         if (next_to_read >= num_batches) continue;
         idx = next_to_read++;
+        gen = generation;
       }
       DecodedBatch b;
       bool ok = Decode(idx, &b);
       {
         std::lock_guard<std::mutex> lk(mu);
+        if (gen != generation) continue;  // seek cleared the ring meanwhile
         if (!ok) b.idx = -2;  // error marker
         ring.push_back(std::move(b));
       }
@@ -248,9 +258,18 @@ void* det_loader_open(const char* dir, int64_t batch_size,
       drop_last ? entries / batch_size : (entries + batch_size - 1) / batch_size;
   ld->last_batch_rows = drop_last ? batch_size
                                   : entries - (ld->num_batches - 1) * batch_size;
+  // Cross-validate stream sizes against label.bin's row count (mirrors the
+  // Python loader's "Size mismatch in data files" check; without it a
+  // truncated or mismatched file would only surface as a failed read — or,
+  // before ReadRaw became strict, as silent zero-filled batches).
   if (num_numerical > 0) {
     ld->numerical_fd = open((base + "/numerical.bin").c_str(), O_RDONLY);
     if (ld->numerical_fd < 0) {
+      delete ld;
+      return nullptr;
+    }
+    if (fstat(ld->numerical_fd, &st) != 0 ||
+        st.st_size != entries * (int64_t)num_numerical * 2) {
       delete ld;
       return nullptr;
     }
@@ -264,6 +283,11 @@ void* det_loader_open(const char* dir, int64_t batch_size,
     }
     ld->cat_fds.push_back(fd);
     ld->cat_itemsize.push_back(cat_itemsizes[c]);
+    if (fstat(fd, &st) != 0 ||
+        st.st_size != entries * (int64_t)cat_itemsizes[c]) {
+      delete ld;
+      return nullptr;
+    }
   }
   ld->prefetch_depth = prefetch_depth;
   if (prefetch_depth > 1) ld->worker = std::thread(&Loader::WorkerLoop, ld);
@@ -306,7 +330,13 @@ int det_loader_get(void* h, int64_t idx, float* labels_out,
         ld->ring.pop_front();
       if (!ld->ring.empty() &&
           (ld->ring.front().idx == idx || ld->ring.front().idx == -2)) {
-        if (ld->ring.front().idx == -2) return 2;
+        if (ld->ring.front().idx == -2) {
+          // consume the error marker so later batches (which may decode
+          // fine, or retry via the inline path) are reachable again
+          ld->ring.pop_front();
+          ld->cv_space.notify_all();
+          return 2;
+        }
         local = std::move(ld->ring.front());
         ld->ring.pop_front();
         b = &local;
@@ -315,6 +345,7 @@ int det_loader_get(void* h, int64_t idx, float* labels_out,
     } else if (idx >= ld->next_to_read || ld->ring.empty()) {
       // random seek: restart read-ahead at idx+1, decode idx inline
       ld->ring.clear();
+      ++ld->generation;
       ld->next_to_read = idx + 1;
       ld->cv_space.notify_all();
     }

@@ -1,37 +1,43 @@
-"""Datasets: dummy benchmark data and the raw-binary Criteo loader.
+"""Datasets: dummy benchmark data and the split-binary Criteo reader.
 
-Port of the reference data utilities
-(`/root/reference/examples/dlrm/utils.py:126-307`): ``DummyDataset`` for
-benchmarking and ``RawBinaryDataset``, a ``pread``-based loader over the
-split Criteo binary format (``label.bin`` bool, ``numerical.bin`` fp16,
-``cat_<i>.bin`` int8/16/32 chosen per vocabulary size) with a thread-pool
-prefetch queue.  Arrays come back as NumPy; the training loop feeds them to
-`jax.device_put` with the right shardings.
+The on-disk format is the reference's (`/root/reference/examples/dlrm/
+utils.py:157-307` defines it: ``label.bin`` bool, ``numerical.bin`` fp16,
+``cat_<i>.bin`` int8/16/32 chosen per vocabulary size) — the format is the
+compatibility contract, the reader is not.  ``BinaryCriteoReader`` is built
+as the Python twin of the native loader (cc/fastloader.cc): each backing
+file is a ``_Stream`` with its own dtype/row-shape/slice rule, batches are
+assembled by one ``_decode`` walking the streams, and read-ahead is a
+bounded ring filled by a single background thread (``_ReadAhead``), with
+random access falling back to an inline decode.  Arrays come back as NumPy;
+the training loop feeds them to ``jax.device_put`` with the right
+shardings.
 
-A C++ fast path for batch assembly lives in ``utils/fastloader`` (same file
-format, used automatically when built).
+The native loader (``utils/fastloader``) is the primary path — same
+format, same ring, batch assembly in C++; ``open_raw_binary_dataset``
+prefers it automatically and this reader is the portable fallback and the
+test oracle.
 """
 
 from __future__ import annotations
 
-import concurrent.futures
-import math
+import dataclasses
 import os
-import queue
-from typing import List, Optional, Sequence, Tuple
+import threading
+import weakref
+from collections import deque
+from typing import Callable, Optional, Sequence
 
 import numpy as np
 
 
-def get_categorical_feature_type(size: int):
-  """Smallest int dtype holding ``size`` categories (reference
-  `examples/dlrm/utils.py:116-123`)."""
-  types = (np.int8, np.int16, np.int32)
-  for numpy_type in types:
-    if size < np.iinfo(numpy_type).max:
-      return numpy_type
+def smallest_int_dtype(num_categories: int):
+  """Smallest signed integer dtype that can index ``num_categories``
+  (the format stores each cat_<i>.bin at this width)."""
+  for candidate in (np.int8, np.int16, np.int32):
+    if num_categories < np.iinfo(candidate).max:
+      return candidate
   raise RuntimeError(
-      f'Categorical feature of size {size} is too big for defined types')
+      f'no integer dtype for a vocabulary of {num_categories}')
 
 
 class DummyDataset:
@@ -66,25 +72,150 @@ class DummyDataset:
       yield self[i]
 
 
-class RawBinaryDataset:
-  """Split-binary Criteo dataset reader (reference ``RawBinaryDataset``,
-  `examples/dlrm/utils.py:157-307`).
+@dataclasses.dataclass
+class _Stream:
+  """One backing file of the split format: where it lives, how a row is
+  encoded, and whether the reader's data-parallel window applies to it."""
+  fd: int
+  disk_dtype: np.dtype
+  row_elems: int
+  windowed: bool
+
+  @property
+  def row_bytes(self) -> int:
+    return self.disk_dtype.itemsize * self.row_elems
+
+  def file_rows(self) -> int:
+    return os.fstat(self.fd).st_size // self.row_bytes
+
+  def read_rows(self, row0: int, nrows: int) -> np.ndarray:
+    raw = os.pread(self.fd, nrows * self.row_bytes, row0 * self.row_bytes)
+    if len(raw) != nrows * self.row_bytes:
+      raise IOError(
+          f'short read: wanted rows [{row0}, {row0 + nrows}) '
+          f'({nrows * self.row_bytes} bytes), got {len(raw)} bytes')
+    return np.frombuffer(raw, dtype=self.disk_dtype)
+
+  def close(self):
+    if self.fd >= 0:
+      try:
+        os.close(self.fd)
+      except OSError:
+        pass
+      self.fd = -1
+
+
+class _ReadAhead:
+  """Bounded ring of decoded batches filled by one background thread —
+  the Python twin of the native loader's prefetch ring (fastloader.cc).
+
+  ``take(idx)`` returns the batch when ``idx`` is (or soon will be) in the
+  ring; returns None when the caller should decode inline (random access
+  behind the ring, or a forward seek — which restarts read-ahead after
+  ``idx``).  A generation counter keeps a stale in-flight decode from
+  landing after a seek cleared the ring.  A decode error lands in the ring
+  in the batch's place and re-raises in the consumer (the C++ twin's -2
+  marker).  The decode method is held weakly so a running thread never
+  keeps its reader (and the reader's file descriptors) alive.
+  """
+
+  def __init__(self, decode: Callable[[int], object], num_batches: int,
+               depth: int):
+    self._decode = weakref.WeakMethod(decode)
+    self._num_batches = num_batches
+    self._depth = depth
+    self._lock = threading.Lock()
+    self._ready = threading.Condition(self._lock)
+    self._space = threading.Condition(self._lock)
+    self._ring: deque = deque()  # (idx, batch), idx strictly increasing
+    self._claim_next = 0         # next index the worker claims
+    self._consumed_upto = 0      # batches below this were taken/skipped
+    self._generation = 0
+    self._stop = False
+    self._thread = threading.Thread(target=self._fill, daemon=True)
+    self._thread.start()
+
+  def _fill(self):
+    while True:
+      with self._lock:
+        while not self._stop and (len(self._ring) >= self._depth or
+                                  self._claim_next >= self._num_batches):
+          self._space.wait()
+        if self._stop:
+          return
+        idx = self._claim_next
+        gen = self._generation
+        self._claim_next += 1
+      decode = self._decode()
+      if decode is None:
+        return  # reader was collected
+      try:
+        batch = decode(idx)
+      except Exception as e:  # surfaced to the consumer by take()
+        batch = e
+      del decode
+      with self._lock:
+        if gen == self._generation:
+          self._ring.append((idx, batch))
+          self._ready.notify_all()
+
+  def take(self, idx: int):
+    with self._lock:
+      if idx < self._consumed_upto:
+        return None  # behind the ring: inline
+      if idx >= self._claim_next:
+        # forward seek: restart read-ahead just past idx, decode it inline
+        self._ring.clear()
+        self._generation += 1
+        self._claim_next = idx + 1
+        self._consumed_upto = idx + 1
+        self._space.notify_all()
+        return None
+      # idx is decoded or in flight: wait for it, dropping skipped batches
+      while True:
+        while self._ring and self._ring[0][0] < idx:
+          self._ring.popleft()
+          self._space.notify_all()
+        if self._ring and self._ring[0][0] == idx:
+          batch = self._ring.popleft()[1]
+          self._consumed_upto = idx + 1
+          self._space.notify_all()
+          if isinstance(batch, Exception):
+            raise batch
+          return batch
+        self._ready.wait()
+
+  def shutdown(self):
+    with self._lock:
+      self._stop = True
+      self._space.notify_all()
+    # GC can drop the reader's last reference inside the fill thread (its
+    # weakref-derived strong ref), running __del__->shutdown there
+    if threading.current_thread() is not self._thread:
+      self._thread.join(timeout=5)
+
+
+class BinaryCriteoReader:
+  """Reader over the split Criteo binary format.
+
+  Item contract (shared with the native ``FastBinaryCriteoReader``): index
+  ``i`` yields ``(numerical [rows, F] f32 | None, [cat [rows] int32, ...]
+  | None, labels [rows, 1] f32)``.
 
   Args:
-    data_path: directory containing ``train/``/``test`` subdirs with
-      ``label.bin``, ``numerical.bin`` and ``cat_<i>.bin``.
-    batch_size: global batch size (one file batch).
-    numerical_features: how many dense features to read (0 = skip file).
+    data_path: directory containing ``train/`` / ``test/`` subdirs.
+    batch_size: global batch size (rows per stored batch).
+    numerical_features: dense feature count (0 skips the file).
     categorical_features: feature ids this worker reads (model-parallel
-      input reads only the local tables' files,
-      reference `examples/dlrm/main.py:162-176`).
-    categorical_feature_sizes: global vocab sizes (defines file dtypes).
-    prefetch_depth: read-ahead depth on the background thread.
+      input reads only the local tables' files).
+    categorical_feature_sizes: global vocab sizes (fix the file dtypes).
+    prefetch_depth: read-ahead ring depth (<=1 disables the thread).
     drop_last_batch: drop the trailing partial batch.
-    valid: read the test split.
-    offset/lbs: data-parallel slice ``[offset : offset+lbs]`` applied to
-      labels/numerical (and categoricals when ``dp_input``).
-    dp_input: slice categorical features per worker too.
+    valid: read the test split (labels stay whole there — every worker
+      evaluates the full batch).
+    offset/lbs: this worker's data-parallel window ``[offset,
+      offset+lbs)`` within each batch; -1 reads whole batches.
+    dp_input: apply the window to categorical features too.
   """
 
   def __init__(self,
@@ -99,131 +230,104 @@ class RawBinaryDataset:
                offset: int = -1,
                lbs: int = -1,
                dp_input: bool = False):
-    suffix = 'test' if valid else 'train'
-    data_path = os.path.join(data_path, suffix)
-    self._label_bytes_per_batch = np.dtype(np.bool_).itemsize * batch_size
-    self._numerical_bytes_per_batch = (
-        numerical_features * np.dtype(np.float16).itemsize * batch_size)
-    self._numerical_features = numerical_features
-    self._batch_size = batch_size
+    split_dir = os.path.join(data_path, 'test' if valid else 'train')
+    self._bs = batch_size
+    self._window = (offset, lbs)
 
-    self._categorical_feature_types = [
-        get_categorical_feature_type(size)
-        for size in (categorical_feature_sizes or [])
+    def open_stream(name, dtype, row_elems, windowed):
+      fd = os.open(os.path.join(split_dir, name), os.O_RDONLY)
+      return _Stream(fd, np.dtype(dtype), row_elems, windowed)
+
+    self._label = open_stream('label.bin', np.bool_, 1,
+                              windowed=not valid)
+    self._dense = (open_stream('numerical.bin', np.float16,
+                               numerical_features, windowed=True)
+                   if numerical_features > 0 else None)
+    sizes = list(categorical_feature_sizes or [])
+    self._cat_ids = list(categorical_features or [])
+    self._cats = [
+        open_stream(f'cat_{cid}.bin', smallest_int_dtype(sizes[cid]), 1,
+                    windowed=dp_input) for cid in self._cat_ids
     ]
-    self._categorical_bytes_per_batch = [
-        np.dtype(t).itemsize * batch_size
-        for t in self._categorical_feature_types
-    ]
-    self._categorical_features = list(categorical_features or [])
 
-    self._label_file = os.open(os.path.join(data_path, 'label.bin'),
-                               os.O_RDONLY)
-    rounder = math.floor if drop_last_batch else math.ceil
-    self._num_entries = int(
-        rounder(os.fstat(self._label_file).st_size /
-                self._label_bytes_per_batch))
-
-    if numerical_features > 0:
-      self._numerical_features_file = os.open(
-          os.path.join(data_path, 'numerical.bin'), os.O_RDONLY)
-      batches = int(
-          rounder(os.fstat(self._numerical_features_file).st_size /
-                  self._numerical_bytes_per_batch))
-      if batches != self._num_entries:
-        raise ValueError(f'Size mismatch in data files. Expected: '
-                         f'{self._num_entries}, got: {batches}')
+    total_rows = self._label.file_rows()
+    if drop_last_batch:
+      self._num_batches = total_rows // batch_size
+      self._tail_rows = batch_size
     else:
-      self._numerical_features_file = None
+      self._num_batches = -(-total_rows // batch_size)
+      self._tail_rows = total_rows - (self._num_batches - 1) * batch_size
+    for stream, name in ([(self._dense, 'numerical.bin')] if self._dense
+                         else []) + [(s, f'cat_{cid}.bin') for s, cid
+                                     in zip(self._cats, self._cat_ids)]:
+      if stream.file_rows() != total_rows:
+        raise ValueError(
+            f'stream {name} holds {stream.file_rows()} rows but label.bin '
+            f'implies {total_rows}')
 
-    self._categorical_features_files = []
-    for cat_id in self._categorical_features:
-      cat_file = os.open(os.path.join(data_path, f'cat_{cat_id}.bin'),
-                         os.O_RDONLY)
-      cat_bytes = self._categorical_bytes_per_batch[cat_id]
-      batches = int(rounder(os.fstat(cat_file).st_size / cat_bytes))
-      if batches != self._num_entries:
-        raise ValueError(f'Size mismatch in data files. Expected: '
-                         f'{self._num_entries}, got: {batches}')
-      self._categorical_features_files.append(cat_file)
-
-    self._prefetch_depth = min(prefetch_depth, self._num_entries)
-    self._prefetch_queue = queue.Queue()
-    self._executor = concurrent.futures.ThreadPoolExecutor(max_workers=1)
-    self.offset = offset
-    self.lbs = lbs
-    self.valid = valid
-    self.dp_input = dp_input
+    self._readahead = (_ReadAhead(self._decode, self._num_batches,
+                                  min(prefetch_depth, self._num_batches))
+                       if prefetch_depth > 1 and self._num_batches > 0
+                       else None)
 
   def __len__(self):
-    return self._num_entries
+    return self._num_batches
+
+  def _rows_of(self, idx: int) -> int:
+    return self._tail_rows if idx == self._num_batches - 1 else self._bs
+
+  def _span(self, idx: int, stream: _Stream):
+    """(first_row, nrows) of this batch within the stream's file."""
+    rows = self._rows_of(idx)
+    row0 = idx * self._bs
+    offset, lbs = self._window
+    if offset >= 0 and stream.windowed:
+      lo = min(offset, rows)
+      return row0 + lo, max(0, min(lbs, rows - lo))
+    return row0, rows
+
+  def _decode(self, idx: int):
+    row0, n = self._span(idx, self._label)
+    labels = self._label.read_rows(row0, n).astype(np.float32)[:, None]
+    numerical = None
+    if self._dense is not None:
+      row0, n = self._span(idx, self._dense)
+      numerical = self._dense.read_rows(row0, n).astype(np.float32).reshape(
+          n, self._dense.row_elems)
+    cats = None
+    if self._cats:
+      cats = []
+      for stream in self._cats:
+        row0, n = self._span(idx, stream)
+        cats.append(stream.read_rows(row0, n).astype(np.int32))
+    return numerical, cats, labels
 
   def __getitem__(self, idx: int):
-    if idx >= self._num_entries:
+    if idx >= self._num_batches:
       raise IndexError()
-    if self._prefetch_depth <= 1:
-      return self._get_item(idx)
-    if idx == 0:
-      for i in range(self._prefetch_depth):
-        self._prefetch_queue.put(self._executor.submit(self._get_item, i))
-    if idx < self._num_entries - self._prefetch_depth:
-      self._prefetch_queue.put(
-          self._executor.submit(self._get_item, idx + self._prefetch_depth))
-    return self._prefetch_queue.get().result()
+    if self._readahead is not None:
+      batch = self._readahead.take(idx)
+      if batch is not None:
+        return batch
+    return self._decode(idx)
 
   def __iter__(self):
     for i in range(len(self)):
       yield self[i]
 
-  def _get_item(self, idx: int):
-    click = self._get_label(idx)
-    numerical_features = self._get_numerical_features(idx)
-    categorical_features = self._get_categorical_features(idx)
-    if self.offset >= 0:
-      sl = slice(self.offset, self.offset + self.lbs)
-      if not self.valid:
-        click = click[sl]
-      if numerical_features is not None:
-        numerical_features = numerical_features[sl]
-      if self.dp_input and categorical_features is not None:
-        categorical_features = [f[sl] for f in categorical_features]
-    return numerical_features, categorical_features, click
-
-  def _get_label(self, idx: int) -> np.ndarray:
-    raw = os.pread(self._label_file, self._label_bytes_per_batch,
-                   idx * self._label_bytes_per_batch)
-    return np.frombuffer(raw, dtype=np.bool_).astype(np.float32)[:, None]
-
-  def _get_numerical_features(self, idx: int) -> Optional[np.ndarray]:
-    if self._numerical_features_file is None:
-      return None
-    raw = os.pread(self._numerical_features_file,
-                   self._numerical_bytes_per_batch,
-                   idx * self._numerical_bytes_per_batch)
-    array = np.frombuffer(raw, dtype=np.float16)
-    return array.reshape(-1, self._numerical_features).astype(np.float32)
-
-  def _get_categorical_features(self, idx: int) -> Optional[List[np.ndarray]]:
-    if not self._categorical_features_files:
-      return None
-    features = []
-    for cat_id, cat_file in zip(self._categorical_features,
-                                self._categorical_features_files):
-      cat_bytes = self._categorical_bytes_per_batch[cat_id]
-      cat_type = self._categorical_feature_types[cat_id]
-      raw = os.pread(cat_file, cat_bytes, idx * cat_bytes)
-      features.append(np.frombuffer(raw, dtype=cat_type).astype(np.int32))
-    return features
+  def close(self):
+    """Stop read-ahead and release file descriptors (idempotent)."""
+    if getattr(self, '_readahead', None) is not None:
+      self._readahead.shutdown()
+      self._readahead = None
+    for stream in [getattr(self, '_label', None),
+                   getattr(self, '_dense', None)] + list(
+                       getattr(self, '_cats', [])):
+      if stream is not None:
+        stream.close()
 
   def __del__(self):
-    data_files = [self._label_file, self._numerical_features_file]
-    data_files += self._categorical_features_files or []
-    for f in data_files:
-      if f is not None:
-        try:
-          os.close(f)
-        except OSError:
-          pass
+    self.close()
 
 
 def write_raw_binary_dataset(data_path: str, split: str,
@@ -231,7 +335,7 @@ def write_raw_binary_dataset(data_path: str, split: str,
                              numerical: Optional[np.ndarray],
                              categoricals: Sequence[np.ndarray],
                              categorical_feature_sizes: Sequence[int]):
-  """Write the split-binary format (inverse of ``RawBinaryDataset``; the
+  """Write the split-binary format (inverse of ``BinaryCriteoReader``; the
   reference ships no writer — used for tests and synthetic data prep)."""
   out = os.path.join(data_path, split)
   os.makedirs(out, exist_ok=True)
@@ -241,5 +345,5 @@ def write_raw_binary_dataset(data_path: str, split: str,
         os.path.join(out, 'numerical.bin'))
   for i, (cat, size) in enumerate(zip(categoricals,
                                       categorical_feature_sizes)):
-    np.asarray(cat, get_categorical_feature_type(size)).tofile(
+    np.asarray(cat, smallest_int_dtype(size)).tofile(
         os.path.join(out, f'cat_{i}.bin'))

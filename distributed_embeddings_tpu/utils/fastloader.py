@@ -3,7 +3,7 @@
 The native library implements the reference loader's file format and
 prefetch semantics (`/root/reference/examples/dlrm/utils.py:157-307`) with
 batch decode (pread + dtype widening + DP slice) in C++ on a background
-thread.  ``FastRawBinaryDataset`` mirrors ``RawBinaryDataset``'s interface;
+thread.  ``FastBinaryCriteoReader`` mirrors ``BinaryCriteoReader``'s interface;
 ``open_raw_binary_dataset`` picks the native path when the library is
 built (``make -C distributed_embeddings_tpu/cc``) and falls back to the
 pure-Python loader otherwise.
@@ -19,12 +19,13 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from distributed_embeddings_tpu.utils.data import (RawBinaryDataset,
-                                                   get_categorical_feature_type)
+from distributed_embeddings_tpu.utils.data import (BinaryCriteoReader,
+                                                   smallest_int_dtype)
 
 _CC_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), 'cc')
 _SO_PATH = os.path.join(_CC_DIR, 'libdetfastloader.so')
+_CC_SRC = os.path.join(_CC_DIR, 'fastloader.cc')
 
 _lib = None
 
@@ -40,13 +41,30 @@ def build(quiet: bool = True) -> bool:
     return False
 
 
+def _stale() -> bool:
+  """True when the built library predates the source (a stale binary must
+  not silently shadow edited source — ADVICE.md round 1)."""
+  try:
+    return os.path.getmtime(_SO_PATH) < os.path.getmtime(_CC_SRC)
+  except OSError:
+    return True
+
+
 def _load():
   global _lib
   if _lib is not None:
     return _lib
-  if not os.path.exists(_SO_PATH):
+  if not os.path.exists(_SO_PATH) or _stale():
+    # build on demand (first use, or source newer than the binary); the
+    # toolchain may be absent, in which case a fresh-enough binary is
+    # still usable and anything else falls back to the Python loader
+    if not build() and not os.path.exists(_SO_PATH):
+      return None
+  try:
+    lib = ctypes.CDLL(_SO_PATH)
+  except OSError:
+    # wrong arch/libc for this platform: unavailable, not fatal
     return None
-  lib = ctypes.CDLL(_SO_PATH)
   lib.det_loader_open.restype = ctypes.c_void_p
   lib.det_loader_open.argtypes = [
       ctypes.c_char_p, ctypes.c_int64, ctypes.c_int,
@@ -74,8 +92,8 @@ def available() -> bool:
   return _load() is not None
 
 
-class FastRawBinaryDataset:
-  """Native-backed drop-in for ``RawBinaryDataset`` (same constructor and
+class FastBinaryCriteoReader:
+  """Native-backed drop-in for ``BinaryCriteoReader`` (same constructor and
   item contract: ``(numerical, categoricals, labels)`` per batch)."""
 
   def __init__(self,
@@ -101,7 +119,7 @@ class FastRawBinaryDataset:
     sizes = list(categorical_feature_sizes or [])
     self._cat_ids = list(categorical_features or [])
     itemsizes = [
-        np.dtype(get_categorical_feature_type(sizes[c])).itemsize
+        np.dtype(smallest_int_dtype(sizes[c])).itemsize
         for c in self._cat_ids
     ]
     ids_arr = (ctypes.c_int * max(len(self._cat_ids), 1))(*(
@@ -133,7 +151,7 @@ class FastRawBinaryDataset:
     full = lib.det_loader_rows(h, idx)
     sliced = (full if self._offset < 0 else
               max(0, min(self._lbs, full - self._offset)))
-    # stream-specific slice rules mirror RawBinaryDataset._get_item:
+    # stream-specific slice rules mirror BinaryCriteoReader._span:
     # labels stay whole on the valid split; cats slice only with dp_input
     label_rows = full if (self._valid and self._offset >= 0) else sliced
     cat_rows = sliced if (self._dp_input and self._offset >= 0) else full
@@ -174,9 +192,9 @@ def open_raw_binary_dataset(*args, native: str = 'auto', **kwargs):
   if native != 'never' and (available() or
                             (native == 'require' and build())):
     if available():
-      return FastRawBinaryDataset(*args, **kwargs)
+      return FastBinaryCriteoReader(*args, **kwargs)
     if native == 'require':
       raise RuntimeError('native fastloader unavailable and build failed')
   if native == 'require':
     raise RuntimeError('native fastloader unavailable')
-  return RawBinaryDataset(*args, **kwargs)
+  return BinaryCriteoReader(*args, **kwargs)

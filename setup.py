@@ -1,0 +1,28 @@
+"""Build hook: compile the native fastloader during packaging when a
+toolchain exists (TPU-native analog of the reference's `setup.py:45-60` +
+`build_pip_pkg.sh`, whose .so is produced by `make` before packaging).
+
+The compute path is pure JAX/Pallas, so the wheel works without the binary:
+`utils/fastloader` rebuilds it on demand or falls back to the Python
+loader.  Metadata lives in pyproject.toml.
+"""
+
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNativeLoader(build_py):
+
+  def run(self):
+    try:
+      subprocess.run(['make', '-C', 'distributed_embeddings_tpu/cc'],
+                     check=True)
+    except (OSError, subprocess.CalledProcessError) as e:
+      print(f'native fastloader not built ({e}); the package falls back '
+            'to the pure-Python loader or builds on first use')
+    super().run()
+
+
+setup(cmdclass={'build_py': BuildWithNativeLoader})

@@ -1,7 +1,7 @@
 """Native C++ raw-binary loader vs the pure-Python reference loader.
 
 Oracle pattern (SURVEY.md §4): the optimized native path must return
-byte-identical batches to ``RawBinaryDataset`` across slicing modes,
+byte-identical batches to ``BinaryCriteoReader`` across slicing modes,
 splits, short final batches, and access orders.
 """
 
@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 from distributed_embeddings_tpu.utils import fastloader
-from distributed_embeddings_tpu.utils.data import (RawBinaryDataset,
+from distributed_embeddings_tpu.utils.data import (BinaryCriteoReader,
                                                    write_raw_binary_dataset)
 
 SIZES = [100, 40000, 3]  # int8, int16, int8 dtypes
@@ -74,16 +74,16 @@ def test_matches_python_loader(dataset_dir, built, mode):
     over = dict(valid=True, offset=16, lbs=16, dp_input=True)
   elif mode == 'drop_last':
     over = dict(drop_last_batch=True)
-  ref = RawBinaryDataset(dataset_dir, **_kwargs(**over))
-  fast = fastloader.FastRawBinaryDataset(dataset_dir, **_kwargs(**over))
+  ref = BinaryCriteoReader(dataset_dir, **_kwargs(**over))
+  fast = fastloader.FastBinaryCriteoReader(dataset_dir, **_kwargs(**over))
   assert len(fast) == len(ref)
   for i in range(len(ref)):
     _assert_batches_equal(fast[i], ref[i])
 
 
 def test_random_access(dataset_dir, built):
-  ref = RawBinaryDataset(dataset_dir, **_kwargs(prefetch_depth=1))
-  fast = fastloader.FastRawBinaryDataset(dataset_dir, **_kwargs())
+  ref = BinaryCriteoReader(dataset_dir, **_kwargs(prefetch_depth=1))
+  fast = fastloader.FastBinaryCriteoReader(dataset_dir, **_kwargs())
   for i in [3, 0, 5, 2, 2]:
     _assert_batches_equal(fast[i], ref[i])
 
@@ -91,22 +91,22 @@ def test_random_access(dataset_dir, built):
 def test_no_numerical_no_cats(dataset_dir, built):
   kw = _kwargs(numerical_features=0, categorical_features=[],
                categorical_feature_sizes=[])
-  ref = RawBinaryDataset(dataset_dir, **kw)
-  fast = fastloader.FastRawBinaryDataset(dataset_dir, **kw)
+  ref = BinaryCriteoReader(dataset_dir, **kw)
+  fast = fastloader.FastBinaryCriteoReader(dataset_dir, **kw)
   for i in range(len(ref)):
     _assert_batches_equal(fast[i], ref[i])
 
 
 def test_factory_fallback(dataset_dir, built):
   ds = fastloader.open_raw_binary_dataset(dataset_dir, **_kwargs())
-  assert isinstance(ds, fastloader.FastRawBinaryDataset)
+  assert isinstance(ds, fastloader.FastBinaryCriteoReader)
   ds2 = fastloader.open_raw_binary_dataset(dataset_dir, native='never',
                                            **_kwargs())
-  assert isinstance(ds2, RawBinaryDataset)
+  assert isinstance(ds2, BinaryCriteoReader)
   _assert_batches_equal(ds[0], ds2[0])
 
 
 def test_index_error(dataset_dir, built):
-  fast = fastloader.FastRawBinaryDataset(dataset_dir, **_kwargs())
+  fast = fastloader.FastBinaryCriteoReader(dataset_dir, **_kwargs())
   with pytest.raises(IndexError):
     fast[len(fast)]

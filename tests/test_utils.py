@@ -4,8 +4,8 @@ import numpy as np
 import pytest
 
 from distributed_embeddings_tpu.utils.data import (DummyDataset,
-                                                   RawBinaryDataset,
-                                                   get_categorical_feature_type,
+                                                   BinaryCriteoReader,
+                                                   smallest_int_dtype,
                                                    write_raw_binary_dataset)
 from distributed_embeddings_tpu.utils.metrics import StreamingAUC, exact_auc
 from distributed_embeddings_tpu.utils.schedules import warmup_poly_decay_schedule
@@ -69,13 +69,13 @@ class TestAUC:
 class TestFeatureTypes:
 
   def test_dtype_selection(self):
-    assert get_categorical_feature_type(100) == np.int8
-    assert get_categorical_feature_type(1000) == np.int16
-    assert get_categorical_feature_type(100000) == np.int32
+    assert smallest_int_dtype(100) == np.int8
+    assert smallest_int_dtype(1000) == np.int16
+    assert smallest_int_dtype(100000) == np.int32
 
   def test_too_big_raises(self):
     with pytest.raises(RuntimeError):
-      get_categorical_feature_type(2**40)
+      smallest_int_dtype(2**40)
 
 
 class TestDummyDataset:
@@ -90,7 +90,7 @@ class TestDummyDataset:
     assert len(list(ds)) == 3
 
 
-class TestRawBinaryDataset:
+class TestBinaryCriteoReader:
 
   @pytest.fixture
   def dataset_dir(self, tmp_path):
@@ -106,7 +106,7 @@ class TestRawBinaryDataset:
 
   def test_round_trip(self, dataset_dir):
     path, labels, numerical, cats, sizes = dataset_dir
-    ds = RawBinaryDataset(path, batch_size=64, numerical_features=4,
+    ds = BinaryCriteoReader(path, batch_size=64, numerical_features=4,
                           categorical_features=[0, 1, 2],
                           categorical_feature_sizes=sizes,
                           prefetch_depth=2)
@@ -121,7 +121,7 @@ class TestRawBinaryDataset:
   def test_dp_slicing(self, dataset_dir):
     path, labels, numerical, cats, sizes = dataset_dir
     # worker 1 of 4: offset 16, local batch 16
-    ds = RawBinaryDataset(path, batch_size=64, numerical_features=4,
+    ds = BinaryCriteoReader(path, batch_size=64, numerical_features=4,
                           categorical_features=[0, 1, 2],
                           categorical_feature_sizes=sizes,
                           offset=16, lbs=16, dp_input=True,
@@ -132,7 +132,7 @@ class TestRawBinaryDataset:
 
   def test_mp_reads_only_selected_tables(self, dataset_dir):
     path, labels, numerical, cats, sizes = dataset_dir
-    ds = RawBinaryDataset(path, batch_size=64, numerical_features=4,
+    ds = BinaryCriteoReader(path, batch_size=64, numerical_features=4,
                           categorical_features=[2],
                           categorical_feature_sizes=sizes,
                           prefetch_depth=0)
@@ -145,7 +145,7 @@ class TestRawBinaryDataset:
     # truncate one categorical file
     with open(f'{path}/train/cat_0.bin', 'r+b') as f:
       f.truncate(10)
-    with pytest.raises(ValueError, match='Size mismatch'):
-      RawBinaryDataset(path, batch_size=64, numerical_features=4,
+    with pytest.raises(ValueError, match='label.bin implies'):
+      BinaryCriteoReader(path, batch_size=64, numerical_features=4,
                        categorical_features=[0],
                        categorical_feature_sizes=sizes)
