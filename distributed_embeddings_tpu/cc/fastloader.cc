@@ -61,6 +61,7 @@ inline float HalfToFloat(uint16_t h) {
 
 struct DecodedBatch {
   int64_t idx = -1;
+  bool error = false;  // decode failed; idx says which batch
   std::vector<float> labels;      // [rows]
   std::vector<float> numerical;   // [rows * num_numerical]
   std::vector<int32_t> cats;      // [n_cats * cat_rows]
@@ -213,11 +214,11 @@ struct Loader {
         gen = generation;
       }
       DecodedBatch b;
-      bool ok = Decode(idx, &b);
+      b.error = !Decode(idx, &b);
+      b.idx = idx;  // error or not, the marker names its batch
       {
         std::lock_guard<std::mutex> lk(mu);
         if (gen != generation) continue;  // seek cleared the ring meanwhile
-        if (!ok) b.idx = -2;  // error marker
         ring.push_back(std::move(b));
       }
       cv_ready.notify_all();
@@ -321,18 +322,16 @@ int det_loader_get(void* h, int64_t idx, float* labels_out,
         idx < ld->next_to_read) {
       ld->cv_ready.wait(lk, [&] {
         for (auto& d : ld->ring)
-          if (d.idx == idx || d.idx == -2) return true;
+          if (d.idx == idx) return true;
         return false;
       });
       // drop everything before idx, keep later read-ahead
-      while (!ld->ring.empty() && ld->ring.front().idx != -2 &&
-             ld->ring.front().idx < idx)
+      while (!ld->ring.empty() && ld->ring.front().idx < idx)
         ld->ring.pop_front();
-      if (!ld->ring.empty() &&
-          (ld->ring.front().idx == idx || ld->ring.front().idx == -2)) {
-        if (ld->ring.front().idx == -2) {
-          // consume the error marker so later batches (which may decode
-          // fine, or retry via the inline path) are reachable again
+      if (!ld->ring.empty() && ld->ring.front().idx == idx) {
+        if (ld->ring.front().error) {
+          // consume the marker (its idx is this batch): the failure is
+          // reported once and a retry can go through the inline path
           ld->ring.pop_front();
           ld->cv_space.notify_all();
           return 2;

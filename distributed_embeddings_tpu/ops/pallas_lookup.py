@@ -17,11 +17,18 @@ padding (``-1`` or ``>= vocab``), one output row per input row.  Per grid
 step, one ``[tile_m, h]`` id block lands in SMEM (a few KB — SMEM-safe by
 construction; scalar control flow reads ids from there to steer the DMA
 queue), while the table stays in HBM and is touched one row per position.
-Where the CUDA version picks among 11 width-template instantiations and a
-tile heuristic (`embedding_lookup_kernels.cu:383-401`), the analogous knobs
-here are ``tile_m`` (output rows per grid step, shrunk for very hot inputs
-to bound the SMEM block) and ``NBUF`` (DMA pipeline depth); the width
-dimension maps directly onto VPU lanes.
+
+Width coverage — where the CUDA version picks among 11 width-template
+instantiations and a tile heuristic (`embedding_lookup_kernels.cu:383-461`),
+the TPU analog is *lane packing*: for ``width < 128`` (any divisor of 128:
+1..64), ``pack = 128 // width`` consecutive table rows are viewed as one
+128-lane vector (a free reshape of the row-major HBM array), so every DMA
+still moves a full HBM burst (512B f32) instead of a ``width``-sized sliver;
+the target row is isolated in-register with a lane mask and the packed
+accumulator collapses to ``width`` lanes with ``pack`` static lane-slice
+adds at tile end.  ``width % 128 == 0`` streams whole rows directly.  The
+remaining knobs are ``tile_m`` (output rows per grid step, shrunk for very
+hot inputs to bound the SMEM block) and ``NBUF`` (DMA pipeline depth).
 
 The static-CSR ``RaggedBatch`` path of ``ops/embedding_lookup`` keeps the
 XLA gather+segment-sum lowering: its per-row position ranges are dynamic,
@@ -63,29 +70,43 @@ def _tile_m_for(h: int) -> int:
 
 
 def _dense_lookup_kernel(ids_ref, table_ref, out_ref, rowbuf, acc, sems, *,
-                         num_rows, tile_m, h, out_dtype):
-  """One output tile: stream its tile_m*h ids, DMA-pipeline table rows,
-  accumulate position k into output row k // h."""
+                         num_rows, tile_m, h, width, pack, out_dtype):
+  """One output tile: stream its tile_m*h ids, DMA-pipeline (packed) table
+  rows, accumulate position k into output row k // h.
+
+  With ``pack > 1`` the table ref is the packed view
+  ``[num_rows // pack, pack * width]``; the row for id ``rid`` sits at
+  packed row ``rid // pack``, lane slot ``rid % pack``.
+  """
   n = tile_m * h
+  lanes = pack * width
   acc[:] = jnp.zeros_like(acc)
 
   def dma(k, slot):
-    rid = jnp.clip(ids_ref[k], 0, num_rows - 1)
+    rid = jnp.clip(ids_ref[k], 0, num_rows - 1) // pack
     return pltpu.make_async_copy(table_ref.at[pl.ds(rid, 1), :],
                                  rowbuf.at[slot], sems.at[slot])
 
   for slot in range(min(NBUF, n)):
     dma(slot, slot).start()
 
+  lane_slot = (jax.lax.broadcasted_iota(jnp.int32, (1, lanes), 1) // width
+               if pack > 1 else None)
+
   def body(k, _):
     slot = jax.lax.rem(k, NBUF)
     dma(k, slot).wait()
-    valid = (ids_ref[k] >= 0) & (ids_ref[k] < num_rows)
+    rid = ids_ref[k]
+    valid = (rid >= 0) & (rid < num_rows)
     r = k // h
 
     @pl.when(valid)
     def _():
-      acc[pl.ds(r, 1), :] += rowbuf[slot].astype(jnp.float32)
+      row = rowbuf[slot].astype(jnp.float32)
+      if pack > 1:
+        row = jnp.where(lane_slot == jnp.clip(rid, 0, num_rows - 1) % pack,
+                        row, 0.0)
+      acc[pl.ds(r, 1), :] += row
 
     nxt = k + NBUF
 
@@ -96,7 +117,16 @@ def _dense_lookup_kernel(ids_ref, table_ref, out_ref, rowbuf, acc, sems, *,
     return 0
 
   jax.lax.fori_loop(0, n, body, 0)
-  out_ref[:] = acc[:].astype(out_dtype)
+  if pack > 1:
+    # collapse the pack slots: out = sum_s acc[:, s*width:(s+1)*width]
+    # (static lane slices; only the looked-up slot of each position is
+    # nonzero, so this is exact)
+    folded = acc[:, 0:width]
+    for s in range(1, pack):
+      folded += acc[:, s * width:(s + 1) * width]
+    out_ref[:] = folded.astype(out_dtype)
+  else:
+    out_ref[:] = acc[:].astype(out_dtype)
 
 
 @functools.partial(jax.jit, static_argnames=('interpret',))
@@ -108,15 +138,27 @@ def _dense_lookup_sum(table: jax.Array, ids: jax.Array,
   num_rows, width = table.shape
   m, h = ids.shape
   tile_m = _tile_m_for(h)
-  if width % 128 != 0:
-    raise ValueError(f'width must be a multiple of 128, got {width}')
+  if width % 128 == 0:
+    pack = 1
+  elif 128 % width == 0 and num_rows % (128 // width) == 0:
+    pack = 128 // width
+  else:
+    raise ValueError(f'width must divide 128 or be a multiple of it (with '
+                     f'vocab divisible by the pack factor), got {width} '
+                     f'(vocab {num_rows})')
   if m % tile_m != 0:
     raise ValueError(f'M ({m}) must be a multiple of tile_m ({tile_m})')
+  lanes = pack * width
+  # row-major [vocab, w] -> [vocab/pack, pack*w] is a free view: pack
+  # consecutive rows become one 128-lane vector
+  packed = table.reshape(num_rows // pack, lanes)
 
   kernel = functools.partial(_dense_lookup_kernel,
                              num_rows=num_rows,
                              tile_m=tile_m,
                              h=h,
+                             width=width,
+                             pack=pack,
                              out_dtype=jnp.float32)
   return pl.pallas_call(
       kernel,
@@ -129,15 +171,15 @@ def _dense_lookup_sum(table: jax.Array, ids: jax.Array,
       out_specs=pl.BlockSpec((tile_m, width), lambda t: (t, 0),
                              memory_space=pltpu.VMEM),
       scratch_shapes=[
-          pltpu.VMEM((NBUF, 1, width), table.dtype),
-          pltpu.VMEM((tile_m, width), jnp.float32),
+          pltpu.VMEM((NBUF, 1, lanes), table.dtype),
+          pltpu.VMEM((tile_m, lanes), jnp.float32),
           pltpu.SemaphoreType.DMA((NBUF,)),
       ],
       out_shape=jax.ShapeDtypeStruct((m, width), jnp.float32),
       compiler_params=pltpu.CompilerParams(
           dimension_semantics=('arbitrary',)),
       interpret=interpret,
-  )(ids.reshape(-1).astype(jnp.int32), table)
+  )(ids.reshape(-1).astype(jnp.int32), packed)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -177,6 +219,9 @@ def supported(table: jax.Array, combiner: Optional[str],
               hotness: int = 1) -> bool:
   """Whether the Pallas path applies (else callers use the XLA fallback).
 
+  Widths: any divisor of 128 (1..64, via lane packing; the vocab must be
+  divisible by the pack factor — the planner pads ``rows_cap`` to 128 so
+  the fused runtime path always qualifies) or any multiple of 128.
   ``combiner=None`` qualifies only at hotness 1, where pass-through equals
   a sum over one element.
   """
@@ -184,9 +229,11 @@ def supported(table: jax.Array, combiner: Optional[str],
     return False
   if hotness > _MAX_IDS_PER_TILE:  # SMEM id block would exceed its budget
     return False
-  return (combiner in (None, 'sum', 'mean') and table.ndim == 2 and
-          table.shape[1] % 128 == 0 and
-          table.dtype in (jnp.float32, jnp.bfloat16))
+  if table.ndim != 2 or table.dtype not in (jnp.float32, jnp.bfloat16):
+    return False
+  vocab, w = table.shape
+  width_ok = (w % 128 == 0) or (128 % w == 0 and vocab % (128 // w) == 0)
+  return combiner in (None, 'sum', 'mean') and width_ok
 
 
 def dense_lookup(table: jax.Array,
@@ -197,7 +244,8 @@ def dense_lookup(table: jax.Array,
   """Fused lookup+combine over the dense padded layout.
 
   Args:
-    table: ``[vocab, width]`` (``width % 128 == 0``, f32/bf16).
+    table: ``[vocab, width]`` (width a divisor or multiple of 128,
+      f32/bf16; sub-128 widths need ``vocab % (128 // width) == 0``).
     ids: ``[M, h]`` int; ids outside ``[0, vocab)`` are padding.
     combiner: 'sum' | 'mean' | None (None requires ``h == 1``).
     out_dtype: output dtype (default ``table.dtype``).

@@ -128,7 +128,10 @@ class GroupSpec:
     width: embedding width of every member table.
     combiner: shared combiner of member tables.
     rows: per-device fused row counts (before padding), length ``num_devices``.
-    rows_cap: max over devices, padded to a multiple of 8 (TPU sublane).
+    rows_cap: max over devices, padded to a multiple of
+      ``max(8, 128 // width)`` so the Pallas kernel's lane packing
+      divides it (ops/pallas_lookup.py:supported) and the sublane
+      alignment holds.
     n_cap: max number of requests any device has in this group (slot count of
       the padded all-to-all buffers).
     requests: per-device request lists, length ``num_devices``.
@@ -393,11 +396,15 @@ class ShardingPlan:
           row_offset += lt.input_dim
         rows.append(row_offset)
         reqs.append(dev_reqs)
+      # sub-128 widths need rows_cap divisible by the Pallas pack factor
+      # 128//width (ops/pallas_lookup.py:supported); >= 8 keeps sublane
+      # alignment either way
+      gran = max(8, 128 // width) if 128 % width == 0 else 8
       spec = GroupSpec(key=key,
                        width=width,
                        combiner=combiner,
                        rows=rows,
-                       rows_cap=max(8, _round_up(max(rows), 8)),
+                       rows_cap=max(gran, _round_up(max(rows), gran)),
                        n_cap=max(len(r) for r in reqs),
                        requests=reqs,
                        member_tables=members)

@@ -55,10 +55,10 @@ def _load():
   if _lib is not None:
     return _lib
   if not os.path.exists(_SO_PATH) or _stale():
-    # build on demand (first use, or source newer than the binary); the
-    # toolchain may be absent, in which case a fresh-enough binary is
-    # still usable and anything else falls back to the Python loader
-    if not build() and not os.path.exists(_SO_PATH):
+    # build on demand (first use, or source newer than the binary); when
+    # the rebuild fails a stale binary must NOT shadow the edited source —
+    # fall back to the Python loader instead
+    if not build():
       return None
   try:
     lib = ctypes.CDLL(_SO_PATH)

@@ -125,6 +125,32 @@ def main():
   t_dense_apply = timeit(jax.jit(dense_sgd), table, dense_g)
   print(f'dense SGD full-table update: {t_dense_apply:8.3f} ms')
 
+  # --- Pallas kernel vs XLA gather across widths (on TPU) -----------------
+  from distributed_embeddings_tpu.ops import pallas_lookup
+  from distributed_embeddings_tpu.parallel.dist_embedding import _fused_lookup
+  if jax.default_backend() == 'tpu':
+    print('\npallas dense kernel vs XLA fallback '
+          f'(vocab {args.rows}, batch {args.batch}):')
+    for w, hot in [(8, 4), (16, 2), (32, 2), (64, 1), (128, 1)]:
+      t = jnp.asarray(
+          rng.normal(size=(args.rows, w)).astype(np.float32) * 0.01)
+      if not pallas_lookup.supported(t, 'sum', hot):
+        print(f'  width {w:4d} hot {hot}: unsupported for vocab '
+              f'{args.rows} (pack divisibility) — skipped')
+        continue
+      ids = jnp.asarray(
+          rng.integers(0, args.rows, size=(args.batch, hot)).astype(np.int32))
+      pl_fn = jax.jit(lambda t, i: pallas_lookup.dense_lookup(
+          t, i, 'sum', out_dtype=jnp.float32))
+      xla_fn = jax.jit(lambda t, i: _fused_lookup(
+          t, i[None], 'sum', jnp.float32)[0])
+      t_pl = timeit(pl_fn, t, ids)
+      t_xla = timeit(xla_fn, t, ids)
+      print(f'  width {w:4d} hot {hot}: pallas {t_pl:8.3f} ms | '
+            f'xla {t_xla:8.3f} ms | speedup {t_xla / t_pl:5.2f}x')
+  else:
+    print('\n(pallas-vs-xla width sweep skipped: no TPU backend)')
+
 
 if __name__ == '__main__':
   main()

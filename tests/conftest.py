@@ -19,5 +19,8 @@ import jax  # noqa: E402
 
 # The session environment may pin JAX_PLATFORMS at a remote TPU tunnel whose
 # plugin re-asserts itself over the env var; the config knob wins.  Tests run
-# on the fake 8-device CPU mesh regardless of attached hardware.
-jax.config.update('jax_platforms', 'cpu')
+# on the fake 8-device CPU mesh regardless of attached hardware —
+# except under DET_TESTS_REAL_TPU=1, which leaves the real backend for the
+# hardware-gated tests (tests/test_pallas_tpu.py).
+if os.environ.get('DET_TESTS_REAL_TPU') != '1':
+  jax.config.update('jax_platforms', 'cpu')

@@ -18,11 +18,12 @@ from distributed_embeddings_tpu.parallel.dist_embedding import _fused_lookup
 
 class TestDenseLookup:
 
+  @pytest.mark.parametrize('w', [8, 16, 32, 64, 128, 256])
   @pytest.mark.parametrize('combiner', ['sum', 'mean'])
   @pytest.mark.parametrize('dtype', [jnp.float32, jnp.bfloat16])
-  def test_matches_oracle(self, combiner, dtype):
+  def test_matches_oracle(self, w, combiner, dtype):
     rng = np.random.default_rng(0)
-    vocab, w, m, h = 200, 128, 100, 4
+    vocab, m, h = 208, 100, 4  # 208 divisible by every pack factor <= 16
     table = jnp.asarray(rng.normal(size=(vocab, w))).astype(dtype)
     ids = rng.integers(0, vocab, size=(m, h)).astype(np.int32)
     # padding convention of the routed layout: ids >= vocab are dropped
@@ -35,6 +36,18 @@ class TestDenseLookup:
     tol = 1e-6 if dtype == jnp.float32 else 2e-2
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=tol, atol=tol)
+
+  @pytest.mark.parametrize('w', [1, 2, 4])
+  def test_tiny_widths(self, w):
+    # reference template coverage goes down to width 1 (.cu:403-459)
+    rng = np.random.default_rng(7)
+    vocab, m, h = 256, 64, 3
+    table = jnp.asarray(rng.normal(size=(vocab, w)).astype(np.float32))
+    ids = jnp.asarray(rng.integers(0, vocab, size=(m, h)).astype(np.int32))
+    got = pallas_lookup.dense_lookup(table, ids, 'sum', interpret=True)
+    want = _fused_lookup(table, ids[None], 'sum', jnp.float32)[0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
 
   def test_none_combiner_hotness1(self):
     rng = np.random.default_rng(1)
@@ -115,6 +128,12 @@ class TestSupported:
     assert pallas_lookup.supported(t128.astype(jnp.bfloat16), 'mean')
     assert pallas_lookup.supported(t128, None, hotness=1)
     assert not pallas_lookup.supported(t128, None, hotness=2)
-    assert not pallas_lookup.supported(jnp.zeros((4, 64), jnp.float32), 'sum')
+    # sub-128 widths pack, provided vocab divides by the pack factor
+    assert pallas_lookup.supported(jnp.zeros((4, 64), jnp.float32), 'sum')
+    assert pallas_lookup.supported(jnp.zeros((16, 8), jnp.float32), 'sum')
+    assert not pallas_lookup.supported(jnp.zeros((10, 8), jnp.float32),
+                                       'sum')  # 10 % 16 != 0
+    assert not pallas_lookup.supported(jnp.zeros((48, 24), jnp.float32),
+                                       'sum')  # 24 divides neither way
     assert not pallas_lookup.supported(
         jnp.zeros((4, 128), jnp.float16), 'sum')

@@ -1,0 +1,79 @@
+"""Pallas lookup kernel on REAL TPU hardware: compiled correctness +
+microbenchmark vs the XLA fallback.
+
+The interpreter tests (test_pallas_lookup.py) validate semantics; DMA and
+semaphore behaviour only exist on the chip, so these run compiled
+(``interpret=False``).  Skipped on the CPU mesh — run with::
+
+    DET_TESTS_REAL_TPU=1 python -m pytest tests/test_pallas_tpu.py -v -s
+
+(DET_TESTS_REAL_TPU stops conftest.py from forcing the CPU backend.)
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_embeddings_tpu.ops import pallas_lookup
+from distributed_embeddings_tpu.parallel.dist_embedding import _fused_lookup
+
+requires_tpu = pytest.mark.skipif(
+    jax.default_backend() != 'tpu',
+    reason='needs a real TPU (DET_TESTS_REAL_TPU=1)')
+
+
+def _bench(fn, *args, iters=20):
+  out = fn(*args)
+  jax.block_until_ready(out)
+  start = time.perf_counter()
+  for _ in range(iters):
+    out = fn(*args)
+  jax.block_until_ready(out)
+  return (time.perf_counter() - start) / iters * 1e3
+
+
+@requires_tpu
+@pytest.mark.parametrize('w', [8, 16, 32, 64, 128, 256])
+@pytest.mark.parametrize('dtype', [jnp.float32, jnp.bfloat16])
+def test_compiled_matches_oracle(w, dtype):
+  rng = np.random.default_rng(0)
+  vocab, m, h = 4096, 512, 4
+  table = jnp.asarray(rng.normal(size=(vocab, w))).astype(dtype)
+  ids = rng.integers(0, vocab, size=(m, h)).astype(np.int32)
+  ids[::3, 2:] = vocab  # padding sentinel
+  ids = jnp.asarray(ids)
+  got = pallas_lookup.dense_lookup(table, ids, 'sum',
+                                   out_dtype=jnp.float32)
+  want = _fused_lookup(table, ids[None], 'sum', jnp.float32)[0]
+  tol = 1e-5 if dtype == jnp.float32 else 2e-2
+  np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                             rtol=tol, atol=tol)
+
+
+@requires_tpu
+@pytest.mark.parametrize('w,hot', [(8, 4), (32, 2), (64, 1), (128, 1)])
+def test_microbench_vs_xla_fallback(w, hot):
+  """The kernel exists to beat the XLA gather on the synthetic models'
+  shapes (VERDICT.md round 1); record both timings and flag pathology."""
+  rng = np.random.default_rng(1)
+  vocab, m = 1_000_000, 65536
+  table = jnp.asarray(rng.normal(size=(vocab, w)).astype(np.float32))
+  ids = jnp.asarray(rng.integers(0, vocab, size=(m, hot)).astype(np.int32))
+
+  pl_fn = jax.jit(lambda t, i: pallas_lookup.dense_lookup(
+      t, i, 'sum', out_dtype=jnp.float32))
+  xla_fn = jax.jit(lambda t, i: _fused_lookup(t, i[None], 'sum',
+                                              jnp.float32)[0])
+  t_pl = _bench(pl_fn, table, ids)
+  t_xla = _bench(xla_fn, table, ids)
+  np.testing.assert_allclose(np.asarray(pl_fn(table, ids)),
+                             np.asarray(xla_fn(table, ids)),
+                             rtol=1e-5, atol=1e-5)
+  print(f'\nwidth {w} hot {hot}: pallas {t_pl:.3f} ms, '
+        f'xla {t_xla:.3f} ms ({t_xla / t_pl:.2f}x)')
+  # soft bound: the kernel must never be pathologically slower
+  assert t_pl < 5 * t_xla
