@@ -4,9 +4,9 @@ TPU-native re-design of the reference's fused CUDA forward kernels
 ``EmbeddingLookUpVariableHot[Wide]``
 (`/root/reference/distributed_embeddings/cc/kernels/embedding_lookup_kernels.cu:175-336`,
 SURVEY.md C2): one pass over the id stream, embedding rows streamed
-HBM->VMEM by a multi-buffered DMA pipeline and accumulated into a
-per-batch-tile VMEM accumulator, so the combined ``[batch, width]`` output
-is the only thing written back to HBM.  The XLA fallback
+HBM->VMEM by a bulk async-copy burst per output tile and combined by a
+fully vectorised masked reduction, so the combined ``[batch, width]``
+output is the only thing written back to HBM.  The XLA fallback
 (`parallel/dist_embedding.py:_fused_lookup`) instead materialises the
 ``[positions, width]`` gather before reducing; this kernel removes that
 intermediate round-trip.
@@ -14,9 +14,14 @@ intermediate round-trip.
 The kernel consumes the *dense padded layout* the distributed runtime
 routes through its all-to-alls: ``ids[M, h]`` with out-of-range sentinel
 padding (``-1`` or ``>= vocab``), one output row per input row.  Per grid
-step, one ``[tile_m, h]`` id block lands in SMEM (a few KB — SMEM-safe by
-construction; scalar control flow reads ids from there to steer the DMA
-queue), while the table stays in HBM and is touched one row per position.
+step, one ``[tile_m, h]`` id block lands twice: in SMEM (scalar control
+flow reads ids from there to address the DMA burst) and in VMEM (the
+combine masks from it without any scalar loop), while the table stays in
+HBM and is touched one row per position.  The id operand stays 2-D:
+Mosaic's layout verifier rejects blocked 1-D s32 operands (XLA lays them
+out T(1024) while a flat ``(tile_m*h,)`` block implies a T(tile_m*h)
+tiling — observed failing on v5e); 2-D SMEM blocks carry no such
+constraint.
 
 Width coverage — where the CUDA version picks among 11 width-template
 instantiations and a tile heuristic (`embedding_lookup_kernels.cu:383-461`),
@@ -27,8 +32,8 @@ still moves a full HBM burst (512B f32) instead of a ``width``-sized sliver;
 the target row is isolated in-register with a lane mask and the packed
 accumulator collapses to ``width`` lanes with ``pack`` static lane-slice
 adds at tile end.  ``width % 128 == 0`` streams whole rows directly.  The
-remaining knobs are ``tile_m`` (output rows per grid step, shrunk for very
-hot inputs to bound the SMEM block) and ``NBUF`` (DMA pipeline depth).
+remaining knob is ``tile_m`` (output rows per grid step, shrunk for hot
+or wide inputs to bound the VMEM position buffer).
 
 The static-CSR ``RaggedBatch`` path of ``ops/embedding_lookup`` keeps the
 XLA gather+segment-sum lowering: its per-row position ranges are dynamic,
@@ -53,80 +58,136 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Pipeline depth of the HBM->VMEM row DMA queue.  Eight in-flight row
-# fetches cover typical HBM latency; raising it costs VMEM (NBUF rows).
-NBUF = 8
 # Default output rows per grid step (accumulator block height).
 TILE_M = 128
-# Cap on ids per grid step: bounds the SMEM id block (4 bytes each).
-_MAX_IDS_PER_TILE = 4096
+# VMEM position-buffer budget per grid step (of ~16 MiB VMEM/core).
+_POSBUF_BYTES = 4 * 1024 * 1024
 
 
-def _tile_m_for(h: int) -> int:
-  """Output-tile height: TILE_M, shrunk when hotness is large so the SMEM
-  id block stays at most _MAX_IDS_PER_TILE ids.  ``supported`` rejects
-  hotness beyond _MAX_IDS_PER_TILE, so this is always >= 1."""
-  return max(1, min(TILE_M, _MAX_IDS_PER_TILE // max(h, 1)))
+def _per_pos_bytes(width: int, dtype) -> int:
+  """Bytes one position's fetch unit occupies in the position buffer:
+  ``stripes`` 128-lane vectors for wide rows, one for narrow f32, a
+  2-sublane pair for narrow bf16 (see ``pair`` in the kernel)."""
+  itemsize = jnp.dtype(dtype).itemsize
+  stripes = max(1, width // 128)
+  units = stripes if (stripes > 1 or itemsize == 4) else 2
+  return units * 128 * itemsize
 
 
-def _dense_lookup_kernel(ids_ref, table_ref, out_ref, rowbuf, acc, sems, *,
-                         num_rows, tile_m, h, width, pack, out_dtype):
-  """One output tile: stream its tile_m*h ids, DMA-pipeline (packed) table
-  rows, accumulate position k into output row k // h.
+def _tile_m_for(h: int, width: int, dtype=jnp.float32) -> int:
+  """Output-tile height: TILE_M, shrunk (in multiples of 8, the f32
+  sublane tile) when hotness or stripe count is large so the VMEM position
+  buffer stays within budget.  ``supported`` rejects combinations that
+  would force it below 8 rows."""
+  budget = _POSBUF_BYTES // (_per_pos_bytes(width, dtype) * max(h, 1))
+  return max(8, min(TILE_M, budget // 8 * 8))
 
-  With ``pack > 1`` the table ref is the packed view
-  ``[num_rows // pack, pack * width]``; the row for id ``rid`` sits at
-  packed row ``rid // pack``, lane slot ``rid % pack``.
+
+def _dense_lookup_kernel(ids_smem, ids_vmem, table_ref, out_ref, posbuf,
+                         sem, *, num_rows, tile_m, h, width, pack, stripes,
+                         pair, out_dtype):
+  """One output tile in two phases.
+
+  Phase A (scalar): issue one async row copy per position — ALL ``tile_m*h``
+  of them back-to-back on a single semaphore, with no interleaved waits, so
+  the scalar core does nothing but read an id from SMEM and start a DMA.
+  (The earlier shipped design waited and vector-accumulated inside the id
+  loop; on a v5e that serialised on the scalar core at ~90 ns/row, 5x
+  slower than XLA's gather.  Issue-only runs at DMA-issue speed and the
+  copies themselves overlap each other.)
+
+  Phase B (vector): one combined semaphore wait for the whole position
+  buffer, then a fully vectorised combine — validity/pack-slot masks come
+  from a *VMEM* copy of the same id block, so no scalar loop touches the
+  data path: ``out[r] = sum_j mask[r, j] * posbuf[r, j]``.
+
+  Table views: Mosaic requires dynamic HBM slices not to cut the memref's
+  tiles, so the row dimension being sliced must be a leading untiled dim
+  and the sliced block must cover whole sublane tiles:
+
+  - f32, width <= 128: 2-D view ``[num_rows // pack, 128]`` (f32 allows
+    single-row dynamic slices); ``pack = 128 // width`` rows per 128-lane
+    vector for sub-128 widths.
+  - width >= 256 (``stripes = width // 128``): 3-D view
+    ``[num_rows, stripes, 128]`` — slicing dim 0 never cuts a tile (a 2-D
+    ``[rows, width > 128]`` memref rejects 1-row dynamic slices; observed
+    on v5e).  f32 only: bf16 stripe slices carry packed-sublane layout
+    offsets the reductions reject, so wide bf16 uses the XLA fallback.
+  - bf16, width <= 128 (``pair == 2``): bf16 rejects single-sublane
+    dynamic slices, so fetch units of TWO consecutive 128-lane vectors
+    from the 3-D view ``[num_rows // (2 * pack), 2, 128]``; the combine
+    selects the fetched half by ``(rid // pack) % 2``.
   """
   n = tile_m * h
-  lanes = pack * width
-  acc[:] = jnp.zeros_like(acc)
+  fetch_div = pack * pair
 
-  def dma(k, slot):
-    rid = jnp.clip(ids_ref[k], 0, num_rows - 1) // pack
-    return pltpu.make_async_copy(table_ref.at[pl.ds(rid, 1), :],
-                                 rowbuf.at[slot], sems.at[slot])
+  # ---- Phase A: issue all row DMAs ----------------------------------
+  def issue_row(r, j):
+    rid = jnp.clip(ids_smem[r, j], 0, num_rows - 1) // fetch_div
+    k = r * h + j
+    pltpu.make_async_copy(table_ref.at[pl.ds(rid, 1)],
+                          posbuf.at[pl.ds(k, 1)], sem).start()
 
-  for slot in range(min(NBUF, n)):
-    dma(slot, slot).start()
+  if h == 1:
+    jax.lax.fori_loop(0, tile_m,
+                      lambda r, _: (issue_row(r, 0), 0)[1], 0)
+  else:
+    jax.lax.fori_loop(
+        0, tile_m, lambda r, _: jax.lax.fori_loop(
+            0, h, lambda j, __: (issue_row(r, j), 0)[1], 0), 0)
 
-  lane_slot = (jax.lax.broadcasted_iota(jnp.int32, (1, lanes), 1) // width
-               if pack > 1 else None)
+  # ---- Phase B: single wait, vectorised combine ---------------------
+  # A self-referential copy descriptor carries posbuf's total byte count;
+  # waiting on it drains exactly the n copies issued above (it is never
+  # started).
+  pltpu.make_async_copy(posbuf, posbuf, sem).wait()
 
-  def body(k, _):
-    slot = jax.lax.rem(k, NBUF)
-    dma(k, slot).wait()
-    rid = ids_ref[k]
-    valid = (rid >= 0) & (rid < num_rows)
-    r = k // h
+  # Masks are carried as f32 multiplies: Mosaic only supports minor-dim
+  # broadcasts ([..., None]) of 32-bit types, not the i1 vectors a bool
+  # jnp.where mask would produce.  Reshapes stay 3-D with the lane dim
+  # intact (4-D reshapes hit "unsupported shape cast"); stripes/halves are
+  # combined by a *static* python loop over the middle dim instead.
+  ids_v = ids_vmem[:]                                    # [tile_m, h]
+  valid = ((ids_v >= 0) & (ids_v < num_rows)).astype(jnp.float32)
+  rid_v = jnp.clip(ids_v, 0, num_rows - 1)
 
-    @pl.when(valid)
-    def _():
-      row = rowbuf[slot].astype(jnp.float32)
-      if pack > 1:
-        row = jnp.where(lane_slot == jnp.clip(rid, 0, num_rows - 1) % pack,
-                        row, 0.0)
-      acc[pl.ds(r, 1), :] += row
+  def unit(s):
+    """Fetch-unit slot ``s`` as f32 ``[tile_m, h, 128]``.
 
-    nxt = k + NBUF
+    Slots are sliced from the *ref* (a fresh zero-offset load): slicing
+    an already-loaded 3-D value leaves nonzero layout offsets that
+    Mosaic's float reductions reject.  For bf16 only the pair path
+    (``stripes == 1``) lowers cleanly — its two slots merge through a
+    select before the reduction; a bf16 stripe loop does not (rejected
+    in ``supported``).
+    """
+    flat = posbuf[:, s, :] if posbuf.ndim == 3 else posbuf[:]
+    return flat.astype(jnp.float32).reshape(tile_m, h, 128)
 
-    @pl.when(nxt < n)
-    def _():
-      dma(nxt, slot).start()
+  if stripes > 1:
+    # wide rows: stripe s of every position goes to output stripe s
+    for s in range(stripes):
+      acc = jnp.sum(unit(s) * valid[..., None], axis=1)
+      out_ref[:, s, :] = acc.astype(out_dtype)
+    return
 
-    return 0
-
-  jax.lax.fori_loop(0, n, body, 0)
+  if pair > 1:  # bf16 narrow: select the fetched half per position
+    half = jax.lax.rem(rid_v // pack, 2).astype(jnp.float32)
+    rows = (unit(0) * (1.0 - half)[..., None] + unit(1) * half[..., None])
+  else:
+    rows = unit(0)
+  mask = valid[..., None]                                # [tile_m, h, 1]
   if pack > 1:
-    # collapse the pack slots: out = sum_s acc[:, s*width:(s+1)*width]
-    # (static lane slices; only the looked-up slot of each position is
-    # nonzero, so this is exact)
+    slot = jax.lax.rem(rid_v, pack)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 128), 2) // width
+    mask = mask * (lane == slot[..., None]).astype(jnp.float32)
+  acc = jnp.sum(rows * mask, axis=1)                     # [tile_m, 128]
+  if pack > 1:
     folded = acc[:, 0:width]
     for s in range(1, pack):
       folded += acc[:, s * width:(s + 1) * width]
-    out_ref[:] = folded.astype(out_dtype)
-  else:
-    out_ref[:] = acc[:].astype(out_dtype)
+    acc = folded
+  out_ref[:] = acc.astype(out_dtype)
 
 
 @functools.partial(jax.jit, static_argnames=('interpret',))
@@ -134,24 +195,44 @@ def _dense_lookup_sum(table: jax.Array, ids: jax.Array,
                       interpret: bool = False) -> jax.Array:
   """Sum-combine ``table[ids[m, :]]`` -> ``[M, width]`` f32; invalid ids
   (negative or >= vocab) contribute nothing.  ``M`` must be a multiple of
-  the tile height ``_tile_m_for(h)``."""
+  the tile height ``_tile_m_for(h, width)``."""
   num_rows, width = table.shape
   m, h = ids.shape
-  tile_m = _tile_m_for(h)
+  is_bf16 = table.dtype == jnp.bfloat16
   if width % 128 == 0:
-    pack = 1
+    pack, stripes, lanes_out = 1, width // 128, 128
   elif 128 % width == 0 and num_rows % (128 // width) == 0:
-    pack = 128 // width
+    pack, stripes, lanes_out = 128 // width, 1, width
   else:
     raise ValueError(f'width must divide 128 or be a multiple of it (with '
                      f'vocab divisible by the pack factor), got {width} '
                      f'(vocab {num_rows})')
+  pair = 2 if (is_bf16 and stripes == 1) else 1
+  if pair == 2 and num_rows % (2 * pack) != 0:
+    raise ValueError(f'bf16 needs vocab divisible by {2 * pack} '
+                     f'(pair fetch), got {num_rows}')
+  if is_bf16 and stripes > 1:
+    raise ValueError(f'bf16 wide widths are unsupported (see supported()), '
+                     f'got width {width} ({stripes} stripes)')
+  tile_m = _tile_m_for(h, width, table.dtype)
   if m % tile_m != 0:
     raise ValueError(f'M ({m}) must be a multiple of tile_m ({tile_m})')
-  lanes = pack * width
-  # row-major [vocab, w] -> [vocab/pack, pack*w] is a free view: pack
-  # consecutive rows become one 128-lane vector
-  packed = table.reshape(num_rows // pack, lanes)
+  # row-major [vocab, w] -> packed view is free (see kernel docstring)
+  if stripes == 1 and pair == 1:
+    packed = table.reshape(num_rows // pack, 128)
+    posbuf_shape = (tile_m * h, 128)
+  elif stripes == 1:
+    packed = table.reshape(num_rows // (2 * pack), 2, 128)
+    posbuf_shape = (tile_m * h, 2, 128)
+  else:
+    packed = table.reshape(num_rows, stripes, 128)
+    posbuf_shape = (tile_m * h, stripes, 128)
+  if stripes == 1:
+    out_block, out_shape = (tile_m, lanes_out), (m, lanes_out)
+    out_index = lambda t: (t, 0)
+  else:
+    out_block, out_shape = (tile_m, stripes, 128), (m, stripes, 128)
+    out_index = lambda t: (t, 0, 0)
 
   kernel = functools.partial(_dense_lookup_kernel,
                              num_rows=num_rows,
@@ -159,27 +240,31 @@ def _dense_lookup_sum(table: jax.Array, ids: jax.Array,
                              h=h,
                              width=width,
                              pack=pack,
+                             stripes=stripes,
+                             pair=pair,
                              out_dtype=jnp.float32)
-  return pl.pallas_call(
+  out = pl.pallas_call(
       kernel,
       grid=(m // tile_m,),
       in_specs=[
-          pl.BlockSpec((tile_m * h,), lambda t: (t,),
+          pl.BlockSpec((tile_m, h), lambda t: (t, 0),
                        memory_space=pltpu.SMEM),
+          pl.BlockSpec((tile_m, h), lambda t: (t, 0),
+                       memory_space=pltpu.VMEM),
           pl.BlockSpec(memory_space=pl.ANY),
       ],
-      out_specs=pl.BlockSpec((tile_m, width), lambda t: (t, 0),
+      out_specs=pl.BlockSpec(out_block, out_index,
                              memory_space=pltpu.VMEM),
       scratch_shapes=[
-          pltpu.VMEM((NBUF, 1, lanes), table.dtype),
-          pltpu.VMEM((tile_m, lanes), jnp.float32),
-          pltpu.SemaphoreType.DMA((NBUF,)),
+          pltpu.VMEM(posbuf_shape, table.dtype),
+          pltpu.SemaphoreType.DMA,
       ],
-      out_shape=jax.ShapeDtypeStruct((m, width), jnp.float32),
+      out_shape=jax.ShapeDtypeStruct(out_shape, jnp.float32),
       compiler_params=pltpu.CompilerParams(
           dimension_semantics=('arbitrary',)),
       interpret=interpret,
-  )(ids.reshape(-1).astype(jnp.int32), packed)
+  )(ids.astype(jnp.int32), ids.astype(jnp.int32), packed)
+  return out.reshape(m, width)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -219,20 +304,39 @@ def supported(table: jax.Array, combiner: Optional[str],
               hotness: int = 1) -> bool:
   """Whether the Pallas path applies (else callers use the XLA fallback).
 
-  Widths: any divisor of 128 (1..64, via lane packing; the vocab must be
-  divisible by the pack factor — the planner pads ``rows_cap`` to 128 so
-  the fused runtime path always qualifies) or any multiple of 128.
-  ``combiner=None`` qualifies only at hotness 1, where pass-through equals
-  a sum over one element.
+  Widths: divisors of 128 from 8 up (8..64, via lane packing; the vocab
+  must be divisible by the pack factor — doubled for bf16's pair fetch —
+  which the planner's ``rows_cap`` granularity guarantees for the fused
+  runtime path, planner.py ``gran``) or any multiple of 128.
+  Widths below 8 produce degenerate lane layouts Mosaic mis-allocates
+  (observed OOM-on-stack at width 1 on v5e) and are memory-trivial anyway,
+  so they take the XLA fallback.  ``combiner=None`` qualifies only at
+  hotness 1, where pass-through equals a sum over one element.
   """
   if combiner is None and hotness != 1:
-    return False
-  if hotness > _MAX_IDS_PER_TILE:  # SMEM id block would exceed its budget
     return False
   if table.ndim != 2 or table.dtype not in (jnp.float32, jnp.bfloat16):
     return False
   vocab, w = table.shape
-  width_ok = (w % 128 == 0) or (128 % w == 0 and vocab % (128 // w) == 0)
+  # VMEM position-buffer budget at the minimum tile height of 8 rows
+  if 8 * hotness * _per_pos_bytes(w, table.dtype) > _POSBUF_BYTES:
+    return False
+  bf16 = table.dtype == jnp.bfloat16
+  if w % 128 == 0:
+    stripes = w // 128
+    if not bf16:
+      width_ok = True
+    elif stripes == 1:
+      width_ok = vocab % 2 == 0        # pair fetch
+    else:
+      # bf16 stripe slices carry packed-sublane layout offsets Mosaic's
+      # reductions reject (v5e); wide bf16 takes the XLA fallback
+      width_ok = False
+  elif w >= 8 and 128 % w == 0:
+    pack = 128 // w
+    width_ok = vocab % (pack * (2 if bf16 else 1)) == 0
+  else:
+    width_ok = False
   return combiner in (None, 'sum', 'mean') and width_ok
 
 
@@ -260,7 +364,7 @@ def dense_lookup(table: jax.Array,
         f'dtype {table.dtype}, combiner {combiner}, hotness {ids.shape[1]}')
   out_dtype = out_dtype or table.dtype
   m, h = ids.shape
-  tile_m = _tile_m_for(h)
+  tile_m = _tile_m_for(h, table.shape[1], table.dtype)
   m_pad = -(-m // tile_m) * tile_m
   if m_pad != m:
     ids = jnp.pad(ids, ((0, m_pad - m), (0, 0)), constant_values=-1)

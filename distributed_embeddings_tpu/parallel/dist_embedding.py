@@ -130,19 +130,23 @@ class DistributedEmbedding:
               combiner: Optional[str]) -> jax.Array:
     """Fused lookup+combine for one subgroup, XLA or Pallas.
 
-    'auto' takes the Pallas single-pass kernel (ops/pallas_lookup.py, the
-    analog of the reference CUDA hot path, SURVEY.md C2) on TPU backends
-    when the shape/dtype qualify, else the XLA gather+segment-sum
-    fallback — mirroring the reference's own native-op vs tf.nn dispatch
-    (embedding_lookup_ops.py:67-102).
+    'auto' currently always takes the XLA gather+segment-sum path: on
+    v5e hardware the XLA gather sustains ~29 ns/random row while any
+    scalar-core-issued per-row DMA floors at ~47 ns/row independent of
+    pipeline depth or semaphore count (measured 2026-07, see
+    docs/perf_notes.md), so the Pallas kernel (ops/pallas_lookup.py, the
+    analog of the reference CUDA hot path, SURVEY.md C2) loses at every
+    width/hotness and stays opt-in (``lookup_impl='pallas'``) —
+    mirroring the reference's own native-op vs tf.nn dispatch
+    (embedding_lookup_ops.py:67-102), with the dispatch decided by
+    measurement instead of availability.
     """
     from distributed_embeddings_tpu.ops import pallas_lookup
     impl = self.lookup_impl
     hotness = routed.shape[2]
     ok = pallas_lookup.supported(table, combiner, hotness)
     if impl == 'auto':
-      on_tpu = jax.default_backend() == 'tpu'
-      impl = 'pallas' if on_tpu and ok else 'xla'
+      impl = 'xla'
     if impl == 'pallas':
       if not ok:
         raise ValueError(

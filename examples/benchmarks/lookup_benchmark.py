@@ -22,20 +22,55 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
 
 
-def timeit(fn, *args, iters=10, warmup=3):
-  for _ in range(warmup):
-    out = fn(*args)
-  jax_block(out)
-  start = time.perf_counter()
-  for _ in range(iters):
-    out = fn(*args)
-  jax_block(out)
-  return (time.perf_counter() - start) / iters * 1000
+def timeit(fn, *args, iters=10):
+  """Per-iteration ms of ``fn(*args)``, safe on the tunnelled TPU harness.
 
-
-def jax_block(out):
+  Plain dispatch loops are meaningless there: ``block_until_ready``
+  returns before the device finishes and identical calls can be served
+  from a result cache (docs/perf_notes.md).  So: run ONE jitted
+  ``lax.scan`` of ``iters`` steps, perturb the input each step (roll of
+  the largest integer leaf — the ids the expensive gather depends on —
+  falling back to a tiny add on the largest float leaf) so nothing
+  hoists out of the loop, give each timed call a distinct offset so the
+  remote cache misses, and force completion with a host transfer of a
+  scalar checksum.
+  """
   import jax
-  jax.block_until_ready(out)
+  import jax.numpy as jnp
+  leaves, treedef = jax.tree.flatten(args)
+  int_sizes = [
+      l.size if jnp.issubdtype(jnp.asarray(l).dtype, jnp.integer) else -1
+      for l in leaves
+  ]
+  if max(int_sizes) > 0:
+    tgt, int_tgt = int(np.argmax(int_sizes)), True
+  else:
+    tgt, int_tgt = int(np.argmax([l.size for l in leaves])), False
+
+  def run(off, *ls):
+    def step(c, k):
+      ls2 = list(ls)
+      x = ls2[tgt]
+      if int_tgt:
+        ls2[tgt] = jnp.roll(x.reshape(-1), k).reshape(x.shape)
+      else:
+        ls2[tgt] = x + jnp.float32(1e-30) * k
+      out = fn(*jax.tree.unflatten(treedef, ls2))
+      s = sum(
+          jnp.sum(jnp.asarray(l).astype(jnp.float32))
+          for l in jax.tree.leaves(out))
+      return c + s, None
+
+    return jax.lax.scan(step, jnp.float32(0), off + jnp.arange(iters))[0]
+
+  jrun = jax.jit(run)
+  float(jrun(0, *leaves))  # compile + warm
+  times = []
+  for off in (1, 1 + iters):
+    start = time.perf_counter()
+    float(jrun(off, *leaves))
+    times.append(time.perf_counter() - start)
+  return min(times) / iters * 1000
 
 
 def main():

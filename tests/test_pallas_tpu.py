@@ -26,20 +26,37 @@ requires_tpu = pytest.mark.skipif(
     reason='needs a real TPU (DET_TESTS_REAL_TPU=1)')
 
 
-def _bench(fn, *args, iters=20):
-  out = fn(*args)
-  jax.block_until_ready(out)
-  start = time.perf_counter()
-  for _ in range(iters):
-    out = fn(*args)
-  jax.block_until_ready(out)
-  return (time.perf_counter() - start) / iters * 1e3
+def _bench(fn, table, stacks, iters):
+  """Per-step ms of ``fn(table, ids)`` via one jitted scan per stack.
+
+  On the tunnelled TPU harness ``block_until_ready`` returns before the
+  device finishes and identical calls can be served from a result cache
+  (docs/perf_notes.md), so: distinct ids per scan step, full-output
+  checksum against DCE, completion forced by a host transfer, fresh
+  stack per timed call.
+  """
+
+  def run(tab, s):
+    def body(c, ids):
+      return c + jnp.sum(fn(tab, ids)), None
+    return jax.lax.scan(body, jnp.float32(0), s)[0]
+
+  f = jax.jit(run)
+  float(f(table, stacks[0]))  # compile + warm
+  times = []
+  for s in stacks[1:]:
+    start = time.perf_counter()
+    float(f(table, s))
+    times.append(time.perf_counter() - start)
+  return min(times) / iters * 1e3
 
 
 @requires_tpu
 @pytest.mark.parametrize('w', [8, 16, 32, 64, 128, 256])
 @pytest.mark.parametrize('dtype', [jnp.float32, jnp.bfloat16])
 def test_compiled_matches_oracle(w, dtype):
+  if dtype == jnp.bfloat16 and w > 128:
+    pytest.skip('wide bf16 takes the XLA fallback (pallas_lookup.supported)')
   rng = np.random.default_rng(0)
   vocab, m, h = 4096, 512, 4
   table = jnp.asarray(rng.normal(size=(vocab, w))).astype(dtype)
@@ -57,21 +74,26 @@ def test_compiled_matches_oracle(w, dtype):
 @requires_tpu
 @pytest.mark.parametrize('w,hot', [(8, 4), (32, 2), (64, 1), (128, 1)])
 def test_microbench_vs_xla_fallback(w, hot):
-  """The kernel exists to beat the XLA gather on the synthetic models'
-  shapes (VERDICT.md round 1); record both timings and flag pathology."""
+  """Record kernel-vs-XLA timings; the measured outcome (XLA's gather
+  wins at every shape on v5e — docs/perf_notes.md) is why 'auto'
+  dispatches to XLA.  The assert only flags pathological regression."""
   rng = np.random.default_rng(1)
-  vocab, m = 1_000_000, 65536
+  vocab, m, iters = 1_000_000, 16384, 20
   table = jnp.asarray(rng.normal(size=(vocab, w)).astype(np.float32))
-  ids = jnp.asarray(rng.integers(0, vocab, size=(m, hot)).astype(np.int32))
+  stacks = [
+      jnp.asarray(
+          rng.integers(0, vocab, size=(iters, m, hot)).astype(np.int32))
+      for _ in range(3)
+  ]
 
-  pl_fn = jax.jit(lambda t, i: pallas_lookup.dense_lookup(
-      t, i, 'sum', out_dtype=jnp.float32))
-  xla_fn = jax.jit(lambda t, i: _fused_lookup(t, i[None], 'sum',
-                                              jnp.float32)[0])
-  t_pl = _bench(pl_fn, table, ids)
-  t_xla = _bench(xla_fn, table, ids)
-  np.testing.assert_allclose(np.asarray(pl_fn(table, ids)),
-                             np.asarray(xla_fn(table, ids)),
+  pl_fn = lambda t, i: pallas_lookup.dense_lookup(t, i, 'sum',
+                                                  out_dtype=jnp.float32)
+  xla_fn = lambda t, i: _fused_lookup(t, i[None], 'sum', jnp.float32)[0]
+  t_pl = _bench(pl_fn, table, stacks, iters)
+  t_xla = _bench(xla_fn, table, stacks, iters)
+  ids = stacks[0][0]
+  np.testing.assert_allclose(np.asarray(jax.jit(pl_fn)(table, ids)),
+                             np.asarray(jax.jit(xla_fn)(table, ids)),
                              rtol=1e-5, atol=1e-5)
   print(f'\nwidth {w} hot {hot}: pallas {t_pl:.3f} ms, '
         f'xla {t_xla:.3f} ms ({t_xla / t_pl:.2f}x)')
