@@ -396,11 +396,12 @@ class ShardingPlan:
           row_offset += lt.input_dim
         rows.append(row_offset)
         reqs.append(dev_reqs)
-      # sub-128 widths need rows_cap divisible by the Pallas pack factor
-      # 128//width — DOUBLED for the bf16 pair fetch, so bf16 tables
-      # qualify too (ops/pallas_lookup.py:supported); >= 8 keeps sublane
-      # alignment either way
-      gran = max(8, 2 * (128 // width)) if 128 % width == 0 else 8
+      # sub-128 widths (8..64) need rows_cap divisible by the Pallas pack
+      # factor 128//width — DOUBLED for the bf16 pair fetch, so bf16
+      # tables qualify too (ops/pallas_lookup.py:supported); widths < 8
+      # always take the XLA fallback, so only sublane alignment applies
+      gran = max(8, 2 * (128 // width)) if (width >= 8
+                                            and 128 % width == 0) else 8
       spec = GroupSpec(key=key,
                        width=width,
                        combiner=combiner,

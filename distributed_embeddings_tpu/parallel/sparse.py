@@ -17,15 +17,17 @@ every shape static:
 - row-wise optimizers apply scatter updates at the looked-up rows only:
   O(batch * hotness * width) instead of O(vocab * width).
 
-Duplicate-id semantics: scatter-add accumulates duplicates, so ``SparseSGD``
-is *exactly* the dense result.  ``SparseAdagrad(dedup=False)`` (default,
-fastest) applies one batched update with the accumulator already containing
-the full batch's sum of per-occurrence squares — vs the reference's
-dedup-then-square (`keras _deduplicate_indexed_slices`); for the exact
-reference semantics use ``dedup=True``, which sums duplicate rows first via a
-static-shape sort (the TPU analog of the reference's
-``cub::DeviceRadixSort`` + ``UniqueByKey`` dedup, `.cu:505-521`).
-``SparseAdam`` always dedups (its update is nonlinear in the per-row grad).
+Every update stream is sort-compacted to its unique rows before touching
+the tables (``compact_segments`` — the TPU analog of the reference's
+``cub::DeviceRadixSort`` + ``UniqueByKey`` dedup, `.cu:505-521`), because
+XLA scatter cost is linear in the static row count (docs/perf_notes.md).
+Duplicate-id SEMANTICS are preserved exactly: ``SparseSGD`` applies the
+summed gradient (identical to dense); ``SparseAdagrad(dedup=False)``
+(default) accumulates the batch's sum of per-occurrence squared gradients,
+vs the reference's dedup-then-square (`keras _deduplicate_indexed_slices`)
+under ``dedup=True`` — both read the post-update accumulator, as the
+uncompacted formulation did.  ``SparseAdam`` is nonlinear in the row grad
+and always uses the deduplicated sum.
 """
 
 from __future__ import annotations
@@ -42,6 +44,88 @@ from distributed_embeddings_tpu.parallel.dist_embedding import DistributedEmbedd
 from distributed_embeddings_tpu.parallel.grad import TrainState
 
 
+def compact_segments(ids: jax.Array,
+                     grads: jax.Array,
+                     cap: int,
+                     sentinel: int,
+                     with_sq: bool = False,
+                     order: Optional[jax.Array] = None):
+  """Sort-dedup and COMPACT segment sums into static capacity ``cap``.
+
+  The key fact motivating this (measured on v5e, docs/perf_notes.md):
+  XLA scatter costs ~110-140 ns per update row REGARDLESS of how many
+  rows are sentinel-dropped — only the *static* row count matters — while
+  sorts are ~5 ns/row and gathers ~10-20 ns/row.  ``dedup_rows`` keeps the
+  nnz-length shape, so its scatters still pay full price; this variant
+  compacts the unique rows to the front of a ``cap``-sized buffer so the
+  optimizer's scatters shrink by the duplicate factor (~6x on the
+  power-law synthetic inputs) or down to the fused table's row count,
+  whichever is smaller.
+
+  Segment sums use the sorted-cumsum-difference trick (vectorised,
+  contiguous); over millions of rows f32 cumsum cancellation adds a
+  relative error ~1e-4 of the running-sum magnitude — well under gradient
+  noise, and the distributed equivalence tests bound it.
+
+  Args:
+    ids: ``[n]`` int32 row ids; ``sentinel`` (and anything >= it) marks
+      padding.
+    grads: ``[n, w]`` per-occurrence gradient rows.
+    cap: static output capacity.  Correct iff the number of unique ids
+      (including one slot for the sentinel segment) is <= cap — callers
+      guarantee this or guard with ``num_unique`` (see return).
+    sentinel: value marking dropped rows in the compacted output.
+    with_sq: also return per-segment sums of squared gradients (for
+      per-occurrence Adagrad accumulator semantics).
+    order: optional precomputed ``argsort(ids)`` (lets callers share the
+      sort with an overflow pre-check).
+
+  Returns:
+    ``(uids[cap], sum_g[cap, w], sum_sq[cap, w] | None, num_unique)``;
+    slots past the unique count hold ``sentinel`` / zeros, ``num_unique``
+    is a traced scalar (segments counted including the sentinel segment).
+  """
+  n = ids.shape[0]
+  if order is None:
+    order = jnp.argsort(ids)
+  sid = ids[order]
+  sg = grads[order].astype(jnp.float32)
+  is_first, is_last, seg_total = _sorted_segments(sid)
+  tot_g = seg_total(sg)
+  tot_sq = seg_total(sg * sg) if with_sq else None
+  rank = jnp.cumsum(is_first.astype(jnp.int32)) - 1
+  num_unique = rank[-1] + 1
+  # bring each segment's last position (holding its total) to slot `rank`
+  key = jnp.where(is_last, rank, n)
+  order2 = jnp.argsort(key)[:cap]
+  valid = key[order2] < n
+  uids = jnp.where(valid, sid[order2], sentinel)
+  sum_g = jnp.where(valid[:, None], tot_g[order2], 0.0)
+  sum_sq = (jnp.where(valid[:, None], tot_sq[order2], 0.0)
+            if with_sq else None)
+  return uids, sum_g, sum_sq, num_unique
+
+
+def _sorted_segments(sid: jax.Array):
+  """Segment machinery over SORTED ids: ``(is_first, is_last, seg_total)``
+  where ``seg_total(x)`` puts each segment's column sums at every position
+  of the segment via the cumsum-difference trick (exact value needed only
+  at the last position)."""
+  n = sid.shape[0]
+  iota = jnp.arange(n, dtype=jnp.int32)
+  change = sid[1:] != sid[:-1]
+  is_first = jnp.concatenate([jnp.ones((1,), bool), change])
+  is_last = jnp.concatenate([change, jnp.ones((1,), bool)])
+  first_pos = jax.lax.cummax(jnp.where(is_first, iota, 0))
+
+  def seg_total(x):
+    csum = jnp.cumsum(x, axis=0)
+    excl = csum - x
+    return csum - excl[first_pos]
+
+  return is_first, is_last, seg_total
+
+
 def dedup_rows(ids: jax.Array, grads: jax.Array,
                sentinel: int) -> Tuple[jax.Array, jax.Array]:
   """Sum rows of ``grads`` sharing an id; static shapes throughout.
@@ -52,37 +136,32 @@ def dedup_rows(ids: jax.Array, grads: jax.Array,
   discards those).  Returns ``(unique_ids, summed_grads)`` of the same
   length as the inputs.
   """
-  n = ids.shape[0]
   order = jnp.argsort(ids)
   sid = ids[order]
-  sg = grads[order]
-  csum = jnp.cumsum(sg.astype(jnp.float32), axis=0)
-  iota = jnp.arange(n, dtype=jnp.int32)
-  is_first = jnp.concatenate(
-      [jnp.ones((1,), bool), sid[1:] != sid[:-1]])
-  is_last = jnp.concatenate(
-      [sid[1:] != sid[:-1], jnp.ones((1,), bool)])
-  # index of the first position of the segment containing each position
-  first_pos = jax.lax.cummax(jnp.where(is_first, iota, 0))
-  excl = csum - sg.astype(jnp.float32)  # exclusive cumsum
-  seg_total = csum - excl[first_pos]    # total at last position of segment
+  sg = grads[order].astype(jnp.float32)
+  _, is_last, seg_total = _sorted_segments(sid)
   uids = jnp.where(is_last, sid, sentinel)
-  return uids, seg_total
+  return uids, seg_total(sg)
 
 
 @dataclasses.dataclass(frozen=True)
 class SparseSGD:
-  """Row-wise SGD; exact (SGD is linear, scatter-add of duplicates matches
+  """Row-wise SGD; exact (SGD is linear, so summed duplicate rows match
   the dense gradient).  The DLRM reference trains with plain SGD
   (`examples/dlrm/main.py:192-194`)."""
   learning_rate: float = 0.01
+  capacity_fraction: float = 0.5
+
+  needs_sq = False
 
   def init(self, dist: DistributedEmbedding, params) -> Dict:
     return {f'group_{gi}': {} for gi in range(len(dist.plan.groups))}
 
-  def row_apply(self, table, state, ids, g, lr):
-    update = (-lr * g).astype(table.dtype)
-    return table.at[ids].add(update, mode='drop'), state
+  def apply_unique(self, table, state, uids, sum_g, sum_sq, lr):
+    """Apply one step at COMPACTED unique rows (``compact_segments``)."""
+    del sum_sq
+    update = (-lr * sum_g).astype(table.dtype)
+    return table.at[uids].add(update, mode='drop'), state
 
 
 @dataclasses.dataclass(frozen=True)
@@ -99,6 +178,13 @@ class SparseAdagrad:
   initial_accumulator_value: float = 0.1
   epsilon: float = 1e-7
   dedup: bool = False
+  capacity_fraction: float = 0.5
+
+  @property
+  def needs_sq(self):
+    # per-occurrence semantics accumulate sum(g_i^2); dedup semantics
+    # accumulate (sum g_i)^2, derivable from sum_g alone
+    return not self.dedup
 
   def init(self, dist: DistributedEmbedding, params) -> Dict:
     return {
@@ -110,15 +196,20 @@ class SparseAdagrad:
         } for gi in range(len(dist.plan.groups))
     }
 
-  def row_apply(self, table, state, ids, g, lr):
-    if self.dedup:
-      ids, g = dedup_rows(ids, g, sentinel=table.shape[0])
-    acc = state['acc']
-    acc = acc.at[ids].add(g * g, mode='drop')
-    safe = jnp.clip(ids, 0, table.shape[0] - 1)
+  def apply_unique(self, table, state, uids, sum_g, sum_sq, lr):
+    """One step at COMPACTED unique rows.
+
+    Matches the uncompacted semantics exactly: with duplicates, every
+    occurrence reads the accumulator AFTER the full batch's additions
+    (the scatter completes before the gather), so the total update of a
+    row is ``-lr * sum_g / sqrt(acc_new + eps)`` in both formulations.
+    """
+    add = sum_g * sum_g if self.dedup else sum_sq
+    acc = state['acc'].at[uids].add(add, mode='drop')
+    safe = jnp.clip(uids, 0, table.shape[0] - 1)
     denom = jnp.sqrt(acc[safe] + self.epsilon)
-    update = (-lr * g / denom).astype(table.dtype)
-    return table.at[ids].add(update, mode='drop'), {'acc': acc}
+    update = (-lr * sum_g / denom).astype(table.dtype)
+    return table.at[uids].add(update, mode='drop'), {'acc': acc}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -130,6 +221,9 @@ class SparseAdam:
   b1: float = 0.9
   b2: float = 0.999
   epsilon: float = 1e-8
+  capacity_fraction: float = 0.5
+
+  needs_sq = False
 
   def init(self, dist: DistributedEmbedding, params) -> Dict:
     out = {}
@@ -142,8 +236,12 @@ class SparseAdam:
       }
     return out
 
-  def row_apply(self, table, state, ids, g, lr):
-    ids, g = dedup_rows(ids, g, sentinel=table.shape[0])
+  def apply_unique(self, table, state, uids, sum_g, sum_sq, lr):
+    """One lazy-Adam step at COMPACTED unique rows (duplicates were
+    segment-summed by ``compact_segments`` — the same dedup the old path
+    did internally)."""
+    del sum_sq
+    ids, g = uids, sum_g
     safe = jnp.clip(ids, 0, table.shape[0] - 1)
     valid = (ids < table.shape[0])[:, None]
     t = state['t'].at[ids].add(1, mode='drop')
@@ -156,6 +254,51 @@ class SparseAdam:
     vhat = v_rows / (1 - self.b2**t_rows)
     update = (-lr * mhat / (jnp.sqrt(vhat) + self.epsilon)).astype(table.dtype)
     return table.at[ids].add(update, mode='drop'), {'m': m, 'v': v, 't': t}
+
+
+def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
+                     rows_cap: int):
+  """Compact duplicate update rows, then run the optimizer on the unique
+  rows only.
+
+  Scatter cost is linear in the STATIC update-row count (~110-140 ns/row
+  on v5e whether or not rows are dropped — docs/perf_notes.md), so the
+  raw per-occurrence stream (batch x hotness x slots rows) is compacted
+  first.  Capacity = min(n, rows_cap + 2, capacity_fraction * n): the
+  fused table's own row count bounds uniques for small fused groups
+  (e.g. the synthetic models' many tiny tables fuse into a ~60k-row group
+  fed by millions of update rows), while the fraction covers big-vocab
+  groups, whose duplicate factor comes from the power-law id distribution.
+  When the fraction bound could be exceeded (traced unique count >
+  capacity), a ``lax.cond`` falls back to full-capacity compaction —
+  always correct, never silently dropping updates.
+  """
+  n = flat_ids.shape[0]
+  sentinel = rows_cap
+  frac = getattr(optimizer, 'capacity_fraction', 0.5)
+  cap_safe = min(n, rows_cap + 2)  # uniques <= rows_cap + sentinel segment
+  cap = min(cap_safe, max(8, -(-int(n * frac) // 8) * 8))
+  with_sq = bool(getattr(optimizer, 'needs_sq', True))
+
+  def apply_at(cap_, order=None):
+    uids, sum_g, sum_sq, _ = compact_segments(flat_ids, flat_g, cap_,
+                                              sentinel, with_sq=with_sq,
+                                              order=order)
+    return optimizer.apply_unique(table, state, uids, sum_g, sum_sq, lr)
+
+  if cap >= cap_safe:
+    return apply_at(cap)
+
+  # fraction-bounded capacity: pre-count uniques on the sorted keys (the
+  # sort is shared with the taken branch via `order`)
+  order = jnp.argsort(flat_ids)
+  sid = flat_ids[order]
+  num_unique = jnp.sum(sid[1:] != sid[:-1]) + 1
+  return jax.lax.cond(
+      num_unique <= cap,
+      lambda: apply_at(cap, order),
+      lambda: apply_at(cap_safe, order),
+  )
 
 
 def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
@@ -195,8 +338,8 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
           else grad_list[0]
       key = f'group_{gi}'
       state_g = {k: v[0] for k, v in opt_state[key].items()}
-      table, state2 = optimizer.row_apply(params[key][0], state_g, flat_ids,
-                                          flat_g, lr)
+      table, state2 = _dedup_and_apply(optimizer, params[key][0], state_g,
+                                       flat_ids, flat_g, lr, rows_cap)
       new_params[key] = table[None]
       new_state[key] = {k: v[None] for k, v in state2.items()}
     return new_params, new_state

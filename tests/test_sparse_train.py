@@ -266,6 +266,63 @@ def test_dedup_rows_unit():
   np.testing.assert_allclose(out, dense, rtol=1e-5, atol=1e-6)
 
 
+def test_compact_segments_unit():
+  from distributed_embeddings_tpu.parallel.sparse import compact_segments
+  rng = np.random.default_rng(3)
+  n, w, vocab = 256, 4, 23
+  ids = rng.integers(0, vocab, size=(n,)).astype(np.int32)
+  ids[5:9] = vocab  # sentinel padding rows
+  g = rng.normal(size=(n, w)).astype(np.float32)
+  cap = vocab + 2
+  uids, sum_g, sum_sq, nuniq = jax.jit(
+      lambda i, x: compact_segments(i, x, cap, sentinel=vocab,
+                                    with_sq=True))(ids, g)
+  uids, sum_g, sum_sq = map(np.asarray, (uids, sum_g, sum_sq))
+  dense = np.zeros((vocab, w), np.float32)
+  np.add.at(dense, ids[ids < vocab], g[ids < vocab])
+  dense_sq = np.zeros((vocab, w), np.float32)
+  np.add.at(dense_sq, ids[ids < vocab], g[ids < vocab]**2)
+  keep = uids < vocab
+  assert sorted(uids[keep].tolist()) == sorted(set(ids[ids < vocab].tolist()))
+  out = np.zeros((vocab, w), np.float32)
+  out[uids[keep]] = sum_g[keep]
+  np.testing.assert_allclose(out, dense, rtol=1e-4, atol=1e-5)
+  out_sq = np.zeros((vocab, w), np.float32)
+  out_sq[uids[keep]] = sum_sq[keep]
+  np.testing.assert_allclose(out_sq, dense_sq, rtol=1e-4, atol=1e-5)
+  # the sentinel occupies one segment; all real uniques must fit
+  assert int(nuniq) == len(set(ids[ids < vocab].tolist())) + 1
+
+
+@pytest.mark.parametrize('frac', [0.02, 1.0])
+def test_capacity_fraction_overflow_fallback(frac):
+  # frac=0.02 forces the traced unique count over the compaction capacity,
+  # exercising the lax.cond full-capacity fallback; frac=1.0 never
+  # overflows.  Both must match the dense keras-adagrad oracle exactly
+  # (dedup=True -> the oracle's sum-then-square semantics).
+  dist, params_emb, gen_inputs, kernel, labels, head_loss_fn = build(seed=5)
+  cats = gen_inputs()
+  opt = SparseAdagrad(learning_rate=LR, dedup=True,
+                      initial_accumulator_value=0.1,
+                      capacity_fraction=frac)
+  g = dense_grads(dist, params_emb, kernel, cats, labels,
+                  head_loss_fn)['embedding']
+  acc0 = jax.tree.map(lambda x: jnp.full_like(x, 0.1), params_emb)
+  want, _ = _keras_adagrad_dense(params_emb, g, acc0, LR)
+
+  step = make_hybrid_train_step(dist, head_loss_fn, optax.sgd(LR), opt,
+                                donate=False)
+  state = init_hybrid_train_state(dist, {
+      'embedding': params_emb,
+      'kernel': kernel
+  }, optax.sgd(LR), opt)
+  state, loss = step(state, cats, labels)
+  assert np.isfinite(float(loss))
+  for k in params_emb:
+    np.testing.assert_allclose(np.asarray(state.params['embedding'][k]),
+                               np.asarray(want[k]), rtol=2e-5, atol=2e-6)
+
+
 def test_hybrid_step_with_lr_schedule():
   dist, params_emb, gen_inputs, kernel, labels, head_loss_fn = build()
   cats = gen_inputs()
