@@ -11,10 +11,16 @@ from distributed_embeddings_tpu.parallel.planner import (
     apply_strategy,
 )
 from distributed_embeddings_tpu.parallel.dist_embedding import DistributedEmbedding
-from distributed_embeddings_tpu.parallel.checkpoint import (get_weights,
-                                                            set_weights,
-                                                            save_npz,
-                                                            load_npz)
+from distributed_embeddings_tpu.parallel.checkpoint import (
+    get_weights,
+    set_weights,
+    get_optimizer_state,
+    set_optimizer_state,
+    save_npz,
+    load_npz,
+    save_train_npz,
+    load_train_npz,
+)
 from distributed_embeddings_tpu.parallel.grad import (broadcast_variables,
                                                       DistributedGradientTape,
                                                       TrainState,

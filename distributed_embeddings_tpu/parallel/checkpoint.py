@@ -20,7 +20,9 @@ copy-on-write hazard exists.
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Union
+import functools
+
+from typing import Dict, List, Optional, Sequence, Union
 
 import jax
 import numpy as np
@@ -30,11 +32,76 @@ from distributed_embeddings_tpu.parallel.dist_embedding import DistributedEmbedd
 
 WeightLike = Union[np.ndarray, str]
 
+# Default streaming-gather chunk: 2**27 elements (512 MiB f32) per fetch,
+# the same order as the reference's 128M-element scatter chunks
+# (dist_model_parallel.py:452,502-524) - bounds host/replica memory when
+# assembling terabyte tables.
+CHUNK_ELEMS = 1 << 27
+
 
 def _load(weight: WeightLike) -> np.ndarray:
   if isinstance(weight, str):
     return np.load(weight, mmap_mode='r')
   return np.asarray(weight)
+
+
+def _chunked_shards(dist: DistributedEmbedding, arr: jax.Array,
+                    chunk_elems: int) -> List[np.ndarray]:
+  """Stream one ``[D, rows_cap, ...]`` group array to host, device by
+  device, in row chunks of at most ``chunk_elems`` elements.
+
+  Each fetch is a jitted SPMD ``dynamic_slice`` whose output is REPLICATED
+  over the mesh, so it works when shards are not host-addressable
+  (multi-host): every process runs the same program and reads its local
+  replica.  The reference needs chunked ``hvd.allgather`` for the same
+  reason (dist_model_parallel.py:577-590); here the chunk cap bounds
+  per-process peak memory instead of MPI's 32-bit limits.
+  """
+  rows_cap = arr.shape[1]
+  row_elems = int(np.prod(arr.shape[2:])) if arr.ndim > 2 else 1
+  step = max(1, min(rows_cap, chunk_elems // max(row_elems, 1)))
+  key = ('ckpt_fetch', arr.shape, str(arr.dtype), step)
+  if key not in dist._fn_cache:
+    sizes = (1, step) + arr.shape[2:]
+
+    @functools.partial(jax.jit,
+                       out_shardings=NamedSharding(dist.mesh, P()))
+    def fetch(a, d, r):
+      start = (d, r) + (0,) * (a.ndim - 2)
+      return jax.lax.dynamic_slice(a, start, sizes)
+
+    dist._fn_cache[key] = fetch
+  fetch = dist._fn_cache[key]
+
+  shards = []
+  for dev in range(dist.world_size):
+    chunks = []
+    for r0 in range(0, rows_cap, step):
+      r0c = min(r0, rows_cap - step)  # clamp the tail chunk; trim below
+      out = np.asarray(jax.device_get(fetch(arr, dev, r0c)))[0]
+      chunks.append(out[r0 - r0c:])
+    shards.append(np.concatenate(chunks, axis=0) if len(chunks) > 1
+                  else chunks[0])
+  return shards
+
+
+def _host_shards(dist: DistributedEmbedding, arr: jax.Array, gather: str,
+                 chunk_elems: int) -> List[np.ndarray]:
+  """Per-device host copies of one group array's ``[rows_cap, ...]``
+  shards, via local-shard reads when addressable, else chunked SPMD
+  streaming."""
+  if gather == 'chunked':
+    return _chunked_shards(dist, arr, chunk_elems)
+  shards: List[Optional[np.ndarray]] = [None] * dist.world_size
+  for s in arr.addressable_shards:
+    dev = s.index[0].start if s.index[0].start is not None else 0
+    shards[dev] = np.asarray(s.data)[0]
+  if any(s is None for s in shards):
+    if gather == 'addressable':
+      raise ValueError('gather="addressable" but some shards are remote; '
+                       'use gather="chunked" (or "auto") on multi-host')
+    return _chunked_shards(dist, arr, chunk_elems)
+  return shards
 
 
 def set_weights(dist: DistributedEmbedding,
@@ -88,31 +155,30 @@ def set_weights(dist: DistributedEmbedding,
 
 
 def get_weights(dist: DistributedEmbedding,
-                params: Dict[str, jax.Array]) -> List[np.ndarray]:
+                params: Dict[str, jax.Array],
+                gather: str = 'auto',
+                chunk_elems: int = CHUNK_ELEMS) -> List[np.ndarray]:
   """Reassemble global per-table weights from the sharded params.
 
   Inverse of ``set_weights`` (reference ``get_weights``,
   dist_model_parallel.py:555-645): un-fuse each device's tall table, undo
   column slicing by concatenating device-ordered shards along the width.
 
+  Args:
+    gather: 'auto' reads local shards when every shard is host-addressable
+      and streams chunked replicated slices otherwise; 'addressable' /
+      'chunked' force one path.
+    chunk_elems: element cap per streamed fetch (see ``_chunked_shards``).
+
   Returns:
     List of ``[rows, width]`` numpy arrays in global table order.
   """
   plan = dist.plan
   group_index = {g.key: gi for gi, g in enumerate(plan.groups)}
-  # Pull each device's shard to host once.
-  host_shards: Dict[int, List[np.ndarray]] = {}
-  for gi, g in enumerate(plan.groups):
-    arr = params[f'group_{gi}']
-    shards = [None] * dist.world_size
-    for s in arr.addressable_shards:
-      dev = s.index[0].start if s.index[0].start is not None else 0
-      shards[dev] = np.asarray(s.data)[0]
-    if any(s is None for s in shards):
-      # multi-host: fall back to a full gather of the global array
-      full = np.asarray(jax.device_get(arr))
-      shards = [full[d] for d in range(dist.world_size)]
-    host_shards[gi] = shards
+  host_shards = {
+      gi: _host_shards(dist, params[f'group_{gi}'], gather, chunk_elems)
+      for gi in range(len(plan.groups))
+  }
 
   result = []
   for tid, shards in enumerate(plan.shard_layout()):
@@ -127,6 +193,109 @@ def get_weights(dist: DistributedEmbedding,
   return result
 
 
+def get_optimizer_state(dist: DistributedEmbedding,
+                        opt_state: Dict[str, Dict[str, jax.Array]],
+                        gather: str = 'auto',
+                        chunk_elems: int = CHUNK_ELEMS
+                        ) -> List[Dict[str, np.ndarray]]:
+  """Reassemble sparse-optimizer state into the global per-table layout.
+
+  Same resharding contract as ``get_weights`` (the reference checkpoints
+  tables only; optimizer state is an extension): a state checkpoint
+  written under one world size / strategy loads under any other.
+
+  Leaf handling: per-element leaves ``[D, rows_cap, width]`` (Adagrad
+  ``acc``, Adam ``m``/``v``) un-fuse and un-column-slice exactly like
+  weights; per-row leaves ``[D, rows_cap]`` (Adam ``t``) are IDENTICAL
+  across column slices of a table (a lookup touches every slice of a
+  row), so the first slice is canonical and yields a ``[rows]`` vector.
+
+  Returns:
+    Per-table dicts of numpy arrays, in global table order (e.g.
+    ``[{'acc': [rows, width]}, ...]``); empty dicts for stateless
+    optimizers.
+  """
+  plan = dist.plan
+  group_index = {g.key: gi for gi, g in enumerate(plan.groups)}
+  leaf_names = sorted({k for gs in opt_state.values() for k in gs})
+  host: Dict[tuple, List[np.ndarray]] = {}
+  for gi in range(len(plan.groups)):
+    for k in opt_state.get(f'group_{gi}', {}):
+      host[(gi, k)] = _host_shards(dist, opt_state[f'group_{gi}'][k],
+                                   gather, chunk_elems)
+
+  result = []
+  for tid, shards in enumerate(plan.shard_layout()):
+    rows = plan.table_configs[tid].input_dim
+    entry = {}
+    for k in leaf_names:
+      pieces = []
+      for dev, group_key, row_offset, col_start, col_end in shards:
+        gi = group_index[group_key]
+        if (gi, k) not in host:
+          continue
+        piece = host[(gi, k)][dev][row_offset:row_offset + rows]
+        pieces.append(piece)
+      if not pieces:
+        continue
+      if pieces[0].ndim == 1:
+        entry[k] = pieces[0]  # per-row: identical across column slices
+      else:
+        entry[k] = (np.concatenate(pieces, axis=1) if len(pieces) > 1
+                    else pieces[0])
+    result.append(entry)
+  return result
+
+
+def set_optimizer_state(dist: DistributedEmbedding,
+                        opt_state: Dict[str, Dict[str, jax.Array]],
+                        table_states: Sequence[Dict[str, np.ndarray]]
+                        ) -> Dict[str, Dict[str, jax.Array]]:
+  """Build the sharded sparse-optimizer state from global per-table state.
+
+  Inverse of ``get_optimizer_state``.  ``opt_state`` supplies the leaf
+  structure/shapes/shardings to rebuild into (e.g. a fresh
+  ``optimizer.init(dist, params)``); per-row ``[rows]`` leaves broadcast
+  to every column slice of their table.  Padding rows (never looked up)
+  are zero-filled.
+  """
+  plan = dist.plan
+  if len(table_states) != len(plan.table_configs):
+    raise ValueError(
+        f'expected {len(plan.table_configs)} per-table states, got '
+        f'{len(table_states)}')
+  new_state: Dict[str, Dict[str, jax.Array]] = {}
+  for gi, g in enumerate(plan.groups):
+    gkey = f'group_{gi}'
+    new_state[gkey] = {}
+    for k, tmpl in opt_state.get(gkey, {}).items():
+      def make_shard(index, g=g, k=k, tmpl=tmpl):
+        dev = index[0].start if index[0].start is not None else 0
+        dtype = tmpl.dtype
+        chunks = []
+        for lt in g.member_tables[dev]:
+          st = np.asarray(table_states[lt.table_id][k])
+          if tmpl.ndim == 3:
+            chunks.append(np.asarray(st[:, lt.col_start:lt.col_end],
+                                     dtype=dtype))
+          else:
+            chunks.append(np.asarray(st, dtype=dtype))
+        pad_rows = g.rows_cap - g.rows[dev]
+        if pad_rows or not chunks:
+          pad_shape = ((pad_rows, g.width) if tmpl.ndim == 3
+                       else (pad_rows,))
+          chunks.append(np.zeros(pad_shape, dtype))
+        return np.concatenate(chunks, axis=0)[None]
+
+      # canonical device-major sharding (the template may still carry the
+      # single-device sharding optimizer.init created it with)
+      sharding = NamedSharding(
+          dist.mesh, P(dist.axis_name, *([None] * (tmpl.ndim - 1))))
+      new_state[gkey][k] = jax.make_array_from_callback(
+          tmpl.shape, sharding, make_shard)
+  return new_state
+
+
 def save_npz(path: str, weights: Sequence[np.ndarray]):
   """Save global weights the way the DLRM example does
   (reference `examples/dlrm/main.py:246-248`)."""
@@ -136,3 +305,45 @@ def save_npz(path: str, weights: Sequence[np.ndarray]):
 def load_npz(path: str) -> List[np.ndarray]:
   data = np.load(path)
   return [data[k] for k in data.files]
+
+
+def save_train_npz(path: str,
+                   weights: Sequence[np.ndarray],
+                   table_states: Optional[Sequence[Dict[str, np.ndarray]]]
+                   = None):
+  """Save weights plus (optionally) sparse-optimizer state in one .npz.
+
+  Keys: ``table{i}`` for weights, ``table{i}/{leaf}`` for state leaves —
+  the global canonical layout, so the file reshards on load like the
+  weight-only path.
+  """
+  if table_states is not None and len(table_states) != len(weights):
+    raise ValueError(f'got {len(table_states)} per-table states for '
+                     f'{len(weights)} weight tables')
+  payload = {f'table{i}': np.asarray(w) for i, w in enumerate(weights)}
+  for i, entry in enumerate(table_states or []):
+    for k, v in entry.items():
+      payload[f'table{i}/{k}'] = np.asarray(v)
+  np.savez(path, **payload)
+
+
+def load_train_npz(path: str):
+  """Inverse of ``save_train_npz``:
+  returns ``(weights, table_states)``."""
+  data = np.load(path)
+  if not data.files:
+    raise ValueError(f'{path}: empty archive')
+  n = 1 + max(int(k.split('/')[0][5:]) for k in data.files)
+  weights: List[Optional[np.ndarray]] = [None] * n
+  states: List[Dict[str, np.ndarray]] = [dict() for _ in range(n)]
+  for k in data.files:
+    head, _, leaf = k.partition('/')
+    i = int(head[5:])
+    if leaf:
+      states[i][leaf] = data[k]
+    else:
+      weights[i] = data[k]
+  missing = [i for i, w in enumerate(weights) if w is None]
+  if missing:
+    raise ValueError(f'{path}: missing weight entries for tables {missing}')
+  return weights, states

@@ -81,9 +81,10 @@ def compact_segments(ids: jax.Array,
       sort with an overflow pre-check).
 
   Returns:
-    ``(uids[cap], sum_g[cap, w], sum_sq[cap, w] | None, num_unique)``;
-    slots past the unique count hold ``sentinel`` / zeros, ``num_unique``
-    is a traced scalar (segments counted including the sentinel segment).
+    ``(uids[c], sum_g[c, w], sum_sq[c, w] | None, num_unique)`` with
+    ``c = min(cap, n)``; slots past the unique count hold ``sentinel`` /
+    zeros, ``num_unique`` is a traced scalar (segments counted including
+    the sentinel segment).
   """
   n = ids.shape[0]
   if order is None:

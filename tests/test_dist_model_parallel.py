@@ -282,6 +282,100 @@ class TestCheckpointRoundTrip:
     for w, r in zip(weights, reloaded):
       np.testing.assert_array_equal(w, r)
 
+  def test_chunked_gather_matches_addressable(self):
+    """Forced streaming gather (the multi-host path) must equal the
+    local-shard read, including when the chunk cap forces several chunks
+    per table (reference chunked allgather, dist_model_parallel.py:577-590)."""
+    rng = np.random.default_rng(12)
+    configs, weights = make_tables(rng, MIXED_SPECS)
+    mesh = create_mesh(jax.devices()[:WORLD])
+    dist = DistributedEmbedding(configs, strategy='memory_balanced',
+                                column_slice_threshold=100, mesh=mesh)
+    params = set_weights(dist, weights)
+    local = get_weights(dist, params, gather='addressable')
+    # chunk cap far below one table -> many chunks incl. a ragged tail
+    streamed = get_weights(dist, params, gather='chunked', chunk_elems=97)
+    for tid, (a, b) in enumerate(zip(local, streamed)):
+      np.testing.assert_array_equal(a, b, err_msg=f'table {tid}')
+
+  def test_optimizer_state_round_trip_and_reshard(self):
+    """SparseAdagrad/SparseAdam state: save under one world/strategy,
+    restore under another, keep training-visible state identical
+    (VERDICT.md round 1, item 4: optimizer-state checkpointing)."""
+    from distributed_embeddings_tpu.parallel import (SparseAdagrad,
+                                                     SparseAdam,
+                                                     get_optimizer_state,
+                                                     set_optimizer_state)
+    rng = np.random.default_rng(13)
+    configs, weights = make_tables(rng, UNIFORM_SPECS)
+    mesh8 = create_mesh(jax.devices()[:8])
+    mesh2 = create_mesh(jax.devices()[:2])
+    d8 = DistributedEmbedding(configs, strategy='memory_balanced',
+                              mesh=mesh8)
+    d2 = DistributedEmbedding(configs, strategy='memory_optimized',
+                              mesh=mesh2, column_slice_threshold=80)
+    for opt in (SparseAdagrad(learning_rate=0.1),
+                SparseAdam(learning_rate=0.1)):
+      p8 = set_weights(d8, weights)
+      s8 = opt.init(d8, p8)
+      # make the state non-trivial: bump every real row deterministically
+      tables8 = get_optimizer_state(d8, s8)
+      for tid, entry in enumerate(tables8):
+        for k in entry:
+          entry[k] = entry[k] + (tid + 1) * (2 if entry[k].ndim == 1 else
+                                             0.5)
+      s8 = set_optimizer_state(d8, s8, tables8)
+      saved = get_optimizer_state(d8, s8)
+      # reshard: world 8 -> world 2, different strategy + column slicing
+      p2 = set_weights(d2, weights)
+      s2 = set_optimizer_state(d2, opt.init(d2, p2), saved)
+      back = get_optimizer_state(d2, s2)
+      for tid, (a, b) in enumerate(zip(saved, back)):
+        assert a.keys() == b.keys()
+        for k in a:
+          np.testing.assert_allclose(a[k], b[k], rtol=0, atol=0,
+                                     err_msg=f'table {tid} leaf {k}')
+      # chunked path agrees too
+      chunked = get_optimizer_state(d2, s2, gather='chunked',
+                                    chunk_elems=53)
+      for a, b in zip(back, chunked):
+        for k in a:
+          np.testing.assert_array_equal(a[k], b[k])
+
+  def test_save_load_train_npz(self, tmp_path):
+    from distributed_embeddings_tpu.parallel import (SparseAdagrad,
+                                                     get_optimizer_state,
+                                                     save_train_npz,
+                                                     load_train_npz,
+                                                     set_optimizer_state)
+    rng = np.random.default_rng(14)
+    configs, weights = make_tables(rng, UNIFORM_SPECS[:4])
+    mesh = create_mesh(jax.devices()[:4])
+    dist = DistributedEmbedding(configs, mesh=mesh)
+    params = set_weights(dist, weights)
+    opt = SparseAdagrad(learning_rate=0.1, initial_accumulator_value=0.25)
+    state = opt.init(dist, params)
+    path = str(tmp_path / 'train.npz')
+    save_train_npz(path, get_weights(dist, params),
+                   get_optimizer_state(dist, state))
+    w2, st2 = load_train_npz(path)
+    params2 = set_weights(dist, w2)
+    state2 = set_optimizer_state(dist, opt.init(dist, params2), st2)
+    for k in params:
+      np.testing.assert_array_equal(np.asarray(params[k]),
+                                    np.asarray(params2[k]))
+    for g in state:
+      for leaf in state[g]:
+        got = np.asarray(state2[g][leaf])
+        want = np.asarray(state[g][leaf])
+        # padding rows restore as zero; compare real rows per device
+        gi = int(g.split('_')[1])
+        grp = dist.plan.groups[gi]
+        for dev in range(dist.world_size):
+          rows = grp.rows[dev]
+          np.testing.assert_array_equal(got[dev, :rows],
+                                        want[dev, :rows])
+
   def test_npy_path_loading(self, tmp_path):
     """.npy path + mmap loading (reference dist_model_parallel.py:473-474)."""
     rng = np.random.default_rng(9)
