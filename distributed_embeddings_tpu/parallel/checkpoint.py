@@ -310,12 +310,14 @@ def load_npz(path: str) -> List[np.ndarray]:
 def save_train_npz(path: str,
                    weights: Sequence[np.ndarray],
                    table_states: Optional[Sequence[Dict[str, np.ndarray]]]
-                   = None):
+                   = None,
+                   extras: Optional[Dict[str, np.ndarray]] = None):
   """Save weights plus (optionally) sparse-optimizer state in one .npz.
 
   Keys: ``table{i}`` for weights, ``table{i}/{leaf}`` for state leaves —
   the global canonical layout, so the file reshards on load like the
-  weight-only path.
+  weight-only path — and ``extra/{name}`` for scalar metadata such as the
+  step counter.
   """
   if table_states is not None and len(table_states) != len(weights):
     raise ValueError(f'got {len(table_states)} per-table states for '
@@ -324,20 +326,27 @@ def save_train_npz(path: str,
   for i, entry in enumerate(table_states or []):
     for k, v in entry.items():
       payload[f'table{i}/{k}'] = np.asarray(v)
+  for k, v in (extras or {}).items():
+    payload[f'extra/{k}'] = np.asarray(v)
   np.savez(path, **payload)
 
 
 def load_train_npz(path: str):
   """Inverse of ``save_train_npz``:
-  returns ``(weights, table_states)``."""
+  returns ``(weights, table_states, extras)``."""
   data = np.load(path)
-  if not data.files:
-    raise ValueError(f'{path}: empty archive')
-  n = 1 + max(int(k.split('/')[0][5:]) for k in data.files)
+  table_keys = [k for k in data.files if k.startswith('table')]
+  if not table_keys:
+    raise ValueError(f'{path}: no table entries')
+  n = 1 + max(int(k.split('/')[0][5:]) for k in table_keys)
   weights: List[Optional[np.ndarray]] = [None] * n
   states: List[Dict[str, np.ndarray]] = [dict() for _ in range(n)]
+  extras: Dict[str, np.ndarray] = {}
   for k in data.files:
     head, _, leaf = k.partition('/')
+    if head == 'extra':
+      extras[leaf] = data[k]
+      continue
     i = int(head[5:])
     if leaf:
       states[i][leaf] = data[k]
@@ -346,4 +355,4 @@ def load_train_npz(path: str):
   missing = [i for i, w in enumerate(weights) if w is None]
   if missing:
     raise ValueError(f'{path}: missing weight entries for tables {missing}')
-  return weights, states
+  return weights, states, extras

@@ -42,6 +42,17 @@ def parse_args():
                       help='run AUC evaluation after training')
   parser.add_argument('--save_weights', default=None,
                       help='npz path for final embedding weights')
+  parser.add_argument('--trainer', default='sparse',
+                      choices=['sparse', 'dense'],
+                      help='sparse = O(nnz) row-wise embedding updates '
+                      '(the perf path; exact for SGD); dense = autodiff '
+                      'table grads through optax')
+  parser.add_argument('--save_state', default=None,
+                      help='npz path for a full resumable checkpoint '
+                      '(embedding weights + sparse-optimizer state + step)')
+  parser.add_argument('--load_state', default=None,
+                      help='resume from a --save_state checkpoint (any '
+                      'world size / strategy: the layout reshards on load)')
   return parser.parse_args()
 
 
@@ -52,9 +63,18 @@ def main():
   import jax.numpy as jnp
   import optax
   from distributed_embeddings_tpu.models.dlrm import DLRM, bce_with_logits
-  from distributed_embeddings_tpu.parallel import (create_mesh, get_weights,
+  from distributed_embeddings_tpu.parallel import (SparseSGD, create_mesh,
+                                                   get_optimizer_state,
+                                                   get_weights,
+                                                   init_hybrid_train_state,
                                                    init_train_state,
-                                                   make_train_step, save_npz)
+                                                   load_train_npz,
+                                                   make_hybrid_train_step,
+                                                   make_train_step, save_npz,
+                                                   save_train_npz,
+                                                   set_optimizer_state,
+                                                   set_weights)
+  from distributed_embeddings_tpu.parallel.grad import TrainState
   from distributed_embeddings_tpu.utils.data import DummyDataset
   from distributed_embeddings_tpu.utils.fastloader import (
       open_raw_binary_dataset)
@@ -115,23 +135,91 @@ def main():
                                         decay_start_step=48000,
                                         decay_steps=24000)
   optimizer = optax.sgd(schedule)
+  dist = model.dist_embedding
 
-  def loss_fn(p, batch):
-    numerical, cats, labels = batch
-    return bce_with_logits(model.apply(p, numerical, list(cats)), labels)
+  if args.trainer == 'sparse':
+    # embedding tables update through row-wise sparse SGD (exact; the
+    # reference's IndexedSlices path), dense params through optax
+    def head_loss_fn(dense_params, emb_outs, hbatch):
+      numerical, labels = hbatch
+      return bce_with_logits(model.head(dense_params, numerical, emb_outs),
+                             labels)
 
-  step = make_train_step(loss_fn, optimizer)
-  state = init_train_state(params, optimizer)
+    emb_opt = SparseSGD(learning_rate=args.learning_rate)
+    step = make_hybrid_train_step(dist, head_loss_fn, optimizer, emb_opt,
+                                  lr_schedule=schedule)
+    state = init_hybrid_train_state(dist, params, optimizer, emb_opt)
+  else:
+    def loss_fn(p, batch):
+      numerical, cats, labels = batch
+      return bce_with_logits(model.apply(p, numerical, list(cats)), labels)
+
+    step = make_train_step(loss_fn, optimizer)
+    state = init_train_state(params, optimizer)
+
+  def flat_with_paths(tree):
+    """Pytree -> ({path_string: leaf}, treedef) for npz round-tripping."""
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return {jax.tree_util.keystr(p): v for p, v in leaves}, treedef
+
+  def restore_like(template, saved, prefix):
+    flat, treedef = flat_with_paths(template)
+    leaves = [
+        jnp.asarray(saved[prefix + k]) if prefix + k in saved else v
+        for k, v in flat.items()
+    ]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+  resume_step = 0
+  if args.load_state:
+    weights, st_tables, extras = load_train_npz(args.load_state)
+    new_params = dict(state.params)
+    new_params['embedding'] = set_weights(dist, weights)
+    # dense params + dense optax state (incl. the schedule count) travel
+    # in extras under flattened paths, so the MLP towers and both LR
+    # schedules resume exactly where they stopped
+    dense_template = {k: v for k, v in new_params.items()
+                      if k != 'embedding'}
+    restored_dense = restore_like(dense_template, extras, 'dense:')
+    new_params.update(restored_dense)
+    if args.trainer == 'sparse':
+      emb_opt_state = state.opt_state[1]
+      if any(st_tables):
+        emb_opt_state = set_optimizer_state(dist, emb_opt_state, st_tables)
+      opt_state = (restore_like(state.opt_state[0], extras, 'opt:'),
+                   emb_opt_state)
+    else:
+      opt_state = restore_like(state.opt_state, extras, 'opt:')
+    resume_step = int(extras.get('step', 0))
+    state = TrainState(new_params, opt_state,
+                       jnp.asarray(resume_step, jnp.int32))
+    print(f'resumed from {args.load_state} at step {resume_step}')
 
   start = time.perf_counter()
   samples = 0
-  for i, (numerical, cats, labels) in enumerate(train_dataset):
-    batch = (jnp.asarray(numerical),
-             tuple(jnp.asarray(c) for c in cats), jnp.asarray(labels))
-    state, loss = step(state, batch)
+  loss = None
+  data_iter = iter(train_dataset)
+  if resume_step:
+    # the raw-binary reader is sequential: skip the batches the resumed
+    # run already consumed (one epoch's worth at most)
+    import itertools
+    skip = resume_step % max(1, len(train_dataset)) \
+        if hasattr(train_dataset, '__len__') else resume_step
+    data_iter = itertools.islice(data_iter, skip, None)
+  for i, (numerical, cats, labels) in enumerate(data_iter):
+    numerical = jnp.asarray(numerical)
+    cats = tuple(jnp.asarray(c) for c in cats)
+    labels = jnp.asarray(labels)
+    if args.trainer == 'sparse':
+      state, loss = step(state, list(cats), (numerical, labels))
+    else:
+      state, loss = step(state, (numerical, cats, labels))
     samples += args.batch_size
     if i % 1000 == 0:
-      print(f'step: {i}  loss: {float(loss):.5f}')
+      print(f'step: {resume_step + i}  loss: {float(loss):.5f}')
+  if loss is None:
+    print('no batches to train on (resume skipped the whole dataset)')
+    return
   jax.block_until_ready(loss)
   elapsed = time.perf_counter() - start
   print(f'trained {samples} samples in {elapsed:.1f}s '
@@ -147,10 +235,28 @@ def main():
       auc_metric.update(np.asarray(labels), np.asarray(preds))
     print(f'Evaluation completed, AUC: {auc_metric.result():.5f}')
 
+  weights = None
+  if args.save_weights or args.save_state:
+    weights = get_weights(dist, state.params['embedding'])
+
   if args.save_weights:
-    weights = get_weights(model.dist_embedding, state.params['embedding'])
     save_npz(args.save_weights, weights)
     print(f'saved embedding weights to {args.save_weights}')
+
+  if args.save_state:
+    st_tables = (get_optimizer_state(dist, state.opt_state[1])
+                 if args.trainer == 'sparse' else None)
+    extras = {'step': np.int64(int(state.step))}
+    dense_params = {k: v for k, v in state.params.items()
+                    if k != 'embedding'}
+    for k, v in flat_with_paths(dense_params)[0].items():
+      extras['dense:' + k] = np.asarray(v)
+    dense_opt = (state.opt_state[0] if args.trainer == 'sparse'
+                 else state.opt_state)  # small with SGD; see --help
+    for k, v in flat_with_paths(dense_opt)[0].items():
+      extras['opt:' + k] = np.asarray(v)
+    save_train_npz(args.save_state, weights, st_tables, extras=extras)
+    print(f'saved resumable state to {args.save_state}')
 
 
 if __name__ == '__main__':

@@ -357,8 +357,10 @@ class TestCheckpointRoundTrip:
     state = opt.init(dist, params)
     path = str(tmp_path / 'train.npz')
     save_train_npz(path, get_weights(dist, params),
-                   get_optimizer_state(dist, state))
-    w2, st2 = load_train_npz(path)
+                   get_optimizer_state(dist, state),
+                   extras={'step': np.int64(7)})
+    w2, st2, extras = load_train_npz(path)
+    assert int(extras['step']) == 7
     params2 = set_weights(dist, w2)
     state2 = set_optimizer_state(dist, opt.init(dist, params2), st2)
     for k in params:
