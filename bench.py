@@ -18,8 +18,6 @@ import json
 import time
 import traceback
 
-import numpy as np
-
 # Published step times, ms, by model -> device count
 # (synthetic_models/README.md:69-75).
 BASELINES_MS = {

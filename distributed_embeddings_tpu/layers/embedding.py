@@ -17,7 +17,6 @@ terabyte tables stream in through the checkpoint path instead
 from __future__ import annotations
 
 import dataclasses
-import math
 from typing import Any, Callable, Dict, Optional, Union
 
 import jax

@@ -21,7 +21,6 @@ very large tables lives with the Pallas kernels.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Union
 
 import jax

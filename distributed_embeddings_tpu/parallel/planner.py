@@ -39,7 +39,6 @@ mapping exactly so save/load can reshard to any world size.
 
 from __future__ import annotations
 
-import collections
 import dataclasses
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 

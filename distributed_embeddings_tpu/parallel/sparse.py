@@ -34,11 +34,11 @@ from __future__ import annotations
 
 import dataclasses
 
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from distributed_embeddings_tpu.parallel.dist_embedding import DistributedEmbedding
 from distributed_embeddings_tpu.parallel.grad import TrainState

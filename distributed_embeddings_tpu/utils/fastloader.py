@@ -12,7 +12,6 @@ pure-Python loader otherwise.
 from __future__ import annotations
 
 import ctypes
-import math
 import os
 import subprocess
 from typing import Optional, Sequence

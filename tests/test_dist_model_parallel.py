@@ -16,7 +16,6 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from distributed_embeddings_tpu.layers import Embedding
 from distributed_embeddings_tpu.parallel import (DistributedEmbedding,
                                                  TableConfig, create_mesh,
                                                  get_weights, set_weights)
