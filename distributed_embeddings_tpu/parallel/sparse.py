@@ -154,6 +154,7 @@ class SparseSGD:
   capacity_fraction: float = 0.5
 
   needs_sq = False
+  supports_lane_packing = True
 
   def init(self, dist: DistributedEmbedding, params) -> Dict:
     return {f'group_{gi}': {} for gi in range(len(dist.plan.groups))}
@@ -180,6 +181,8 @@ class SparseAdagrad:
   epsilon: float = 1e-7
   dedup: bool = False
   capacity_fraction: float = 0.5
+
+  supports_lane_packing = True
 
   @property
   def needs_sq(self):
@@ -225,6 +228,8 @@ class SparseAdam:
   capacity_fraction: float = 0.5
 
   needs_sq = False
+  # the per-row step counter 't' is not an elementwise-lane quantity
+  supports_lane_packing = False
 
   def init(self, dist: DistributedEmbedding, params) -> Dict:
     out = {}
@@ -257,6 +262,42 @@ class SparseAdam:
     return table.at[ids].add(update, mode='drop'), {'m': m, 'v': v, 't': t}
 
 
+def _lane_pack(uids, sum_g, sum_sq, pack: int, rows_cap: int):
+  """Re-compact per-row updates at packed-row granularity.
+
+  View the ``[rows_cap, w]`` table as ``[rows_cap // pack, pack * w]``
+  (free, row-major): row ``uid`` becomes packed row ``uid // pack``,
+  lanes ``(uid % pack) * w ..``.  Updates whose rows share a packed row
+  merge (they occupy disjoint lanes), so the scatter row count drops to
+  at most ``rows_cap // pack`` — for small fused groups fed by many
+  updates that is another ``pack``-fold shrink on top of the unique-row
+  compaction (e.g. synthetic-tiny's 31 small tables: 60k unique rows ->
+  3.8k packed rows at width 8).
+
+  Returns ``(pids, g_packed, sq_packed)`` sized
+  ``min(len(uids), rows_cap // pack + 2)``.
+  """
+  c, w = sum_g.shape
+  lanes = pack * w
+  psent = rows_cap // pack
+  pids = jnp.where(uids >= rows_cap, psent, uids // pack)
+  slot = jnp.where(uids >= rows_cap, 0, jax.lax.rem(uids, pack))
+  lane = jnp.arange(lanes, dtype=jnp.int32) // w
+  mask = (lane[None, :] == slot[:, None]).astype(sum_g.dtype)
+  g_lanes = jnp.tile(sum_g, (1, pack)) * mask
+  payload = (g_lanes if sum_sq is None else jnp.concatenate(
+      [g_lanes, jnp.tile(sum_sq, (1, pack)) * mask], axis=1))
+  cap2 = min(c, psent + 2)
+  # uids come rank-ordered (ascending, sentinels last) from the outer
+  # compact_segments, so pids is already sorted: skip the argsort
+  pids_c, pay_c, _, _ = compact_segments(
+      pids, payload, cap2, psent,
+      order=jnp.arange(c, dtype=jnp.int32))
+  g_packed = pay_c[:, :lanes]
+  sq_packed = pay_c[:, lanes:] if sum_sq is not None else None
+  return pids_c, g_packed, sq_packed
+
+
 def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
                      rows_cap: int):
   """Compact duplicate update rows, then run the optimizer on the unique
@@ -273,6 +314,12 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
   When the fraction bound could be exceeded (traced unique count >
   capacity), a ``lax.cond`` falls back to full-capacity compaction —
   always correct, never silently dropping updates.
+
+  For sub-128 widths a second, packed-granularity compaction follows
+  when it shrinks the scatters further (``_lane_pack``); the optimizer
+  then runs lane-wise on the packed ``[rows_cap // pack, pack * w]``
+  views (exact: untouched lanes receive zero gradient, and Adagrad's
+  accumulator/denominator math is elementwise).
   """
   n = flat_ids.shape[0]
   sentinel = rows_cap
@@ -280,11 +327,26 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
   cap_safe = min(n, rows_cap + 2)  # uniques <= rows_cap + sentinel segment
   cap = min(cap_safe, max(8, -(-int(n * frac) // 8) * 8))
   with_sq = bool(getattr(optimizer, 'needs_sq', True))
+  w = flat_g.shape[1]
+  pack = 128 // w if (w < 128 and 128 % w == 0) else 1
+  packable = (pack > 1 and rows_cap % pack == 0
+              and getattr(optimizer, 'supports_lane_packing', False)
+              and rows_cap // pack + 2 < cap)
 
   def apply_at(cap_, order=None):
     uids, sum_g, sum_sq, _ = compact_segments(flat_ids, flat_g, cap_,
                                               sentinel, with_sq=with_sq,
                                               order=order)
+    if packable:
+      pids, g_p, sq_p = _lane_pack(uids, sum_g, sum_sq, pack, rows_cap)
+      ptable = table.reshape(rows_cap // pack, pack * w)
+      pstate = {
+          k: v.reshape(rows_cap // pack, pack * w)
+          for k, v in state.items()
+      }
+      t2, s2 = optimizer.apply_unique(ptable, pstate, pids, g_p, sq_p, lr)
+      return (t2.reshape(rows_cap, w),
+              {k: v.reshape(rows_cap, w) for k, v in s2.items()})
     return optimizer.apply_unique(table, state, uids, sum_g, sum_sq, lr)
 
   if cap >= cap_safe:
