@@ -378,6 +378,7 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
     gs = res_and_g[len(subs):]
     new_params = dict(params)
     new_state = dict(opt_state)
+    fence = lr  # serialisation token threaded through the group applies
     for gi, group in enumerate(dist.plan.groups):
       ids_list, grad_list = [], []
       rows_cap = group.rows_cap
@@ -400,11 +401,18 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
       flat_g = jnp.concatenate(grad_list) if len(grad_list) > 1 \
           else grad_list[0]
       key = f'group_{gi}'
+      # serialise the per-group applies: without a data dependency XLA may
+      # schedule every group's sort/gather/scatter pipeline concurrently,
+      # keeping all their multi-hundred-MB compaction temporaries live at
+      # once — on a chip already holding params + accumulator that tips
+      # peak HBM over the edge (docs/perf_notes.md, train-step section)
+      (flat_ids, fence) = jax.lax.optimization_barrier((flat_ids, fence))
       state_g = {k: v[0] for k, v in opt_state[key].items()}
       table, state2 = _dedup_and_apply(optimizer, params[key][0], state_g,
                                        flat_ids, flat_g, lr, rows_cap)
       new_params[key] = table[None]
       new_state[key] = {k: v[None] for k, v in state2.items()}
+      fence = table[0, 0]
     return new_params, new_state
 
   n_groups = len(dist.plan.groups)
