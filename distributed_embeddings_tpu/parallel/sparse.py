@@ -91,27 +91,40 @@ def compact_segments(ids: jax.Array,
     order = jnp.argsort(ids)
   sid = ids[order]
   sg = grads[order].astype(jnp.float32)
-  is_first, is_last, seg_total = _sorted_segments(sid)
-  tot_g = seg_total(sg)
-  tot_sq = seg_total(sg * sg) if with_sq else None
+  is_first, is_last, first_pos, _ = _sorted_segments(sid)
   rank = jnp.cumsum(is_first.astype(jnp.int32)) - 1
   num_unique = rank[-1] + 1
-  # bring each segment's last position (holding its total) to slot `rank`
+  # bring each segment's last position to slot `rank`
   key = jnp.where(is_last, rank, n)
   order2 = jnp.argsort(key)[:cap]
   valid = key[order2] < n
   uids = jnp.where(valid, sid[order2], sentinel)
-  sum_g = jnp.where(valid[:, None], tot_g[order2], 0.0)
-  sum_sq = (jnp.where(valid[:, None], tot_sq[order2], 0.0)
-            if with_sq else None)
+
+  # Segment totals ONLY at the compacted positions: total = inclusive
+  # cumsum at the segment's last position minus the cumsum just before
+  # its first position.  This keeps a single [n, w] running-sum buffer
+  # per payload (instead of materialising per-position totals plus an
+  # n-row gather of the exclusive sums) — the compaction's big
+  # temporaries halve and one n-row random gather disappears.
+  fp = first_pos[order2]                             # [cap]
+
+  def seg_tot(csum):
+    hi = csum[order2]
+    lo = jnp.where((fp > 0)[:, None], csum[jnp.maximum(fp - 1, 0)], 0.0)
+    return jnp.where(valid[:, None], hi - lo, 0.0)
+
+  sum_g = seg_tot(jnp.cumsum(sg, axis=0))
+  sum_sq = seg_tot(jnp.cumsum(sg * sg, axis=0)) if with_sq else None
   return uids, sum_g, sum_sq, num_unique
 
 
 def _sorted_segments(sid: jax.Array):
-  """Segment machinery over SORTED ids: ``(is_first, is_last, seg_total)``
-  where ``seg_total(x)`` puts each segment's column sums at every position
-  of the segment via the cumsum-difference trick (exact value needed only
-  at the last position)."""
+  """Segment machinery over SORTED ids:
+  ``(is_first, is_last, first_pos, seg_total)`` where ``first_pos[p]`` is
+  the first position of the segment containing ``p`` and ``seg_total(x)``
+  puts each segment's column sums at every position of the segment via
+  the cumsum-difference trick (exact value needed only at the last
+  position)."""
   n = sid.shape[0]
   iota = jnp.arange(n, dtype=jnp.int32)
   change = sid[1:] != sid[:-1]
@@ -124,7 +137,7 @@ def _sorted_segments(sid: jax.Array):
     excl = csum - x
     return csum - excl[first_pos]
 
-  return is_first, is_last, seg_total
+  return is_first, is_last, first_pos, seg_total
 
 
 def dedup_rows(ids: jax.Array, grads: jax.Array,
@@ -140,7 +153,7 @@ def dedup_rows(ids: jax.Array, grads: jax.Array,
   order = jnp.argsort(ids)
   sid = ids[order]
   sg = grads[order].astype(jnp.float32)
-  _, is_last, seg_total = _sorted_segments(sid)
+  _, is_last, _, seg_total = _sorted_segments(sid)
   uids = jnp.where(is_last, sid, sentinel)
   return uids, seg_total(sg)
 
@@ -311,15 +324,24 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
   (e.g. the synthetic models' many tiny tables fuse into a ~60k-row group
   fed by millions of update rows), while the fraction covers big-vocab
   groups, whose duplicate factor comes from the power-law id distribution.
-  When the fraction bound could be exceeded (traced unique count >
-  capacity), a ``lax.cond`` falls back to full-capacity compaction —
-  always correct, never silently dropping updates.
+  When the fraction bound is exceeded (traced unique count > capacity),
+  a ``lax.cond``-gated correction wave applies the dropped segments —
+  always correct, never silently dropping updates (overflow structure
+  below).
 
   For sub-128 widths a second, packed-granularity compaction follows
   when it shrinks the scatters further (``_lane_pack``); the optimizer
   then runs lane-wise on the packed ``[rows_cap // pack, pack * w]``
   views (exact: untouched lanes receive zero gradient, and Adagrad's
   accumulator/denominator math is elementwise).
+
+  Overflow structure: the capped apply runs UNconditionally and a
+  ``lax.cond`` wraps only the rare *correction* wave for the segments
+  the cap dropped.  The waves touch disjoint unique rows, so applying
+  them separately is exact for every optimizer here.  (An earlier
+  formulation put the whole apply inside a two-branch cond; XLA then
+  materialised a full accumulator copy for the branches — +4.5 GB of
+  temps at synthetic-tiny scale, measured via memory_analysis.)
   """
   n = flat_ids.shape[0]
   sentinel = rows_cap
@@ -333,35 +355,45 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
               and getattr(optimizer, 'supports_lane_packing', False)
               and rows_cap // pack + 2 < cap)
 
-  def apply_at(cap_, order=None):
-    uids, sum_g, sum_sq, _ = compact_segments(flat_ids, flat_g, cap_,
-                                              sentinel, with_sq=with_sq,
-                                              order=order)
-    if packable:
-      pids, g_p, sq_p = _lane_pack(uids, sum_g, sum_sq, pack, rows_cap)
-      ptable = table.reshape(rows_cap // pack, pack * w)
-      pstate = {
-          k: v.reshape(rows_cap // pack, pack * w)
-          for k, v in state.items()
-      }
-      t2, s2 = optimizer.apply_unique(ptable, pstate, pids, g_p, sq_p, lr)
-      return (t2.reshape(rows_cap, w),
-              {k: v.reshape(rows_cap, w) for k, v in s2.items()})
-    return optimizer.apply_unique(table, state, uids, sum_g, sum_sq, lr)
+  order = jnp.argsort(flat_ids) if cap < cap_safe else None
+  uids, sum_g, sum_sq, num_unique = compact_segments(
+      flat_ids, flat_g, cap, sentinel, with_sq=with_sq, order=order)
+  if packable:
+    pids, g_p, sq_p = _lane_pack(uids, sum_g, sum_sq, pack, rows_cap)
+    ptable = table.reshape(rows_cap // pack, pack * w)
+    pstate = {
+        k: v.reshape(rows_cap // pack, pack * w) for k, v in state.items()
+    }
+    t2, s2 = optimizer.apply_unique(ptable, pstate, pids, g_p, sq_p, lr)
+    t2 = t2.reshape(rows_cap, w)
+    s2 = {k: v.reshape(rows_cap, w) for k, v in s2.items()}
+  else:
+    t2, s2 = optimizer.apply_unique(table, state, uids, sum_g, sum_sq, lr)
 
   if cap >= cap_safe:
-    return apply_at(cap)
+    return t2, s2
 
-  # fraction-bounded capacity: pre-count uniques on the sorted keys (the
-  # sort is shared with the taken branch via `order`)
-  order = jnp.argsort(flat_ids)
-  sid = flat_ids[order]
-  num_unique = jnp.sum(sid[1:] != sid[:-1]) + 1
-  return jax.lax.cond(
-      num_unique <= cap,
-      lambda: apply_at(cap, order),
-      lambda: apply_at(cap_safe, order),
-  )
+  def correction(args):
+    # apply the segments the cap dropped (ranks >= cap), compacted to
+    # the guaranteed bound so the branch's scatters stay O(rows_cap)
+    # rather than O(n) when the fused table is smaller than the stream
+    t3, s3 = args
+    sid = flat_ids[order]
+    sg = flat_g[order].astype(jnp.float32)
+    is_first, is_last, _, seg_total = _sorted_segments(sid)
+    rank = jnp.cumsum(is_first.astype(jnp.int32)) - 1
+    keep = is_last & (rank >= cap)
+    key2 = jnp.where(keep, rank, n)
+    order3 = jnp.argsort(key2)[:cap_safe]
+    valid3 = key2[order3] < n
+    uids2 = jnp.where(valid3, sid[order3], sentinel)
+    tot_g = jnp.where(valid3[:, None], seg_total(sg)[order3], 0.0)
+    tot_sq = (jnp.where(valid3[:, None], seg_total(sg * sg)[order3], 0.0)
+              if with_sq else None)
+    return optimizer.apply_unique(t3, s3, uids2, tot_g, tot_sq, lr)
+
+  return jax.lax.cond(num_unique > cap, correction, lambda args: args,
+                      (t2, s2))
 
 
 def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
@@ -406,7 +438,8 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
       # keeping all their multi-hundred-MB compaction temporaries live at
       # once — on a chip already holding params + accumulator that tips
       # peak HBM over the edge (docs/perf_notes.md, train-step section)
-      (flat_ids, fence) = jax.lax.optimization_barrier((flat_ids, fence))
+      (flat_ids, flat_g, fence) = jax.lax.optimization_barrier(
+          (flat_ids, flat_g, fence))
       state_g = {k: v[0] for k, v in opt_state[key].items()}
       table, state2 = _dedup_and_apply(optimizer, params[key][0], state_g,
                                        flat_ids, flat_g, lr, rows_cap)
