@@ -113,6 +113,12 @@ def main():
                       help='sparse = O(nnz) row-wise embedding updates '
                       '(parallel/sparse.py, matching the reference '
                       'IndexedSlices path); dense = autodiff + optax')
+  parser.add_argument('--fused_apply', action='store_true',
+                      help='opt into the fused Pallas row-wise Adagrad '
+                      'apply (ops/pallas_rowwise.py)')
+  parser.add_argument('--capacity_fraction', type=float, default=0.5,
+                      help='compaction capacity as a fraction of the raw '
+                      'update stream (parallel/sparse.py)')
   args = parser.parse_args()
 
   jax, devices, backend_note = init_backend()
@@ -168,7 +174,9 @@ def main():
 
   # keras Adagrad defaults (reference synthetic_models/main.py:105)
   optimizer = optax.adagrad(0.01, initial_accumulator_value=0.1, eps=1e-7)
-  emb_opt = SparseAdagrad(learning_rate=0.01)
+  emb_opt = SparseAdagrad(learning_rate=0.01,
+                          capacity_fraction=args.capacity_fraction,
+                          use_pallas_apply=args.fused_apply)
   if args.trainer == 'sparse':
     state = init_hybrid_train_state(model.dist_embedding, params, optimizer,
                                     emb_opt)
@@ -230,6 +238,18 @@ def main():
     metric += f' (baseline: {baseline_ndev}xA100 {baseline} ms)'
   if backend_note:
     metric += f' [{backend_note}]'
+  if args.fused_apply:
+    # per-group static eligibility for the fused Pallas apply (the
+    # runtime guard in parallel/sparse.py can still decline at trace
+    # time); without this note an A/B run can silently measure the XLA
+    # path and read as "kernel is no faster"
+    f32 = jnp.dtype(args.param_dtype) == jnp.float32
+    groups = model.dist_embedding.plan.groups
+    ok = sum(1 for g in groups
+             if f32 and (g.width % 128 == 0 or
+                         (g.width >= 8 and 128 % g.width == 0)))
+    metric += (f' [fused_apply: {ok}/{len(groups)} groups eligible'
+               f'{"" if backend == "tpu" else ", inactive off-TPU"}]')
   emit({
       'metric': metric,
       'value': round(step_ms, 3),

@@ -1,0 +1,220 @@
+"""Pallas TPU kernel: fused row-wise Adagrad update over unique rows.
+
+The XLA formulation of one sparse Adagrad step costs three random-access
+passes over HBM per unique row — accumulator scatter-add, accumulator
+gather, table scatter-add — at ~110-140 ns per scatter row on v5e
+(docs/perf_notes.md).  This kernel fuses the whole update into one pass:
+per unique row, DMA the table row and accumulator row into VMEM, apply
+the Adagrad math vectorised, and DMA both back — 4 copies at the ~47 ns
+DMA-issue floor, roughly halving the projected per-row cost.  OPT-IN
+(`SparseAdagrad(use_pallas_apply=True)`) until hardware measurement
+confirms the win; the XLA path stays the default.
+
+Operates on 128-lane rows only: either tables of width 128, or the
+lane-packed ``[rows_cap // pack, 128]`` views the sparse path already
+builds for sub-128 widths (`parallel/sparse.py:_lane_pack`) — mirroring
+how the lookup kernel covers narrow widths.  f32 tables only: bf16
+single-sublane HBM slices are rejected by Mosaic (see
+ops/pallas_lookup.py), and the bf16 pair-fetch trick is unsafe here
+because WRITING a fetched pair back would race a neighbouring unique
+row's read-modify-write in another grid step.
+
+Correctness preconditions (the sparse path guarantees both):
+- ``uids`` hold UNIQUE row ids in ascending order with all sentinels
+  (>= num_rows) in a contiguous tail (``compact_segments`` rank order) —
+  uniqueness removes read-modify-write hazards between grid steps, and
+  the sorted tail lets a per-tile count skip sentinel work entirely.
+- the update semantics are elementwise per row (Adagrad with either
+  accumulator mode; plain SGD degenerates to ``sum_sq=None``).
+
+Reference analog: the CUDA backward applies ``IndexedSlices`` through
+the framework optimizer (SURVEY.md C3); fusing optimizer math into the
+scatter pass itself has no reference counterpart — it exists because TPU
+scatters are scalar-issued rather than atomic-parallel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# unique rows processed per grid step (two [TILE, 128] f32 buffers each
+# for table and accumulator rows: 256 KiB of VMEM)
+TILE = 128
+
+# Test hook: when True, the SparseAdagrad integration path engages the
+# kernel in interpreter mode on any backend, so the REAL producers
+# (lane-packed views, the overflow correction wave) exercise the
+# kernel's preconditions in CI rather than only on hardware.
+FORCE_INTERPRET = False
+
+
+def _adagrad_kernel(count_smem, ids_smem, g_ref, sq_ref, lr_smem, table_in,
+                    acc_in, table_ref, acc_ref, tbuf, abuf, sem, *,
+                    num_rows, dedup, eps, have_sq):
+  """One tile of unique rows: burst-read, vector update, burst-write.
+
+  ``table_ref``/``acc_ref`` are the ANY-space OUTPUT refs, aliased onto
+  the ``table_in``/``acc_in`` inputs (the update happens in place; rows
+  are unique, so no grid step reads a row another step writes);
+  ``count_smem`` holds the number of valid (non-sentinel) rows in the
+  whole stream.
+  """
+  del table_in, acc_in  # same memory as the aliased output refs
+  t = pl.program_id(0)
+  base = t * TILE
+  cnt = jnp.clip(count_smem[0, 0] - base, 0, TILE)
+
+  def read_row(k, _):
+    rid = jnp.clip(ids_smem[k, 0], 0, num_rows - 1)
+    pltpu.make_async_copy(table_ref.at[pl.ds(rid, 1)],
+                          tbuf.at[pl.ds(k, 1)], sem).start()
+    pltpu.make_async_copy(acc_ref.at[pl.ds(rid, 1)],
+                          abuf.at[pl.ds(k, 1)], sem).start()
+    return 0
+
+  jax.lax.fori_loop(0, cnt, read_row, 0)
+
+  def wait_row(k, _):
+    pltpu.make_async_copy(table_ref.at[pl.ds(0, 1)],
+                          tbuf.at[pl.ds(k, 1)], sem).wait()
+    pltpu.make_async_copy(acc_ref.at[pl.ds(0, 1)],
+                          abuf.at[pl.ds(k, 1)], sem).wait()
+    return 0
+
+  jax.lax.fori_loop(0, cnt, wait_row, 0)
+
+  g = g_ref[:]                                  # [TILE, 128] f32
+  add = g * g if (dedup or not have_sq) else sq_ref[:]
+  acc_new = abuf[:] + add
+  lr = lr_smem[0, 0]
+  upd = -lr * g * jax.lax.rsqrt(acc_new + eps)
+  tbuf[:] = tbuf[:] + upd
+  abuf[:] = acc_new
+
+  def write_row(k, _):
+    rid = jnp.clip(ids_smem[k, 0], 0, num_rows - 1)
+    pltpu.make_async_copy(tbuf.at[pl.ds(k, 1)],
+                          table_ref.at[pl.ds(rid, 1)], sem).start()
+    pltpu.make_async_copy(abuf.at[pl.ds(k, 1)],
+                          acc_ref.at[pl.ds(rid, 1)], sem).start()
+    return 0
+
+  jax.lax.fori_loop(0, cnt, write_row, 0)
+
+  def drain_row(k, _):
+    pltpu.make_async_copy(tbuf.at[pl.ds(k, 1)],
+                          table_ref.at[pl.ds(0, 1)], sem).wait()
+    pltpu.make_async_copy(abuf.at[pl.ds(k, 1)],
+                          acc_ref.at[pl.ds(0, 1)], sem).wait()
+    return 0
+
+  jax.lax.fori_loop(0, cnt, drain_row, 0)
+
+
+def supported(table: jax.Array, acc: jax.Array) -> bool:
+  """Whether the fused apply path handles these arrays."""
+  return (table.ndim == 2 and table.shape[1] == 128
+          and table.dtype == jnp.float32 and acc.shape == table.shape
+          and acc.dtype == jnp.float32)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=('dedup', 'eps', 'interpret'))
+def adagrad_apply(table: jax.Array,
+                  acc: jax.Array,
+                  uids: jax.Array,
+                  sum_g: jax.Array,
+                  sum_sq: Optional[jax.Array],
+                  lr,
+                  *,
+                  dedup: bool,
+                  eps: float,
+                  interpret: bool = False):
+  """Fused in-place Adagrad step at unique 128-lane rows.
+
+  Args:
+    table/acc: ``[num_rows, 128]`` f32 (donate for true in-place).
+    uids: ``[c]`` ascending unique row ids, sentinels (>= num_rows) in a
+      contiguous tail.
+    sum_g: ``[c, 128]`` f32 per-row summed gradients.
+    sum_sq: ``[c, 128]`` f32 per-row summed squared gradients, or None
+      (then ``dedup`` semantics are used regardless).
+    lr: scalar learning rate.
+    dedup: accumulator adds ``sum_g**2`` (reference dedup semantics)
+      instead of ``sum_sq``.
+
+  Returns:
+    ``(new_table, new_acc)``.
+  """
+  if not supported(table, acc):
+    raise ValueError(
+        f'pallas adagrad_apply unsupported: table {table.shape} '
+        f'{table.dtype}, acc {acc.shape} {acc.dtype}')
+  num_rows = table.shape[0]
+  c = uids.shape[0]
+  c_pad = -(-c // TILE) * TILE
+  if c_pad != c:
+    pad = c_pad - c
+    uids = jnp.pad(uids, (0, pad), constant_values=num_rows)
+    sum_g = jnp.pad(sum_g, ((0, pad), (0, 0)))
+    if sum_sq is not None:
+      sum_sq = jnp.pad(sum_sq, ((0, pad), (0, 0)))
+  have_sq = sum_sq is not None
+  count = jnp.sum(uids < num_rows).astype(jnp.int32).reshape(1, 1)
+  lr_arr = jnp.asarray(lr, jnp.float32).reshape(1, 1)
+  if have_sq:
+    sq_operand = sum_sq
+    sq_spec = pl.BlockSpec((TILE, 128), lambda t: (t, 0),
+                           memory_space=pltpu.VMEM)
+  else:
+    # the kernel never reads sq when have_sq is false; a single shared
+    # zero block avoids streaming a second gradient-sized operand
+    sq_operand = jnp.zeros((TILE, 128), jnp.float32)
+    sq_spec = pl.BlockSpec((TILE, 128), lambda t: (0, 0),
+                           memory_space=pltpu.VMEM)
+
+  kernel = functools.partial(_adagrad_kernel,
+                             num_rows=num_rows,
+                             dedup=dedup,
+                             eps=eps,
+                             have_sq=have_sq)
+  out_t, out_a = pl.pallas_call(
+      kernel,
+      grid=(c_pad // TILE,),
+      in_specs=[
+          pl.BlockSpec(memory_space=pltpu.SMEM),         # count [1,1]
+          pl.BlockSpec((TILE, 1), lambda t: (t, 0),
+                       memory_space=pltpu.SMEM),          # ids column
+          pl.BlockSpec((TILE, 128), lambda t: (t, 0),
+                       memory_space=pltpu.VMEM),          # sum_g
+          sq_spec,                                        # sum_sq
+          pl.BlockSpec(memory_space=pltpu.SMEM),          # lr [1,1]
+          pl.BlockSpec(memory_space=pl.ANY),              # table
+          pl.BlockSpec(memory_space=pl.ANY),              # acc
+      ],
+      out_specs=[
+          pl.BlockSpec(memory_space=pl.ANY),
+          pl.BlockSpec(memory_space=pl.ANY),
+      ],
+      out_shape=[
+          jax.ShapeDtypeStruct(table.shape, table.dtype),
+          jax.ShapeDtypeStruct(acc.shape, acc.dtype),
+      ],
+      input_output_aliases={5: 0, 6: 1},
+      scratch_shapes=[
+          pltpu.VMEM((TILE, 128), jnp.float32),
+          pltpu.VMEM((TILE, 128), jnp.float32),
+          pltpu.SemaphoreType.DMA,
+      ],
+      compiler_params=pltpu.CompilerParams(
+          dimension_semantics=('arbitrary',)),
+      interpret=interpret,
+  )(count, uids.astype(jnp.int32)[:, None], sum_g,
+    sq_operand, lr_arr, table, acc)
+  return out_t, out_a

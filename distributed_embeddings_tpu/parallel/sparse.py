@@ -194,6 +194,11 @@ class SparseAdagrad:
   epsilon: float = 1e-7
   dedup: bool = False
   capacity_fraction: float = 0.5
+  # opt-in fused Pallas apply (ops/pallas_rowwise.py): one DMA pass over
+  # the unique rows instead of three XLA random passes; takes effect on
+  # TPU for 128-lane f32 tables (incl. lane-packed views), silently
+  # falling back to the XLA path elsewhere
+  use_pallas_apply: bool = False
 
   supports_lane_packing = True
 
@@ -221,6 +226,16 @@ class SparseAdagrad:
     (the scatter completes before the gather), so the total update of a
     row is ``-lr * sum_g / sqrt(acc_new + eps)`` in both formulations.
     """
+    if self.use_pallas_apply:
+      from distributed_embeddings_tpu.ops import pallas_rowwise
+      interpret = pallas_rowwise.FORCE_INTERPRET
+      if ((jax.default_backend() == 'tpu' or interpret)
+          and pallas_rowwise.supported(table, state['acc'])):
+        t2, a2 = pallas_rowwise.adagrad_apply(
+            table, state['acc'], uids, sum_g, sum_sq,
+            jnp.asarray(lr, jnp.float32), dedup=self.dedup,
+            eps=self.epsilon, interpret=interpret)
+        return t2, {'acc': a2}
     add = sum_g * sum_g if self.dedup else sum_sq
     acc = state['acc'].at[uids].add(add, mode='drop')
     safe = jnp.clip(uids, 0, table.shape[0] - 1)
