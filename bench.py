@@ -97,10 +97,9 @@ def main():
   parser.add_argument('--batch_size', type=int, default=65536)
   parser.add_argument('--steps', type=int, default=20)
   parser.add_argument('--warmup', type=int, default=4,
-                      help='requested warmup steps; the harness always runs '
-                      'ceil(max(warmup,1)/steps) >= 1 untimed rounds of the '
-                      'timed scan program (one round minimum, to compile '
-                      'it), so effective warmup is that many x --steps')
+                      help='untimed warmup steps before the timed loop; '
+                      'at least 3 always run (compile + the one-time '
+                      'donation-layout recompile + one cached call)')
   parser.add_argument('--alpha', type=float, default=1.05,
                       help='power-law exponent for ids (0=uniform)')
   parser.add_argument('--param_dtype', default='float32',
@@ -185,47 +184,44 @@ def main():
   else:
     state = init_train_state(params, optimizer)
 
-  # Steps run under one jitted lax.scan so remote-dispatch overhead is
-  # amortised; batches cycle through the generated pool as scan xs (distinct
-  # per step, so nothing hoists out of the loop).
-  def make_scan(n_steps):
-    def body(state, batch):
-      if args.trainer == 'sparse':
+  # Time the bare jitted step in an async-dispatch python loop: dispatches
+  # queue without blocking (the sync is one scalar pull at the end), so the
+  # device pipelines back-to-back steps exactly as a lax.scan would, while
+  # the program stays half the compile time of a scan wrapper.  Batches
+  # cycle through the generated pool so consecutive steps see distinct ids.
+  def make_step():
+    if args.trainer == 'sparse':
+      def body(state, batch):
         (numerical, cats), labels = batch
         return raw_step(state, list(cats), (numerical, labels))
-      loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
-      updates, opt_state = optimizer.update(grads, state.opt_state,
-                                            state.params)
-      new_params = jax.tree.map(lambda p, u: p + u.astype(p.dtype),
-                                state.params, updates)
-      return TrainState(new_params, opt_state, state.step + 1), loss
+    else:
+      def body(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        new_params = jax.tree.map(lambda p, u: p + u.astype(p.dtype),
+                                  state.params, updates)
+        return TrainState(new_params, opt_state, state.step + 1), loss
 
-    def run(state, xs):
-      return jax.lax.scan(body, state, xs)
+    return jax.jit(body, donate_argnums=(0,))
 
-    return jax.jit(run, donate_argnums=(0,))
+  step = make_step()
+  pool = [((jnp.asarray(num), tuple(jnp.asarray(c) for c in cats)),
+           jnp.asarray(lab)) for (num, cats), lab in gen.pool]
 
-  def stack_batches(n):
-    picks = [gen.pool[i % len(gen.pool)] for i in range(n)]
-    num = jnp.stack([jnp.asarray(p[0][0]) for p in picks])
-    cats = tuple(
-        jnp.stack([jnp.asarray(p[0][1][k]) for p in picks])
-        for k in range(len(gen.pool[0][0][1])))
-    labels = jnp.stack([jnp.asarray(p[1]) for p in picks])
-    return ((num, cats), labels)
-
-  # Warm up the *same* compiled scan that gets timed (a different scan
-  # length would be a different program and push compilation into the
-  # timed region).
-  run = make_scan(args.steps)
-  xs = stack_batches(args.steps)
-  for _ in range(max(1, -(-args.warmup // args.steps))):
-    state, losses = run(state, xs)
-  float(losses[-1])  # force full sync (block_until_ready is unreliable here)
+  # Warm up until the program is actually cached: the first call compiles,
+  # and the second recompiles once more when XLA's chosen output layouts
+  # for the donated state differ from the initial buffers' layouts — only
+  # from the third call on is the program cached (measured on v5e: 50s,
+  # 46s, then 1.1s steady state; docs/perf_notes.md).
+  for i in range(max(3, args.warmup)):
+    state, loss = step(state, pool[i % len(pool)])
+  float(loss)  # force full sync (block_until_ready is unreliable here)
 
   start = time.perf_counter()
-  state, losses = run(state, xs)
-  float(losses[-1])
+  for i in range(args.steps):
+    state, loss = step(state, pool[i % len(pool)])
+  float(loss)
   elapsed = time.perf_counter() - start
 
   step_ms = elapsed / args.steps * 1000
@@ -238,16 +234,20 @@ def main():
     metric += f' (baseline: {baseline_ndev}xA100 {baseline} ms)'
   if backend_note:
     metric += f' [{backend_note}]'
-  if args.fused_apply:
+  if args.fused_apply and args.trainer == 'sparse':
     # per-group static eligibility for the fused Pallas apply (the
     # runtime guard in parallel/sparse.py can still decline at trace
     # time); without this note an A/B run can silently measure the XLA
-    # path and read as "kernel is no faster"
+    # path and read as "kernel is no faster".  Mirrors the real gate:
+    # pallas_rowwise.supported() wants 128-lane f32 rows, reached either
+    # directly (width 128) or through sparse.py's _lane_pack view
+    # (width dividing 128 with pack-aligned rows_cap).
     f32 = jnp.dtype(args.param_dtype) == jnp.float32
     groups = model.dist_embedding.plan.groups
     ok = sum(1 for g in groups
-             if f32 and (g.width % 128 == 0 or
-                         (g.width >= 8 and 128 % g.width == 0)))
+             if f32 and (g.width == 128 or
+                         (g.width < 128 and 128 % g.width == 0 and
+                          g.rows_cap % (128 // g.width) == 0)))
     metric += (f' [fused_apply: {ok}/{len(groups)} groups eligible'
                f'{"" if backend == "tpu" else ", inactive off-TPU"}]')
   emit({
