@@ -222,9 +222,16 @@ class SparseAdagrad:
     """One step at COMPACTED unique rows.
 
     Matches the uncompacted semantics exactly: with duplicates, every
-    occurrence reads the accumulator AFTER the full batch's additions
-    (the scatter completes before the gather), so the total update of a
-    row is ``-lr * sum_g / sqrt(acc_new + eps)`` in both formulations.
+    occurrence reads the accumulator AFTER the full batch's additions,
+    so the total update of a row is ``-lr * sum_g / sqrt(acc_new +
+    eps)`` in both formulations.  Because ``uids`` are unique, the new
+    accumulator rows are computed by a GATHER from the pre-update
+    accumulator plus ``add`` and written back with one scatter-set —
+    gathering from the post-scatter accumulator instead (the earlier
+    formulation) creates a scatter->gather dependency that XLA broke by
+    rematerialising the 4.5 GB-temp scatter, i.e. a third full scatter
+    pass per step (~143 ms each at synthetic-tiny scale, trace in
+    docs/perf_notes.md).
     """
     if self.use_pallas_apply:
       from distributed_embeddings_tpu.ops import pallas_rowwise
@@ -237,10 +244,11 @@ class SparseAdagrad:
             eps=self.epsilon, interpret=interpret)
         return t2, {'acc': a2}
     add = sum_g * sum_g if self.dedup else sum_sq
-    acc = state['acc'].at[uids].add(add, mode='drop')
     safe = jnp.clip(uids, 0, table.shape[0] - 1)
-    denom = jnp.sqrt(acc[safe] + self.epsilon)
-    update = (-lr * sum_g / denom).astype(table.dtype)
+    acc_rows = state['acc'][safe] + add
+    acc = state['acc'].at[uids].set(acc_rows, mode='drop')
+    update = (-lr * sum_g * jax.lax.rsqrt(acc_rows + self.epsilon)).astype(
+        table.dtype)
     return table.at[uids].add(update, mode='drop'), {'acc': acc}
 
 

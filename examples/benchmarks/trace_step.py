@@ -64,13 +64,10 @@ def main():
     loss.block_until_ready()
     print(f'warmup {i}: {time.perf_counter() - t0:.1f}s')
 
+  import contextlib
   times = []
-  if args.trace:
-    import contextlib
-    cm = jax.profiler.trace(args.trace)
-  else:
-    import contextlib
-    cm = contextlib.nullcontext()
+  cm = (jax.profiler.trace(args.trace) if args.trace
+        else contextlib.nullcontext())
   with cm:
     for i in range(args.calls):
       t0 = time.perf_counter()
