@@ -118,6 +118,10 @@ def main():
   parser.add_argument('--capacity_fraction', type=float, default=0.5,
                       help='compaction capacity as a fraction of the raw '
                       'update stream (parallel/sparse.py)')
+  parser.add_argument('--auto_capacity', action='store_true',
+                      help='calibrate per-group compaction capacities from '
+                      'the first generated batch (calibrate_capacity_rows) '
+                      'instead of --capacity_fraction')
   args = parser.parse_args()
 
   jax, devices, backend_note = init_backend()
@@ -173,8 +177,16 @@ def main():
 
   # keras Adagrad defaults (reference synthetic_models/main.py:105)
   optimizer = optax.adagrad(0.01, initial_accumulator_value=0.1, eps=1e-7)
+  capacity_rows = None
+  if args.auto_capacity and args.trainer == 'sparse':
+    from distributed_embeddings_tpu.parallel import calibrate_capacity_rows
+    (_, cats0), _ = gen.pool[0]
+    capacity_rows = calibrate_capacity_rows(model.dist_embedding,
+                                            [jnp.asarray(c) for c in cats0],
+                                            params=params['embedding'])
   emb_opt = SparseAdagrad(learning_rate=0.01,
                           capacity_fraction=args.capacity_fraction,
+                          capacity_rows=capacity_rows,
                           use_pallas_apply=args.fused_apply)
   if args.trainer == 'sparse':
     state = init_hybrid_train_state(model.dist_embedding, params, optimizer,

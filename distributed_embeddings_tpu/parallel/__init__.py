@@ -31,6 +31,7 @@ from distributed_embeddings_tpu.parallel.sparse import (
     SparseSGD,
     SparseAdagrad,
     SparseAdam,
+    calibrate_capacity_rows,
     dedup_rows,
     make_hybrid_train_step,
     init_hybrid_train_state,

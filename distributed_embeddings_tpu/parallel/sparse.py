@@ -166,6 +166,7 @@ class SparseSGD:
   (`examples/dlrm/main.py:192-194`)."""
   learning_rate: float = 0.01
   capacity_fraction: float = 0.5
+  capacity_rows: Optional[Tuple[Optional[int], ...]] = None
 
   needs_sq = False
   supports_lane_packing = True
@@ -197,6 +198,7 @@ class SparseAdagrad:
   epsilon: float = 1e-7
   dedup: bool = True
   capacity_fraction: float = 0.5
+  capacity_rows: Optional[Tuple[Optional[int], ...]] = None
   # opt-in fused Pallas apply (ops/pallas_rowwise.py): one DMA pass over
   # the unique rows instead of three XLA random passes; takes effect on
   # TPU for 128-lane f32 tables (incl. lane-packed views), silently
@@ -265,6 +267,7 @@ class SparseAdam:
   b2: float = 0.999
   epsilon: float = 1e-8
   capacity_fraction: float = 0.5
+  capacity_rows: Optional[Tuple[Optional[int], ...]] = None
 
   needs_sq = False
   # the per-row step counter 't' is not an elementwise-lane quantity
@@ -338,7 +341,7 @@ def _lane_pack(uids, sum_g, sum_sq, pack: int, rows_cap: int):
 
 
 def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
-                     rows_cap: int):
+                     rows_cap: int, cap_rows: Optional[int] = None):
   """Compact duplicate update rows, then run the optimizer on the unique
   rows only.
 
@@ -371,9 +374,14 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
   """
   n = flat_ids.shape[0]
   sentinel = rows_cap
-  frac = getattr(optimizer, 'capacity_fraction', 0.5)
   cap_safe = min(n, rows_cap + 2)  # uniques <= rows_cap + sentinel segment
-  cap = min(cap_safe, max(8, -(-int(n * frac) // 8) * 8))
+  if cap_rows is not None:
+    # calibrated per-group capacity (calibrate_capacity_rows); the
+    # overflow correction wave below keeps under-estimates correct
+    cap = min(cap_safe, max(8, -(-int(cap_rows) // 8) * 8))
+  else:
+    frac = getattr(optimizer, 'capacity_fraction', 0.5)
+    cap = min(cap_safe, max(8, -(-int(n * frac) // 8) * 8))
   with_sq = bool(getattr(optimizer, 'needs_sq', True))
   w = flat_g.shape[1]
   pack = 128 // w if (w < 128 and 128 % w == 0) else 1
@@ -467,8 +475,13 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
       (flat_ids, flat_g, fence) = jax.lax.optimization_barrier(
           (flat_ids, flat_g, fence))
       state_g = {k: v[0] for k, v in opt_state[key].items()}
+      cap_rows = None
+      caps = getattr(optimizer, 'capacity_rows', None)
+      if caps is not None and gi < len(caps):
+        cap_rows = caps[gi]
       table, state2 = _dedup_and_apply(optimizer, params[key][0], state_g,
-                                       flat_ids, flat_g, lr, rows_cap)
+                                       flat_ids, flat_g, lr, rows_cap,
+                                       cap_rows=cap_rows)
       new_params[key] = table[None]
       new_state[key] = {k: v[None] for k, v in state2.items()}
       fence = table[0, 0]
@@ -571,6 +584,64 @@ def make_hybrid_train_step(dist: DistributedEmbedding,
   if not jit:
     return step  # composable form (e.g. as a lax.scan body)
   return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def calibrate_capacity_rows(dist: DistributedEmbedding, cats,
+                            margin: float = 1.3,
+                            params=None) -> Tuple[int, ...]:
+  """Measure per-group unique-update-row counts on a sample batch and
+  return calibrated ``capacity_rows`` for the sparse optimizers.
+
+  The compaction capacity sets the STATIC size of every per-group
+  scatter/gather in the apply (docs/perf_notes.md: scatter cost is
+  linear in static rows, dropped or not), so sizing it from the id
+  distribution instead of the worst case shrinks the apply
+  proportionally — e.g. synthetic-tiny's big fused group carries 859k
+  uniques per 65536-batch against a 1.44M default cap.  Power-law id
+  streams are stationary, so one batch plus ``margin`` headroom is
+  representative; if a later batch still overflows, the ``lax.cond``
+  correction wave applies the dropped segments (slower, never wrong).
+
+  Runs the forward eagerly on whatever backend is active (CPU works and
+  avoids burning TPU compile time on a throwaway program).
+
+  The apply runs per device under ``shard_map`` with ONE static capacity
+  per group, so the calibration takes the MAX unique count across the
+  device axis (each device routes a different id subset to its shard).
+
+  Args:
+    dist: the (built) ``DistributedEmbedding``.
+    cats: a representative embedding input list, as passed to
+      ``forward_with_residuals``.
+    margin: multiplicative headroom over the measured unique count.
+    params: optional embedding params to reuse (skips a throwaway
+      ``dist.init`` — the id streams don't depend on parameter values,
+      but the forward needs arrays of the right shape).
+
+  Returns:
+    One capacity (int rows) per fusion group, ordered by group index —
+    pass as ``SparseAdagrad(capacity_rows=...)`` etc.
+  """
+  import numpy as np
+  if params is None:
+    params = dist.init(0)
+  _, residuals, (_, hotness) = dist.forward_with_residuals(params, cats)
+  subs = dist._subgroups(hotness)
+  per_group = {}
+  for si, sub in enumerate(subs):
+    ids = np.asarray(residuals[si])        # [D, n_cap, GB, h]
+    per_group.setdefault(sub.gi, []).append(ids.reshape(ids.shape[0], -1))
+  caps = []
+  for gi, group in enumerate(dist.plan.groups):
+    streams = per_group.get(gi)
+    if not streams:
+      caps.append(8)
+      continue
+    per_dev = np.concatenate(streams, axis=1)  # [D, total_stream]
+    uniq = max(
+        np.unique(row[row < group.rows_cap]).size for row in per_dev)
+    caps.append(max(8, int(uniq * margin)))
+  return tuple(caps)
 
 
 def init_hybrid_train_state(dist: DistributedEmbedding, params,

@@ -323,6 +323,40 @@ def test_capacity_fraction_overflow_fallback(frac):
                                np.asarray(want[k]), rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.parametrize('mode', ['calibrated', 'too_small'])
+def test_capacity_rows_calibration(mode):
+  # calibrated per-group capacities must reproduce the dense oracle; a
+  # deliberately under-sized capacity_rows must stay correct through the
+  # overflow correction wave
+  from distributed_embeddings_tpu.parallel import calibrate_capacity_rows
+  dist, params_emb, gen_inputs, kernel, labels, head_loss_fn = build(seed=7)
+  cats = gen_inputs()
+  if mode == 'calibrated':
+    caps = calibrate_capacity_rows(dist, cats, margin=1.3)
+    assert len(caps) == len(dist.plan.groups)
+    assert all(isinstance(c, int) and c >= 8 for c in caps)
+  else:
+    caps = tuple(8 for _ in dist.plan.groups)
+  opt = SparseAdagrad(learning_rate=LR, dedup=True,
+                      initial_accumulator_value=0.1, capacity_rows=caps)
+  g = dense_grads(dist, params_emb, kernel, cats, labels,
+                  head_loss_fn)['embedding']
+  acc0 = jax.tree.map(lambda x: jnp.full_like(x, 0.1), params_emb)
+  want, _ = _keras_adagrad_dense(params_emb, g, acc0, LR)
+
+  step = make_hybrid_train_step(dist, head_loss_fn, optax.sgd(LR), opt,
+                                donate=False)
+  state = init_hybrid_train_state(dist, {
+      'embedding': params_emb,
+      'kernel': kernel
+  }, optax.sgd(LR), opt)
+  state, loss = step(state, cats, labels)
+  assert np.isfinite(float(loss))
+  for k in params_emb:
+    np.testing.assert_allclose(np.asarray(state.params['embedding'][k]),
+                               np.asarray(want[k]), rtol=2e-5, atol=2e-6)
+
+
 def test_hybrid_step_with_lr_schedule():
   dist, params_emb, gen_inputs, kernel, labels, head_loss_fn = build()
   cats = gen_inputs()
