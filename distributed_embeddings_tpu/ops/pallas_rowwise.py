@@ -1,29 +1,33 @@
 """Pallas TPU kernel: fused row-wise Adagrad update over unique rows.
 
 The XLA formulation of one sparse Adagrad step costs three random-access
-passes over HBM per unique row — accumulator scatter-add, accumulator
-gather, table scatter-add — at ~110-140 ns per scatter row on v5e
+passes over HBM per unique row — accumulator gather, accumulator
+scatter-set, table scatter-add — at ~100-140 ns per scatter row on v5e
 (docs/perf_notes.md).  This kernel fuses the whole update into one pass:
 per unique row, DMA the table row and accumulator row into VMEM, apply
-the Adagrad math vectorised, and DMA both back — 4 copies at the ~47 ns
-DMA-issue floor, roughly halving the projected per-row cost.  OPT-IN
+the Adagrad math vectorised, and DMA both back.  Writes are parity
+double-buffered across grid steps, so tile t's read issue overlaps tile
+t-1's writes in flight — the per-row cost approaches the DMA-issue
+floor instead of three serialized scatter passes.  OPT-IN
 (`SparseAdagrad(use_pallas_apply=True)`) until hardware measurement
 confirms the win; the XLA path stays the default.
 
-Operates on 128-lane rows only: either tables of width 128, or the
-lane-packed ``[rows_cap // pack, 128]`` views the sparse path already
-builds for sub-128 widths (`parallel/sparse.py:_lane_pack`) — mirroring
-how the lookup kernel covers narrow widths.  f32 tables only: bf16
-single-sublane HBM slices are rejected by Mosaic (see
+Supported row widths: 128 (native lane count) and any narrow width
+dividing 128 with at least 8 lanes (8/16/32/64) — the big fused groups
+of the synthetic benchmarks are width 8-16 and too tall to lane-pack,
+so the kernel must take them at natural width (narrow rows waste VPU
+lanes, but the math is trivial; the cost is DMA issue).  f32 tables
+only: bf16 single-sublane HBM slices are rejected by Mosaic (see
 ops/pallas_lookup.py), and the bf16 pair-fetch trick is unsafe here
 because WRITING a fetched pair back would race a neighbouring unique
 row's read-modify-write in another grid step.
 
 Correctness preconditions (the sparse path guarantees both):
-- ``uids`` hold UNIQUE row ids in ascending order with all sentinels
-  (>= num_rows) in a contiguous tail (``compact_segments`` rank order) —
-  uniqueness removes read-modify-write hazards between grid steps, and
-  the sorted tail lets a per-tile count skip sentinel work entirely.
+- ``uids`` hold UNIQUE row ids with all sentinels (>= num_rows) in a
+  contiguous tail (``compact_segments`` rank order) — uniqueness
+  removes read-modify-write hazards between grid steps (including the
+  deferred-write overlap), and the sorted tail lets a per-tile count
+  skip sentinel work entirely.
 - the update semantics are elementwise per row (Adagrad with either
   accumulator mode; plain SGD degenerates to ``sum_sq=None``).
 
@@ -43,8 +47,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# unique rows processed per grid step (two [TILE, 128] f32 buffers each
-# for table and accumulator rows: 256 KiB of VMEM)
+# unique rows processed per grid step (two parity copies of two
+# [TILE, width] f32 buffers: 256 KiB of VMEM at width 128)
 TILE = 128
 
 # Test hook: when True, the SparseAdagrad integration path engages the
@@ -54,74 +58,104 @@ TILE = 128
 FORCE_INTERPRET = False
 
 
+def _tile_count(total, t):
+  """Valid (non-sentinel) rows in tile ``t`` — pure function of the
+  grid step, so any tile can reconstruct another tile's DMA count when
+  draining its deferred writes."""
+  return jnp.clip(total - t * TILE, 0, TILE)
+
+
 def _adagrad_kernel(count_smem, ids_smem, g_ref, sq_ref, lr_smem, table_in,
-                    acc_in, table_ref, acc_ref, tbuf, abuf, sem, *,
-                    num_rows, dedup, eps, have_sq):
+                    acc_in, table_ref, acc_ref, tbuf, abuf, rsem, wsem, *,
+                    num_rows, num_tiles, dedup, eps, have_sq):
   """One tile of unique rows: burst-read, vector update, burst-write.
 
   ``table_ref``/``acc_ref`` are the ANY-space OUTPUT refs, aliased onto
   the ``table_in``/``acc_in`` inputs (the update happens in place; rows
-  are unique, so no grid step reads a row another step writes);
-  ``count_smem`` holds the number of valid (non-sentinel) rows in the
-  whole stream.
+  are unique, so no grid step reads a row another step writes, even
+  with writes still in flight);  ``count_smem`` holds the number of
+  valid rows in the whole stream.  ``tbuf``/``abuf`` are ``[2, TILE,
+  w]`` parity scratch: tile ``t`` uses parity ``t % 2`` and drains tile
+  ``t-2``'s writes before reusing the buffer, so the writes of tile
+  ``t-1`` stay in flight through tile ``t``'s read issue.
   """
   del table_in, acc_in  # same memory as the aliased output refs
   t = pl.program_id(0)
-  base = t * TILE
-  cnt = jnp.clip(count_smem[0, 0] - base, 0, TILE)
+  p = jax.lax.rem(t, 2)
+  total = count_smem[0, 0]
+  cnt = _tile_count(total, t)
+
+  def wait_writes(tile, _):
+    """Drain the 2*cnt(tile) writes issued at grid step ``tile`` (its
+    parity is ``tile % 2``)."""
+    prev = _tile_count(total, tile)
+    pp = jax.lax.rem(tile, 2)
+
+    def w(k, _):
+      pltpu.make_async_copy(tbuf.at[pp, pl.ds(k, 1)],
+                            table_ref.at[pl.ds(0, 1)], wsem.at[pp]).wait()
+      pltpu.make_async_copy(abuf.at[pp, pl.ds(k, 1)],
+                            acc_ref.at[pl.ds(0, 1)], wsem.at[pp]).wait()
+      return 0
+
+    jax.lax.fori_loop(0, prev, w, 0)
+    return 0
+
+  # reuse of this parity's buffers: tile t-2's writes must be done
+  jax.lax.cond(t >= 2, lambda _: wait_writes(t - 2, 0), lambda _: 0, 0)
 
   def read_row(k, _):
     rid = jnp.clip(ids_smem[k, 0], 0, num_rows - 1)
     pltpu.make_async_copy(table_ref.at[pl.ds(rid, 1)],
-                          tbuf.at[pl.ds(k, 1)], sem).start()
+                          tbuf.at[p, pl.ds(k, 1)], rsem).start()
     pltpu.make_async_copy(acc_ref.at[pl.ds(rid, 1)],
-                          abuf.at[pl.ds(k, 1)], sem).start()
+                          abuf.at[p, pl.ds(k, 1)], rsem).start()
     return 0
 
   jax.lax.fori_loop(0, cnt, read_row, 0)
 
   def wait_row(k, _):
     pltpu.make_async_copy(table_ref.at[pl.ds(0, 1)],
-                          tbuf.at[pl.ds(k, 1)], sem).wait()
+                          tbuf.at[p, pl.ds(k, 1)], rsem).wait()
     pltpu.make_async_copy(acc_ref.at[pl.ds(0, 1)],
-                          abuf.at[pl.ds(k, 1)], sem).wait()
+                          abuf.at[p, pl.ds(k, 1)], rsem).wait()
     return 0
 
   jax.lax.fori_loop(0, cnt, wait_row, 0)
 
-  g = g_ref[:]                                  # [TILE, 128] f32
+  g = g_ref[:]                                  # [TILE, w] f32
   add = g * g if (dedup or not have_sq) else sq_ref[:]
-  acc_new = abuf[:] + add
+  acc_new = abuf[p] + add
   lr = lr_smem[0, 0]
   upd = -lr * g * jax.lax.rsqrt(acc_new + eps)
-  tbuf[:] = tbuf[:] + upd
-  abuf[:] = acc_new
+  tbuf[p] = tbuf[p] + upd
+  abuf[p] = acc_new
 
   def write_row(k, _):
     rid = jnp.clip(ids_smem[k, 0], 0, num_rows - 1)
-    pltpu.make_async_copy(tbuf.at[pl.ds(k, 1)],
-                          table_ref.at[pl.ds(rid, 1)], sem).start()
-    pltpu.make_async_copy(abuf.at[pl.ds(k, 1)],
-                          acc_ref.at[pl.ds(rid, 1)], sem).start()
+    pltpu.make_async_copy(tbuf.at[p, pl.ds(k, 1)],
+                          table_ref.at[pl.ds(rid, 1)], wsem.at[p]).start()
+    pltpu.make_async_copy(abuf.at[p, pl.ds(k, 1)],
+                          acc_ref.at[pl.ds(rid, 1)], wsem.at[p]).start()
     return 0
 
   jax.lax.fori_loop(0, cnt, write_row, 0)
 
-  def drain_row(k, _):
-    pltpu.make_async_copy(tbuf.at[pl.ds(k, 1)],
-                          table_ref.at[pl.ds(0, 1)], sem).wait()
-    pltpu.make_async_copy(abuf.at[pl.ds(k, 1)],
-                          acc_ref.at[pl.ds(0, 1)], sem).wait()
-    return 0
-
-  jax.lax.fori_loop(0, cnt, drain_row, 0)
+  # last grid step: nothing overlaps past the kernel — drain everything
+  # still in flight (tile t-1's writes and this tile's own)
+  @pl.when(t == num_tiles - 1)
+  def _drain():
+    jax.lax.cond(t >= 1, lambda _: wait_writes(t - 1, 0), lambda _: 0, 0)
+    wait_writes(t, 0)
 
 
 def supported(table: jax.Array, acc: jax.Array) -> bool:
   """Whether the fused apply path handles these arrays."""
-  return (table.ndim == 2 and table.shape[1] == 128
-          and table.dtype == jnp.float32 and acc.shape == table.shape
-          and acc.dtype == jnp.float32)
+  if not (table.ndim == 2 and table.dtype == jnp.float32
+          and acc.shape == table.shape and acc.dtype == jnp.float32):
+    return False
+  w = table.shape[1]
+  return w == 128 or (8 <= w < 128 and 128 % w == 0)
 
 
 @functools.partial(jax.jit,
@@ -136,14 +170,14 @@ def adagrad_apply(table: jax.Array,
                   dedup: bool,
                   eps: float,
                   interpret: bool = False):
-  """Fused in-place Adagrad step at unique 128-lane rows.
+  """Fused in-place Adagrad step at unique rows (width 8..128 | 128).
 
   Args:
-    table/acc: ``[num_rows, 128]`` f32 (donate for true in-place).
-    uids: ``[c]`` ascending unique row ids, sentinels (>= num_rows) in a
+    table/acc: ``[num_rows, w]`` f32 (donate for true in-place).
+    uids: ``[c]`` unique row ids, sentinels (>= num_rows) in a
       contiguous tail.
-    sum_g: ``[c, 128]`` f32 per-row summed gradients.
-    sum_sq: ``[c, 128]`` f32 per-row summed squared gradients, or None
+    sum_g: ``[c, w]`` f32 per-row summed gradients.
+    sum_sq: ``[c, w]`` f32 per-row summed squared gradients, or None
       (then ``dedup`` semantics are used regardless).
     lr: scalar learning rate.
     dedup: accumulator adds ``sum_g**2`` (reference dedup semantics)
@@ -156,7 +190,7 @@ def adagrad_apply(table: jax.Array,
     raise ValueError(
         f'pallas adagrad_apply unsupported: table {table.shape} '
         f'{table.dtype}, acc {acc.shape} {acc.dtype}')
-  num_rows = table.shape[0]
+  num_rows, w = table.shape
   c = uids.shape[0]
   c_pad = -(-c // TILE) * TILE
   if c_pad != c:
@@ -170,28 +204,30 @@ def adagrad_apply(table: jax.Array,
   lr_arr = jnp.asarray(lr, jnp.float32).reshape(1, 1)
   if have_sq:
     sq_operand = sum_sq
-    sq_spec = pl.BlockSpec((TILE, 128), lambda t: (t, 0),
+    sq_spec = pl.BlockSpec((TILE, w), lambda t: (t, 0),
                            memory_space=pltpu.VMEM)
   else:
     # the kernel never reads sq when have_sq is false; a single shared
     # zero block avoids streaming a second gradient-sized operand
-    sq_operand = jnp.zeros((TILE, 128), jnp.float32)
-    sq_spec = pl.BlockSpec((TILE, 128), lambda t: (0, 0),
+    sq_operand = jnp.zeros((TILE, w), jnp.float32)
+    sq_spec = pl.BlockSpec((TILE, w), lambda t: (0, 0),
                            memory_space=pltpu.VMEM)
 
+  num_tiles = c_pad // TILE
   kernel = functools.partial(_adagrad_kernel,
                              num_rows=num_rows,
+                             num_tiles=num_tiles,
                              dedup=dedup,
                              eps=eps,
                              have_sq=have_sq)
   out_t, out_a = pl.pallas_call(
       kernel,
-      grid=(c_pad // TILE,),
+      grid=(num_tiles,),
       in_specs=[
           pl.BlockSpec(memory_space=pltpu.SMEM),         # count [1,1]
           pl.BlockSpec((TILE, 1), lambda t: (t, 0),
                        memory_space=pltpu.SMEM),          # ids column
-          pl.BlockSpec((TILE, 128), lambda t: (t, 0),
+          pl.BlockSpec((TILE, w), lambda t: (t, 0),
                        memory_space=pltpu.VMEM),          # sum_g
           sq_spec,                                        # sum_sq
           pl.BlockSpec(memory_space=pltpu.SMEM),          # lr [1,1]
@@ -208,9 +244,10 @@ def adagrad_apply(table: jax.Array,
       ],
       input_output_aliases={5: 0, 6: 1},
       scratch_shapes=[
-          pltpu.VMEM((TILE, 128), jnp.float32),
-          pltpu.VMEM((TILE, 128), jnp.float32),
+          pltpu.VMEM((2, TILE, w), jnp.float32),
+          pltpu.VMEM((2, TILE, w), jnp.float32),
           pltpu.SemaphoreType.DMA,
+          pltpu.SemaphoreType.DMA((2,)),
       ],
       compiler_params=pltpu.CompilerParams(
           dimension_semantics=('arbitrary',)),

@@ -201,8 +201,9 @@ class SparseAdagrad:
   capacity_rows: Optional[Tuple[Optional[int], ...]] = None
   # opt-in fused Pallas apply (ops/pallas_rowwise.py): one DMA pass over
   # the unique rows instead of three XLA random passes; takes effect on
-  # TPU for 128-lane f32 tables (incl. lane-packed views), silently
-  # falling back to the XLA path elsewhere
+  # TPU for f32 tables of width 128 or widths 8..64 dividing 128
+  # (natural-width or lane-packed), silently falling back to the XLA
+  # path elsewhere
   use_pallas_apply: bool = False
 
   supports_lane_packing = True

@@ -19,15 +19,15 @@ def xla_reference(table, acc, uids, sum_g, sum_sq, lr, dedup, eps):
   return table.at[uids].add(upd, mode='drop'), acc2
 
 
-def make_case(rng, rows, c, valid):
-  table = jnp.asarray(rng.normal(size=(rows, 128)).astype(np.float32))
+def make_case(rng, rows, c, valid, width=128):
+  table = jnp.asarray(rng.normal(size=(rows, width)).astype(np.float32))
   acc = jnp.asarray(
-      rng.uniform(0.1, 1.0, size=(rows, 128)).astype(np.float32))
+      rng.uniform(0.1, 1.0, size=(rows, width)).astype(np.float32))
   # ascending unique ids with a sentinel tail (compact_segments order)
   ids = np.sort(rng.choice(rows, size=valid, replace=False)).astype(np.int32)
   uids = np.full((c,), rows, np.int32)
   uids[:valid] = ids
-  g = rng.normal(size=(c, 128)).astype(np.float32)
+  g = rng.normal(size=(c, width)).astype(np.float32)
   g[valid:] = 0
   sq = (g * g * rng.uniform(0.5, 1.5, size=(c, 1))).astype(np.float32)
   return table, acc, jnp.asarray(uids), jnp.asarray(g), jnp.asarray(sq)
@@ -35,11 +35,13 @@ def make_case(rng, rows, c, valid):
 
 @pytest.mark.parametrize('dedup,with_sq', [(False, True), (True, True),
                                            (True, False)])
-@pytest.mark.parametrize('rows,c,valid', [(512, 128, 100), (1000, 300, 256),
-                                          (64, 64, 64)])
-def test_matches_xla(rows, c, valid, dedup, with_sq):
+@pytest.mark.parametrize('rows,c,valid,width',
+                         [(512, 128, 100, 128), (1000, 300, 256, 128),
+                          (64, 64, 64, 128), (5000, 300, 280, 16),
+                          (2000, 200, 150, 8), (777, 140, 130, 64)])
+def test_matches_xla(rows, c, valid, width, dedup, with_sq):
   rng = np.random.default_rng(rows + c + valid)
-  table, acc, uids, g, sq = make_case(rng, rows, c, valid)
+  table, acc, uids, g, sq = make_case(rng, rows, c, valid, width)
   sq_in = sq if with_sq else None
   got_t, got_a = pallas_rowwise.adagrad_apply(
       table, acc, uids, g, sq_in, 0.05, dedup=dedup, eps=1e-7,
@@ -66,14 +68,21 @@ def test_untouched_rows_unchanged():
 
 
 def test_unsupported_shapes_raise():
-  t64 = jnp.zeros((32, 64), jnp.float32)
-  a64 = jnp.zeros((32, 64), jnp.float32)
-  assert not pallas_rowwise.supported(t64, a64)
+  # widths 8..128 dividing 128 are supported; others are not
+  for w in (8, 16, 32, 64, 128):
+    arr = jnp.zeros((32, w), jnp.float32)
+    assert pallas_rowwise.supported(arr, arr)
+  t3 = jnp.zeros((32, 3), jnp.float32)
+  assert not pallas_rowwise.supported(t3, t3)       # sub-8 degenerate
+  t48 = jnp.zeros((32, 48), jnp.float32)
+  assert not pallas_rowwise.supported(t48, t48)     # does not divide 128
+  t256 = jnp.zeros((32, 256), jnp.float32)
+  assert not pallas_rowwise.supported(t256, t256)   # wide: XLA fallback
   tb = jnp.zeros((32, 128), jnp.bfloat16)
   assert not pallas_rowwise.supported(tb, jnp.zeros((32, 128), jnp.float32))
   with pytest.raises(ValueError, match='unsupported'):
-    pallas_rowwise.adagrad_apply(t64, a64, jnp.zeros((8,), jnp.int32),
-                                 jnp.zeros((8, 64)), None, 0.1,
+    pallas_rowwise.adagrad_apply(t48, t48, jnp.zeros((8,), jnp.int32),
+                                 jnp.zeros((8, 48)), None, 0.1,
                                  dedup=True, eps=1e-7, interpret=True)
 
 
