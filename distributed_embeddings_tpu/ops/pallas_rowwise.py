@@ -170,7 +170,7 @@ def adagrad_apply(table: jax.Array,
                   dedup: bool,
                   eps: float,
                   interpret: bool = False):
-  """Fused in-place Adagrad step at unique rows (width 8..128 | 128).
+  """Fused in-place Adagrad step at unique rows (width 8/16/32/64/128).
 
   Args:
     table/acc: ``[num_rows, w]`` f32 (donate for true in-place).
