@@ -26,7 +26,9 @@ from distributed_embeddings_tpu.parallel.grad import (broadcast_variables,
                                                       TrainState,
                                                       make_train_step,
                                                       init_train_state)
-from distributed_embeddings_tpu.parallel.mesh import create_mesh
+from distributed_embeddings_tpu.parallel.mesh import (create_mesh,
+                                                      init_distributed,
+                                                      make_global_batch)
 from distributed_embeddings_tpu.parallel.sparse import (
     SparseSGD,
     SparseAdagrad,

@@ -43,3 +43,68 @@ def table_sharding(mesh: Mesh, axis_name: str = DEFAULT_AXIS,
 def replicated(mesh: Mesh) -> NamedSharding:
   """Replicated sharding (dense/data-parallel parameters)."""
   return NamedSharding(mesh, P())
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> int:
+  """Join the multi-host world — the ``hvd.init()`` analog
+  (`/root/reference/.../dist_model_parallel.py:350-353`).
+
+  Call once per process before any other JAX use; afterwards
+  ``jax.devices()`` spans every host's chips and ``create_mesh()``
+  builds the global mesh, over which the runtime's collectives ride ICI
+  within a slice and DCN across slices (XLA picks the transport from
+  the mesh's device topology — no NCCL/MPI-style backend selection
+  exists or is needed).  With no arguments, TPU pod environments
+  auto-discover coordinates (GKE/Cloud metadata); single-process use
+  needs no call at all.
+
+  Returns this process's index (the ``hvd.rank()`` analog; also
+  available any time as ``jax.process_index()``).
+  """
+  if any(a is not None for a in (coordinator_address, num_processes,
+                                 process_id)):
+    # explicit topology: forward everything (jax fills any None from the
+    # cluster env) and let misconfiguration errors propagate
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+  else:
+    try:
+      jax.distributed.initialize()
+    except ValueError:
+      # no cluster coordinates detectable -> single-process world.  A
+      # RuntimeError ("must be called before any JAX calls") is NOT
+      # swallowed: calling too late is a real bug that would otherwise
+      # silently degrade a pod job to N independent single-host worlds.
+      pass
+  return jax.process_index()
+
+
+def make_global_batch(mesh: Mesh, *arrays):
+  """Assemble process-local batch shards into global mesh-sharded arrays.
+
+  Each process feeds only its local slice of the global batch (the
+  reference's per-rank dataset slicing, `examples/dlrm/utils.py` MP/DP
+  split); this stitches those into batch-sharded global ``jax.Array``s
+  without any cross-host copy (device buffers stay where the host put
+  them).  Single-process meshes just ``device_put`` with the batch
+  sharding.
+
+  Args:
+    mesh: the global mesh (all processes).
+    *arrays: process-local numpy/jax arrays, leading dim = local batch.
+
+  Returns:
+    One global array per input (tuple if several), leading dim =
+    global batch, sharded over the mesh axis.
+  """
+  outs = []
+  for a in arrays:
+    sharding = batch_sharding(mesh, mesh.axis_names[0], np.ndim(a))
+    if jax.process_count() == 1:
+      outs.append(jax.device_put(a, sharding))
+    else:
+      outs.append(jax.make_array_from_process_local_data(sharding, a))
+  return outs[0] if len(outs) == 1 else tuple(outs)

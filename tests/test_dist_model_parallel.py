@@ -489,6 +489,49 @@ class TestBfloat16EndToEnd:
       np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-2)
 
 
+class TestMultihostHelpers:
+
+  def test_make_global_batch_single_process(self):
+    from distributed_embeddings_tpu.parallel import make_global_batch
+    mesh = create_mesh(jax.devices()[:4])
+    num = np.arange(32, dtype=np.float32).reshape(8, 4)
+    cats = np.arange(8, dtype=np.int32)
+    gnum, gcats = make_global_batch(mesh, num, cats)
+    assert gnum.shape == (8, 4) and gcats.shape == (8,)
+    np.testing.assert_array_equal(np.asarray(gnum), num)
+    np.testing.assert_array_equal(np.asarray(gcats), cats)
+    # batch dim sharded over the mesh axis
+    assert gnum.sharding.spec[0] == 'data'
+    single = make_global_batch(mesh, num)
+    np.testing.assert_array_equal(np.asarray(single), num)
+
+  def test_init_distributed_single_process(self):
+    # degenerate single-process world: returns process index 0 without a
+    # coordinator.  Runs in a fresh interpreter because init_distributed
+    # must precede backend init (it deliberately propagates the
+    # called-too-late RuntimeError instead of degrading silently).
+    import os
+    import subprocess
+    import sys
+    code = ('import jax; jax.config.update("jax_platforms", "cpu");\n'
+            'from distributed_embeddings_tpu.parallel import '
+            'init_distributed\n'
+            'assert init_distributed() == 0\n'
+            'print("rank0-ok")')
+    proc = subprocess.run([sys.executable, '-c', code],
+                          capture_output=True, text=True, timeout=240,
+                          env={**os.environ, 'JAX_PLATFORMS': 'cpu'})
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert 'rank0-ok' in proc.stdout
+
+  def test_init_distributed_called_too_late_raises(self):
+    from distributed_embeddings_tpu.parallel import init_distributed
+    # backend is already up in the test process: the no-arg path must
+    # surface the mistake, not silently stay single-process
+    with pytest.raises(RuntimeError):
+      init_distributed()
+
+
 class TestInit:
 
   def test_init_shapes_match_plan(self):

@@ -72,7 +72,7 @@ def test_compiled_matches_oracle(w, dtype):
 
 
 @requires_tpu
-@pytest.mark.parametrize('w', [8, 16, 64, 128])
+@pytest.mark.parametrize('w', [8, 16, 32, 64, 128])
 @pytest.mark.parametrize('dedup', [True, False])
 def test_rowwise_apply_compiled_matches_xla(w, dedup):
   """Fused row-wise Adagrad apply (ops/pallas_rowwise.py) compiled on
