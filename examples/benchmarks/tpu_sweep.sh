@@ -1,0 +1,33 @@
+#!/bin/bash
+# One-shot measurement sweep for a healthy TPU tunnel, highest-value first.
+# Each step is independently killable; results append to the log.
+# Usage: bash examples/benchmarks/tpu_sweep.sh [logfile]
+set -u
+LOG=${1:-/tmp/tpu_sweep.log}
+cd "$(dirname "$0")/../.."
+run() {
+  echo "=== $* ($(date +%H:%M:%S)) ===" | tee -a "$LOG"
+  timeout "${T:-900}" "$@" 2>&1 | grep -v WARNING | tail -6 | tee -a "$LOG"
+}
+
+# 1. kernel A/B at the exact dominant shape (fast, most informative)
+T=1200 run python -m pytest tests/test_pallas_tpu.py -q -s -k rowwise_apply_microbench
+
+# 2. steady-state step time, XLA apply vs fused apply, calibrated caps
+T=1200 run python examples/benchmarks/trace_step.py --calls 3 --auto_capacity
+T=1200 run python examples/benchmarks/trace_step.py --calls 3 --auto_capacity --fused_apply
+
+# 3. the official bench artifact line (what BENCH_rN.json captures)
+T=1200 run python bench.py --model tiny --steps 10 --auto_capacity
+T=1200 run python bench.py --model tiny --steps 10 --auto_capacity --fused_apply
+
+# 4. bf16 tables variant
+T=1200 run python bench.py --model tiny --steps 10 --auto_capacity --param_dtype bfloat16
+
+# 5. DLRM-shaped criteo model (width 128, hotness 1: kernel sweet spot)
+T=1200 run python bench.py --model criteo --steps 10 --auto_capacity --fused_apply
+
+# 6. remaining hardware correctness gates
+T=1800 run python -m pytest tests/test_pallas_tpu.py -q -s -k "not microbench"
+
+echo "sweep done: $LOG"

@@ -20,6 +20,7 @@ def main():
   p.add_argument('--param_dtype', default='float32')
   p.add_argument('--fused_apply', action='store_true')
   p.add_argument('--capacity_fraction', type=float, default=0.5)
+  p.add_argument('--auto_capacity', action='store_true')
   p.add_argument('--calls', type=int, default=3)
   args = p.parse_args()
 
@@ -51,8 +52,15 @@ def main():
     return bce_with_logits(model.head(dp, numerical, eo), labels)
 
   opt = optax.adagrad(0.01, initial_accumulator_value=0.1, eps=1e-7)
+  capacity_rows = None
+  if args.auto_capacity:
+    from distributed_embeddings_tpu.parallel import calibrate_capacity_rows
+    capacity_rows = calibrate_capacity_rows(dist, list(cats0),
+                                            params=params['embedding'])
+    print('calibrated capacity_rows:', capacity_rows)
   emb_opt = SparseAdagrad(learning_rate=0.01,
                           capacity_fraction=args.capacity_fraction,
+                          capacity_rows=capacity_rows,
                           use_pallas_apply=args.fused_apply)
   step = jax.jit(make_hybrid_train_step(dist, head_loss_fn, opt, emb_opt,
                                         jit=False), donate_argnums=(0,))
