@@ -118,10 +118,12 @@ def main():
   parser.add_argument('--capacity_fraction', type=float, default=0.5,
                       help='compaction capacity as a fraction of the raw '
                       'update stream (parallel/sparse.py)')
-  parser.add_argument('--auto_capacity', action='store_true',
+  parser.add_argument('--auto_capacity',
+                      action=argparse.BooleanOptionalAction, default=True,
                       help='calibrate per-group compaction capacities from '
                       'the first generated batch (calibrate_capacity_rows) '
-                      'instead of --capacity_fraction')
+                      'instead of --capacity_fraction (default: on; '
+                      '--no-auto_capacity reverts to the fraction)')
   args = parser.parse_args()
 
   jax, devices, backend_note = init_backend()
