@@ -159,6 +159,21 @@ def dedup_rows(ids: jax.Array, grads: jax.Array,
   return uids, seg_total(sg)
 
 
+def _distinct_oob(uids: jax.Array, limit: int) -> jax.Array:
+  """Make the ``unique_indices=True`` scatter promise literally true.
+
+  Compacted id buffers pad unused slots with ONE repeated sentinel value;
+  XLA documents undefined behavior for non-unique indices under the
+  uniqueness hint, even though ``mode='drop'`` discards the out-of-bounds
+  slots in practice.  Replacing the tail with DISTINCT out-of-bounds ids
+  (``limit + position``) keeps the buffer strictly ascending and dropped,
+  at the cost of one iota+where.
+  """
+  n = uids.shape[0]
+  return jnp.where(uids < limit,
+                   uids, limit + jnp.arange(n, dtype=uids.dtype))
+
+
 @dataclasses.dataclass(frozen=True)
 class SparseSGD:
   """Row-wise SGD; exact (SGD is linear, so summed duplicate rows match
@@ -178,7 +193,12 @@ class SparseSGD:
     """Apply one step at COMPACTED unique rows (``compact_segments``)."""
     del sum_sq
     update = (-lr * sum_g).astype(table.dtype)
-    return table.at[uids].add(update, mode='drop'), state
+    # compacted ids are ascending; _distinct_oob makes them strictly
+    # unique so the hints let XLA vectorise the scatter instead of
+    # serialising for duplicates
+    uids = _distinct_oob(uids, table.shape[0])
+    return table.at[uids].add(update, mode='drop', unique_indices=True,
+                              indices_are_sorted=True), state
 
 
 @dataclasses.dataclass(frozen=True)
@@ -251,11 +271,20 @@ class SparseAdagrad:
         return t2, {'acc': a2}
     add = sum_g * sum_g if self.dedup else sum_sq
     safe = jnp.clip(uids, 0, table.shape[0] - 1)
-    acc_rows = state['acc'][safe] + add
-    acc = state['acc'].at[uids].set(acc_rows, mode='drop')
+    # compacted ids are ascending; _distinct_oob makes them strictly
+    # unique (clipped sentinel gathers may duplicate the last row, hence
+    # unique_indices=False there): the hints let XLA vectorise the
+    # gather/scatters instead of serialising for duplicates
+    uids = _distinct_oob(uids, table.shape[0])
+    acc_rows = state['acc'].at[safe].get(unique_indices=False,
+                                         indices_are_sorted=True) + add
+    acc = state['acc'].at[uids].set(acc_rows, mode='drop',
+                                    unique_indices=True,
+                                    indices_are_sorted=True)
     update = (-lr * sum_g * jax.lax.rsqrt(acc_rows + self.epsilon)).astype(
         table.dtype)
-    return table.at[uids].add(update, mode='drop'), {'acc': acc}
+    return table.at[uids].add(update, mode='drop', unique_indices=True,
+                              indices_are_sorted=True), {'acc': acc}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -290,19 +319,26 @@ class SparseAdam:
     segment-summed by ``compact_segments`` — the same dedup the old path
     did internally)."""
     del sum_sq
-    ids, g = uids, sum_g
-    safe = jnp.clip(ids, 0, table.shape[0] - 1)
-    valid = (ids < table.shape[0])[:, None]
-    t = state['t'].at[ids].add(1, mode='drop')
-    m_rows = self.b1 * state['m'][safe] + (1 - self.b1) * g
-    v_rows = self.b2 * state['v'][safe] + (1 - self.b2) * g * g
-    m = state['m'].at[ids].set(jnp.where(valid, m_rows, 0), mode='drop')
-    v = state['v'].at[ids].set(jnp.where(valid, v_rows, 0), mode='drop')
-    t_rows = t[safe].astype(jnp.float32)[:, None]
+    safe = jnp.clip(uids, 0, table.shape[0] - 1)
+    valid = (uids < table.shape[0])[:, None]
+    ids, g = _distinct_oob(uids, table.shape[0]), sum_g
+    # strictly unique ascending ids; see SparseAdagrad.apply_unique
+    hints = dict(unique_indices=True, indices_are_sorted=True)
+    ghints = dict(unique_indices=False, indices_are_sorted=True)
+    t = state['t'].at[ids].add(1, mode='drop', **hints)
+    m_rows = self.b1 * state['m'].at[safe].get(**ghints) + (1 - self.b1) * g
+    v_rows = (self.b2 * state['v'].at[safe].get(**ghints) +
+              (1 - self.b2) * g * g)
+    m = state['m'].at[ids].set(jnp.where(valid, m_rows, 0), mode='drop',
+                               **hints)
+    v = state['v'].at[ids].set(jnp.where(valid, v_rows, 0), mode='drop',
+                               **hints)
+    t_rows = t.at[safe].get(**ghints).astype(jnp.float32)[:, None]
     mhat = m_rows / (1 - self.b1**t_rows)
     vhat = v_rows / (1 - self.b2**t_rows)
     update = (-lr * mhat / (jnp.sqrt(vhat) + self.epsilon)).astype(table.dtype)
-    return table.at[ids].add(update, mode='drop'), {'m': m, 'v': v, 't': t}
+    return table.at[ids].add(update, mode='drop', **hints), {'m': m, 'v': v,
+                                                             't': t}
 
 
 def _lane_pack(uids, sum_g, sum_sq, pack: int, rows_cap: int):
