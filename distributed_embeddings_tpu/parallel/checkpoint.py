@@ -142,8 +142,10 @@ def set_weights(dist: DistributedEmbedding,
       chunks = []
       for lt in g.member_tables[dev]:
         chunks.append(
-            np.asarray(loaded[lt.table_id][:, lt.col_start:lt.col_end],
-                       dtype=dist.param_dtype))
+            np.asarray(
+                loaded[lt.table_id][lt.row_start:lt.row_end,
+                                    lt.col_start:lt.col_end],
+                dtype=dist.param_dtype))
       pad_rows = g.rows_cap - g.rows[dev]
       if pad_rows or not chunks:
         chunks.append(np.zeros((pad_rows, g.width), dist.param_dtype))
@@ -182,14 +184,23 @@ def get_weights(dist: DistributedEmbedding,
 
   result = []
   for tid, shards in enumerate(plan.shard_layout()):
-    pieces = []
-    for dev, group_key, row_offset, col_start, col_end in shards:
+    cfg = plan.table_configs[tid]
+    if len(shards) == 1:
+      dev, group_key, row_offset, _, _, _, _ = shards[0]
       gi = group_index[group_key]
-      rows = plan.table_configs[tid].input_dim
-      pieces.append(
-          host_shards[gi][dev][row_offset:row_offset + rows, :])
-    result.append(np.concatenate(pieces, axis=1) if len(pieces) > 1
-                  else pieces[0])
+      result.append(
+          host_shards[gi][dev][row_offset:row_offset + cfg.input_dim, :])
+      continue
+    # paste row x column windows into the global [rows, width] canvas
+    # (covers column slicing, row slicing, and plain tables uniformly)
+    out = np.empty((cfg.input_dim, cfg.output_dim),
+                   host_shards[group_index[shards[0][1]]][0].dtype)
+    for dev, group_key, row_offset, col_start, col_end, row_start, \
+        row_end in shards:
+      gi = group_index[group_key]
+      out[row_start:row_end, col_start:col_end] = (
+          host_shards[gi][dev][row_offset:row_offset + (row_end - row_start)])
+    result.append(out)
   return result
 
 
@@ -226,23 +237,29 @@ def get_optimizer_state(dist: DistributedEmbedding,
 
   result = []
   for tid, shards in enumerate(plan.shard_layout()):
-    rows = plan.table_configs[tid].input_dim
+    cfg = plan.table_configs[tid]
     entry = {}
     for k in leaf_names:
-      pieces = []
-      for dev, group_key, row_offset, col_start, col_end in shards:
+      canvas = None
+      for dev, group_key, row_offset, col_start, col_end, row_start, \
+          row_end in shards:
         gi = group_index[group_key]
         if (gi, k) not in host:
           continue
-        piece = host[(gi, k)][dev][row_offset:row_offset + rows]
-        pieces.append(piece)
-      if not pieces:
-        continue
-      if pieces[0].ndim == 1:
-        entry[k] = pieces[0]  # per-row: identical across column slices
-      else:
-        entry[k] = (np.concatenate(pieces, axis=1) if len(pieces) > 1
-                    else pieces[0])
+        span = row_end - row_start
+        piece = host[(gi, k)][dev][row_offset:row_offset + span]
+        if canvas is None:
+          shape = ((cfg.input_dim,) if piece.ndim == 1
+                   else (cfg.input_dim, cfg.output_dim))
+          canvas = np.zeros(shape, piece.dtype)
+        if piece.ndim == 1:
+          # per-row leaf: identical across column slices of a row window,
+          # so column shards just overwrite with the same values
+          canvas[row_start:row_end] = piece
+        else:
+          canvas[row_start:row_end, col_start:col_end] = piece
+      if canvas is not None:
+        entry[k] = canvas
     result.append(entry)
   return result
 
@@ -276,10 +293,13 @@ def set_optimizer_state(dist: DistributedEmbedding,
         for lt in g.member_tables[dev]:
           st = np.asarray(table_states[lt.table_id][k])
           if tmpl.ndim == 3:
-            chunks.append(np.asarray(st[:, lt.col_start:lt.col_end],
-                                     dtype=dtype))
+            chunks.append(
+                np.asarray(
+                    st[lt.row_start:lt.row_end, lt.col_start:lt.col_end],
+                    dtype=dtype))
           else:
-            chunks.append(np.asarray(st, dtype=dtype))
+            chunks.append(np.asarray(st[lt.row_start:lt.row_end],
+                                     dtype=dtype))
         pad_rows = g.rows_cap - g.rows[dev]
         if pad_rows or not chunks:
           pad_shape = ((pad_rows, g.width) if tmpl.ndim == 3

@@ -74,8 +74,12 @@ class DistributedEmbedding:
     column_slice_threshold: slice tables with more elements than this along
       the width dimension; ``None`` slices only when there are fewer tables
       than devices (reference docstring, dist_model_parallel.py:319-323).
-    row_slice: not implemented (parity: reference raises too,
-      dist_model_parallel.py:345-346).
+    row_slice: element-count threshold above which tables shard along ROWS
+      (each shard serves its resident id window; shard partial outputs are
+      summed).  BEYOND the reference, whose ``row_slice`` raises
+      NotImplementedError (dist_model_parallel.py:345-346): this is the axis
+      that fits tables whose single column slice still exceeds device HBM.
+      ``None`` disables.  Mean-combiner tables cannot row-slice yet.
     dp_input: if True inputs are data-parallel ``[global_batch(, hot)]``
       arrays sharded over the mesh; otherwise model-parallel canonical
       inputs (see ``apply``).
@@ -100,8 +104,13 @@ class DistributedEmbedding:
                param_dtype: Any = jnp.float32,
                compute_dtype: Any = None,
                lookup_impl: str = 'auto'):
-    if row_slice is not None:
-      raise NotImplementedError('Row slicing embedding is not supported yet!')
+    if row_slice is not None and (isinstance(row_slice, bool)
+                                  or not isinstance(row_slice,
+                                                    (int, np.integer))):
+      raise TypeError(
+          f'row_slice must be an int element-count threshold or None, '
+          f'got {row_slice!r}')
+    row_slice = None if row_slice is None else int(row_slice)
     if lookup_impl not in ('auto', 'xla', 'pallas'):
       raise ValueError(f'Unknown lookup_impl {lookup_impl!r}')
     self.lookup_impl = lookup_impl
@@ -120,7 +129,8 @@ class DistributedEmbedding:
                              world_size=self.world_size,
                              strategy=strategy,
                              input_table_map=input_table_map,
-                             column_slice_threshold=column_slice_threshold)
+                             column_slice_threshold=column_slice_threshold,
+                             row_slice_threshold=row_slice)
     self.num_inputs = len(self.plan.input_table_map)
     # compiled-function cache, keyed by shape signature; lives on the
     # instance so dropping the layer frees its traced executables
@@ -180,11 +190,19 @@ class DistributedEmbedding:
       for lt in g.member_tables[dev]:
         cfg = self.table_configs[lt.table_id]
         init = get_initializer(cfg.initializer)
+        kwargs = {}
+        if (lt.input_dim != cfg.input_dim
+            and getattr(init, 'row_scale_sensitive', False)):
+          # row shard of a row-count-sensitive initializer: draw at the
+          # shard shape but with the FULL table's scale
+          kwargs['rows'] = cfg.input_dim
         sub = jax.random.fold_in(
-            jax.random.fold_in(key, lt.table_id), lt.col_start)
+            jax.random.fold_in(
+                jax.random.fold_in(key, lt.table_id), lt.col_start),
+            lt.row_start)
         chunks.append(
-            init(sub, (lt.input_dim, lt.width),
-                 self.param_dtype).astype(self.param_dtype))
+            init(sub, (lt.input_dim, lt.width), self.param_dtype,
+                 **kwargs).astype(self.param_dtype))
       pad_rows = g.rows_cap - g.rows[dev]
       if pad_rows or not chunks:
         chunks.append(jnp.zeros((pad_rows, g.width), self.param_dtype))
@@ -339,12 +357,17 @@ class DistributedEmbedding:
         n_cap = max(len(rs) for rs in per_dev)
         offs = np.zeros((self.world_size, n_cap), np.int32)
         vocab = np.ones((self.world_size, n_cap), np.int32)
+        row_lo = np.zeros((self.world_size, n_cap), np.int32)
+        row_hi = np.ones((self.world_size, n_cap), np.int32)
         for dev, rs in enumerate(per_dev):
           for s, r in enumerate(rs):
             offs[dev, s] = r.row_offset
             vocab[dev, s] = self.table_configs[r.table_id].input_dim
+            row_lo[dev, s] = r.row_start
+            row_hi[dev, s] = r.row_end
         subs.append(_SubGroup(gi=gi, group=g, hotness=h, n_cap=n_cap,
-                              requests=per_dev, offsets=offs, vocab=vocab))
+                              requests=per_dev, offsets=offs, vocab=vocab,
+                              row_lo=row_lo, row_hi=row_hi))
     return subs
 
   def _assemble(self, subs, sub_back):
@@ -352,6 +375,9 @@ class DistributedEmbedding:
     slice re-concat, dist_model_parallel.py:443,446-450).
 
     ``sub_back[si]``: [D, n_cap, B, w] received outputs of subgroup si.
+    Pieces sharing a column range are ROW-shard partial sums (each shard
+    contributed its resident rows, zeros elsewhere) and are added; distinct
+    column ranges concatenate, as in the reference.
     """
     # (device, group_key, plan slot) -> (subgroup index, subslot)
     locate = {}
@@ -361,10 +387,21 @@ class DistributedEmbedding:
           locate[(dev, r.group_key, r.slot)] = (si, s)
     outs = []
     for reqs in self.plan.input_requests:
+      # input_requests are sorted by (col_start, row_start): group runs of
+      # equal column range, summing within a run
       pieces = []
-      for r in reqs:
-        si, s = locate[(r.device, r.group_key, r.slot)]
-        pieces.append(sub_back[si][r.device, s])
+      i = 0
+      while i < len(reqs):
+        j = i
+        part = None
+        while j < len(reqs) and reqs[j].col_start == reqs[i].col_start:
+          r = reqs[j]
+          si, s = locate[(r.device, r.group_key, r.slot)]
+          p = sub_back[si][r.device, s]
+          part = p if part is None else part + p
+          j += 1
+        pieces.append(part)
+        i = j
       outs.append(pieces[0] if len(pieces) == 1 else jnp.concatenate(
           pieces, axis=-1))
     return tuple(outs)
@@ -417,7 +454,9 @@ class DistributedEmbedding:
         ids = recv.transpose(1, 0, 2, 3).reshape(sub.n_cap, global_batch, h)
         rows_cap = self.plan.groups[sub.gi].rows_cap
         routed = _route_ids(ids, jnp.asarray(sub.offsets)[me],
-                            jnp.asarray(sub.vocab)[me], rows_cap)
+                            jnp.asarray(sub.vocab)[me], rows_cap,
+                            jnp.asarray(sub.row_lo)[me],
+                            jnp.asarray(sub.row_hi)[me])
         out = self._lookup(params[f'group_{sub.gi}'][0], routed,
                            sub.group.combiner)
         residuals.append(routed[None])
@@ -496,7 +535,9 @@ class DistributedEmbedding:
         ids = canon[0]  # [n_cap, GB, h]
         rows_cap = self.plan.groups[sub.gi].rows_cap
         routed = _route_ids(ids, jnp.asarray(sub.offsets)[me],
-                            jnp.asarray(sub.vocab)[me], rows_cap)
+                            jnp.asarray(sub.vocab)[me], rows_cap,
+                            jnp.asarray(sub.row_lo)[me],
+                            jnp.asarray(sub.row_hi)[me])
         out = self._lookup(params[f'group_{sub.gi}'][0], routed,
                            sub.group.combiner)
         residuals.append(routed[None])
@@ -628,21 +669,37 @@ class _SubGroup:
   n_cap: int
   requests: List[List['Request']]
   offsets: np.ndarray  # [D, n_cap] fused row offsets
-  vocab: np.ndarray    # [D, n_cap] per-slot vocabulary sizes
+  vocab: np.ndarray    # [D, n_cap] per-slot FULL vocabulary sizes
+  row_lo: np.ndarray   # [D, n_cap] per-slot resident row window start
+  row_hi: np.ndarray   # [D, n_cap] per-slot resident row window end
 
 
 def _route_ids(ids: jax.Array, offsets: jax.Array, vocab: jax.Array,
-               rows_cap: int) -> jax.Array:
+               rows_cap: int,
+               row_lo: Optional[jax.Array] = None,
+               row_hi: Optional[jax.Array] = None) -> jax.Array:
   """Map raw slot ids into fused-table row space.
 
   ``ids``: [n_cap, GB, h] with -1 sentinel padding; ``offsets``/``vocab``:
-  [n_cap] per-slot fused row offsets and vocabulary sizes.  Ids are clipped
-  inside the slot's own table segment so bad ids can't read a neighbouring
+  [n_cap] per-slot fused row offsets and FULL vocabulary sizes.  Ids are
+  clipped inside the slot's own table so bad ids can't read a neighbouring
   fused table's rows; padding positions map to ``rows_cap`` (one past the
   fused table), which both the lookup and the sparse scatter drop.
+
+  ``row_lo``/``row_hi`` give each slot's resident row window (row-sliced
+  tables: the shard serves only ids in ``[row_lo, row_hi)``; ids owned by
+  another shard drop to the sentinel, so shard partial outputs sum to the
+  whole).  Clipping runs FIRST against the full vocabulary, so an
+  out-of-vocab id lands on the last row and is served by exactly the tail
+  shard — identical clip semantics to the unsliced table.  Full tables pass
+  ``row_lo=0, row_hi=vocab`` (or None), making the window check a no-op.
   """
   mask = ids >= 0
   clipped = jnp.clip(ids, 0, vocab[:, None, None] - 1)
+  if row_lo is not None:
+    lo = row_lo[:, None, None]
+    mask = mask & (clipped >= lo) & (clipped < row_hi[:, None, None])
+    clipped = clipped - lo
   return jnp.where(mask, clipped + offsets[:, None, None], rows_cap)
 
 

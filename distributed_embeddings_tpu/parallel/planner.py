@@ -81,12 +81,23 @@ class TableConfig:
 
 @dataclasses.dataclass
 class LocalTable:
-  """One (possibly column-sliced, possibly slice-merged) table shard placed on
-  a device.  Columns ``[col_start, col_end)`` of global table ``table_id``."""
+  """One (possibly column- or row-sliced, possibly slice-merged) table shard
+  placed on a device: rows ``[row_start, row_end)`` x columns
+  ``[col_start, col_end)`` of global table ``table_id``.  ``input_dim`` is
+  the SHARD's resident row count (= ``row_end - row_start``), so fused-group
+  row-offset arithmetic is shard-local.  A table is sliced along at most one
+  axis: column shards span all rows, row shards span all columns."""
   table_id: int
   input_dim: int
   col_start: int
   col_end: int
+  row_start: int = 0
+  row_end: int = -1  # set to row_start + input_dim in __post_init__
+
+  def __post_init__(self):
+    if self.row_end < 0:
+      self.row_end = self.row_start + self.input_dim
+    assert self.row_end - self.row_start == self.input_dim
 
   @property
   def width(self) -> int:
@@ -100,7 +111,9 @@ class Request:
   ``input_id`` indexes the user's input list; the request consumes that input's
   ids, adds ``row_offset`` (position of its table inside the fused group
   parameter) and produces ``width`` output columns ``[col_start, col_end)`` of
-  the input's logical output.
+  the input's logical output.  For a ROW-sliced table the request serves only
+  ids in ``[row_start, row_end)`` (others drop to the sentinel and contribute
+  zero); requests sharing an input and column range are summed at assembly.
   """
   input_id: int
   table_id: int
@@ -110,6 +123,8 @@ class Request:
   row_offset: int
   col_start: int
   col_end: int
+  row_start: int = 0
+  row_end: int = -1  # always set explicitly from the shard's LocalTable
 
   @property
   def width(self) -> int:
@@ -178,6 +193,36 @@ def slice_table_column(config: TableConfig, column_slice_threshold,
   return [
       cols_per_slice + (1 if i < remainder else 0) for i in range(num_slices)
   ]
+
+
+def slice_table_row(config: TableConfig, row_slice_threshold,
+                    world_size: int) -> List[int]:
+  """Split a table's rows into power-of-2 many shards each below threshold.
+
+  Mirrors ``slice_table_column``'s sizing rule on the row axis: N = smallest
+  power of 2 with ``size / N <= threshold``, capped at
+  ``min(N, world_size, input_dim)``; rows divided evenly with the remainder
+  spread over the first shards.  No reference analog (the reference's
+  ``row_slice`` raises NotImplementedError, dist_model_parallel.py:345-346) —
+  this is the axis that fits tables whose single column slice still exceeds
+  device HBM (e.g. Criteo-1TB's 227M-row table).
+
+  Returns:
+    List of shard row counts (sum = input_dim); ``[input_dim]`` when the
+    table is under threshold.
+  """
+  if row_slice_threshold is None:
+    return [config.input_dim]
+  table_size = config.size
+  num_shards = 1
+  while table_size > row_slice_threshold:
+    num_shards *= 2
+    table_size /= 2
+  if num_shards == 1:
+    return [config.input_dim]
+  num_shards = min(num_shards, world_size, config.input_dim)
+  rows_per, remainder = divmod(config.input_dim, num_shards)
+  return [rows_per + (1 if i < remainder else 0) for i in range(num_shards)]
 
 
 def auto_column_slice_threshold(table_sizes: Sequence[int],
@@ -261,6 +306,10 @@ class ShardingPlan:
       ``None`` means identity (reference dist_model_parallel.py:80-81).
     column_slice_threshold: see ``slice_table_column``; ``None`` enables the
       automatic fewer-tables-than-workers slicing only.
+    row_slice_threshold: see ``slice_table_row``; tables above this element
+      count shard along ROWS instead of columns (shard partial outputs are
+      summed at assembly).  ``None`` disables row slicing.  Beyond the
+      reference, whose ``row_slice`` raises NotImplementedError.
   """
 
   def __init__(self,
@@ -268,7 +317,8 @@ class ShardingPlan:
                world_size: int,
                strategy: str = 'basic',
                input_table_map: Optional[Sequence[int]] = None,
-               column_slice_threshold: Optional[int] = None):
+               column_slice_threshold: Optional[int] = None,
+               row_slice_threshold: Optional[int] = None):
     if strategy not in ('basic', 'memory_balanced', 'memory_optimized'):
       raise ValueError(f'Unsupported shard strategy {strategy}')
     # Single-process case may skip collectives; mirror the reference's
@@ -282,23 +332,58 @@ class ShardingPlan:
       raise ValueError('input_table_map entries must index table_configs')
     self.input_table_map = list(input_table_map)
     self.column_slice_threshold = column_slice_threshold
+    self.row_slice_threshold = row_slice_threshold
+
+    # --- 1a. row slicing (beyond the reference; see slice_table_row) -----
+    # A qualifying table is sliced along rows only (its shards span every
+    # column); all other tables go through column slicing below.
+    self.row_slice_rows: List[List[int]] = [
+        slice_table_row(c, row_slice_threshold, world_size)
+        for c in self.table_configs
+    ]
+    self.row_sliced: List[bool] = [
+        len(rs) > 1 for rs in self.row_slice_rows
+    ]
+    for tid, sliced in enumerate(self.row_sliced):
+      if sliced and self.table_configs[tid].combiner == 'mean':
+        raise NotImplementedError(
+            'row slicing a mean-combiner table is not supported yet '
+            '(shard partial sums need the global id count at assembly)')
 
     # --- 1. column slicing (C11) -----------------------------------------
     threshold = column_slice_threshold
     if threshold is None:
-      threshold = auto_column_slice_threshold(
-          [c.size for c in self.table_configs], world_size)
+      # the automatic fewer-units-than-workers threshold counts row shards
+      # as placement units: only the remaining devices need column slices
+      n_row_shards = sum(
+          len(rs) for tid, rs in enumerate(self.row_slice_rows)
+          if self.row_sliced[tid])
+      col_sizes = [
+          c.size for tid, c in enumerate(self.table_configs)
+          if not self.row_sliced[tid]
+      ]
+      if col_sizes:
+        threshold = auto_column_slice_threshold(
+            col_sizes, max(0, world_size - n_row_shards))
     # slice widths per table, and flattened slice list in table order
+    # (row-sliced tables keep their full width in one "column slice")
     self.slice_widths: List[List[int]] = [
+        [c.output_dim] if self.row_sliced[tid] else
         slice_table_column(c, threshold, world_size)
-        for c in self.table_configs
+        for tid, c in enumerate(self.table_configs)
     ]
     flat_ids: List[int] = []
     flat_sizes: List[int] = []
     for tid, widths in enumerate(self.slice_widths):
-      for w in widths:
-        flat_ids.append(tid)
-        flat_sizes.append(self.table_configs[tid].input_dim * w)
+      if self.row_sliced[tid]:
+        w = self.table_configs[tid].output_dim
+        for rows in self.row_slice_rows[tid]:
+          flat_ids.append(tid)
+          flat_sizes.append(rows * w)
+      else:
+        for w in widths:
+          flat_ids.append(tid)
+          flat_sizes.append(self.table_configs[tid].input_dim * w)
 
     # Ranges of inputs whose outputs must be re-concatenated because their
     # table was sliced (reference sliced_out_ranges, :199-205). Updated below
@@ -315,6 +400,7 @@ class ShardingPlan:
     # checkpoint math assumes (dist_model_parallel.py:477-492).
     next_slice_of_table = [0] * len(self.table_configs)
     col_cursor = [0] * len(self.table_configs)
+    row_cursor = [0] * len(self.table_configs)
     # device -> list of LocalTable (merged)
     self.local_tables: List[List[LocalTable]] = [[] for _ in range(world_size)]
     # table -> list of (device, LocalTable) in claim (device) order
@@ -325,6 +411,29 @@ class ShardingPlan:
       merged: Dict[int, LocalTable] = {}
       for pos in placed[dev]:
         tid = flat_ids[pos]
+        if self.row_sliced[tid]:
+          # claim the next row window; same-device contiguous windows merge
+          rows = self.row_slice_rows[tid][next_slice_of_table[tid]]
+          next_slice_of_table[tid] += 1
+          start = row_cursor[tid]
+          row_cursor[tid] += rows
+          if tid in merged:
+            lt = merged[tid]
+            if lt.row_end != start:
+              raise AssertionError('non-contiguous row-slice merge')
+            lt.row_end = start + rows
+            lt.input_dim += rows
+          else:
+            lt = LocalTable(table_id=tid,
+                            input_dim=rows,
+                            col_start=0,
+                            col_end=self.table_configs[tid].output_dim,
+                            row_start=start,
+                            row_end=start + rows)
+            merged[tid] = lt
+            self.local_tables[dev].append(lt)
+            self.table_shards[tid].append((dev, lt))
+          continue
         w = self.slice_widths[tid][next_slice_of_table[tid]]
         next_slice_of_table[tid] += 1
         start = col_cursor[tid]
@@ -391,7 +500,9 @@ class ShardingPlan:
                         slot=len(dev_reqs),
                         row_offset=row_offset,
                         col_start=lt.col_start,
-                        col_end=lt.col_end))
+                        col_end=lt.col_end,
+                        row_start=lt.row_start,
+                        row_end=lt.row_end))
           row_offset += lt.input_dim
         rows.append(row_offset)
         reqs.append(dev_reqs)
@@ -415,16 +526,31 @@ class ShardingPlan:
         for r in dev_reqs:
           self.input_requests[r.input_id].append(r)
 
-    # Output slices of each input arrive in device order; their column ranges
-    # must tile [0, output_dim) exactly.
+    # Output slices of each input arrive in device order.  Distinct column
+    # ranges must tile [0, output_dim) exactly; requests SHARING a column
+    # range are row shards whose outputs sum at assembly, and their row
+    # windows must partition [0, input_dim) exactly.
     for inp, rs in enumerate(self.input_requests):
-      rs.sort(key=lambda r: r.col_start)
-      expect = 0
-      for r in rs:
-        if r.col_start != expect:
+      rs.sort(key=lambda r: (r.col_start, r.row_start))
+      cfg = self.table_configs[self.input_table_map[inp]]
+      expect_col = 0
+      i = 0
+      while i < len(rs):
+        j = i
+        expect_row = 0
+        while j < len(rs) and rs[j].col_start == rs[i].col_start:
+          if (rs[j].col_end != rs[i].col_end
+              or rs[j].row_start != expect_row):
+            raise AssertionError(f'input {inp}: non-tiling row shards')
+          expect_row = rs[j].row_end
+          j += 1
+        if expect_row != cfg.input_dim:
+          raise AssertionError(f'input {inp}: row shards do not cover table')
+        if rs[i].col_start != expect_col:
           raise AssertionError(f'input {inp}: non-tiling column slices')
-        expect = r.col_end
-      if expect != self.table_configs[self.input_table_map[inp]].output_dim:
+        expect_col = rs[i].col_end
+        i = j
+      if expect_col != cfg.output_dim:
         raise AssertionError(f'input {inp}: column slices do not cover table')
 
   # ---- parity / introspection views (reference attribute contracts) -----
@@ -481,11 +607,12 @@ class ShardingPlan:
 
   def shard_layout(self):
     """Per-table physical layout: list (over tables) of shard records
-    ``(device, group_key, fused_row_offset, col_start, col_end)`` in device
-    (claim) order.  This is the global-canonical-layout contract the
-    checkpoint reshard path relies on (reference
-    dist_model_parallel.py:452-645): shards of a table hold contiguous,
-    device-ordered column ranges of the full ``[rows, width]`` weight.
+    ``(device, group_key, fused_row_offset, col_start, col_end, row_start,
+    row_end)`` in (column, row) range order.  This is the
+    global-canonical-layout contract the checkpoint reshard path relies on
+    (reference dist_model_parallel.py:452-645): shards of a table hold
+    contiguous, device-ordered column ranges (and, for row-sliced tables,
+    row ranges) of the full ``[rows, width]`` weight.
     """
     layout = [[] for _ in self.table_configs]
     for g in self.groups:
@@ -493,10 +620,11 @@ class ShardingPlan:
         row_offset = 0
         for lt in g.member_tables[dev]:
           layout[lt.table_id].append(
-              (dev, g.key, row_offset, lt.col_start, lt.col_end))
+              (dev, g.key, row_offset, lt.col_start, lt.col_end,
+               lt.row_start, lt.row_end))
           row_offset += lt.input_dim
     for shards in layout:
-      shards.sort(key=lambda s: s[3])
+      shards.sort(key=lambda s: (s[3], s[5]))
     return layout
 
   def device_memory_elements(self) -> List[int]:

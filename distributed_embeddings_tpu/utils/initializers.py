@@ -27,12 +27,20 @@ def uniform_initializer(minval=-0.05, maxval=0.05) -> Initializer:
 
 def scaled_uniform_initializer() -> Initializer:
   """Uniform(+-1/sqrt(rows)): the DLRM table initializer
-  (reference `examples/dlrm/utils.py:27-41`, ``DLRMInitializer``)."""
+  (reference `examples/dlrm/utils.py:27-41`, ``DLRMInitializer``).
 
-  def init(key, shape, dtype=jnp.float32):
-    maxval = 1.0 / math.sqrt(shape[0])
+  The scale depends on the TABLE's row count, not the drawn shape: a row
+  shard of a bigger table passes ``rows=<full table rows>`` so the shard
+  draws with the whole table's scale (the ``row_scale_sensitive`` marker
+  tells the runtime to do so; a shard initialised at its own shape would
+  get sqrt(num_shards)x too-large variance).
+  """
+
+  def init(key, shape, dtype=jnp.float32, rows=None):
+    maxval = 1.0 / math.sqrt(rows if rows is not None else shape[0])
     return jax.random.uniform(key, shape, dtype, -maxval, maxval)
 
+  init.row_scale_sensitive = True
   return init
 
 

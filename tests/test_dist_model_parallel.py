@@ -211,9 +211,13 @@ class TestValidation:
     configs = [TableConfig(20, 4, 'sum')] * 4
     return DistributedEmbedding(configs, mesh=mesh, **kw)
 
-  def test_row_slice_not_implemented(self):
-    with pytest.raises(NotImplementedError):
+  def test_row_slice_accepts_threshold_only(self):
+    # row_slice is IMPLEMENTED here (beyond the reference, whose param
+    # raises NotImplementedError): it takes an int element threshold
+    with pytest.raises(TypeError, match='row_slice'):
       self.make(row_slice=True)
+    dist = self.make(row_slice=10**9)  # above every table: no slicing
+    assert not any(dist.plan.row_sliced)
 
   def test_wrong_input_count(self):
     dist = self.make()

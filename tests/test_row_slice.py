@@ -1,0 +1,267 @@
+"""Row-wise sharding (row_slice): planner, forward/backward equivalence,
+sparse training, and resharding checkpoint.
+
+BEYOND the reference: its ``row_slice`` raises NotImplementedError
+(`/root/reference/.../dist_model_parallel.py:345-346`).  Design: each row
+shard serves only ids inside its resident window (others drop to the
+sentinel and contribute zero), shard partial outputs are summed at
+assembly — exact for sum/None combiners; out-of-vocab ids clip to the last
+row, served by exactly the tail shard, preserving unsliced clip semantics.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from distributed_embeddings_tpu.parallel import (DistributedEmbedding,
+                                                 SparseAdagrad, SparseSGD,
+                                                 TableConfig, create_mesh,
+                                                 get_optimizer_state,
+                                                 get_weights,
+                                                 init_hybrid_train_state,
+                                                 make_hybrid_train_step,
+                                                 set_optimizer_state,
+                                                 set_weights)
+from distributed_embeddings_tpu.parallel.planner import (ShardingPlan,
+                                                         slice_table_row)
+
+WORLD = 8
+LR = 0.3
+
+
+def oracle_lookup(w, ids, combiner):
+  """Single-table oracle with clip + ``-1``-padding-drop semantics."""
+  if ids.ndim == 1:
+    ids = ids[:, None]
+  out = np.zeros((ids.shape[0], w.shape[1]), np.float32)
+  cnt = np.zeros((ids.shape[0],), np.float32)
+  for i, row in enumerate(ids):
+    for v in row:
+      if v < 0:
+        continue
+      out[i] += w[min(v, w.shape[0] - 1)]
+      cnt[i] += 1
+  if combiner == 'mean':
+    out /= np.maximum(cnt, 1)[:, None]
+  return out
+
+
+class TestPlanner:
+
+  def test_slice_table_row_sizing(self):
+    cfg = TableConfig(100, 8, 'sum')
+    assert slice_table_row(cfg, None, 8) == [100]
+    assert slice_table_row(cfg, 800, 8) == [100]
+    assert slice_table_row(cfg, 400, 8) == [50, 50]
+    assert slice_table_row(cfg, 300, 8) == [25, 25, 25, 25]
+    # capped at world size
+    assert slice_table_row(cfg, 10, 2) == [50, 50]
+    # remainder spreads over the first shards
+    assert slice_table_row(TableConfig(10, 8, 'sum'), 20, 4) == [3, 3, 2, 2]
+
+  def test_plan_layout_and_flags(self):
+    plan = ShardingPlan(
+        [TableConfig(100, 8, 'sum'), TableConfig(16, 8, 'sum')],
+        world_size=4, strategy='basic', row_slice_threshold=300)
+    assert plan.row_sliced == [True, False]
+    shards = plan.shard_layout()[0]
+    windows = sorted((rs, re) for _, _, _, _, _, rs, re in shards)
+    assert windows == [(0, 25), (25, 50), (50, 75), (75, 100)]
+    assert all(cs == 0 and ce == 8 for _, _, _, cs, ce, _, _ in shards)
+    # row-sliced tables produce no column-slice output ranges
+    assert plan.sliced_out_ranges == []
+
+  def test_mean_combiner_raises(self):
+    with pytest.raises(NotImplementedError, match='mean'):
+      ShardingPlan([TableConfig(100, 8, 'mean')], world_size=4,
+                   row_slice_threshold=300)
+
+  def test_bad_row_slice_type_raises(self):
+    mesh = create_mesh(jax.devices()[:2])
+    with pytest.raises(TypeError, match='row_slice'):
+      DistributedEmbedding([TableConfig(10, 4, 'sum')], mesh=mesh,
+                           row_slice='yes')
+
+
+@pytest.mark.parametrize('dp_input', [True, False])
+@pytest.mark.parametrize('strategy', ['basic', 'memory_balanced'])
+def test_forward_equivalence(dp_input, strategy):
+  rng = np.random.default_rng(3)
+  mesh = create_mesh(jax.devices()[:WORLD])
+  configs = [TableConfig(100, 8, 'sum'), TableConfig(16, 8, None),
+             TableConfig(64, 4, 'sum'), TableConfig(40, 8, 'sum')]
+  dist = DistributedEmbedding(configs, mesh=mesh, strategy=strategy,
+                              dp_input=dp_input, row_slice=120)
+  assert any(dist.plan.row_sliced)
+  weights = [rng.normal(size=(c.input_dim, c.output_dim)).astype(np.float32)
+             for c in configs]
+  params = set_weights(dist, weights)
+  ids = []
+  for c, hot in zip(configs, (3, 1, 2, 2)):
+    x = rng.integers(0, c.input_dim, size=(16, hot)).astype(np.int32)
+    ids.append(x.squeeze(1) if hot == 1 else x)
+  ids[0][0, 1] = 170   # out-of-vocab: clips to last row
+  ids[0][1, 2] = -1    # padding: drops
+  if dp_input:
+    inputs = [jnp.asarray(x) for x in ids]
+  else:
+    # worker-order inputs at global batch; row-sliced inputs appear once
+    # per owning device
+    flat = [i for dev in dist.plan.input_ids_list for i in dev]
+    inputs = [jnp.asarray(ids[i]) for i in flat]
+  outs = dist.apply(params, inputs)
+  for t, c in enumerate(configs):
+    want = oracle_lookup(weights[t], ids[t], c.combiner)
+    np.testing.assert_allclose(np.asarray(outs[t]), want, rtol=1e-5,
+                               atol=1e-5, err_msg=f'table {t}')
+
+
+def test_shared_table_row_sliced():
+  # two inputs share one row-sliced table (input_table_map)
+  rng = np.random.default_rng(4)
+  mesh = create_mesh(jax.devices()[:4])
+  configs = [TableConfig(80, 8, 'sum')]
+  dist = DistributedEmbedding(configs, mesh=mesh, row_slice=200,
+                              input_table_map=[0, 0])
+  weights = [rng.normal(size=(80, 8)).astype(np.float32)]
+  params = set_weights(dist, weights)
+  a = rng.integers(0, 80, size=(8, 2)).astype(np.int32)
+  b = rng.integers(0, 80, size=(8, 3)).astype(np.int32)
+  outs = dist.apply(params, [jnp.asarray(a), jnp.asarray(b)])
+  np.testing.assert_allclose(np.asarray(outs[0]),
+                             oracle_lookup(weights[0], a, 'sum'),
+                             rtol=1e-5, atol=1e-5)
+  np.testing.assert_allclose(np.asarray(outs[1]),
+                             oracle_lookup(weights[0], b, 'sum'),
+                             rtol=1e-5, atol=1e-5)
+
+
+def _train_setup(rng, opt_builder):
+  mesh = create_mesh(jax.devices()[:WORLD])
+  configs = [TableConfig(96, 8, 'sum'), TableConfig(48, 8, 'sum')]
+  dist = DistributedEmbedding(configs, mesh=mesh, row_slice=400)
+  assert dist.plan.row_sliced[0]
+  weights = [rng.normal(size=(c.input_dim, c.output_dim)).astype(np.float32)
+             for c in configs]
+  inputs = [
+      jnp.asarray(rng.integers(0, c.input_dim, (16, 3)).astype(np.int32))
+      for c in configs
+  ]
+  kernel = jnp.asarray(rng.standard_normal((16, 1)).astype(np.float32) * 0.1)
+  labels = jnp.asarray(rng.integers(0, 2, (16, 1)).astype(np.float32))
+
+  def head_loss_fn(dense_params, emb_outs, batch):
+    h = jnp.concatenate(list(emb_outs), axis=-1)
+    return jnp.mean((h @ dense_params['kernel'] - batch)**2)
+
+  def dense_oracle_grads():
+    def loss(ws):
+      outs = []
+      for t, w in enumerate(ws):
+        out = jnp.zeros((16, 8))
+        for h in range(3):
+          out = out + w[np.asarray(inputs[t])[:, h]]
+        outs.append(out)
+      h = jnp.concatenate(outs, axis=-1)
+      return jnp.mean((h @ kernel - labels)**2)
+
+    return jax.grad(loss)([jnp.asarray(w) for w in weights])
+
+  return (dist, configs, weights, inputs, kernel, labels, head_loss_fn,
+          dense_oracle_grads)
+
+
+def test_sparse_adagrad_step_equivalence():
+  rng = np.random.default_rng(5)
+  (dist, configs, weights, inputs, kernel, labels, head_loss_fn,
+   oracle_grads) = _train_setup(rng, None)
+  opt = SparseAdagrad(learning_rate=LR, initial_accumulator_value=0.1)
+  step = make_hybrid_train_step(dist, head_loss_fn, optax.sgd(LR), opt,
+                                donate=False)
+  params = set_weights(dist, weights)
+  state = init_hybrid_train_state(dist, {
+      'embedding': params,
+      'kernel': kernel
+  }, optax.sgd(LR), opt)
+  state, loss = step(state, inputs, labels)
+  assert np.isfinite(float(loss))
+  got = get_weights(dist, state.params['embedding'])
+  g = oracle_grads()
+  for t in range(len(configs)):
+    acc = np.full_like(weights[t], 0.1) + np.asarray(g[t])**2
+    want = weights[t] - LR * np.asarray(g[t]) / np.sqrt(acc + 1e-7)
+    np.testing.assert_allclose(got[t], want, rtol=3e-5, atol=3e-6)
+
+
+def test_dense_autodiff_step_equivalence():
+  # the dense path differentiates through the assembly sum automatically
+  rng = np.random.default_rng(6)
+  (dist, configs, weights, inputs, kernel, labels, head_loss_fn,
+   oracle_grads) = _train_setup(rng, None)
+  params = set_weights(dist, weights)
+
+  def loss_fn(p):
+    outs = dist.apply(p, inputs)
+    return head_loss_fn({'kernel': kernel}, outs, labels)
+
+  grads = jax.grad(loss_fn)(params)
+  stepped = jax.tree.map(lambda p, g: p - LR * g, params, grads)
+  got = get_weights(dist, stepped)
+  g = oracle_grads()
+  for t in range(len(configs)):
+    want = weights[t] - LR * np.asarray(g[t])
+    np.testing.assert_allclose(got[t], want, rtol=3e-5, atol=3e-6)
+
+
+def test_scaled_uniform_init_uses_full_table_rows():
+  # a row shard must draw with the FULL table's 1/sqrt(rows) scale, not
+  # the shard's (which would be sqrt(num_shards)x too wide)
+  mesh = create_mesh(jax.devices()[:4])
+  rows = 4096
+  configs = [TableConfig(rows, 8, 'sum', initializer='scaled_uniform'),
+             TableConfig(64, 8, 'sum')]
+  dist = DistributedEmbedding(configs, mesh=mesh, row_slice=rows * 8 // 4)
+  assert dist.plan.row_sliced[0]
+  table = get_weights(dist, dist.init(0))[0]
+  bound = 1.0 / np.sqrt(rows)
+  assert np.abs(table).max() <= bound + 1e-7
+  # and it actually fills the scale (would be ~2x smaller if the shard
+  # scale 1/sqrt(rows/4) were divided the other way)
+  assert np.abs(table).max() > 0.9 * bound
+
+
+def test_checkpoint_reshard_row_to_column():
+  # save under row-sliced world 8, restore under column-sliced world 2,
+  # optimizer state included
+  rng = np.random.default_rng(7)
+  configs = [TableConfig(96, 8, 'sum'), TableConfig(40, 8, 'sum')]
+  weights = [rng.normal(size=(c.input_dim, c.output_dim)).astype(np.float32)
+             for c in configs]
+  mesh8 = create_mesh(jax.devices()[:4])
+  mesh2 = create_mesh(jax.devices()[:2])
+  d8 = DistributedEmbedding(configs, mesh=mesh8, row_slice=200)
+  d2 = DistributedEmbedding(configs, mesh=mesh2, column_slice_threshold=200)
+  assert any(d8.plan.row_sliced) and not any(d2.plan.row_sliced)
+  p8 = set_weights(d8, weights)
+  opt = SparseSGD(learning_rate=LR)
+  s8 = opt.init(d8, p8)
+  saved_w = get_weights(d8, p8)
+  saved_s = get_optimizer_state(d8, s8)
+  p2 = set_weights(d2, saved_w)
+  for w, b in zip(weights, get_weights(d2, p2)):
+    np.testing.assert_array_equal(w, b)
+  # Adagrad state round-trips through the row-sliced layout
+  aopt = SparseAdagrad(learning_rate=LR)
+  sa8 = aopt.init(d8, p8)
+  st = get_optimizer_state(d8, sa8)
+  for entry, c in zip(st, configs):
+    assert entry['acc'].shape == (c.input_dim, c.output_dim)
+  sa2 = set_optimizer_state(d2, aopt.init(d2, set_weights(d2, saved_w)), st)
+  back = get_optimizer_state(d2, sa2)
+  for a, b in zip(st, back):
+    for k in a:
+      np.testing.assert_array_equal(a[k], b[k])
+  del saved_s
