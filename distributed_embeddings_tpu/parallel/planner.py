@@ -642,7 +642,8 @@ class ShardingPlan:
   def describe(self) -> str:
     """Human-readable plan summary."""
     lines = [
-        f'ShardingPlan: {len(self.table_configs)} tables, '
+        f'ShardingPlan: {len(self.table_configs)} tables '
+        f'({sum(self.row_sliced)} row-sliced), '
         f'{len(self.input_table_map)} inputs, world_size={self.world_size}, '
         f'strategy={self.strategy}'
     ]

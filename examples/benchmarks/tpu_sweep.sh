@@ -27,7 +27,10 @@ T=1200 run python bench.py --model tiny --steps 10 --auto_capacity --param_dtype
 # 5. DLRM-shaped criteo model (width 128, hotness 1: kernel sweet spot)
 T=1200 run python bench.py --model criteo --steps 10 --auto_capacity --fused_apply
 
-# 6. remaining hardware correctness gates
+# 6. primitive scatter/gather hint A/B (informs perf notes)
+T=900 run python examples/benchmarks/scatter_probe.py
+
+# 7. remaining hardware correctness gates
 T=1800 run python -m pytest tests/test_pallas_tpu.py -q -s -k "not microbench"
 
 echo "sweep done: $LOG"
