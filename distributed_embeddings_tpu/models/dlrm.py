@@ -106,6 +106,8 @@ class DLRM:
     mesh: mesh for the distributed embedding; None uses all devices.
     dist_strategy: table placement strategy.
     column_slice_threshold: forwarded to the planner.
+    row_slice: element threshold for ROW sharding big tables (beyond the
+      reference; fits Criteo's 227M-row table across chips).
     dp_input: data-parallel categorical inputs (see DistributedEmbedding).
     compute_dtype: activation dtype (bfloat16 for the AMP-equivalent path,
       reference `examples/dlrm/README.md:8`).
@@ -118,6 +120,7 @@ class DLRM:
   mesh: Optional[Mesh] = None
   dist_strategy: str = 'memory_balanced'
   column_slice_threshold: Optional[int] = None
+  row_slice: Optional[int] = None
   dp_input: bool = True
   param_dtype: Any = jnp.float32
   compute_dtype: Any = jnp.float32
@@ -143,6 +146,7 @@ class DLRM:
         configs,
         strategy=self.dist_strategy,
         column_slice_threshold=self.column_slice_threshold,
+        row_slice=self.row_slice,
         dp_input=self.dp_input,
         mesh=self.mesh,
         param_dtype=self.param_dtype,

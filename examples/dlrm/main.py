@@ -36,6 +36,9 @@ def parse_args():
   parser.add_argument('--dp_input', action='store_true')
   parser.add_argument('--dist_strategy', default='memory_balanced')
   parser.add_argument('--column_slice_threshold', type=int, default=None)
+  parser.add_argument('--row_slice', type=int, default=None,
+                      help='element threshold above which tables shard '
+                      'along rows (fits tables bigger than one chip)')
   parser.add_argument('--compute_dtype', default='float32',
                       choices=['float32', 'bfloat16'])
   parser.add_argument('--eval', action='store_true',
@@ -98,6 +101,7 @@ def main():
                mesh=mesh,
                dist_strategy=args.dist_strategy,
                column_slice_threshold=args.column_slice_threshold,
+               row_slice=args.row_slice,
                dp_input=args.dp_input,
                compute_dtype=jnp.dtype(args.compute_dtype))
   params = model.init(0)
