@@ -331,6 +331,12 @@ class ShardingPlan:
     if any(t < 0 or t >= len(self.table_configs) for t in input_table_map):
       raise ValueError('input_table_map entries must index table_configs')
     self.input_table_map = list(input_table_map)
+    for name, thr in (('column_slice_threshold', column_slice_threshold),
+                      ('row_slice_threshold', row_slice_threshold)):
+      if thr is not None and thr <= 0:
+        # a non-positive threshold would spin the halving loops forever
+        # (table_size /= 2 bottoms out at 0.0, never below a negative)
+        raise ValueError(f'{name} must be positive, got {thr}')
     self.column_slice_threshold = column_slice_threshold
     self.row_slice_threshold = row_slice_threshold
 

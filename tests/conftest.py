@@ -24,3 +24,10 @@ import jax  # noqa: E402
 # hardware-gated tests (tests/test_pallas_tpu.py).
 if os.environ.get('DET_TESTS_REAL_TPU') != '1':
   jax.config.update('jax_platforms', 'cpu')
+
+# Persistent compilation cache: repeat suite runs skip recompilation
+# (harmless if absent; the cache key includes platform + program).
+jax.config.update(
+    'jax_compilation_cache_dir',
+    os.path.join(os.path.dirname(os.path.dirname(__file__)), '.jax_cache'))
+jax.config.update('jax_persistent_cache_min_compile_time_secs', 2)

@@ -85,6 +85,16 @@ class TestPlanner:
       DistributedEmbedding([TableConfig(10, 4, 'sum')], mesh=mesh,
                            row_slice='yes')
 
+  def test_nonpositive_thresholds_raise(self):
+    # a negative threshold would otherwise spin the halving loop forever
+    mesh = create_mesh(jax.devices()[:2])
+    with pytest.raises(ValueError, match='row_slice_threshold'):
+      DistributedEmbedding([TableConfig(10, 4, 'sum')], mesh=mesh,
+                           row_slice=-1)
+    with pytest.raises(ValueError, match='column_slice_threshold'):
+      DistributedEmbedding([TableConfig(10, 4, 'sum')], mesh=mesh,
+                           column_slice_threshold=0)
+
 
 @pytest.mark.parametrize('dp_input', [True, False])
 @pytest.mark.parametrize('strategy', ['basic', 'memory_balanced'])
