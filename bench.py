@@ -15,6 +15,7 @@ traceback, so the driver's artifact never ends up unparseable.
 
 import argparse
 import json
+import os
 import time
 import traceback
 
@@ -57,7 +58,6 @@ def init_backend(max_tries: int = 2, delay_s: float = 15.0,
   uses the ``jax.config`` platform knob — the env var alone does not stop
   the tunnel plugin from grabbing the backend (tests/conftest.py).
   """
-  import os
   import subprocess
   import sys
   if os.environ.get('DET_BENCH_FORCE_CPU'):
@@ -130,7 +130,6 @@ def main():
   # persistent compilation cache: the train-step programs compile in
   # 50-100s on the tunnelled TPU (docs/perf_notes.md); caching them makes
   # repeat bench runs start measuring in seconds
-  import os
   jax.config.update(
       'jax_compilation_cache_dir',
       os.path.join(os.path.dirname(os.path.abspath(__file__)), '.jax_cache'))
@@ -268,15 +267,15 @@ def main():
     # per-group static eligibility for the fused Pallas apply (the
     # runtime guard in parallel/sparse.py can still decline at trace
     # time); without this note an A/B run can silently measure the XLA
-    # path and read as "kernel is no faster".  Mirrors
-    # pallas_rowwise.supported(): f32 rows of width 128 or a narrow
-    # width 8..64 dividing 128 (taken either natural-width or through
-    # sparse.py's _lane_pack view — both kernel-eligible).
-    f32 = jnp.dtype(args.param_dtype) == jnp.float32
+    # path and read as "kernel is no faster".  Asks the kernel's own
+    # supported() on the group's row signature (single source of truth).
+    from distributed_embeddings_tpu.ops import pallas_rowwise
+    dt = jnp.dtype(args.param_dtype)
     groups = model.dist_embedding.plan.groups
-    ok = sum(1 for g in groups
-             if f32 and (g.width == 128 or
-                         (8 <= g.width < 128 and 128 % g.width == 0)))
+    ok = sum(
+        1 for g in groups if pallas_rowwise.supported(
+            jax.ShapeDtypeStruct((8, g.width), dt),
+            jax.ShapeDtypeStruct((8, g.width), jnp.float32)))
     metric += (f' [fused_apply: {ok}/{len(groups)} groups eligible'
                f'{"" if backend == "tpu" else ", inactive off-TPU"}]')
   emit({

@@ -85,7 +85,7 @@ def _adagrad_kernel(count_smem, ids_smem, g_ref, sq_ref, lr_smem, table_in,
   total = count_smem[0, 0]
   cnt = _tile_count(total, t)
 
-  def wait_writes(tile, _):
+  def wait_writes(tile):
     """Drain the 2*cnt(tile) writes issued at grid step ``tile`` (its
     parity is ``tile % 2``)."""
     prev = _tile_count(total, tile)
@@ -102,7 +102,7 @@ def _adagrad_kernel(count_smem, ids_smem, g_ref, sq_ref, lr_smem, table_in,
     return 0
 
   # reuse of this parity's buffers: tile t-2's writes must be done
-  jax.lax.cond(t >= 2, lambda _: wait_writes(t - 2, 0), lambda _: 0, 0)
+  jax.lax.cond(t >= 2, lambda _: wait_writes(t - 2), lambda _: 0, 0)
 
   def read_row(k, _):
     rid = jnp.clip(ids_smem[k, 0], 0, num_rows - 1)
@@ -145,8 +145,8 @@ def _adagrad_kernel(count_smem, ids_smem, g_ref, sq_ref, lr_smem, table_in,
   # still in flight (tile t-1's writes and this tile's own)
   @pl.when(t == num_tiles - 1)
   def _drain():
-    jax.lax.cond(t >= 1, lambda _: wait_writes(t - 1, 0), lambda _: 0, 0)
-    wait_writes(t, 0)
+    jax.lax.cond(t >= 1, lambda _: wait_writes(t - 1), lambda _: 0, 0)
+    wait_writes(t)
 
 
 def supported(table: jax.Array, acc: jax.Array) -> bool:
