@@ -79,7 +79,8 @@ class DistributedEmbedding:
       summed).  BEYOND the reference, whose ``row_slice`` raises
       NotImplementedError (dist_model_parallel.py:345-346): this is the axis
       that fits tables whose single column slice still exceeds device HBM.
-      ``None`` disables.  Mean-combiner tables cannot row-slice yet.
+      ``None`` disables.  Mean tables row-slice too: shards look up with
+      'sum' and the runtime divides by the true per-sample id count.
     dp_input: if True inputs are data-parallel ``[global_batch(, hot)]``
       arrays sharded over the mesh; otherwise model-parallel canonical
       inputs (see ``apply``).
@@ -347,13 +348,24 @@ class DistributedEmbedding:
     at the same width, config_v3.py:32-40), so each (group, hotness) class
     gets its own exactly-sized canonical buffer.
     """
+    def is_row_sliced(r):
+      cfg = self.table_configs[r.table_id]
+      return (r.row_start, r.row_end) != (0, cfg.input_dim)
+
     subs = []
     for gi, g in enumerate(self.plan.groups):
-      hots = sorted({hotness[r.input_id] for reqs in g.requests
-                     for r in reqs})
-      for h in hots:
-        per_dev = [[r for r in reqs if hotness[r.input_id] == h]
-                   for reqs in g.requests]
+      # mean-combiner groups additionally split by the row-sliced flag:
+      # row shards of a mean table look up with 'sum' (their partials add
+      # at assembly, which then divides by the true id count), so they
+      # cannot share a lookup call with unsliced mean requests
+      classes = sorted({(hotness[r.input_id],
+                         g.combiner == 'mean' and is_row_sliced(r))
+                        for reqs in g.requests for r in reqs})
+      for h, rsliced in classes:
+        per_dev = [[
+            r for r in reqs if hotness[r.input_id] == h and (
+                g.combiner == 'mean' and is_row_sliced(r)) == rsliced
+        ] for reqs in g.requests]
         n_cap = max(len(rs) for rs in per_dev)
         offs = np.zeros((self.world_size, n_cap), np.int32)
         vocab = np.ones((self.world_size, n_cap), np.int32)
@@ -367,7 +379,8 @@ class DistributedEmbedding:
             row_hi[dev, s] = r.row_end
         subs.append(_SubGroup(gi=gi, group=g, hotness=h, n_cap=n_cap,
                               requests=per_dev, offsets=offs, vocab=vocab,
-                              row_lo=row_lo, row_hi=row_hi))
+                              row_lo=row_lo, row_hi=row_hi,
+                              mean_row_sliced=rsliced))
     return subs
 
   def _assemble(self, subs, sub_back):
@@ -375,9 +388,10 @@ class DistributedEmbedding:
     slice re-concat, dist_model_parallel.py:443,446-450).
 
     ``sub_back[si]``: [D, n_cap, B, w] received outputs of subgroup si.
-    Pieces sharing a column range are ROW-shard partial sums (each shard
-    contributed its resident rows, zeros elsewhere) and are added; distinct
-    column ranges concatenate, as in the reference.
+    Pieces sharing a column range are ROW-shard partials (each shard
+    contributed its resident rows, zeros elsewhere; mean shards already
+    divided by the true count owner-side) and are added; distinct column
+    ranges concatenate, as in the reference.
     """
     # (device, group_key, plan slot) -> (subgroup index, subslot)
     locate = {}
@@ -458,7 +472,13 @@ class DistributedEmbedding:
                             jnp.asarray(sub.row_lo)[me],
                             jnp.asarray(sub.row_hi)[me])
         out = self._lookup(params[f'group_{sub.gi}'][0], routed,
-                           sub.group.combiner)
+                           sub.lookup_combiner)
+        if sub.mean_row_sliced:
+          # mean row shards look up with 'sum'; divide by the TRUE
+          # per-sample id count HERE, where the full raw ids are in hand
+          # (each owner received them all) - the divided partials then
+          # simply sum at assembly
+          out = out / _valid_count(ids)[..., None].astype(out.dtype)
         residuals.append(routed[None])
         # --- mp -> dp all_to_all (reference 'out_mp_to_dp', :434) --------
         back = out.reshape(sub.n_cap, D, local_batch,
@@ -539,7 +559,10 @@ class DistributedEmbedding:
                             jnp.asarray(sub.row_lo)[me],
                             jnp.asarray(sub.row_hi)[me])
         out = self._lookup(params[f'group_{sub.gi}'][0], routed,
-                           sub.group.combiner)
+                           sub.lookup_combiner)
+        if sub.mean_row_sliced:
+          # owner-side division by the true count (see the dp path)
+          out = out / _valid_count(ids)[..., None].astype(out.dtype)
         residuals.append(routed[None])
         back = out.reshape(sub.n_cap, D, local_batch,
                            sub.group.width).transpose(1, 0, 2, 3)
@@ -607,6 +630,13 @@ class DistributedEmbedding:
     from Horovod's registered alltoall gradient + ``IndexedSlices``,
     SURVEY.md §3.2-3.3).
 
+    PRECONDITION for ROW-SLICED MEAN inputs: the forward divides the
+    owner-side partial sums by the true per-sample id count, so the
+    matching cotangent must arrive here ALREADY divided by that count —
+    ``make_hybrid_train_step`` does this; callers composing the pieces
+    themselves must divide ``d_outs[i]`` by
+    ``_valid_count(ids_i)[:, None]`` for each such input.
+
     Args:
       d_outs: per-input cotangents ``[GB, out_dim_i]`` (batch-sharded).
       global_batch / hotness: the forward call's signature.
@@ -672,6 +702,23 @@ class _SubGroup:
   vocab: np.ndarray    # [D, n_cap] per-slot FULL vocabulary sizes
   row_lo: np.ndarray   # [D, n_cap] per-slot resident row window start
   row_hi: np.ndarray   # [D, n_cap] per-slot resident row window end
+  # row shards of a mean table: lookup runs with 'sum' and the runtime
+  # divides by the true per-sample id count at assembly / in the sparse
+  # cotangent (see _subgroups)
+  mean_row_sliced: bool = False
+
+  @property
+  def lookup_combiner(self):
+    return 'sum' if self.mean_row_sliced else self.group.combiner
+
+
+def _valid_count(ids: jax.Array) -> jax.Array:
+  """Count of valid (non-``-1``-padding) ids over the trailing hot axis,
+  clamped >= 1 — the mean-combiner denominator (out-of-vocab ids count:
+  they clip to the last row and ARE looked up, matching
+  ``_fused_lookup``'s mask).  Works on ``[..., h]`` or 1-D ids."""
+  ids = ids[:, None] if ids.ndim == 1 else ids
+  return jnp.maximum(jnp.sum(ids >= 0, axis=-1), 1).astype(jnp.float32)
 
 
 def _route_ids(ids: jax.Array, offsets: jax.Array, vocab: jax.Array,

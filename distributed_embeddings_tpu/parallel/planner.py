@@ -350,11 +350,10 @@ class ShardingPlan:
     self.row_sliced: List[bool] = [
         len(rs) > 1 for rs in self.row_slice_rows
     ]
-    for tid, sliced in enumerate(self.row_sliced):
-      if sliced and self.table_configs[tid].combiner == 'mean':
-        raise NotImplementedError(
-            'row slicing a mean-combiner table is not supported yet '
-            '(shard partial sums need the global id count at assembly)')
+    # mean-combiner row slicing: shards look up with 'sum' and the
+    # runtime divides by the true per-sample id count at assembly
+    # (dist_embedding._assemble) / pre-divides the sparse cotangent
+    # (sparse.make_hybrid_train_step) — no planner-level restriction.
 
     # --- 1. column slicing (C11) -----------------------------------------
     threshold = column_slice_threshold

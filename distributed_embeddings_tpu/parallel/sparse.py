@@ -41,7 +41,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from distributed_embeddings_tpu.parallel.dist_embedding import DistributedEmbedding
+from distributed_embeddings_tpu.ops.ragged import RaggedBatch
+from distributed_embeddings_tpu.parallel.dist_embedding import (
+    DistributedEmbedding, _valid_count)
 from distributed_embeddings_tpu.parallel.grad import TrainState
 
 
@@ -491,9 +493,12 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
           continue
         ids = residuals[si][0]            # [n_cap, GB, h]
         gg = gs[si][0].astype(jnp.float32)  # [n_cap, GB, w]
-        if group.combiner == 'mean':
+        if group.combiner == 'mean' and not sub.mean_row_sliced:
           cnt = jnp.sum(ids < rows_cap, axis=2).astype(jnp.float32)
           gg = gg / jnp.maximum(cnt, 1.0)[..., None]
+        # mean_row_sliced: the cotangent arrives pre-divided by the TRUE
+        # per-sample count (make_hybrid_train_step), and the shard-local
+        # count here would be the window count - no division
         pos_g = jnp.broadcast_to(gg[:, :, None, :], ids.shape + (w,))
         ids_list.append(ids.reshape(-1))
         grad_list.append(pos_g.reshape(-1, w))
@@ -607,7 +612,31 @@ def make_hybrid_train_step(dist: DistributedEmbedding,
     new_dense = jax.tree.map(lambda p, u: p + u.astype(p.dtype),
                              dense_params, updates)
 
-    gsubs = dist.backward_to_mp(list(d_emb), global_batch, hotness)
+    # row-sliced MEAN inputs: the forward divided the owner-side partial
+    # sums by the true per-sample id count; the manual transpose must
+    # divide the cotangent the same way (computable here, where the raw
+    # ids are available - the shard-local apply cannot know the global
+    # count)
+    if dist.dp_input:
+      cat_pos = {i: i for i in range(len(dist.plan.input_table_map))}
+    else:
+      # mp inputs arrive in worker order; an input (row-sliced) may appear
+      # on several devices with identical ids - any occurrence serves
+      cat_pos = {}
+      flat = [i for dev in dist.plan.input_ids_list for i in dev]
+      for pos, i in enumerate(flat):
+        cat_pos.setdefault(i, pos)
+    d_emb = list(d_emb)
+    for i, tid in enumerate(dist.plan.input_table_map):
+      if (dist.plan.row_sliced[tid]
+          and dist.table_configs[tid].combiner == 'mean'):
+        x = cats[cat_pos[i]]
+        if isinstance(x, RaggedBatch):
+          x = x.to_padded_dense(dist._ragged_cap(x))
+        d_emb[i] = d_emb[i] / _valid_count(
+            jnp.asarray(x))[:, None].astype(d_emb[i].dtype)
+
+    gsubs = dist.backward_to_mp(d_emb, global_batch, hotness)
     lr = (lr_schedule(state.step) if lr_schedule is not None
           else emb_optimizer.learning_rate)
     new_emb, emb_opt_state = sparse_apply_updates(

@@ -74,10 +74,10 @@ class TestPlanner:
     # row-sliced tables produce no column-slice output ranges
     assert plan.sliced_out_ranges == []
 
-  def test_mean_combiner_raises(self):
-    with pytest.raises(NotImplementedError, match='mean'):
-      ShardingPlan([TableConfig(100, 8, 'mean')], world_size=4,
-                   row_slice_threshold=300)
+  def test_mean_combiner_plans(self):
+    plan = ShardingPlan([TableConfig(100, 8, 'mean')], world_size=4,
+                        row_slice_threshold=300)
+    assert plan.row_sliced == [True]
 
   def test_bad_row_slice_type_raises(self):
     mesh = create_mesh(jax.devices()[:2])
@@ -101,7 +101,7 @@ class TestPlanner:
 def test_forward_equivalence(dp_input, strategy):
   rng = np.random.default_rng(3)
   mesh = create_mesh(jax.devices()[:WORLD])
-  configs = [TableConfig(100, 8, 'sum'), TableConfig(16, 8, None),
+  configs = [TableConfig(100, 8, 'mean'), TableConfig(16, 8, None),
              TableConfig(64, 4, 'sum'), TableConfig(40, 8, 'sum')]
   dist = DistributedEmbedding(configs, mesh=mesh, strategy=strategy,
                               dp_input=dp_input, row_slice=120)
@@ -224,6 +224,100 @@ def test_dense_autodiff_step_equivalence():
   for t in range(len(configs)):
     want = weights[t] - LR * np.asarray(g[t])
     np.testing.assert_allclose(got[t], want, rtol=3e-5, atol=3e-6)
+
+
+@pytest.mark.parametrize('dp_input', [True, False])
+def test_mean_row_sliced_subset_of_devices(dp_input):
+  # regression (round-2 review): a mean table sliced over a strict SUBSET
+  # of devices must still divide by the true count — the division happens
+  # owner-side pre-all_to_all, so non-owner devices never need the ids
+  rng = np.random.default_rng(12)
+  mesh = create_mesh(jax.devices()[:4])
+  configs = [TableConfig(96, 8, 'mean'), TableConfig(48, 8, 'sum'),
+             TableConfig(32, 8, 'sum')]
+  dist = DistributedEmbedding(configs, mesh=mesh, dp_input=dp_input,
+                              row_slice=400)
+  # 2 row shards + 2 plain tables over 4 devices: shards own devices 0-1
+  assert dist.plan.row_sliced == [True, False, False]
+  weights = [rng.normal(size=(c.input_dim, c.output_dim)).astype(np.float32)
+             for c in configs]
+  params = set_weights(dist, weights)
+  ids = [rng.integers(0, c.input_dim, size=(16, 3)).astype(np.int32)
+         for c in configs]
+  ids[0][0, 1] = -1  # padding shrinks this sample's mean denominator
+  if dp_input:
+    inputs = [jnp.asarray(x) for x in ids]
+  else:
+    flat = [i for dev in dist.plan.input_ids_list for i in dev]
+    inputs = [jnp.asarray(ids[i]) for i in flat]
+  outs = dist.apply(params, inputs)
+  for t, c in enumerate(configs):
+    np.testing.assert_allclose(np.asarray(outs[t]),
+                               oracle_lookup(weights[t], ids[t], c.combiner),
+                               rtol=1e-5, atol=1e-5, err_msg=f'table {t}')
+
+
+@pytest.mark.parametrize('dp_input', [True, False])
+def test_sparse_step_mean_row_sliced(dp_input):
+  # a row-sliced MEAN table trains correctly through the sparse path:
+  # shard lookups are sums, owners divide by the true count, and the
+  # cotangent is pre-divided (not by the shard-local window count) — in
+  # both input modes (mp mode exercises the worker-order cat mapping)
+  rng = np.random.default_rng(11)
+  mesh = create_mesh(jax.devices()[:4])
+  configs = [TableConfig(96, 8, 'mean'), TableConfig(48, 8, 'sum')]
+  dist = DistributedEmbedding(configs, mesh=mesh, row_slice=400,
+                              dp_input=dp_input)
+  assert dist.plan.row_sliced[0]
+  weights = [rng.normal(size=(c.input_dim, c.output_dim)).astype(np.float32)
+             for c in configs]
+  ids0 = rng.integers(0, 96, (16, 3)).astype(np.int32)
+  ids0[0, 2] = -1  # padding: mean denominator counts 2 for this sample
+  ids1 = rng.integers(0, 48, (16, 3)).astype(np.int32)
+  ids = [ids0, ids1]
+  if dp_input:
+    inputs = [jnp.asarray(x) for x in ids]
+  else:
+    flat = [i for dev in dist.plan.input_ids_list for i in dev]
+    inputs = [jnp.asarray(ids[i]) for i in flat]
+  kernel = jnp.asarray(rng.standard_normal((16, 1)).astype(np.float32) * 0.1)
+  labels = jnp.asarray(rng.integers(0, 2, (16, 1)).astype(np.float32))
+
+  def head_loss_fn(dense_params, emb_outs, batch):
+    h = jnp.concatenate(list(emb_outs), axis=-1)
+    return jnp.mean((h @ dense_params['kernel'] - batch)**2)
+
+  opt = SparseSGD(learning_rate=LR)
+  step = make_hybrid_train_step(dist, head_loss_fn, optax.sgd(LR), opt,
+                                donate=False)
+  params = set_weights(dist, weights)
+  state = init_hybrid_train_state(dist, {
+      'embedding': params,
+      'kernel': kernel
+  }, optax.sgd(LR), opt)
+  state, loss = step(state, inputs, labels)
+  assert np.isfinite(float(loss))
+  got = get_weights(dist, state.params['embedding'])
+
+  # dense-gradient oracle with explicit mean semantics
+  def loss_fn(ws):
+    cnt0 = jnp.maximum(jnp.sum(jnp.asarray(ids0) >= 0, axis=1), 1)
+    out0 = jnp.zeros((16, 8))
+    for h in range(3):
+      valid = (jnp.asarray(ids0)[:, h] >= 0)[:, None]
+      out0 = out0 + jnp.where(valid, ws[0][jnp.asarray(ids0)[:, h]], 0)
+    out0 = out0 / cnt0[:, None]
+    out1 = jnp.zeros((16, 8))
+    for h in range(3):
+      out1 = out1 + ws[1][jnp.asarray(ids1)[:, h]]
+    h = jnp.concatenate([out0, out1], axis=-1)
+    return jnp.mean((h @ kernel - labels)**2)
+
+  g = jax.grad(loss_fn)([jnp.asarray(w) for w in weights])
+  for t in range(2):
+    want = weights[t] - LR * np.asarray(g[t])
+    np.testing.assert_allclose(got[t], want, rtol=3e-5, atol=3e-6,
+                               err_msg=f'table {t}')
 
 
 def test_scaled_uniform_init_uses_full_table_rows():
