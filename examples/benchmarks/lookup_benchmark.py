@@ -84,6 +84,8 @@ def main():
   args = parser.parse_args()
 
   import jax
+  if os.environ.get('JAX_PLATFORMS') == 'cpu':
+    jax.config.update('jax_platforms', 'cpu')
   import jax.numpy as jnp
   from distributed_embeddings_tpu.ops.embedding_lookup import embedding_lookup
   from distributed_embeddings_tpu.ops.ragged import RaggedBatch

@@ -25,6 +25,10 @@ def main():
   args = p.parse_args()
 
   import jax
+  if os.environ.get('JAX_PLATFORMS') == 'cpu':
+    # env var alone does not stop the TPU tunnel plugin; the
+    # config knob wins (tests/conftest.py)
+    jax.config.update('jax_platforms', 'cpu')
   import jax.numpy as jnp
   import optax
   from distributed_embeddings_tpu.models.synthetic import (SYNTHETIC_MODELS,
