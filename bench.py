@@ -115,6 +115,9 @@ def main():
   parser.add_argument('--fused_apply', action='store_true',
                       help='opt into the fused Pallas row-wise Adagrad '
                       'apply (ops/pallas_rowwise.py)')
+  parser.add_argument('--row_slice', type=int, default=None,
+                      help='element threshold for row-sharding big tables '
+                      '(multi-chip; beyond the reference)')
   parser.add_argument('--capacity_fraction', type=float, default=0.5,
                       help='compaction capacity as a fraction of the raw '
                       'update stream (parallel/sparse.py)')
@@ -167,6 +170,7 @@ def main():
   model = SyntheticModel(config,
                          mesh=mesh,
                          dp_input=True,
+                         row_slice=args.row_slice,
                          param_dtype=jnp.dtype(args.param_dtype),
                          compute_dtype=compute_dtype)
   params = model.init(0)

@@ -234,12 +234,14 @@ class SyntheticModel:
     config: one of ``SYNTHETIC_MODELS``.
     mesh: device mesh.
     column_slice_threshold: forwarded to the planner.
+    row_slice: element threshold for ROW sharding (beyond the reference).
     dp_input: data-parallel input (reference benchmark default is False).
     param_dtype / compute_dtype: storage and activation dtypes.
   """
   config: ModelConfig
   mesh: Optional[Mesh] = None
   column_slice_threshold: Optional[int] = None
+  row_slice: Optional[int] = None
   dp_input: bool = False
   strategy: str = 'memory_balanced'
   param_dtype: Any = jnp.float32
@@ -253,6 +255,7 @@ class SyntheticModel:
         tables,
         strategy=self.strategy,
         column_slice_threshold=self.column_slice_threshold,
+        row_slice=self.row_slice,
         dp_input=self.dp_input,
         input_table_map=input_table_map,
         mesh=self.mesh,
