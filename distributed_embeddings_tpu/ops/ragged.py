@@ -87,6 +87,10 @@ class RaggedBatch:
 
     Canonical densification used by the distributed runtime, which routes
     fixed-capacity buffers through all-to-all (see parallel/dist_embedding.py).
+
+    Ids past ``hot_cap`` in a row are silently DROPPED (shapes must stay
+    static); pick ``hot_cap`` >= the max row length.  The runtime's eager
+    path does this automatically (``DistributedEmbedding._ragged_cap``).
     """
     rowids = self.row_ids()
     pos = jnp.arange(self.nnz_cap, dtype=self.row_splits.dtype)

@@ -336,8 +336,24 @@ class DistributedEmbedding:
     return inputs, batch, tuple(hotness)
 
   def _ragged_cap(self, ragged: RaggedBatch) -> int:
-    # densification capacity: average capacity per row, at least 1
-    return max(1, -(-ragged.nnz_cap // ragged.nrows))
+    """Densification capacity for a ragged input.
+
+    ``to_padded_dense`` silently DROPS ids past the capacity, so with
+    concrete (eager) inputs — the normal ``apply`` path — the TRUE max
+    row length is used, rounded up to the next power of two to bound
+    the set of compiled shapes.  Under tracing the lengths are not
+    readable; the average-capacity heuristic then applies and skewed
+    rows can truncate — pass pre-densified ids (``to_padded_dense`` with
+    a sufficient cap) to jitted code instead.
+    """
+    try:
+      lengths = np.asarray(ragged.row_lengths())
+    except jax.errors.TracerArrayConversionError:
+      # traced: lengths unknowable at trace time — average heuristic,
+      # with the truncation hazard documented above
+      return max(1, -(-ragged.nnz_cap // ragged.nrows))
+    m = int(lengths.max()) if lengths.size else 1
+    return 1 << max(0, m - 1).bit_length() if m > 1 else 1
 
   def _subgroups(self, hotness: tuple) -> List['_SubGroup']:
     """Partition each fusion group's requests by input hotness.

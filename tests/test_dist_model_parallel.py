@@ -493,6 +493,35 @@ class TestBfloat16EndToEnd:
       np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-2)
 
 
+class TestRaggedDistributed:
+
+  def test_skewed_ragged_batch_not_truncated(self):
+    # regression: the eager densification cap must cover the MAX row
+    # length, not the average — a skewed ragged batch (one hot row among
+    # singletons) used to silently drop ids past ceil(nnz/rows)
+    from distributed_embeddings_tpu.ops.ragged import RaggedBatch
+    rng = np.random.default_rng(21)
+    mesh = create_mesh(jax.devices()[:4])
+    configs = [TableConfig(50, 8, 'sum'), TableConfig(30, 8, 'sum')]
+    dist = DistributedEmbedding(configs, mesh=mesh)
+    weights = [
+        rng.normal(size=(c.input_dim, c.output_dim)).astype(np.float32)
+        for c in configs
+    ]
+    params = set_weights(dist, weights)
+    rows0 = [[1, 2, 3, 4, 5, 6, 7, 8, 9]] + [[i % 50] for i in range(7)]
+    rows1 = [[i % 30] for i in range(8)]
+    inputs = [RaggedBatch.from_lists(rows0, nnz_cap=16),
+              RaggedBatch.from_lists(rows1, nnz_cap=8)]
+    outs = dist.apply(params, inputs)
+    want0 = np.stack([np.sum(weights[0][r], axis=0) for r in rows0])
+    want1 = np.stack([np.sum(weights[1][r], axis=0) for r in rows1])
+    np.testing.assert_allclose(np.asarray(outs[0]), want0, rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs[1]), want1, rtol=1e-5,
+                               atol=1e-5)
+
+
 class TestMultihostHelpers:
 
   def test_make_global_batch_single_process(self):
