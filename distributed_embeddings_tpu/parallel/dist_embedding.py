@@ -353,7 +353,10 @@ class DistributedEmbedding:
       # with the truncation hazard documented above
       return max(1, -(-ragged.nnz_cap // ragged.nrows))
     m = int(lengths.max()) if lengths.size else 1
-    return 1 << max(0, m - 1).bit_length() if m > 1 else 1
+    if m <= 1:
+      return 1
+    # next pow2, clamped to nnz_cap (no row can be longer than that)
+    return min(1 << max(0, m - 1).bit_length(), ragged.nnz_cap)
 
   def _subgroups(self, hotness: tuple) -> List['_SubGroup']:
     """Partition each fusion group's requests by input hotness.

@@ -649,7 +649,20 @@ def make_hybrid_train_step(dist: DistributedEmbedding,
 
   if not jit:
     return step  # composable form (e.g. as a lax.scan body)
-  return jax.jit(step, donate_argnums=(0,) if donate else ())
+  jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+
+  def run(state, cats, batch):
+    # densify RaggedBatch inputs HERE, outside the jit boundary, where
+    # the true max row length is readable — inside jit the lengths are
+    # tracers and the average-cap fallback can silently truncate skewed
+    # rows (see DistributedEmbedding._ragged_cap)
+    cats = [
+        x.to_padded_dense(dist._ragged_cap(x))
+        if isinstance(x, RaggedBatch) else x for x in cats
+    ]
+    return jitted(state, cats, batch)
+
+  return run
 
 
 def calibrate_capacity_rows(dist: DistributedEmbedding, cats,

@@ -522,6 +522,47 @@ class TestRaggedDistributed:
                                atol=1e-5)
 
 
+  def test_skewed_ragged_through_jitted_hybrid_step(self):
+    # the jitted train step densifies RaggedBatch inputs OUTSIDE the jit
+    # boundary, where the true max row length is readable — a skewed
+    # batch must produce the exact dense-oracle update
+    import optax
+    from distributed_embeddings_tpu.ops.ragged import RaggedBatch
+    from distributed_embeddings_tpu.parallel import (
+        SparseSGD, init_hybrid_train_state, make_hybrid_train_step)
+    rng = np.random.default_rng(2)
+    mesh = create_mesh(jax.devices()[:4])
+    dist = DistributedEmbedding([TableConfig(30, 8, 'sum')], mesh=mesh)
+    w = [rng.normal(size=(30, 8)).astype(np.float32)]
+    rows = [[1, 2, 3, 4, 5, 6, 7]] + [[i % 30] for i in range(7)]
+    rb = RaggedBatch.from_lists(rows, nnz_cap=16)
+    kernel = jnp.asarray(
+        rng.standard_normal((8, 1)).astype(np.float32) * 0.1)
+    labels = jnp.zeros((8, 1), jnp.float32)
+
+    def head(dp, eo, b):
+      return jnp.mean((jnp.concatenate(list(eo), -1) @ dp['kernel'] - b)**2)
+
+    opt = SparseSGD(learning_rate=0.3)
+    step = make_hybrid_train_step(dist, head, optax.sgd(0.3), opt,
+                                  donate=False)
+    state = init_hybrid_train_state(dist, {
+        'embedding': set_weights(dist, w),
+        'kernel': kernel
+    }, optax.sgd(0.3), opt)
+    state, _ = step(state, [rb], labels)
+
+    def loss_fn(wt):
+      outs = jnp.stack([jnp.sum(wt[jnp.asarray(r)], axis=0) for r in rows])
+      return jnp.mean((outs @ kernel - labels)**2)
+
+    g = jax.grad(loss_fn)(jnp.asarray(w[0]))
+    want = w[0] - 0.3 * np.asarray(g)
+    np.testing.assert_allclose(
+        np.asarray(get_weights(dist, state.params['embedding'])[0]), want,
+        rtol=3e-5, atol=3e-6)
+
+
 class TestMultihostHelpers:
 
   def test_make_global_batch_single_process(self):

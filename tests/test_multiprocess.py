@@ -1,0 +1,111 @@
+"""REAL multi-process distributed test: two jax.distributed CPU processes
+form one 8-device world (4 local devices each) and drive init_distributed,
+make_global_batch, the distributed forward, and the chunked checkpoint
+gather over genuinely non-addressable shards.
+
+The reference only gets such coverage under `horovodrun -np N`
+(`/root/reference/tests/dist_model_parallel_test.py`); here the world is
+spawned in-test.  Skipped by default off-CI-speed runs? No — it is quick
+(~1 min) but guarded by DET_SKIP_MULTIPROC for constrained environments.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+WORKER = r'''
+import os, sys
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+import jax.numpy as jnp
+from distributed_embeddings_tpu.parallel import (DistributedEmbedding,
+                                                 TableConfig, create_mesh,
+                                                 get_weights,
+                                                 init_distributed,
+                                                 make_global_batch,
+                                                 set_weights)
+
+coord, pid = sys.argv[1], int(sys.argv[2])
+rank = init_distributed(coordinator_address=coord, num_processes=2,
+                        process_id=pid)
+assert rank == pid == jax.process_index()
+devs = jax.devices()
+assert len(devs) == 8, len(devs)
+
+mesh = create_mesh()
+configs = [TableConfig(40, 8, 'sum'), TableConfig(24, 8, 'sum'),
+           TableConfig(64, 4, 'mean')]
+dist = DistributedEmbedding(configs, mesh=mesh, strategy='memory_balanced')
+rng = np.random.default_rng(0)  # same seed everywhere: deterministic plan
+weights = [rng.normal(size=(c.input_dim, c.output_dim)).astype(np.float32)
+           for c in configs]
+params = set_weights(dist, weights)
+
+# process-local batch slices -> global batch 16
+local = 8
+ids = [rng.integers(0, c.input_dim, size=(16, 3)).astype(np.int32)
+       for c in configs]
+g0, g1, g2 = make_global_batch(
+    mesh, *[x[pid * local:(pid + 1) * local] for x in ids])
+outs = dist.apply(params, [g0, g1, g2])
+
+# verify THIS process's addressable slice of each output vs the oracle
+for t, c in enumerate(configs):
+  out = outs[t]
+  want_full = np.zeros((16, c.output_dim), np.float32)
+  for i, row in enumerate(ids[t]):
+    for v in row:
+      want_full[i] += weights[t][v]
+    if c.combiner == 'mean':
+      want_full[i] /= len(ids[t][i])
+  for shard in out.addressable_shards:
+    sl = shard.index[0]
+    np.testing.assert_allclose(np.asarray(shard.data),
+                               want_full[sl], rtol=1e-5, atol=1e-5)
+
+# chunked gather: shards on the other process are NOT addressable here
+back = get_weights(dist, params, gather='chunked', chunk_elems=64)
+for w, b in zip(weights, back):
+  np.testing.assert_array_equal(w, b)
+print(f'MP-OK rank={rank}')
+'''
+
+
+@pytest.mark.skipif(os.environ.get('DET_SKIP_MULTIPROC') == '1',
+                    reason='multi-process test disabled')
+def test_two_process_world(tmp_path):
+  with socket.socket() as s:
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+  coord = f'127.0.0.1:{port}'
+  env = {
+      **os.environ,
+      'XLA_FLAGS': '--xla_force_host_platform_device_count=4',
+      'JAX_PLATFORMS': 'cpu',
+  }
+  env.pop('_DET_TPU_DRYRUN_CHILD', None)
+  procs = [
+      subprocess.Popen([sys.executable, '-c', WORKER, coord, str(i)],
+                       env=env, stdout=subprocess.PIPE,
+                       stderr=subprocess.STDOUT, text=True,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+      for i in range(2)
+  ]
+  outs = []
+  for p in procs:
+    try:
+      out, _ = p.communicate(timeout=420)
+    except subprocess.TimeoutExpired:
+      for q in procs:
+        q.kill()
+      raise
+    outs.append(out)
+  for i, (p, out) in enumerate(zip(procs, outs)):
+    assert p.returncode == 0, f'rank {i} failed:\n{out[-2000:]}'
+    assert f'MP-OK rank={i}' in out
