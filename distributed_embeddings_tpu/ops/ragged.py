@@ -11,7 +11,7 @@ static; only the split values are data.
 from __future__ import annotations
 
 import dataclasses
-from typing import Sequence
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -33,9 +33,17 @@ class RaggedBatch:
     row_splits: ``[batch + 1]`` int array, monotonically non-decreasing,
       ``row_splits[0] == 0``.  Row ``i`` owns
       ``values[row_splits[i]:row_splits[i+1]]``.
+    hot_cap: optional STATIC upper bound on the row length, carried as
+      pytree aux data so it survives tracing.  ``from_lists`` sets it
+      automatically; set it when building by hand so jitted consumers
+      (e.g. the distributed runtime's densification) can size padded
+      buffers without a device sync — and, under tracing, without
+      falling back to an average-capacity heuristic that can silently
+      truncate skewed rows.
   """
   values: jax.Array
   row_splits: jax.Array
+  hot_cap: Optional[int] = None
 
   @property
   def nrows(self) -> int:
@@ -80,7 +88,8 @@ class RaggedBatch:
     splits = np.zeros((len(rows) + 1,), dtype=np.int32)
     np.cumsum([len(r) for r in rows], out=splits[1:])
     return cls(values=jnp.asarray(values, dtype),
-               row_splits=jnp.asarray(splits, dtype))
+               row_splits=jnp.asarray(splits, dtype),
+               hot_cap=max((len(r) for r in rows), default=1))
 
   def to_padded_dense(self, hot_cap: int, pad_value: int = -1) -> jax.Array:
     """``[batch, hot_cap]`` dense ids with ``pad_value`` at padding positions.
@@ -105,12 +114,11 @@ class RaggedBatch:
         self.values, mode='drop', unique_indices=False)
 
   def tree_flatten(self):
-    return (self.values, self.row_splits), None
+    return (self.values, self.row_splits), self.hot_cap
 
   @classmethod
   def tree_unflatten(cls, aux, children):
-    del aux
-    return cls(*children)
+    return cls(*children, hot_cap=aux)
 
 
 @jax.tree_util.register_pytree_node_class

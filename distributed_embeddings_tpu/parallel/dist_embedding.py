@@ -346,13 +346,18 @@ class DistributedEmbedding:
     rows can truncate — pass pre-densified ids (``to_padded_dense`` with
     a sufficient cap) to jitted code instead.
     """
-    try:
-      lengths = np.asarray(ragged.row_lengths())
-    except jax.errors.TracerArrayConversionError:
-      # traced: lengths unknowable at trace time — average heuristic,
-      # with the truncation hazard documented above
-      return max(1, -(-ragged.nnz_cap // ragged.nrows))
-    m = int(lengths.max()) if lengths.size else 1
+    if ragged.hot_cap is not None:
+      # static bound carried on the batch (set by from_lists / the user):
+      # no device sync, valid under tracing
+      m = int(ragged.hot_cap)
+    else:
+      try:
+        lengths = np.asarray(ragged.row_lengths())
+      except jax.errors.TracerArrayConversionError:
+        # traced without hot_cap: lengths unknowable at trace time —
+        # average heuristic, with the truncation hazard documented above
+        return max(1, -(-ragged.nnz_cap // ragged.nrows))
+      m = int(lengths.max()) if lengths.size else 1
     if m <= 1:
       return 1
     # next pow2, clamped to nnz_cap (no row can be longer than that)

@@ -522,6 +522,23 @@ class TestRaggedDistributed:
                                atol=1e-5)
 
 
+  def test_skewed_ragged_through_user_jitted_apply(self):
+    # hot_cap rides RaggedBatch as STATIC pytree aux, so even a USER-
+    # jitted apply (fully traced inputs) sizes the padded buffers from
+    # the true max row length — no silent truncation
+    from distributed_embeddings_tpu.ops.ragged import RaggedBatch
+    rng = np.random.default_rng(23)
+    mesh = create_mesh(jax.devices()[:4])
+    dist = DistributedEmbedding([TableConfig(30, 8, 'sum')], mesh=mesh)
+    w = [rng.normal(size=(30, 8)).astype(np.float32)]
+    params = set_weights(dist, w)
+    rows = [[1, 2, 3, 4, 5, 6, 7]] + [[i % 30] for i in range(7)]
+    rb = RaggedBatch.from_lists(rows, nnz_cap=16)
+    out = jax.jit(lambda p, r: dist.apply(p, [r]))(params, rb)
+    want = np.stack([np.sum(w[0][r], axis=0) for r in rows])
+    np.testing.assert_allclose(np.asarray(out[0]), want, rtol=1e-5,
+                               atol=1e-5)
+
   def test_skewed_ragged_through_jitted_hybrid_step(self):
     # the jitted train step densifies RaggedBatch inputs OUTSIDE the jit
     # boundary, where the true max row length is readable — a skewed

@@ -5,8 +5,8 @@ gather over genuinely non-addressable shards.
 
 The reference only gets such coverage under `horovodrun -np N`
 (`/root/reference/tests/dist_model_parallel_test.py`); here the world is
-spawned in-test.  Skipped by default off-CI-speed runs? No — it is quick
-(~1 min) but guarded by DET_SKIP_MULTIPROC for constrained environments.
+spawned in-test.  Quick (~1 min); set DET_SKIP_MULTIPROC=1 to disable in
+constrained environments.
 """
 
 import os
