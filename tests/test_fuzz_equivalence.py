@@ -109,3 +109,91 @@ def test_fuzz_forward_and_checkpoint(seed):
   # checkpoint round trip under whatever layout the fuzz produced
   for w, b in zip(weights, get_weights(dist, params)):
     np.testing.assert_array_equal(w, b)
+
+
+@pytest.mark.parametrize('seed', range(4))
+def test_fuzz_sparse_train_step(seed):
+  """One SparseSGD step over a random layout == the dense-gradient
+  oracle (SGD is linear, so any correct routing/compaction/apply chain
+  must reproduce it exactly up to f32 summation order)."""
+  import optax
+  from distributed_embeddings_tpu.parallel import (SparseSGD,
+                                                   init_hybrid_train_state,
+                                                   make_hybrid_train_step)
+  rng = np.random.default_rng(2000 + seed)
+  world = int(rng.choice([2, 4, 8]))
+  mesh = create_mesh(jax.devices()[:world])
+  n_tables = world + int(rng.integers(0, 3))
+  configs = []
+  for _ in range(n_tables):
+    rows = int(rng.integers(8, 200))
+    width = int(rng.choice([4, 8, 16]))
+    configs.append(TableConfig(rows, width, rng.choice(['sum', 'mean'])))
+  sizes = [c.size for c in configs]
+  row_thr = (int(rng.integers(min(sizes), max(sizes) + 1))
+             if rng.random() < 0.5 else None)
+  try:
+    dist = DistributedEmbedding(configs, mesh=mesh, row_slice=row_thr,
+                                strategy=str(rng.choice(
+                                    ['basic', 'memory_balanced'])))
+  except ValueError as e:
+    if 'Not enough table' in str(e):
+      pytest.skip(str(e))
+    raise
+  weights = [
+      rng.normal(size=(c.input_dim, c.output_dim)).astype(np.float32)
+      for c in configs
+  ]
+  batch = world * 2
+  ids = []
+  for c in configs:
+    x = rng.integers(0, c.input_dim, size=(batch, 3)).astype(np.int32)
+    # sprinkle padding (never emptying a row) and an out-of-vocab id so
+    # the valid-count cotangent path is exercised non-trivially
+    x[rng.integers(0, batch), rng.integers(1, 3)] = -1
+    if rng.random() < 0.5:
+      x[rng.integers(0, batch), 0] = c.input_dim + 2
+    ids.append(x)
+  total_w = sum(c.output_dim for c in configs)
+  kernel = jnp.asarray(
+      rng.standard_normal((total_w, 1)).astype(np.float32) * 0.1)
+  labels = jnp.asarray(rng.integers(0, 2, (batch, 1)).astype(np.float32))
+  lr = 0.3
+
+  def head_loss_fn(dense_params, emb_outs, b):
+    h = jnp.concatenate(list(emb_outs), axis=-1)
+    return jnp.mean((h @ dense_params['kernel'] - b)**2)
+
+  opt = SparseSGD(learning_rate=lr)
+  step = make_hybrid_train_step(dist, head_loss_fn, optax.sgd(lr), opt,
+                                donate=False)
+  state = init_hybrid_train_state(dist, {
+      'embedding': set_weights(dist, weights),
+      'kernel': kernel
+  }, optax.sgd(lr), opt)
+  state, loss = step(state, [jnp.asarray(x) for x in ids], labels)
+  assert np.isfinite(float(loss))
+  got = get_weights(dist, state.params['embedding'])
+
+  def loss_fn(ws):
+    outs = []
+    for t, c in enumerate(configs):
+      x = jnp.asarray(ids[t])
+      valid = x >= 0
+      safe = jnp.clip(x, 0, c.input_dim - 1)  # OOV clips to last row
+      out = jnp.zeros((batch, c.output_dim))
+      for h in range(3):
+        out = out + jnp.where(valid[:, h, None], ws[t][safe[:, h]], 0)
+      if c.combiner == 'mean':
+        out = out / jnp.maximum(jnp.sum(valid, axis=1), 1)[:, None]
+      outs.append(out)
+    h = jnp.concatenate(outs, axis=-1)
+    return jnp.mean((h @ kernel - labels)**2)
+
+  g = jax.grad(loss_fn)([jnp.asarray(w) for w in weights])
+  for t in range(n_tables):
+    want = weights[t] - lr * np.asarray(g[t])
+    np.testing.assert_allclose(got[t], want, rtol=3e-5, atol=3e-6,
+                               err_msg=f'seed {seed} table {t} '
+                               f'({configs[t].combiner}, world {world}, '
+                               f'row_thr {row_thr})')
