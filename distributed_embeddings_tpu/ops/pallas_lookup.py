@@ -1,5 +1,18 @@
 """Pallas TPU kernel: fused gather-accumulate embedding lookup.
 
+STATUS (measured on v5e, docs/perf_notes.md): this kernel LOSES to the
+XLA gather+segment-sum fallback at every width/hotness on current
+TensorCore hardware — any scalar-core-issued per-row DMA floors at
+~47 ns/row against XLA's ~29 ns/row gather — so ``lookup_impl='auto'``
+never selects it and nobody should pass ``lookup_impl='pallas'`` for
+performance on v5e/v5p.  It is kept, tested, as (a) the measurement-gated
+dispatch seam mirroring the reference's native-op vs ``tf.nn`` dispatch
+(``embedding_lookup_ops.py:67-102``), and (b) the landing point for a
+SparseCore offload, the one credible route below the XLA gather floor on
+hardware that exposes it (VERDICT.md round 2; docs/perf_notes.md
+"SparseCore seam").  Do not spend further tuning effort here for
+TensorCore targets.
+
 TPU-native re-design of the reference's fused CUDA forward kernels
 ``EmbeddingLookUpVariableHot[Wide]``
 (`/root/reference/distributed_embeddings/cc/kernels/embedding_lookup_kernels.cu:175-336`,

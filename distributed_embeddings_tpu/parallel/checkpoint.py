@@ -192,8 +192,11 @@ def get_weights(dist: DistributedEmbedding,
           host_shards[gi][dev][row_offset:row_offset + cfg.input_dim, :])
       continue
     # paste row x column windows into the global [rows, width] canvas
-    # (covers column slicing, row slicing, and plain tables uniformly)
-    out = np.empty((cfg.input_dim, cfg.output_dim),
+    # (covers column slicing, row slicing, and plain tables uniformly);
+    # zeros, not empty: the planner asserts the windows tile the table,
+    # but a future layout gap must read as zeros, never as uninitialised
+    # memory (ADVICE.md round 2)
+    out = np.zeros((cfg.input_dim, cfg.output_dim),
                    host_shards[group_index[shards[0][1]]][0].dtype)
     for dev, group_key, row_offset, col_start, col_end, row_start, \
         row_end in shards:

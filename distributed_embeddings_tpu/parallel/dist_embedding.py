@@ -342,9 +342,10 @@ class DistributedEmbedding:
     concrete (eager) inputs — the normal ``apply`` path — the TRUE max
     row length is used, rounded up to the next power of two to bound
     the set of compiled shapes.  Under tracing the lengths are not
-    readable; the average-capacity heuristic then applies and skewed
-    rows can truncate — pass pre-densified ids (``to_padded_dense`` with
-    a sufficient cap) to jitted code instead.
+    readable and no safe capacity exists: a batch without a static
+    ``hot_cap`` raises (no silent truncation) — pass pre-densified ids
+    (``to_padded_dense`` with a sufficient cap) to jitted code, or set
+    ``hot_cap``.
     """
     if ragged.hot_cap is not None:
       # static bound carried on the batch (set by from_lists / the user):
@@ -354,9 +355,18 @@ class DistributedEmbedding:
       try:
         lengths = np.asarray(ragged.row_lengths())
       except jax.errors.TracerArrayConversionError:
-        # traced without hot_cap: lengths unknowable at trace time —
-        # average heuristic, with the truncation hazard documented above
-        return max(1, -(-ragged.nnz_cap // ragged.nrows))
+        # Traced without hot_cap: the row lengths are unknowable at trace
+        # time, so ANY capacity chosen here risks silently dropping ids of
+        # skewed rows.  Refuse loudly instead of guessing (VERDICT.md
+        # round 2, "What's weak" 3 / ADVICE.md medium).
+        raise ValueError(
+            'RaggedBatch reached a traced (jit) context without a static '
+            'hot_cap: the densification capacity cannot be derived from '
+            'traced row lengths, and guessing risks silently dropping '
+            'ids.  Either construct the batch with an explicit hot_cap '
+            '(RaggedBatch.from_lists sets one automatically), or densify '
+            'before the jit boundary with '
+            'batch.to_padded_dense(capacity).') from None
       m = int(lengths.max()) if lengths.size else 1
     if m <= 1:
       return 1
@@ -401,44 +411,117 @@ class DistributedEmbedding:
             vocab[dev, s] = self.table_configs[r.table_id].input_dim
             row_lo[dev, s] = r.row_start
             row_hi[dev, s] = r.row_end
+        # ---- output-side routing ----------------------------------------
+        # Row-shard slots leave mp space through ONE psum_scatter per
+        # input — summing the K shard partials on the way — instead of
+        # shipping K full [GB, w] partials through the all_to_all and
+        # summing at assembly: a row-sliced input costs one slot of
+        # output traffic regardless of shard count.  The all_to_all
+        # buffer carries only the remaining slots, at its own (smaller)
+        # slot capacity ``out_n_cap``.
+        merge_inputs = sorted({
+            r.input_id for rs in per_dev for r in rs if is_row_sliced(r)
+        })
+        m_of = {inp: m for m, inp in enumerate(merge_inputs)}
+        merge_slot = np.full((self.world_size, max(1, len(merge_inputs))),
+                             n_cap, np.int32)
+        out_pos = {}
+        keep_lists = []
+        for dev, rs in enumerate(per_dev):
+          keep = []
+          for s, r in enumerate(rs):
+            if is_row_sliced(r):
+              merge_slot[dev, m_of[r.input_id]] = s
+            else:
+              out_pos[(dev, s)] = len(keep)
+              keep.append(s)
+          keep_lists.append(keep)
+        out_n_cap = (n_cap if not merge_inputs else
+                     max(len(k) for k in keep_lists))
+        out_sel = np.full((self.world_size, out_n_cap), n_cap, np.int32)
+        for dev, keep in enumerate(keep_lists):
+          out_sel[dev, :len(keep)] = keep
         subs.append(_SubGroup(gi=gi, group=g, hotness=h, n_cap=n_cap,
                               requests=per_dev, offsets=offs, vocab=vocab,
                               row_lo=row_lo, row_hi=row_hi,
-                              mean_row_sliced=rsliced))
+                              mean_row_sliced=rsliced,
+                              merge_inputs=tuple(merge_inputs),
+                              merge_slot=merge_slot, out_sel=out_sel,
+                              out_n_cap=out_n_cap, out_pos=out_pos))
     return subs
 
-  def _assemble(self, subs, sub_back):
+  def _emit_outputs(self, sub, si, out, me, local_batch, merge_out,
+                    sub_back):
+    """Ship one subgroup's lookup outputs out of mp space.
+
+    ``out``: [n_cap, GB, w] per-device combined lookups.  Row-shard slots
+    go through one ``psum_scatter`` per merged input — the reduction over
+    the owning shards (non-owners contribute zeros) and the mp->dp
+    redistribution in a single collective, appended to ``merge_out`` as
+    dp-local ``[B, w]``.  Remaining slots ride the canonical all_to_all
+    buffer (reference 'out_mp_to_dp', dist_model_parallel.py:434),
+    appended to ``sub_back`` as ``[D, out_n_cap, B, w]`` (``None`` when
+    every slot merged).
+    """
+    D = self.world_size
+    w = sub.group.width
+    if sub.merge_inputs:
+      out_ext = jnp.concatenate(
+          [out, jnp.zeros((1,) + out.shape[1:], out.dtype)])
+      mslot = jnp.asarray(sub.merge_slot)[me]
+      for m, inp in enumerate(sub.merge_inputs):
+        partial = out_ext[mslot[m]]  # [GB, w]; zeros when not an owner
+        if D > 1:
+          partial = jax.lax.psum_scatter(partial, self.axis_name,
+                                         scatter_dimension=0, tiled=True)
+        merge_out[(si, inp)] = partial  # [B, w], already summed
+      if not sub.out_n_cap:
+        sub_back.append(None)
+        return
+      picked = out_ext[jnp.asarray(sub.out_sel)[me]]
+    else:
+      picked = out  # identity selection: every slot rides the a2a buffer
+    back = picked.reshape(sub.out_n_cap, D, local_batch,
+                          w).transpose(1, 0, 2, 3)
+    if D > 1:
+      back = jax.lax.all_to_all(back, self.axis_name, 0, 0)
+    sub_back.append(back)
+
+  def _assemble(self, subs, sub_back, merge_out):
     """Gather output pieces back to input order (reference reorder + column
     slice re-concat, dist_model_parallel.py:443,446-450).
 
-    ``sub_back[si]``: [D, n_cap, B, w] received outputs of subgroup si.
-    Pieces sharing a column range are ROW-shard partials (each shard
-    contributed its resident rows, zeros elsewhere; mean shards already
-    divided by the true count owner-side) and are added; distinct column
-    ranges concatenate, as in the reference.
+    ``sub_back[si]``: [D, out_n_cap, B, w] received all_to_all outputs of
+    subgroup si (``None`` when every slot merged); ``merge_out[(si, inp)]``:
+    [B, w] psum_scatter result of row-sliced input ``inp`` — already the
+    sum over its shards (mean shards divided by the true count
+    owner-side).  Distinct column ranges concatenate, as in the reference.
     """
-    # (device, group_key, plan slot) -> (subgroup index, subslot)
+    # (device, group_key, plan slot) -> (subgroup index, a2a position or
+    # None for row-shard slots, which were merged upstream)
     locate = {}
     for si, sub in enumerate(subs):
       for dev, rs in enumerate(sub.requests):
         for s, r in enumerate(rs):
-          locate[(dev, r.group_key, r.slot)] = (si, s)
+          locate[(dev, r.group_key, r.slot)] = (si, sub.out_pos.get((dev, s)))
     outs = []
-    for reqs in self.plan.input_requests:
-      # input_requests are sorted by (col_start, row_start): group runs of
-      # equal column range, summing within a run
+    for inp, reqs in enumerate(self.plan.input_requests):
+      # input_requests are sorted by (col_start, row_start); requests
+      # sharing a column range are row shards of one table, whose summed
+      # output arrived as a single psum_scatter piece
       pieces = []
       i = 0
       while i < len(reqs):
         j = i
-        part = None
         while j < len(reqs) and reqs[j].col_start == reqs[i].col_start:
-          r = reqs[j]
-          si, s = locate[(r.device, r.group_key, r.slot)]
-          p = sub_back[si][r.device, s]
-          part = p if part is None else part + p
           j += 1
-        pieces.append(part)
+        r = reqs[i]
+        si, pos = locate[(r.device, r.group_key, r.slot)]
+        if pos is None:
+          pieces.append(merge_out[(si, inp)])
+        else:
+          assert j == i + 1, 'unmerged requests sharing a column range'
+          pieces.append(sub_back[si][r.device, pos])
         i = j
       outs.append(pieces[0] if len(pieces) == 1 else jnp.concatenate(
           pieces, axis=-1))
@@ -467,8 +550,9 @@ class DistributedEmbedding:
       # axis_index from closed-over [D, n_cap] arrays.
       me = jax.lax.axis_index(self.axis_name)
       sub_back = []
+      merge_out = {}
       residuals = []
-      for sub in subs:
+      for si, sub in enumerate(subs):
         h = sub.hotness
         # --- canonical send buffer [D, n_cap, B, h]: slot (dev, s) holds
         # the ids destined for device dev's s-th request of this class ----
@@ -504,13 +588,11 @@ class DistributedEmbedding:
           # simply sum at assembly
           out = out / _valid_count(ids)[..., None].astype(out.dtype)
         residuals.append(routed[None])
-        # --- mp -> dp all_to_all (reference 'out_mp_to_dp', :434) --------
-        back = out.reshape(sub.n_cap, D, local_batch,
-                           sub.group.width).transpose(1, 0, 2, 3)
-        if D > 1:
-          back = jax.lax.all_to_all(back, self.axis_name, 0, 0)
-        sub_back.append(back)
-      outs = self._assemble(subs, sub_back)
+        # --- mp -> dp: all_to_all + per-input psum_scatter for row
+        # shards (reference 'out_mp_to_dp', :434) -------------------------
+        self._emit_outputs(sub, si, out, me, local_batch, merge_out,
+                           sub_back)
+      outs = self._assemble(subs, sub_back, merge_out)
       if with_residuals:
         return outs + tuple(residuals)
       return outs
@@ -574,8 +656,9 @@ class DistributedEmbedding:
     def local_fn(params, *canonicals):
       me = jax.lax.axis_index(self.axis_name)
       sub_back = []
+      merge_out = {}
       residuals = []
-      for sub, canon in zip(subs, canonicals):
+      for si, (sub, canon) in enumerate(zip(subs, canonicals)):
         ids = canon[0]  # [n_cap, GB, h]
         rows_cap = self.plan.groups[sub.gi].rows_cap
         routed = _route_ids(ids, jnp.asarray(sub.offsets)[me],
@@ -588,12 +671,9 @@ class DistributedEmbedding:
           # owner-side division by the true count (see the dp path)
           out = out / _valid_count(ids)[..., None].astype(out.dtype)
         residuals.append(routed[None])
-        back = out.reshape(sub.n_cap, D, local_batch,
-                           sub.group.width).transpose(1, 0, 2, 3)
-        if D > 1:
-          back = jax.lax.all_to_all(back, self.axis_name, 0, 0)
-        sub_back.append(back)
-      outs = self._assemble(subs, sub_back)
+        self._emit_outputs(sub, si, out, me, local_batch, merge_out,
+                           sub_back)
+      outs = self._assemble(subs, sub_back, merge_out)
       if with_residuals:
         return outs + tuple(residuals)
       return outs
@@ -681,23 +761,61 @@ class DistributedEmbedding:
     subs = self._subgroups(hotness)
 
     def local_fn(*d_outs):
+      me = jax.lax.axis_index(self.axis_name)
       gsubs = []
       for sub in subs:
         w = sub.group.width
-        slots = []
-        for dev in range(D):
-          rs = sub.requests[dev]
-          for s in range(sub.n_cap):
-            if s < len(rs):
-              r = rs[s]
-              slots.append(d_outs[r.input_id][:, r.col_start:r.col_end])
+        dt = d_outs[0].dtype
+
+        def a2a_cotangent(n_slots, sel, sub=sub, w=w, dt=dt):
+          """Cotangent of the a2a-shipped slots: [n_slots, GB, w] per
+          device; all_to_all is self-transpose."""
+          slots = []
+          for dev in range(D):
+            rs = sub.requests[dev]
+            for pos in range(n_slots):
+              s = int(sel[dev, pos]) if sel is not None else pos
+              if s < len(rs):
+                r = rs[s]
+                slots.append(d_outs[r.input_id][:, r.col_start:r.col_end])
+              else:
+                slots.append(jnp.zeros((local_batch, w), dt))
+          drecv = jnp.stack(slots).reshape(D, n_slots, local_batch, w)
+          if D > 1:
+            drecv = jax.lax.all_to_all(drecv, self.axis_name, 0, 0)
+          return drecv.transpose(1, 0, 2, 3).reshape(
+              n_slots, global_batch, w)
+
+        if not sub.merge_inputs:
+          gsubs.append(a2a_cotangent(sub.n_cap, None)[None])
+          continue
+        # Row-shard slots: every owner needs the FULL [GB, w] cotangent
+        # (transpose of the forward psum_scatter) — ONE all_gather per
+        # merged input, shared by all its owners, instead of one a2a
+        # slot per shard.  Reconstruct the per-slot [n_cap, GB, w] grads
+        # by a per-device static index into the concatenated sources.
+        M = len(sub.merge_inputs)
+        parts = []
+        if sub.out_n_cap:
+          parts.append(a2a_cotangent(sub.out_n_cap, sub.out_sel))
+        for inp in sub.merge_inputs:
+          dloc = d_outs[inp]  # [B, w]: row shards span the full width
+          g_full = (jax.lax.all_gather(dloc, self.axis_name, axis=0,
+                                       tiled=True) if D > 1 else dloc)
+          parts.append(g_full[None].astype(dt))
+        parts.append(jnp.zeros((1, global_batch, w), dt))
+        cat = jnp.concatenate(parts, axis=0)
+        zero_row = sub.out_n_cap + M
+        recon = np.full((D, sub.n_cap), zero_row, np.int32)
+        for dev, rs in enumerate(sub.requests):
+          for s, r in enumerate(rs):
+            pos = sub.out_pos.get((dev, s))
+            if pos is not None:
+              recon[dev, s] = pos
             else:
-              slots.append(jnp.zeros((local_batch, w), d_outs[0].dtype))
-        # cotangent of the received buffer; all_to_all is self-transpose
-        drecv = jnp.stack(slots).reshape(D, sub.n_cap, local_batch, w)
-        if D > 1:
-          drecv = jax.lax.all_to_all(drecv, self.axis_name, 0, 0)
-        g = drecv.transpose(1, 0, 2, 3).reshape(sub.n_cap, global_batch, w)
+              recon[dev, s] = sub.out_n_cap + sub.merge_inputs.index(
+                  r.input_id)
+        g = cat[jnp.asarray(recon)[me]]
         gsubs.append(g[None])
       return tuple(gsubs)
 
@@ -730,6 +848,13 @@ class _SubGroup:
   # divides by the true per-sample id count at assembly / in the sparse
   # cotangent (see _subgroups)
   mean_row_sliced: bool = False
+  # ---- output-side routing (see _subgroups / _emit_outputs) ----
+  # inputs whose slots are row shards, merged via one psum_scatter each
+  merge_inputs: tuple = ()
+  merge_slot: Optional[np.ndarray] = None  # [D, max(1, M)] slot or n_cap
+  out_sel: Optional[np.ndarray] = None     # [D, out_n_cap] slot or n_cap
+  out_n_cap: int = 0                       # a2a slot capacity
+  out_pos: Optional[dict] = None           # (dev, slot) -> a2a position
 
   @property
   def lookup_combiner(self):

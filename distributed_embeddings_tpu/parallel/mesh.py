@@ -11,6 +11,8 @@ axis later without changing the runtime contract.
 
 from __future__ import annotations
 
+import logging
+
 from typing import Optional, Sequence
 
 import jax
@@ -73,12 +75,19 @@ def init_distributed(coordinator_address: Optional[str] = None,
   else:
     try:
       jax.distributed.initialize()
-    except ValueError:
+    except ValueError as e:
       # no cluster coordinates detectable -> single-process world.  A
       # RuntimeError ("must be called before any JAX calls") is NOT
       # swallowed: calling too late is a real bug that would otherwise
       # silently degrade a pod job to N independent single-host worlds.
-      pass
+      # The swallowed error is still logged: a MALFORMED pod env also
+      # raises ValueError, and silence there would mask the same
+      # degraded-to-N-worlds failure (ADVICE.md round 2).
+      logging.getLogger(__name__).warning(
+          'jax.distributed.initialize() found no usable cluster '
+          'environment (%s); continuing as a single-process world. '
+          'If this job was launched as a multi-host pod, pass '
+          'coordinator_address/num_processes/process_id explicitly.', e)
   return jax.process_index()
 
 

@@ -654,8 +654,8 @@ def make_hybrid_train_step(dist: DistributedEmbedding,
   def run(state, cats, batch):
     # densify RaggedBatch inputs HERE, outside the jit boundary, where
     # the true max row length is readable — inside jit the lengths are
-    # tracers and the average-cap fallback can silently truncate skewed
-    # rows (see DistributedEmbedding._ragged_cap)
+    # tracers and a batch without a static hot_cap raises (see
+    # DistributedEmbedding._ragged_cap)
     cats = [
         x.to_padded_dense(dist._ragged_cap(x))
         if isinstance(x, RaggedBatch) else x for x in cats
@@ -667,7 +667,8 @@ def make_hybrid_train_step(dist: DistributedEmbedding,
 
 def calibrate_capacity_rows(dist: DistributedEmbedding, cats,
                             margin: float = 1.3,
-                            params=None) -> Tuple[int, ...]:
+                            params=None,
+                            prefer_cpu: bool = True) -> Tuple[int, ...]:
   """Measure per-group unique-update-row counts on a sample batch and
   return calibrated ``capacity_rows`` for the sparse optimizers.
 
@@ -681,8 +682,13 @@ def calibrate_capacity_rows(dist: DistributedEmbedding, cats,
   representative; if a later batch still overflows, the ``lax.cond``
   correction wave applies the dropped segments (slower, never wrong).
 
-  Runs the forward eagerly on whatever backend is active (CPU works and
-  avoids burning TPU compile time on a throwaway program).
+  With ``prefer_cpu`` (the default) and a non-CPU mesh, the measurement
+  forward runs on a CPU *mirror* of the plan (same table configs, same
+  deterministic plan, zero-valued params — the id routing doesn't depend
+  on parameter values): compiling a throwaway eager forward on a
+  tunnelled TPU costs 50-100 s (docs/perf_notes.md), on CPU seconds
+  (ADVICE.md round 2).  Falls back to the active backend when fewer CPU
+  devices than ``world_size`` exist.
 
   The apply runs per device under ``shard_map`` with ONE static capacity
   per group, so the calibration takes the MAX unique count across the
@@ -696,12 +702,60 @@ def calibrate_capacity_rows(dist: DistributedEmbedding, cats,
     params: optional embedding params to reuse (skips a throwaway
       ``dist.init`` — the id streams don't depend on parameter values,
       but the forward needs arrays of the right shape).
+    prefer_cpu: run the measurement on a CPU plan mirror when the mesh
+      is not CPU (see above).
 
   Returns:
     One capacity (int rows) per fusion group, ordered by group index —
     pass as ``SparseAdagrad(capacity_rows=...)`` etc.
   """
   import numpy as np
+  if (prefer_cpu
+      and dist.mesh.devices.ravel()[0].platform != 'cpu'):
+    try:
+      cpus = jax.devices('cpu')
+    except RuntimeError:
+      # platform-restricted process (e.g. JAX_PLATFORMS=tpu): no CPU
+      # backend to mirror onto — measure on the active backend
+      cpus = []
+    if len(cpus) < dist.world_size:
+      import logging
+      logging.getLogger(__name__).warning(
+          'calibrate_capacity_rows: %d CPU device(s) < world_size %d, '
+          'measuring on the %s backend instead (expect a throwaway '
+          'compile).  Set XLA_FLAGS=--xla_force_host_platform_device_'
+          'count=%d before JAX initialises to calibrate on CPU.',
+          len(cpus), dist.world_size,
+          dist.mesh.devices.ravel()[0].platform, dist.world_size)
+    else:
+      from distributed_embeddings_tpu.parallel.mesh import create_mesh
+      mirror = DistributedEmbedding(
+          dist.table_configs,
+          strategy=dist.plan.strategy,
+          column_slice_threshold=dist.plan.column_slice_threshold,
+          row_slice=dist.plan.row_slice_threshold,
+          dp_input=dist.dp_input,
+          input_table_map=dist.plan.input_table_map,
+          mesh=create_mesh(cpus[:dist.world_size],
+                           axis_name=dist.axis_name),
+          axis_name=dist.axis_name,
+          param_dtype=dist.param_dtype,
+          compute_dtype=dist.compute_dtype)
+      zeros = {
+          f'group_{gi}': np.zeros((dist.world_size, g.rows_cap, g.width),
+                                  dist.param_dtype)
+          for gi, g in enumerate(mirror.plan.groups)
+      }
+
+      def to_host(x):
+        if isinstance(x, RaggedBatch):
+          return RaggedBatch(np.asarray(x.values), np.asarray(x.row_splits),
+                             hot_cap=x.hot_cap)
+        return np.asarray(x)
+
+      return calibrate_capacity_rows(mirror, [to_host(x) for x in cats],
+                                     margin=margin, params=zeros,
+                                     prefer_cpu=False)
   if params is None:
     params = dist.init(0)
   _, residuals, (_, hotness) = dist.forward_with_residuals(params, cats)

@@ -539,6 +539,21 @@ class TestRaggedDistributed:
     np.testing.assert_allclose(np.asarray(out[0]), want, rtol=1e-5,
                                atol=1e-5)
 
+  def test_traced_ragged_without_hot_cap_raises(self):
+    # VERDICT r2 item 5: a hand-built RaggedBatch (no hot_cap) reaching a
+    # user-jitted apply must raise loudly instead of silently truncating
+    # skewed rows via the old average-capacity heuristic
+    from distributed_embeddings_tpu.ops.ragged import RaggedBatch
+    mesh = create_mesh(jax.devices()[:4])
+    dist = DistributedEmbedding([TableConfig(30, 8, 'sum')], mesh=mesh)
+    params = dist.init(0)
+    rb = RaggedBatch(
+        values=jnp.arange(8, dtype=jnp.int32) % 30,
+        row_splits=jnp.asarray([0, 1, 2, 3, 4, 5, 6, 7, 8], jnp.int32))
+    assert rb.hot_cap is None
+    with pytest.raises(ValueError, match='hot_cap'):
+      jax.jit(lambda p, r: dist.apply(p, [r]))(params, rb)
+
   def test_skewed_ragged_through_jitted_hybrid_step(self):
     # the jitted train step densifies RaggedBatch inputs OUTSIDE the jit
     # boundary, where the true max row length is readable — a skewed

@@ -369,3 +369,21 @@ def test_checkpoint_reshard_row_to_column():
     for k in a:
       np.testing.assert_array_equal(a[k], b[k])
   del saved_s
+
+
+def test_row_slice_output_traffic_shard_count_independent():
+  # VERDICT r2 item 4: a row-sliced input leaves mp space through ONE
+  # psum_scatter (its shard partials summed in the collective), not
+  # through K all_to_all slots summed at assembly — the output buffer
+  # volume is shard-count-independent
+  mesh = create_mesh(jax.devices()[:4])
+  configs = [TableConfig(1000, 8, 'sum'), TableConfig(64, 8, 'sum')]
+  dist = DistributedEmbedding(configs, mesh=mesh, row_slice=4000)
+  assert dist.plan.row_sliced[0] and not dist.plan.row_sliced[1]
+  assert len(dist.plan.table_shards[0]) > 1
+  subs = dist._subgroups((1, 1))
+  merged = sorted(inp for s in subs for inp in s.merge_inputs)
+  assert merged == [0]
+  # the unsliced input keeps its single a2a slot; the row-sliced input
+  # adds NO a2a slots (its k shards would have been k slots before)
+  assert sum(s.out_n_cap for s in subs) == 1
