@@ -120,6 +120,20 @@ class DistributedEmbedding:
     self.axis_name = axis_name
     if axis_name not in self.mesh.shape:
       raise ValueError(f'mesh has no axis {axis_name!r}')
+    extra = [a for a in self.mesh.axis_names if a != axis_name]
+    if len(extra) > 1:
+      raise ValueError(
+          f'mesh may have at most one extra (DCN/slice) axis besides '
+          f'{axis_name!r}, got axes {self.mesh.axis_names}')
+    # Two-axis (ICI x DCN) topology: tables shard over the inner
+    # ``axis_name`` (all_to_all/psum_scatter ride ICI) and REPLICATE over
+    # the outer slice axis; the batch data-parallelises over the product.
+    # Cross-slice traffic is only the per-step update-stream gather
+    # (sparse path, parallel/sparse.py) / dense-grad psum (autodiff).
+    self.dcn_axis = extra[0] if extra else None
+    self.num_slices = self.mesh.shape[self.dcn_axis] if self.dcn_axis else 1
+    self._batch_axes = ((self.dcn_axis, axis_name) if self.dcn_axis
+                        else (axis_name,))
     self.world_size = self.mesh.shape[axis_name]
     self.dp_input = dp_input
     self.param_dtype = jnp.dtype(param_dtype)
@@ -305,10 +319,10 @@ class DistributedEmbedding:
       if any(x.shape[0] != batch for x in inputs):
         raise ValueError('All input need to have same batchsize. got ' +
                          str({x.shape[0] for x in inputs}))
-      if batch % self.world_size:
+      if batch % (self.world_size * self.num_slices):
         raise ValueError(
             f'Global batchsize {batch} not divisible workers count '
-            f'{self.world_size}.')
+            f'{self.world_size * self.num_slices}.')
       hotness = self._input_hotness(inputs)
       self._check_combiner_hotness(hotness)
       return inputs, batch, tuple(hotness)
@@ -323,10 +337,10 @@ class DistributedEmbedding:
     if any(x.shape[0] != batch for x in inputs):
       raise ValueError('All input need to have same batchsize. got ' +
                        str({x.shape[0] for x in inputs}))
-    if batch % self.world_size:
+    if batch % (self.world_size * self.num_slices):
       raise ValueError(
           f'Global batchsize {batch} not divisible workers count '
-          f'{self.world_size}.')
+          f'{self.world_size * self.num_slices}.')
     hot_by_input = {}
     for wid, inp in zip(flat_ids, inputs):
       h = 1 if inp.ndim == 1 else inp.shape[1]
@@ -541,7 +555,10 @@ class DistributedEmbedding:
     if key in self._fn_cache:
       return self._fn_cache[key]
     D = self.world_size
-    local_batch = global_batch // D
+    # each slice serves its own contiguous [slice_batch] sub-batch with
+    # its table replica; all collectives below stay intra-slice (ICI)
+    slice_batch = global_batch // self.num_slices
+    local_batch = slice_batch // D
     subs = self._subgroups(hotness)
 
     def local_fn(params, *inputs):
@@ -571,9 +588,9 @@ class DistributedEmbedding:
         # dist_model_parallel.py:404) -------------------------------------
         recv = (jax.lax.all_to_all(send, self.axis_name, 0, 0)
                 if D > 1 else send)
-        # [n_cap, D*B, h]: global batch in source-major order (the
+        # [n_cap, D*B, h]: the slice's batch in source-major order (the
         # reference's [world_size * local] reshape, :405-410)
-        ids = recv.transpose(1, 0, 2, 3).reshape(sub.n_cap, global_batch, h)
+        ids = recv.transpose(1, 0, 2, 3).reshape(sub.n_cap, slice_batch, h)
         rows_cap = self.plan.groups[sub.gi].rows_cap
         routed = _route_ids(ids, jnp.asarray(sub.offsets)[me],
                             jnp.asarray(sub.vocab)[me], rows_cap,
@@ -597,16 +614,17 @@ class DistributedEmbedding:
         return outs + tuple(residuals)
       return outs
 
+    bax = self._batch_axes
     in_specs = (
         {f'group_{gi}': P(self.axis_name, None, None)
          for gi in range(len(self.plan.groups))},
-    ) + tuple(
-        P(self.axis_name) if h == 1 else P(self.axis_name, None)
-        for h in hotness)
-    out_specs = tuple(P(self.axis_name, None) for _ in range(self.num_inputs))
+    ) + tuple(P(bax) if h == 1 else P(bax, None) for h in hotness)
+    out_specs = tuple(P(bax, None) for _ in range(self.num_inputs))
     if with_residuals:
+      # residuals [D, n_cap, GB, h]: dim 0 is the table shard (inner
+      # axis), dim 2 the batch, slice-partitioned over the outer axis
       out_specs = out_specs + tuple(
-          P(self.axis_name, None, None, None) for _ in subs)
+          P(self.axis_name, None, self.dcn_axis, None) for _ in subs)
     fn = jax.jit(
         jax.shard_map(local_fn,
                       mesh=self.mesh,
@@ -625,7 +643,8 @@ class DistributedEmbedding:
     if key in self._fn_cache:
       return self._fn_cache[key]
     D = self.world_size
-    local_batch = global_batch // D
+    slice_batch = global_batch // self.num_slices
+    local_batch = slice_batch // D
     subs = self._subgroups(hotness)
     # worker-order position of (device, input_id)
     pos_of = {}
@@ -651,7 +670,9 @@ class DistributedEmbedding:
       stacked = jnp.stack(slots).reshape(D, sub.n_cap, global_batch,
                                          sub.hotness)
       return jax.lax.with_sharding_constraint(
-          stacked, NamedSharding(self.mesh, P(self.axis_name)))
+          stacked,
+          NamedSharding(self.mesh,
+                        P(self.axis_name, None, self.dcn_axis)))
 
     def local_fn(params, *canonicals):
       me = jax.lax.axis_index(self.axis_name)
@@ -678,17 +699,19 @@ class DistributedEmbedding:
         return outs + tuple(residuals)
       return outs
 
-    out_specs = tuple(P(self.axis_name, None) for _ in range(self.num_inputs))
+    out_specs = tuple(
+        P(self._batch_axes, None) for _ in range(self.num_inputs))
     if with_residuals:
       out_specs = out_specs + tuple(
-          P(self.axis_name, None, None, None) for _ in subs)
+          P(self.axis_name, None, self.dcn_axis, None) for _ in subs)
     sharded = jax.shard_map(
         local_fn,
         mesh=self.mesh,
         in_specs=(
             {f'group_{gi}': P(self.axis_name, None, None)
              for gi in range(len(self.plan.groups))},
-        ) + tuple(P(self.axis_name, None, None, None) for _ in subs),
+        ) + tuple(
+            P(self.axis_name, None, self.dcn_axis, None) for _ in subs),
         out_specs=out_specs,
         check_vma=False)
 
@@ -757,7 +780,8 @@ class DistributedEmbedding:
     if key in self._fn_cache:
       return self._fn_cache[key]
     D = self.world_size
-    local_batch = global_batch // D
+    slice_batch = global_batch // self.num_slices
+    local_batch = slice_batch // D
     subs = self._subgroups(hotness)
 
     def local_fn(*d_outs):
@@ -784,7 +808,7 @@ class DistributedEmbedding:
           if D > 1:
             drecv = jax.lax.all_to_all(drecv, self.axis_name, 0, 0)
           return drecv.transpose(1, 0, 2, 3).reshape(
-              n_slots, global_batch, w)
+              n_slots, slice_batch, w)
 
         if not sub.merge_inputs:
           gsubs.append(a2a_cotangent(sub.n_cap, None)[None])
@@ -803,7 +827,7 @@ class DistributedEmbedding:
           g_full = (jax.lax.all_gather(dloc, self.axis_name, axis=0,
                                        tiled=True) if D > 1 else dloc)
           parts.append(g_full[None].astype(dt))
-        parts.append(jnp.zeros((1, global_batch, w), dt))
+        parts.append(jnp.zeros((1, slice_batch, w), dt))
         cat = jnp.concatenate(parts, axis=0)
         zero_row = sub.out_n_cap + M
         recon = np.full((D, sub.n_cap), zero_row, np.int32)
@@ -824,9 +848,10 @@ class DistributedEmbedding:
             local_fn,
             mesh=self.mesh,
             in_specs=tuple(
-                P(self.axis_name, None) for _ in range(self.num_inputs)),
+                P(self._batch_axes, None) for _ in range(self.num_inputs)),
             out_specs=tuple(
-                P(self.axis_name, None, None, None) for _ in subs),
+                P(self.axis_name, None, self.dcn_axis, None)
+                for _ in subs),
             check_vma=False))
     self._fn_cache[key] = fn
     return fn

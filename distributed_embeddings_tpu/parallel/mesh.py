@@ -5,8 +5,10 @@ The reference gets its world from `hvd.init()/size()/rank()`
 `jax.sharding.Mesh` with a single ``'data'`` axis used both for
 data-parallel batch sharding and model-parallel table placement (the
 reference likewise equates DP ranks and MP ranks,
-dist_model_parallel.py:348-349).  Multi-slice (DCN) extensions add an outer
-axis later without changing the runtime contract.
+dist_model_parallel.py:348-349) — or, for multi-slice topologies, a
+two-axis ``('dcn', 'data')`` mesh (``create_mesh((slices, chips))``)
+where tables shard over the inner ICI axis, replicate across slices,
+and the batch data-parallelises over the product.
 """
 
 from __future__ import annotations
@@ -20,20 +22,47 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DEFAULT_AXIS = 'data'
+DCN_AXIS = 'dcn'
 
 
 def create_mesh(devices: Optional[Sequence] = None,
-                axis_name: str = DEFAULT_AXIS) -> Mesh:
-  """One-axis mesh over all (or the given) devices."""
+                axis_name: str = DEFAULT_AXIS,
+                dcn_axis: str = DCN_AXIS) -> Mesh:
+  """One-axis mesh over all (or the given) devices — or, given a 2-tuple
+  shape like ``create_mesh((2, 4))``, a two-axis ``(dcn, data)`` mesh for
+  multi-slice topologies: the OUTER axis spans slices (traffic crosses
+  DCN), the INNER axis spans a slice's chips (traffic rides ICI).  The
+  runtime places tables on the inner axis — every all_to_all/psum_scatter
+  stays intra-slice — replicates them across the outer axis, and
+  data-parallelises the batch over the product (the cross-slice exchange
+  is the once-per-step update-stream gather, see parallel/sparse.py).
+  Device order follows ``jax.devices()``, which enumerates slice-major on
+  multi-slice TPU deployments; pass an explicit ``[S, D]`` device array
+  to override.
+  """
   if devices is None:
     devices = jax.devices()
-  return Mesh(np.asarray(devices), (axis_name,))
+  if (isinstance(devices, (tuple, list)) and len(devices) == 2
+      and all(isinstance(d, (int, np.integer)) for d in devices)):
+    n = int(devices[0]) * int(devices[1])
+    avail = jax.devices()
+    if len(avail) < n:
+      raise ValueError(
+          f'create_mesh({devices}) needs {n} devices, have {len(avail)}')
+    devices = np.asarray(avail[:n]).reshape(tuple(devices))
+  devices = np.asarray(devices)
+  if devices.ndim == 2:
+    return Mesh(devices, (dcn_axis, axis_name))
+  return Mesh(devices, (axis_name,))
 
 
 def batch_sharding(mesh: Mesh, axis_name: str = DEFAULT_AXIS,
                    ndim: int = 2) -> NamedSharding:
-  """Sharding for activations/inputs: batch dim split over the mesh axis."""
-  return NamedSharding(mesh, P(axis_name, *([None] * (ndim - 1))))
+  """Sharding for activations/inputs: batch dim split over the mesh axis
+  (over the slice x data product on a two-axis mesh)."""
+  extra = tuple(a for a in mesh.axis_names if a != axis_name)
+  batch_axes = extra + (axis_name,) if extra else axis_name
+  return NamedSharding(mesh, P(batch_axes, *([None] * (ndim - 1))))
 
 
 def table_sharding(mesh: Mesh, axis_name: str = DEFAULT_AXIS,
@@ -111,7 +140,9 @@ def make_global_batch(mesh: Mesh, *arrays):
   """
   outs = []
   for a in arrays:
-    sharding = batch_sharding(mesh, mesh.axis_names[0], np.ndim(a))
+    # the data axis is the innermost mesh axis (a 2-axis mesh is
+    # (dcn, data)); batch_sharding splits over the full product
+    sharding = batch_sharding(mesh, mesh.axis_names[-1], np.ndim(a))
     if jax.process_count() == 1:
       outs.append(jax.device_put(a, sharding))
     else:

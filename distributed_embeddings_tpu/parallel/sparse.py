@@ -379,10 +379,31 @@ def _lane_pack(uids, sum_g, sum_sq, pack: int, rows_cap: int):
   return pids_c, g_packed, sq_packed
 
 
+def _capacity(optimizer, n: int, rows_cap: int,
+              cap_rows: Optional[int]) -> int:
+  """Static compaction capacity for an ``n``-row update stream: the
+  calibrated per-group row count (``calibrate_capacity_rows``) when
+  given — the overflow correction wave keeps under-estimates correct —
+  else ``capacity_fraction`` of the stream; always bounded by the fused
+  table's own row count."""
+  cap_safe = min(n, rows_cap + 2)
+  if cap_rows is not None:
+    return min(cap_safe, max(8, -(-int(cap_rows) // 8) * 8))
+  frac = getattr(optimizer, 'capacity_fraction', 0.5)
+  return min(cap_safe, max(8, -(-int(n * frac) // 8) * 8))
+
+
 def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
-                     rows_cap: int, cap_rows: Optional[int] = None):
+                     rows_cap: int, cap_rows: Optional[int] = None,
+                     flat_sq=None):
   """Compact duplicate update rows, then run the optimizer on the unique
   rows only.
+
+  ``flat_sq``: optional pre-accumulated per-occurrence squared-gradient
+  rows aligned with ``flat_g`` (the cross-slice gather pre-compacts per
+  slice; squares of per-slice SUMS would be wrong, so the squares travel
+  as their own additive channel).  When absent, squares are computed
+  from the raw stream as usual.
 
   Scatter cost is linear in the STATIC update-row count (~110-140 ns/row
   on v5e whether or not rows are dropped — docs/perf_notes.md), so the
@@ -414,13 +435,7 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
   n = flat_ids.shape[0]
   sentinel = rows_cap
   cap_safe = min(n, rows_cap + 2)  # uniques <= rows_cap + sentinel segment
-  if cap_rows is not None:
-    # calibrated per-group capacity (calibrate_capacity_rows); the
-    # overflow correction wave below keeps under-estimates correct
-    cap = min(cap_safe, max(8, -(-int(cap_rows) // 8) * 8))
-  else:
-    frac = getattr(optimizer, 'capacity_fraction', 0.5)
-    cap = min(cap_safe, max(8, -(-int(n * frac) // 8) * 8))
+  cap = _capacity(optimizer, n, rows_cap, cap_rows)
   with_sq = bool(getattr(optimizer, 'needs_sq', True))
   w = flat_g.shape[1]
   pack = 128 // w if (w < 128 and 128 % w == 0) else 1
@@ -429,8 +444,18 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
               and rows_cap // pack + 2 < cap)
 
   order = jnp.argsort(flat_ids) if cap < cap_safe else None
-  uids, sum_g, sum_sq, num_unique = compact_segments(
-      flat_ids, flat_g, cap, sentinel, with_sq=with_sq, order=order)
+  if with_sq and flat_sq is not None:
+    # squares arrive pre-accumulated: segment-sum them as an extra
+    # payload column block instead of squaring the (pre-summed) grads
+    payload = jnp.concatenate(
+        [flat_g.astype(jnp.float32),
+         flat_sq.astype(jnp.float32)], axis=1)
+    uids, tot, _, num_unique = compact_segments(
+        flat_ids, payload, cap, sentinel, order=order)
+    sum_g, sum_sq = tot[:, :w], tot[:, w:]
+  else:
+    uids, sum_g, sum_sq, num_unique = compact_segments(
+        flat_ids, flat_g, cap, sentinel, with_sq=with_sq, order=order)
   if packable:
     pids, g_p, sq_p = _lane_pack(uids, sum_g, sum_sq, pack, rows_cap)
     ptable = table.reshape(rows_cap // pack, pack * w)
@@ -461,8 +486,12 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
     valid3 = key2[order3] < n
     uids2 = jnp.where(valid3, sid[order3], sentinel)
     tot_g = jnp.where(valid3[:, None], seg_total(sg)[order3], 0.0)
-    tot_sq = (jnp.where(valid3[:, None], seg_total(sg * sg)[order3], 0.0)
-              if with_sq else None)
+    if with_sq:
+      sq_src = (flat_sq[order].astype(jnp.float32)
+                if flat_sq is not None else sg * sg)
+      tot_sq = jnp.where(valid3[:, None], seg_total(sq_src)[order3], 0.0)
+    else:
+      tot_sq = None
     return optimizer.apply_unique(t3, s3, uids2, tot_g, tot_sq, lr)
 
   return jax.lax.cond(num_unique > cap, correction, lambda args: args,
@@ -521,9 +550,36 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
       caps = getattr(optimizer, 'capacity_rows', None)
       if caps is not None and gi < len(caps):
         cap_rows = caps[gi]
+      flat_sq = None
+      if dist.num_slices > 1:
+        # Cross-slice update exchange — the DP-gradient step for the
+        # slice-REPLICATED table shards (each slice computed updates
+        # from its own sub-batch; every replica must apply them all,
+        # identically).  Streams pre-compact to unique rows per slice,
+        # bounding the DCN gather to the fused table's row count
+        # instead of the raw batch*hotness stream; per-occurrence-
+        # squares optimizers (needs_sq) ship the squares as their own
+        # additive channel (squares of pre-summed rows would be wrong).
+        # After the gather every slice holds the identical combined
+        # stream, so the applies (and replicas) stay in sync.
+        # Pre-compaction capacity must be the GUARANTEED bound
+        # (uniques + sentinel <= rows_cap + 2): a fraction/calibrated
+        # cap could silently drop segments here, where no correction
+        # wave runs (the wave guards only the post-gather apply).
+        needs_sq = bool(getattr(optimizer, 'needs_sq', True))
+        pcap = min(flat_ids.shape[0], rows_cap + 2)
+        uids_s, sum_g_s, sum_sq_s, _ = compact_segments(
+            flat_ids, flat_g, pcap, rows_cap, with_sq=needs_sq)
+        flat_ids = jax.lax.all_gather(uids_s, dist.dcn_axis, axis=0,
+                                      tiled=True)
+        flat_g = jax.lax.all_gather(sum_g_s, dist.dcn_axis, axis=0,
+                                    tiled=True)
+        if needs_sq:
+          flat_sq = jax.lax.all_gather(sum_sq_s, dist.dcn_axis, axis=0,
+                                       tiled=True)
       table, state2 = _dedup_and_apply(optimizer, params[key][0], state_g,
                                        flat_ids, flat_g, lr, rows_cap,
-                                       cap_rows=cap_rows)
+                                       cap_rows=cap_rows, flat_sq=flat_sq)
       new_params[key] = table[None]
       new_state[key] = {k: v[None] for k, v in state2.items()}
       fence = table[0, 0]
@@ -533,14 +589,16 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
   param_specs = {f'group_{gi}': P(ax, None, None) for gi in range(n_groups)}
 
   def apply(params, opt_state, lr, *res_and_g):
-    # every optimizer-state leaf is [D, ...] sharded on axis 0
+    # every optimizer-state leaf is [D, ...] sharded on axis 0 (and,
+    # on a two-axis mesh, replicated over the slice axis)
     state_spec = jax.tree.map(
         lambda x: P(ax, *([None] * (x.ndim - 1))), opt_state)
     fn = jax.shard_map(
         local_fn,
         mesh=dist.mesh,
         in_specs=(param_specs, state_spec, P()) + tuple(
-            P(ax, None, None, None) for _ in range(2 * len(subs))),
+            P(ax, None, dist.dcn_axis, None)
+            for _ in range(2 * len(subs))),
         out_specs=(param_specs, state_spec),
         check_vma=False)
     return fn(params, opt_state, lr, *res_and_g)
