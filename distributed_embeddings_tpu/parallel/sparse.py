@@ -379,6 +379,13 @@ def _lane_pack(uids, sum_g, sum_sq, pack: int, rows_cap: int):
   return pids_c, g_packed, sq_packed
 
 
+def _guaranteed_cap(n: int, rows_cap: int) -> int:
+  """The capacity that can NEVER drop a segment: unique fused rows plus
+  the one sentinel segment are at most ``rows_cap + 2`` (``_route_ids``
+  maps all padding to the single sentinel value ``rows_cap``)."""
+  return min(n, rows_cap + 2)
+
+
 def _capacity(optimizer, n: int, rows_cap: int,
               cap_rows: Optional[int]) -> int:
   """Static compaction capacity for an ``n``-row update stream: the
@@ -386,7 +393,7 @@ def _capacity(optimizer, n: int, rows_cap: int,
   given — the overflow correction wave keeps under-estimates correct —
   else ``capacity_fraction`` of the stream; always bounded by the fused
   table's own row count."""
-  cap_safe = min(n, rows_cap + 2)
+  cap_safe = _guaranteed_cap(n, rows_cap)
   if cap_rows is not None:
     return min(cap_safe, max(8, -(-int(cap_rows) // 8) * 8))
   frac = getattr(optimizer, 'capacity_fraction', 0.5)
@@ -434,7 +441,7 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
   """
   n = flat_ids.shape[0]
   sentinel = rows_cap
-  cap_safe = min(n, rows_cap + 2)  # uniques <= rows_cap + sentinel segment
+  cap_safe = _guaranteed_cap(n, rows_cap)
   cap = _capacity(optimizer, n, rows_cap, cap_rows)
   with_sq = bool(getattr(optimizer, 'needs_sq', True))
   w = flat_g.shape[1]
@@ -567,16 +574,23 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
         # cap could silently drop segments here, where no correction
         # wave runs (the wave guards only the post-gather apply).
         needs_sq = bool(getattr(optimizer, 'needs_sq', True))
-        pcap = min(flat_ids.shape[0], rows_cap + 2)
+        pcap = _guaranteed_cap(flat_ids.shape[0], rows_cap)
         uids_s, sum_g_s, sum_sq_s, _ = compact_segments(
             flat_ids, flat_g, pcap, rows_cap, with_sq=needs_sq)
-        flat_ids = jax.lax.all_gather(uids_s, dist.dcn_axis, axis=0,
-                                      tiled=True)
-        flat_g = jax.lax.all_gather(sum_g_s, dist.dcn_axis, axis=0,
-                                    tiled=True)
+        # ONE DCN collective per group: ids ride as a bitcast f32
+        # column alongside the grad (and square) payload
+        packed = [
+            jax.lax.bitcast_convert_type(uids_s, jnp.float32)[:, None],
+            sum_g_s
+        ]
         if needs_sq:
-          flat_sq = jax.lax.all_gather(sum_sq_s, dist.dcn_axis, axis=0,
-                                       tiled=True)
+          packed.append(sum_sq_s)
+        gathered = jax.lax.all_gather(jnp.concatenate(packed, axis=1),
+                                      dist.dcn_axis, axis=0, tiled=True)
+        flat_ids = jax.lax.bitcast_convert_type(gathered[:, 0], jnp.int32)
+        flat_g = gathered[:, 1:1 + w]
+        if needs_sq:
+          flat_sq = gathered[:, 1 + w:]
       table, state2 = _dedup_and_apply(optimizer, params[key][0], state_g,
                                        flat_ids, flat_g, lr, rows_cap,
                                        cap_rows=cap_rows, flat_sq=flat_sq)

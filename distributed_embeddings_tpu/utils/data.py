@@ -327,7 +327,12 @@ class BinaryCriteoReader:
         stream.close()
 
   def __del__(self):
-    self.close()
+    try:
+      self.close()
+    except Exception:
+      # interpreter teardown: module globals (threading, os) may already
+      # be torn down; fds are reclaimed by the OS anyway
+      pass
 
 
 def write_raw_binary_dataset(data_path: str, split: str,

@@ -1,0 +1,164 @@
+"""Long-horizon convergence + AUC evidence (VERDICT r2 item 6).
+
+The reference's end target is DLRM training to AUC parity
+(`/root/reference/examples/dlrm/README.md:7`, 0.80248 on Criteo-1TB);
+one-step equivalence tests cannot show that the sparse optimizer path
+actually TRAINS.  This test closes that gap at CI scale: a synthetic
+Criteo-format split with a learnable rule is written with
+``write_raw_binary_dataset``, read back through ``BinaryCriteoReader``
+(the real data path end-to-end), and a small DLRM is trained for
+512 steps (two epochs) with BOTH trainers from the same init:
+
+- the sparse O(nnz) hybrid step (the production path), and
+- the dense autodiff + optax step (the reference-parity path).
+
+Asserted: loss descends for both; the two trainers end at near-identical
+embedding weights (SGD's sparse update is exact, so only float
+accumulation may separate them); eval AUC clears the rule's learnable
+bar and matches between trainers.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from distributed_embeddings_tpu.models.dlrm import DLRM, bce_with_logits
+from distributed_embeddings_tpu.parallel import (SparseSGD, create_mesh,
+                                                 get_weights,
+                                                 init_hybrid_train_state,
+                                                 init_train_state,
+                                                 make_hybrid_train_step,
+                                                 make_train_step)
+from distributed_embeddings_tpu.utils.data import (BinaryCriteoReader,
+                                                   write_raw_binary_dataset)
+from distributed_embeddings_tpu.utils.metrics import StreamingAUC, exact_auc
+
+TABLE_SIZES = [64, 128, 32, 100]
+NUM_F = 4
+BATCH = 64
+STEPS = 512  # 2 epochs over the 16384-row train split
+LR = 0.3
+
+
+def _make_split(rng, n):
+  """Learnable rule: logit from two categorical parities + one numerical."""
+  cats = [rng.integers(0, s, n).astype(np.int64) for s in TABLE_SIZES]
+  numerical = rng.normal(size=(n, NUM_F)).astype(np.float16)
+  logit = (1.5 * (cats[0] % 2) + 1.0 * (cats[1] % 3 == 0) - 1.2 +
+           0.8 * numerical[:, 0].astype(np.float32))
+  p = 1.0 / (1.0 + np.exp(-logit))
+  labels = (rng.random(n) < p).astype(np.bool_)
+  return labels, numerical, cats
+
+
+@pytest.fixture(scope='module')
+def dataset(tmp_path_factory):
+  root = tmp_path_factory.mktemp('criteo_synth')
+  rng = np.random.default_rng(17)
+  write_raw_binary_dataset(str(root), 'train', *_make_split(rng, 16384),
+                           TABLE_SIZES)
+  write_raw_binary_dataset(str(root), 'test', *_make_split(rng, 1024),
+                           TABLE_SIZES)
+  return str(root)
+
+
+def _reader(path, valid=False):
+  return BinaryCriteoReader(path, batch_size=BATCH,
+                            numerical_features=NUM_F,
+                            categorical_features=list(
+                                range(len(TABLE_SIZES))),
+                            categorical_feature_sizes=TABLE_SIZES,
+                            prefetch_depth=2, drop_last_batch=True,
+                            valid=valid)
+
+
+def _model(mesh):
+  return DLRM(table_sizes=TABLE_SIZES, embedding_dim=8,
+              bottom_mlp_dims=[16, 8], top_mlp_dims=[16, 1],
+              num_numerical_features=NUM_F, mesh=mesh)
+
+
+def _eval_auc(model, params, path):
+  ds = _reader(path, valid=True)
+  auc = StreamingAUC()
+  all_l, all_p = [], []
+  for i in range(len(ds)):
+    num, cats, labels = ds[i]
+    logits = model.apply(params, jnp.asarray(num),
+                         [jnp.asarray(c) for c in cats])
+    preds = np.asarray(jax.nn.sigmoid(logits))[:, 0]
+    auc.update(labels[:, 0], preds)
+    all_l.append(labels[:, 0])
+    all_p.append(preds)
+  streaming = auc.result()
+  exact = exact_auc(np.concatenate(all_l), np.concatenate(all_p))
+  assert abs(streaming - exact) < 5e-3, (streaming, exact)
+  return exact
+
+
+def test_sparse_and_dense_trainers_converge_to_same_auc(dataset):
+  mesh = create_mesh(jax.devices()[:8])
+  model = _model(mesh)
+  params0 = model.init(0)
+  ds = _reader(dataset)
+  n_batches = len(ds)
+
+  # --- sparse O(nnz) hybrid trainer (production path) -------------------
+  def head_loss_fn(dense_params, emb_outs, hbatch):
+    numerical, labels = hbatch
+    return bce_with_logits(model.head(dense_params, numerical, emb_outs),
+                           labels)
+
+  emb_opt = SparseSGD(learning_rate=LR)
+  sstate = init_hybrid_train_state(model.dist_embedding,
+                                   jax.tree.map(jnp.copy, params0),
+                                   optax.sgd(LR), emb_opt)
+  sstep = make_hybrid_train_step(model.dist_embedding, head_loss_fn,
+                                 optax.sgd(LR), emb_opt, donate=False)
+  sparse_losses = []
+  for step in range(STEPS):
+    num, cats, labels = ds[step % n_batches]
+    sstate, loss = sstep(sstate, [jnp.asarray(c) for c in cats],
+                         (jnp.asarray(num), jnp.asarray(labels)))
+    sparse_losses.append(float(loss))
+
+  # --- dense autodiff trainer (reference-parity path) -------------------
+  def loss_fn(p, batch_data):
+    numerical, cats, labels = batch_data
+    return bce_with_logits(model.apply(p, numerical, list(cats)), labels)
+
+  dstep = make_train_step(loss_fn, optax.sgd(LR), donate=False)
+  dstate = init_train_state(jax.tree.map(jnp.copy, params0), optax.sgd(LR))
+  dense_losses = []
+  for step in range(STEPS):
+    num, cats, labels = ds[step % n_batches]
+    dstate, loss = dstep(dstate, (jnp.asarray(num),
+                                  tuple(jnp.asarray(c) for c in cats),
+                                  jnp.asarray(labels)))
+    dense_losses.append(float(loss))
+
+  # --- loss descent over the horizon ------------------------------------
+  for name, losses in (('sparse', sparse_losses), ('dense', dense_losses)):
+    head = float(np.mean(losses[:16]))
+    tail = float(np.mean(losses[-16:]))
+    assert tail < head * 0.85, (name, head, tail)
+    assert np.isfinite(losses).all(), name
+
+  # --- the two trainers agree (SGD sparse update is exact per step) -----
+  sw = get_weights(model.dist_embedding, sstate.params['embedding'])
+  dw = get_weights(model.dist_embedding, dstate.params['embedding'])
+  for t, (a, b) in enumerate(zip(sw, dw)):
+    np.testing.assert_allclose(a, b, rtol=5e-3, atol=5e-4,
+                               err_msg=f'table {t} after {STEPS} steps')
+
+  # --- AUC parity between trainers on the held-out split ----------------
+  # the rule's Bayes AUC is ~0.776 (rank by the true sampling
+  # probability); two epochs land within ~0.04 of it
+  auc_sparse = _eval_auc(model, sstate.params, dataset)
+  auc_dense = _eval_auc(model, dstate.params, dataset)
+  assert auc_sparse > 0.74, auc_sparse
+  assert auc_dense > 0.74, auc_dense
+  assert abs(auc_sparse - auc_dense) < 0.02, (auc_sparse, auc_dense)
