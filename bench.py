@@ -115,6 +115,10 @@ def main():
   parser.add_argument('--fused_apply', action='store_true',
                       help='opt into the fused Pallas row-wise Adagrad '
                       'apply (ops/pallas_rowwise.py)')
+  parser.add_argument('--segwalk_apply', action='store_true',
+                      help='opt into the fused segment-walk apply '
+                      '(ops/pallas_segwalk.py): sorted raw stream in, '
+                      'no compaction pipeline')
   parser.add_argument('--row_slice', type=int, default=None,
                       help='element threshold for row-sharding big tables '
                       '(multi-chip; beyond the reference)')
@@ -200,7 +204,8 @@ def main():
   emb_opt = SparseAdagrad(learning_rate=0.01,
                           capacity_fraction=args.capacity_fraction,
                           capacity_rows=capacity_rows,
-                          use_pallas_apply=args.fused_apply)
+                          use_pallas_apply=args.fused_apply,
+                          use_segwalk_apply=args.segwalk_apply)
   if args.trainer == 'sparse':
     state = init_hybrid_train_state(model.dist_embedding, params, optimizer,
                                     emb_opt)
@@ -267,27 +272,39 @@ def main():
     # a shape proxy, not the Criteo-1TB vocabularies.
     metric += (f' [throughput {args.batch_size / (step_ms / 1000) / 1e6:.3f}'
                f'M samples/s; reference DLRM 8xA100 TF32: 9.158M]')
-  if args.fused_apply and args.trainer == 'sparse':
-    # per-group static eligibility for the fused Pallas apply (the
+  def eligibility_note(flag_name, is_supported):
+    # per-group static eligibility for a fused Pallas apply (the
     # runtime guard in parallel/sparse.py can still decline at trace
     # time); without this note an A/B run can silently measure the XLA
     # path and read as "kernel is no faster".  Asks the kernel's own
     # supported() on the group's row signature (single source of truth).
-    from distributed_embeddings_tpu.ops import pallas_rowwise
-    dt = jnp.dtype(args.param_dtype)
     groups = model.dist_embedding.plan.groups
-    ok = sum(
-        1 for g in groups if pallas_rowwise.supported(
+    ok = sum(1 for g in groups if is_supported(g))
+    return (f' [{flag_name}: {ok}/{len(groups)} groups eligible'
+            f'{"" if backend == "tpu" else ", inactive off-TPU"}]')
+
+  dt = jnp.dtype(args.param_dtype)
+  if args.fused_apply and args.trainer == 'sparse':
+    from distributed_embeddings_tpu.ops import pallas_rowwise
+    metric += eligibility_note(
+        'fused_apply', lambda g: pallas_rowwise.supported(
             jax.ShapeDtypeStruct((8, g.width), dt),
             jax.ShapeDtypeStruct((8, g.width), jnp.float32)))
-    metric += (f' [fused_apply: {ok}/{len(groups)} groups eligible'
-               f'{"" if backend == "tpu" else ", inactive off-TPU"}]')
+  if args.segwalk_apply and args.trainer == 'sparse':
+    from distributed_embeddings_tpu.ops import pallas_segwalk
+    metric += eligibility_note(
+        'segwalk_apply', lambda g: pallas_segwalk.supported(
+            jax.ShapeDtypeStruct((8, g.width), dt)))
   emit({
       'metric': metric,
       'value': round(step_ms, 3),
       'unit': 'ms/step',
       'vs_baseline': (round(baseline / step_ms, 4)
                       if baseline and not on_cpu else None),
+      # CPU-fallback lines use a clamped batch on different hardware:
+      # flag them unplottable instead of relying on the metric prose
+      # (VERDICT r2 weak 5)
+      'comparable': not on_cpu,
   })
 
 

@@ -1,0 +1,311 @@
+"""Pallas TPU kernel: fused segment-walk sparse optimizer apply.
+
+One streaming pass over the SORTED per-occurrence update stream that
+does segment summation AND the optimizer read-modify-write together —
+the "compaction+apply in one pass" kernel the round-2 perf notes
+designed (docs/perf_notes.md tail; VERDICT r2 item 2).  The XLA
+pipeline it replaces costs, per step on synthetic-tiny's big group
+(measured): ~300 ms of compaction (full-stream cumsums, rank sort,
+cap-sized gathers) plus the scatter passes of the apply (~100 ns per
+static row).  This kernel reads the sorted stream once at
+sequential-DMA bandwidth, reduces each id's run in VMEM with a
+segmented scan, and touches HBM randomly only at each segment's LAST
+position — one read + one write of the table (and accumulator) row per
+UNIQUE id, at the DMA-issue floor.
+
+Inputs are produced by plain XLA (`parallel/sparse.py:_segwalk_apply`):
+``argsort`` of the raw ids (~5 ns/row) and the one unavoidable gather
+of the gradient rows into sorted order — everything else the old
+pipeline did per payload disappears.  There is NO capacity/overflow
+machinery: every segment is applied exactly once, whatever the unique
+count.
+
+Semantics supported (all exact):
+- 'sgd':            ``table[uid] -= lr * seg_sum``
+- 'adagrad_dedup':  ``acc += seg_sum**2`` then scaled add (reference
+  dedup semantics, the default)
+- 'adagrad_sq':     ``acc += seg_sum_of_squares`` (per-occurrence
+  squares ride the same scan as a second payload; no extra operand)
+
+Reference analog: the CUDA backward's sort->segment-reduce feeding
+``IndexedSlices`` into the framework optimizer
+(`embedding_lookup_kernels.cu:463-635`, SURVEY.md C3) — fused here with
+the optimizer itself because TPU scatters are scalar-issued rather than
+atomic-parallel.
+
+Hazard discipline (v1, deliberately simple): each grid step issues its
+RMW reads as one async burst, waits, updates in VMEM, issues the write
+burst, and drains it before the step ends — so no writes are in flight
+across grid steps and the single staging buffer pair is trivially safe.
+Sorted unique segment-lasts mean no two steps ever touch the same row
+anyway; the cross-step write/read overlap that `ops/pallas_rowwise.py`
+adds with parity buffers is a latency optimization (~one DMA round trip
+per tile, ~5-10 ms over a 3M-row stream) left for a v2 once hardware
+numbers exist.  Like that kernel this one is OPT-IN
+(``use_segwalk_apply=True``) until measured on chip.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Test hook, as in ops/pallas_rowwise.py: engage the kernel in
+# interpreter mode on any backend so CI exercises the real producers.
+FORCE_INTERPRET = False
+
+
+def _tile_rows(width: int) -> int:
+  """Stream rows per grid step: sized so the two [tile, width] f32
+  staging arrays plus the gradient block stay ~100-400 KiB of VMEM,
+  capped at 512 scalar-walk iterations."""
+  return max(128, min(512, 32768 // width))
+
+
+def _seg_scan(vals: jax.Array, starts: jax.Array) -> jax.Array:
+  """Segmented inclusive prefix sum along the sublane axis.
+
+  Hillis-Steele with STATIC shifts only (slices + concat + elementwise;
+  no cumsum/gather primitives, whose Mosaic lowering for this layout is
+  uncertain).  ``starts``: [T, 1] f32, 1.0 at segment starts.  log2(T)
+  unrolled steps, each a handful of vector ops.
+  """
+  t = vals.shape[0]
+  stop = jnp.broadcast_to(starts, vals.shape)
+  d = 1
+  while d < t:
+    pad_v = jnp.zeros((d,) + vals.shape[1:], vals.dtype)
+    pad_s = jnp.ones((d,) + vals.shape[1:], vals.dtype)
+    shifted_v = jnp.concatenate([pad_v, vals[:-d]], axis=0)
+    shifted_s = jnp.concatenate([pad_s, stop[:-d]], axis=0)
+    vals = vals + shifted_v * (1.0 - stop)
+    stop = jnp.maximum(stop, shifted_s)
+    d *= 2
+  return vals
+
+
+def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, g_ref, lr_smem,
+                    table_in, acc_in, table_ref, acc_ref, tbuf, abuf,
+                    carry, carry_id, rsem, wsem, *, num_rows, tile,
+                    width, op):
+  """One [tile, width] block of the sorted stream.
+
+  ``op``: 'sgd' | 'adagrad_dedup' | 'adagrad_sq' (static).  ``carry``
+  [2, width] VMEM scratch holds the running (sum, sum_sq) of the
+  segment spanning the tile boundary; ``carry_id`` [1, 1] SMEM its id.
+  For 'sgd' the acc refs point at a dummy buffer and are never DMA'd.
+  """
+  del table_in, acc_in  # same memory as the aliased output refs
+  has_acc = op != 'sgd'
+  t = pl.program_id(0)
+
+  @pl.when(t == 0)
+  def _init():
+    carry_id[0, 0] = -1
+    carry[...] = jnp.zeros((2, width), jnp.float32)
+
+  # ----- vector side: segmented totals ---------------------------------
+  sid_col = sid_vmem[:]                                 # [tile, 1] int32
+  prev = jnp.concatenate(
+      [jnp.full((1, 1), -2, jnp.int32), sid_col[:-1]], axis=0)
+  starts = jnp.concatenate(
+      [jnp.ones((1, 1), jnp.float32),
+       (sid_col[1:] != prev[1:]).astype(jnp.float32)], axis=0)
+  g = g_ref[:]                                          # [tile, w] f32
+  # both scalars live in SMEM: scalar compare, then broadcast
+  cont = (sid_smem[0, 0] == carry_id[0, 0]).astype(jnp.float32)
+  if op == 'adagrad_sq':
+    payload = jnp.concatenate([g, g * g], axis=1)       # [tile, 2w]
+    carry_row = carry[...].reshape(1, 2 * width)
+  else:
+    payload = g
+    carry_row = carry[0:1]
+  inject = jnp.concatenate(
+      [payload[0:1] + cont * carry_row, payload[1:]], axis=0)
+  seg = _seg_scan(inject, starts)                       # [tile, w|2w]
+  tot = seg[:, :width]
+
+  # ----- scalar walk 1: burst-read rows at segment-last positions ------
+  def read_row(k, cnt):
+    def do(c):
+      rid = jnp.clip(sid_smem[k, 0], 0, num_rows - 1)
+      pltpu.make_async_copy(table_ref.at[pl.ds(rid, 1)],
+                            tbuf.at[pl.ds(k, 1)], rsem).start()
+      if has_acc:
+        pltpu.make_async_copy(acc_ref.at[pl.ds(rid, 1)],
+                              abuf.at[pl.ds(k, 1)], rsem).start()
+      return c + 1
+
+    return jax.lax.cond(
+        (islast_smem[k, 0] == 1) & (sid_smem[k, 0] < num_rows), do,
+        lambda c: c, cnt)
+
+  nval = jax.lax.fori_loop(0, tile, read_row, 0)
+
+  def wait_read(k, _):
+    pltpu.make_async_copy(table_ref.at[pl.ds(0, 1)],
+                          tbuf.at[pl.ds(k, 1)], rsem).wait()
+    if has_acc:
+      pltpu.make_async_copy(acc_ref.at[pl.ds(0, 1)],
+                            abuf.at[pl.ds(k, 1)], rsem).wait()
+    return 0
+
+  jax.lax.fori_loop(0, nval, wait_read, 0)
+
+  # ----- vector update (garbage at non-last rows is never written) -----
+  lr = lr_smem[0, 0]
+  if op == 'sgd':
+    tbuf[...] = tbuf[...] - lr * tot
+  else:
+    add = tot * tot if op == 'adagrad_dedup' else seg[:, width:]
+    acc_new = abuf[...] + add
+    eps = lr_smem[0, 1]
+    tbuf[...] = tbuf[...] - lr * tot * jax.lax.rsqrt(acc_new + eps)
+    abuf[...] = acc_new
+
+  # ----- update carries (AFTER the scan consumed the old values) -------
+  if op == 'adagrad_sq':
+    carry[...] = seg[tile - 1:tile].reshape(2, width)
+  else:
+    carry[0:1] = seg[tile - 1:tile]
+  carry_id[0, 0] = sid_smem[tile - 1, 0]
+
+  # ----- scalar walk 2: burst-write, then drain before the step ends ---
+  def write_row(k, _):
+    def do(_):
+      rid = jnp.clip(sid_smem[k, 0], 0, num_rows - 1)
+      pltpu.make_async_copy(tbuf.at[pl.ds(k, 1)],
+                            table_ref.at[pl.ds(rid, 1)], wsem).start()
+      if has_acc:
+        pltpu.make_async_copy(abuf.at[pl.ds(k, 1)],
+                              acc_ref.at[pl.ds(rid, 1)], wsem).start()
+      return 0
+
+    jax.lax.cond(
+        (islast_smem[k, 0] == 1) & (sid_smem[k, 0] < num_rows), do,
+        lambda _: 0, 0)
+    return 0
+
+  jax.lax.fori_loop(0, tile, write_row, 0)
+
+  def wait_write(k, _):
+    pltpu.make_async_copy(tbuf.at[pl.ds(k, 1)],
+                          table_ref.at[pl.ds(0, 1)], wsem).wait()
+    if has_acc:
+      pltpu.make_async_copy(abuf.at[pl.ds(k, 1)],
+                            acc_ref.at[pl.ds(0, 1)], wsem).wait()
+    return 0
+
+  jax.lax.fori_loop(0, nval, wait_write, 0)
+
+
+def supported(table: jax.Array) -> bool:
+  """f32 2-D tables at width 128 or a narrow width dividing 128 (>= 8),
+  mirroring ops/pallas_rowwise.py."""
+  if not (table.ndim == 2 and table.dtype == jnp.float32):
+    return False
+  w = table.shape[1]
+  return w == 128 or (8 <= w < 128 and 128 % w == 0)
+
+
+@functools.partial(jax.jit, static_argnames=('op', 'eps', 'interpret'))
+def segwalk_apply(table: jax.Array,
+                  acc: Optional[jax.Array],
+                  sorted_ids: jax.Array,
+                  sorted_g: jax.Array,
+                  lr,
+                  *,
+                  op: str,
+                  eps: float = 1e-7,
+                  interpret: bool = False):
+  """Apply one optimizer step from a SORTED per-occurrence stream.
+
+  Args:
+    table: ``[num_rows, w]`` f32 (donate for in-place).
+    acc: Adagrad accumulator ``[num_rows, w]`` f32, or None for 'sgd'.
+    sorted_ids: ``[n]`` int32 ascending; sentinels (>= num_rows) last.
+    sorted_g: ``[n, w]`` f32 gradient rows in the same order.
+    lr: scalar learning rate.
+    op: 'sgd' | 'adagrad_dedup' | 'adagrad_sq'.
+
+  Returns:
+    ``new_table`` ('sgd') or ``(new_table, new_acc)``.
+  """
+  if op not in ('sgd', 'adagrad_dedup', 'adagrad_sq'):
+    raise ValueError(f'unknown op {op!r}')
+  if not supported(table):
+    raise ValueError(f'segwalk unsupported table {table.shape} '
+                     f'{table.dtype}')
+  if (op == 'sgd') != (acc is None):
+    raise ValueError('acc must be provided iff op is an adagrad variant')
+  num_rows, w = table.shape
+  tile = _tile_rows(w)
+  n = sorted_ids.shape[0]
+  n_pad = -(-n // tile) * tile
+  if n_pad != n:
+    pad = n_pad - n
+    sorted_ids = jnp.pad(sorted_ids, (0, pad), constant_values=num_rows)
+    sorted_g = jnp.pad(sorted_g, ((0, pad), (0, 0)))
+  # global segment-last flags (the one lookahead the kernel cannot do)
+  is_last = jnp.concatenate([
+      (sorted_ids[1:] != sorted_ids[:-1]),
+      jnp.ones((1,), bool)
+  ]).astype(jnp.int32)
+  num_tiles = n_pad // tile
+  lr_arr = jnp.stack([jnp.asarray(lr, jnp.float32),
+                      jnp.asarray(eps, jnp.float32)]).reshape(1, 2)
+  ids2d = sorted_ids.astype(jnp.int32)[:, None]
+  # 'sgd' has no accumulator: a small dummy keeps the operand/alias
+  # structure uniform (the kernel never issues DMAs against it)
+  acc_operand = acc if acc is not None else jnp.zeros((8, w), jnp.float32)
+
+  kernel = functools.partial(_segwalk_kernel,
+                             num_rows=num_rows,
+                             tile=tile,
+                             width=w,
+                             op=op)
+  outs = pl.pallas_call(
+      kernel,
+      grid=(num_tiles,),
+      in_specs=[
+          pl.BlockSpec((tile, 1), lambda t: (t, 0),
+                       memory_space=pltpu.SMEM),   # ids (scalar walk)
+          pl.BlockSpec((tile, 1), lambda t: (t, 0),
+                       memory_space=pltpu.SMEM),   # is_last (walk)
+          pl.BlockSpec((tile, 1), lambda t: (t, 0),
+                       memory_space=pltpu.VMEM),   # ids (vector scan)
+          pl.BlockSpec((tile, w), lambda t: (t, 0),
+                       memory_space=pltpu.VMEM),   # sorted grads
+          pl.BlockSpec(memory_space=pltpu.SMEM),   # [lr, eps]
+          pl.BlockSpec(memory_space=pl.ANY),       # table
+          pl.BlockSpec(memory_space=pl.ANY),       # acc (or dummy)
+      ],
+      out_specs=[
+          pl.BlockSpec(memory_space=pl.ANY),
+          pl.BlockSpec(memory_space=pl.ANY),
+      ],
+      out_shape=[
+          jax.ShapeDtypeStruct(table.shape, table.dtype),
+          jax.ShapeDtypeStruct(acc_operand.shape, acc_operand.dtype),
+      ],
+      input_output_aliases={5: 0, 6: 1},
+      scratch_shapes=[
+          pltpu.VMEM((tile, w), jnp.float32),      # tbuf
+          pltpu.VMEM((tile, w), jnp.float32),      # abuf
+          pltpu.VMEM((2, w), jnp.float32),         # carry (sum, sum_sq)
+          pltpu.SMEM((1, 1), jnp.int32),           # carry id
+          pltpu.SemaphoreType.DMA,                 # read semaphore
+          pltpu.SemaphoreType.DMA,                 # write semaphore
+      ],
+      compiler_params=pltpu.CompilerParams(
+          dimension_semantics=('arbitrary',)),
+      interpret=interpret,
+  )(ids2d, is_last[:, None], ids2d, sorted_g, lr_arr, table, acc_operand)
+  if op == 'sgd':
+    return outs[0]
+  return outs[0], outs[1]

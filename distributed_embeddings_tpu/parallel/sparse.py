@@ -184,6 +184,12 @@ class SparseSGD:
   learning_rate: float = 0.01
   capacity_fraction: float = 0.5
   capacity_rows: Optional[Tuple[Optional[int], ...]] = None
+  # opt-in fused segment-walk apply (ops/pallas_segwalk.py): one
+  # streaming pass does segment-sum + update together, skipping the
+  # whole compaction pipeline; takes effect on TPU for f32 tables of
+  # width 128 or widths 8..64 dividing 128, silently falling back to
+  # the XLA path elsewhere
+  use_segwalk_apply: bool = False
 
   needs_sq = False
   supports_lane_packing = True
@@ -227,6 +233,11 @@ class SparseAdagrad:
   # (natural-width or lane-packed), silently falling back to the XLA
   # path elsewhere
   use_pallas_apply: bool = False
+  # opt-in fused segment-walk apply (ops/pallas_segwalk.py): consumes
+  # the SORTED raw stream directly — segment-sum + update in one pass,
+  # no compaction pipeline at all; same width/dtype support as above.
+  # Takes precedence over use_pallas_apply when both are set.
+  use_segwalk_apply: bool = False
 
   supports_lane_packing = True
 
@@ -505,6 +516,37 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
                       (t2, s2))
 
 
+def _use_segwalk(optimizer, table) -> bool:
+  """Whether the fused segment-walk kernel serves this group's apply."""
+  if not getattr(optimizer, 'use_segwalk_apply', False):
+    return False
+  from distributed_embeddings_tpu.ops import pallas_segwalk
+  if not pallas_segwalk.supported(table):
+    return False
+  return (jax.default_backend() == 'tpu'
+          or pallas_segwalk.FORCE_INTERPRET)
+
+
+def _segwalk_apply(optimizer, table, state, flat_ids, flat_g, lr):
+  """Sort the raw stream and hand it to the fused segment-walk kernel
+  (ops/pallas_segwalk.py) — no compaction, no capacity, no correction
+  wave: every segment is applied exactly once."""
+  from distributed_embeddings_tpu.ops import pallas_segwalk
+  interp = pallas_segwalk.FORCE_INTERPRET
+  order = jnp.argsort(flat_ids)
+  sid = flat_ids[order].astype(jnp.int32)
+  sg = flat_g[order].astype(jnp.float32)
+  if isinstance(optimizer, SparseSGD):
+    t2 = pallas_segwalk.segwalk_apply(
+        table, None, sid, sg, lr, op='sgd', interpret=interp)
+    return t2, state
+  op = 'adagrad_dedup' if optimizer.dedup else 'adagrad_sq'
+  t2, a2 = pallas_segwalk.segwalk_apply(
+      table, state['acc'], sid, sg, lr, op=op, eps=optimizer.epsilon,
+      interpret=interp)
+  return t2, {'acc': a2}
+
+
 def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
                         global_batch: int, hotness: tuple):
   """shard_map'd per-device sparse update over all fusion groups."""
@@ -591,9 +633,17 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
         flat_g = gathered[:, 1:1 + w]
         if needs_sq:
           flat_sq = gathered[:, 1 + w:]
-      table, state2 = _dedup_and_apply(optimizer, params[key][0], state_g,
-                                       flat_ids, flat_g, lr, rows_cap,
-                                       cap_rows=cap_rows, flat_sq=flat_sq)
+      if flat_sq is None and _use_segwalk(optimizer, params[key][0]):
+        # fused segment-walk path (flat_sq present means the stream
+        # carries pre-accumulated squares the kernel cannot consume —
+        # multi-slice per-occurrence Adagrad falls back to XLA)
+        table, state2 = _segwalk_apply(optimizer, params[key][0],
+                                       state_g, flat_ids, flat_g, lr)
+      else:
+        table, state2 = _dedup_and_apply(optimizer, params[key][0],
+                                         state_g, flat_ids, flat_g, lr,
+                                         rows_cap, cap_rows=cap_rows,
+                                         flat_sq=flat_sq)
       new_params[key] = table[None]
       new_state[key] = {k: v[None] for k, v in state2.items()}
       fence = table[0, 0]

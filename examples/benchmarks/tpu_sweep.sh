@@ -12,6 +12,10 @@ run() {
 
 # 1. kernel A/B at the exact dominant shape (fast, most informative)
 T=1200 run python -m pytest tests/test_pallas_tpu.py -q -s -k rowwise_apply_microbench
+T=1200 run python -m pytest tests/test_pallas_tpu.py -q -s -k segwalk_apply_microbench
+
+# 1b. segment-walk kernel correctness compiled (round-3 perf bet)
+T=1200 run python -m pytest tests/test_pallas_tpu.py -q -s -k segwalk_apply_compiled
 
 # 2. steady-state step time, XLA apply vs fused apply, calibrated caps
 T=1200 run python examples/benchmarks/trace_step.py --calls 3 --auto_capacity
@@ -20,6 +24,7 @@ T=1200 run python examples/benchmarks/trace_step.py --calls 3 --auto_capacity --
 # 3. the official bench artifact line (what BENCH_rN.json captures)
 T=1200 run python bench.py --model tiny --steps 10 --auto_capacity
 T=1200 run python bench.py --model tiny --steps 10 --auto_capacity --fused_apply
+T=1200 run python bench.py --model tiny --steps 10 --segwalk_apply
 
 # 4. bf16 tables variant
 T=1200 run python bench.py --model tiny --steps 10 --auto_capacity --param_dtype bfloat16

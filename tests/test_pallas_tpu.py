@@ -185,3 +185,100 @@ def test_microbench_vs_xla_fallback(w, hot):
         f'xla {t_xla:.3f} ms ({t_xla / t_pl:.2f}x)')
   # soft bound: the kernel must never be pathologically slower
   assert t_pl < 5 * t_xla
+
+
+@requires_tpu
+@pytest.mark.parametrize('op', ['sgd', 'adagrad_dedup', 'adagrad_sq'])
+@pytest.mark.parametrize('w', [16, 128])
+def test_segwalk_apply_compiled_matches_oracle(op, w):
+  """Fused segment-walk apply (ops/pallas_segwalk.py) compiled on the
+  chip: the per-row SMEM walk, carry threading, and RMW DMA bursts only
+  exist on hardware."""
+  from test_pallas_segwalk import oracle, LR, EPS
+  from distributed_embeddings_tpu.ops import pallas_segwalk
+  rng = np.random.default_rng(4)
+  rows, n = 50_000, 20_000
+  table = rng.normal(size=(rows, w)).astype(np.float32)
+  acc = None if op == 'sgd' else rng.uniform(
+      0.05, 0.5, size=(rows, w)).astype(np.float32)
+  ids = rng.integers(0, rows, n).astype(np.int32)
+  ids[rng.random(n) < 0.1] = rows  # sentinel tail after sort
+  # power-law-ish duplicates: fold a chunk onto few hot rows
+  ids[:2000] = rng.integers(0, 50, 2000)
+  grads = rng.normal(size=(n, w)).astype(np.float32)
+  want_t, want_a = oracle(op, table, acc, ids, grads)
+  # compiled (interpret=False): bypass run_kernel's interpret=True
+  order = np.argsort(ids, kind='stable')
+  sid = jnp.asarray(ids[order], jnp.int32)
+  sg = jnp.asarray(grads[order], jnp.float32)
+  if op == 'sgd':
+    got_t = np.asarray(pallas_segwalk.segwalk_apply(
+        jnp.asarray(table), None, sid, sg, LR, op=op, eps=EPS))
+    got_a = None
+  else:
+    t2, a2 = pallas_segwalk.segwalk_apply(
+        jnp.asarray(table), jnp.asarray(acc), sid, sg, LR, op=op,
+        eps=EPS)
+    got_t, got_a = np.asarray(t2), np.asarray(a2)
+  np.testing.assert_allclose(got_t, want_t, rtol=1e-4, atol=1e-4)
+  if got_a is not None:
+    np.testing.assert_allclose(got_a, want_a, rtol=1e-4, atol=1e-4)
+
+
+@requires_tpu
+@pytest.mark.parametrize('w,n', [(16, 1 << 21), (128, 1 << 18)])
+def test_segwalk_apply_microbench(w, n):
+  """Segment-walk (sorted raw stream in, no compaction) vs the XLA
+  compact-then-apply pipeline at synthetic-tiny-like scale: this is the
+  round-3 perf bet — the ~300 ms compaction pipeline should collapse
+  into the stream read (docs/perf_notes.md, multi-chip model)."""
+  from distributed_embeddings_tpu.ops import pallas_segwalk
+  from distributed_embeddings_tpu.parallel.sparse import (SparseAdagrad,
+                                                          _dedup_and_apply)
+  rng = np.random.default_rng(5)
+  rows = 8_000_000 if w == 16 else 1_000_000
+  iters = 3
+  table = jnp.zeros((rows, w), jnp.float32) + 0.5
+  acc = jnp.ones((rows, w), jnp.float32)
+  opt = SparseAdagrad(learning_rate=0.01, dedup=True)
+  stacks = []
+  for _ in range(3):
+    s = np.empty((iters, n), np.int32)
+    for i in range(iters):
+      # zipf-ish duplicates like the power-law generator
+      raw = (rng.pareto(1.05, n) * 1000).astype(np.int64) % rows
+      s[i] = raw.astype(np.int32)
+    stacks.append(jnp.asarray(s))
+  g = jnp.asarray(rng.normal(size=(n, w)).astype(np.float32))
+
+  def segwalk_fn(tab, ac, ids):
+    order = jnp.argsort(ids)
+    return pallas_segwalk.segwalk_apply(
+        tab, ac, ids[order].astype(jnp.int32), g[order], 0.01,
+        op='adagrad_dedup', eps=1e-7)
+
+  def xla_fn(tab, ac, ids):
+    t2, s2 = _dedup_and_apply(opt, tab, {'acc': ac}, ids, g, 0.01, rows)
+    return t2, s2['acc']
+
+  def bench(fn):
+    def run(tab, ac, s):
+      def body(carry, ids):
+        t2, a2 = fn(*carry, ids)
+        return (t2, a2), None
+      (t2, a2), _ = jax.lax.scan(body, (tab, ac), s)
+      return jnp.sum(t2[:8]) + jnp.sum(a2[:8])
+    f = jax.jit(run)
+    float(f(table, acc, stacks[0]))
+    times = []
+    for s in stacks[1:]:
+      start = time.perf_counter()
+      float(f(table, acc, s))
+      times.append(time.perf_counter() - start)
+    return min(times) / iters * 1e3
+
+  t_sw = bench(segwalk_fn)
+  t_xla = bench(xla_fn)
+  print(f'\nsegwalk apply w={w} n={n}: segwalk {t_sw:.1f} ms, '
+        f'xla pipeline {t_xla:.1f} ms ({t_xla / t_sw:.2f}x)')
+  assert t_sw < 5 * t_xla
