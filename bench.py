@@ -244,9 +244,11 @@ def main():
   # for the donated state differ from the initial buffers' layouts — only
   # from the third call on is the program cached (measured on v5e: 50s,
   # 46s, then 1.1s steady state; docs/perf_notes.md).
+  warm_start = time.perf_counter()
   for i in range(max(3, args.warmup)):
     state, loss = step(state, pool[i % len(pool)])
   float(loss)  # force full sync (block_until_ready is unreliable here)
+  warmup_s = time.perf_counter() - warm_start
 
   start = time.perf_counter()
   for i in range(args.steps):
@@ -305,6 +307,10 @@ def main():
       # flag them unplottable instead of relying on the metric prose
       # (VERDICT r2 weak 5)
       'comparable': not on_cpu,
+      # compile+warmup wall time: how much of a driver timeout budget
+      # the two-compile warmup burned (VERDICT r2 weak 6); the
+      # persistent .jax_cache makes repeats drop to seconds
+      'warmup_s': round(warmup_s, 1),
   })
 
 

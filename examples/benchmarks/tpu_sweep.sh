@@ -1,6 +1,9 @@
 #!/bin/bash
 # One-shot measurement sweep for a healthy TPU tunnel, highest-value first.
 # Each step is independently killable; results append to the log.
+# Ordering principle: tunnel windows can be SHORT — the official bench
+# artifact line comes first (it alone closes VERDICT item 1), then the
+# kernel A/Bs that decide defaults, then correctness gates, then extras.
 # Usage: bash examples/benchmarks/tpu_sweep.sh [logfile]
 set -u
 LOG=${1:-/tmp/tpu_sweep.log}
@@ -10,32 +13,37 @@ run() {
   timeout "${T:-900}" "$@" 2>&1 | grep -v WARNING | tail -6 | tee -a "$LOG"
 }
 
-# 1. kernel A/B at the exact dominant shape (fast, most informative)
-T=1200 run python -m pytest tests/test_pallas_tpu.py -q -s -k rowwise_apply_microbench
-T=1200 run python -m pytest tests/test_pallas_tpu.py -q -s -k segwalk_apply_microbench
+# 0. THE official artifact line: steady-state tiny step time on the chip
+# (two ~50s compiles then 10 timed steps; .jax_cache makes reruns fast)
+T=1200 run python bench.py --model tiny --steps 10 --auto_capacity
 
-# 1b. segment-walk kernel correctness compiled (round-3 perf bet)
+# 1. the round-3 perf bets A/B'd at the same shape
+T=1200 run python bench.py --model tiny --steps 10 --segwalk_apply
+T=1200 run python bench.py --model tiny --steps 10 --auto_capacity --fused_apply
+
+# 2. kernel microbenches at the exact dominant shapes (decide defaults)
+T=1200 run python -m pytest tests/test_pallas_tpu.py -q -s -k segwalk_apply_microbench
+T=1200 run python -m pytest tests/test_pallas_tpu.py -q -s -k rowwise_apply_microbench
+
+# 3. segment-walk kernel correctness compiled (gates flipping any default)
 T=1200 run python -m pytest tests/test_pallas_tpu.py -q -s -k segwalk_apply_compiled
 
-# 2. steady-state step time, XLA apply vs fused apply, calibrated caps
+# 4. steady-state trace decomposition, XLA vs fused vs segwalk apply
 T=1200 run python examples/benchmarks/trace_step.py --calls 3 --auto_capacity
 T=1200 run python examples/benchmarks/trace_step.py --calls 3 --auto_capacity --fused_apply
+T=1200 run python examples/benchmarks/trace_step.py --calls 3 --segwalk_apply
 
-# 3. the official bench artifact line (what BENCH_rN.json captures)
-T=1200 run python bench.py --model tiny --steps 10 --auto_capacity
-T=1200 run python bench.py --model tiny --steps 10 --auto_capacity --fused_apply
-T=1200 run python bench.py --model tiny --steps 10 --segwalk_apply
-
-# 4. bf16 tables variant
+# 5. bf16 tables variant
 T=1200 run python bench.py --model tiny --steps 10 --auto_capacity --param_dtype bfloat16
 
-# 5. DLRM-shaped criteo model (width 128, hotness 1: kernel sweet spot)
+# 6. DLRM-shaped criteo model (width 128, hotness 1: kernel sweet spot)
 T=1200 run python bench.py --model criteo --steps 10 --auto_capacity --fused_apply
+T=1200 run python bench.py --model criteo --steps 10 --segwalk_apply
 
-# 6. primitive scatter/gather hint A/B (informs perf notes)
+# 7. primitive scatter/gather hint A/B (informs perf notes)
 T=900 run python examples/benchmarks/scatter_probe.py
 
-# 7. remaining hardware correctness gates
+# 8. remaining hardware correctness gates (full TPU-gated suite)
 T=1800 run python -m pytest tests/test_pallas_tpu.py -q -s -k "not microbench"
 
 echo "sweep done: $LOG"

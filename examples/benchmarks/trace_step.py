@@ -19,6 +19,7 @@ def main():
   p.add_argument('--trace', default='')
   p.add_argument('--param_dtype', default='float32')
   p.add_argument('--fused_apply', action='store_true')
+  p.add_argument('--segwalk_apply', action='store_true')
   p.add_argument('--capacity_fraction', type=float, default=0.5)
   p.add_argument('--auto_capacity', action='store_true')
   p.add_argument('--calls', type=int, default=3)
@@ -65,7 +66,8 @@ def main():
   emb_opt = SparseAdagrad(learning_rate=0.01,
                           capacity_fraction=args.capacity_fraction,
                           capacity_rows=capacity_rows,
-                          use_pallas_apply=args.fused_apply)
+                          use_pallas_apply=args.fused_apply,
+                          use_segwalk_apply=args.segwalk_apply)
   step = jax.jit(make_hybrid_train_step(dist, head_loss_fn, opt, emb_opt,
                                         jit=False), donate_argnums=(0,))
   state = init_hybrid_train_state(dist, params, opt, emb_opt)
