@@ -36,11 +36,16 @@ def oracle_lookup(w, ids, combiner):
   return out
 
 
-@pytest.mark.parametrize('seed', range(6))
+@pytest.mark.parametrize('seed', range(8))
 def test_fuzz_forward_and_checkpoint(seed):
   rng = np.random.default_rng(1000 + seed)
   world = int(rng.choice([2, 4, 8]))
-  mesh = create_mesh(jax.devices()[:world])
+  # sometimes a two-axis (dcn x data) multi-slice mesh over the same
+  # device count: tables shard over world//2 inner devices, replicate
+  # across 2 slices, batch DP over the product
+  two_axis = world >= 4 and rng.random() < 0.35
+  mesh = (create_mesh((2, world // 2)) if two_axis
+          else create_mesh(jax.devices()[:world]))
   # at least one placement unit per device even with no slicing
   n_tables = world + int(rng.integers(0, 4))
   configs = []
@@ -104,14 +109,14 @@ def test_fuzz_forward_and_checkpoint(seed):
         np.asarray(outs[inp]), want, rtol=2e-5, atol=2e-5,
         err_msg=f'seed {seed} input {inp} ({c.combiner}, world {world}, '
         f'{strategy}, col_thr {col_thr}, row_thr {row_thr}, '
-        f'dp {dp_input})')
+        f'dp {dp_input}, two_axis {two_axis})')
 
   # checkpoint round trip under whatever layout the fuzz produced
   for w, b in zip(weights, get_weights(dist, params)):
     np.testing.assert_array_equal(w, b)
 
 
-@pytest.mark.parametrize('seed', range(4))
+@pytest.mark.parametrize('seed', range(6))
 def test_fuzz_sparse_train_step(seed):
   """One SparseSGD step over a random layout == the dense-gradient
   oracle (SGD is linear, so any correct routing/compaction/apply chain
@@ -122,7 +127,9 @@ def test_fuzz_sparse_train_step(seed):
                                                    make_hybrid_train_step)
   rng = np.random.default_rng(2000 + seed)
   world = int(rng.choice([2, 4, 8]))
-  mesh = create_mesh(jax.devices()[:world])
+  two_axis = world >= 4 and rng.random() < 0.35
+  mesh = (create_mesh((2, world // 2)) if two_axis
+          else create_mesh(jax.devices()[:world]))
   n_tables = world + int(rng.integers(0, 3))
   configs = []
   for _ in range(n_tables):
