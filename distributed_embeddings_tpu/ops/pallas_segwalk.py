@@ -20,6 +20,15 @@ pipeline did per payload disappears.  There is NO capacity/overflow
 machinery: every segment is applied exactly once, whatever the unique
 count.
 
+Narrow widths lane-pack: for ``width < 128`` (dividing 128, rows
+divisible by the pack factor) the table is viewed as
+``[rows/pack, 128]``, the id stream divides by ``pack`` (adjacent uids
+sharing a packed row merge into one segment, their totals living in
+disjoint lanes via an in-register mask expansion), and each unique
+PACKED row costs one full-512B-burst DMA pair — both fewer random DMAs
+(up to ``pack`` x) and full-burst ones, with no extra HBM stream
+traffic (the expansion happens in VMEM).
+
 Semantics supported (all exact):
 - 'sgd':            ``table[uid] -= lr * seg_sum``
 - 'adagrad_dedup':  ``acc += seg_sum**2`` then scaled add (reference
@@ -89,16 +98,25 @@ def _seg_scan(vals: jax.Array, starts: jax.Array) -> jax.Array:
   return vals
 
 
-def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, g_ref, lr_smem,
-                    table_in, acc_in, table_ref, acc_ref, tbuf, abuf,
-                    carry, carry_id, rsem, wsem, *, num_rows, tile,
-                    width, op):
-  """One [tile, width] block of the sorted stream.
+def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, slot_vmem, g_ref,
+                    lr_smem, table_in, acc_in, table_ref, acc_ref, tbuf,
+                    abuf, carry, carry_id, rsem, wsem, *, num_rows, tile,
+                    width, gw, pack, op):
+  """One [tile, gw] block of the sorted stream against [*, width] rows.
 
   ``op``: 'sgd' | 'adagrad_dedup' | 'adagrad_sq' (static).  ``carry``
   [2, width] VMEM scratch holds the running (sum, sum_sq) of the
   segment spanning the tile boundary; ``carry_id`` [1, 1] SMEM its id.
   For 'sgd' the acc refs point at a dummy buffer and are never DMA'd.
+
+  Lane packing (``pack > 1``): ids arrive PRE-divided by ``pack`` (the
+  table is viewed as ``[rows/pack, pack*gw]``, a free row-major
+  reshape), ``slot_vmem`` carries each row's original ``id % pack``,
+  and the gradient block expands in-register to the packed width with a
+  lane mask — so each unique PACKED row costs one full-burst DMA pair
+  serving up to ``pack`` original rows, and the scan/optimizer math is
+  unchanged (untouched lanes carry zero gradient; Adagrad is
+  elementwise, the exact argument of ``parallel/sparse.py:_lane_pack``).
   """
   del table_in, acc_in  # same memory as the aliased output refs
   has_acc = op != 'sgd'
@@ -116,7 +134,10 @@ def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, g_ref, lr_smem,
   starts = jnp.concatenate(
       [jnp.ones((1, 1), jnp.float32),
        (sid_col[1:] != prev[1:]).astype(jnp.float32)], axis=0)
-  g = g_ref[:]                                          # [tile, w] f32
+  g = g_ref[:]                                          # [tile, gw] f32
+  if pack > 1:
+    lane = jax.lax.broadcasted_iota(jnp.int32, (tile, width), 1) // gw
+    g = jnp.tile(g, (1, pack)) * (lane == slot_vmem[:]).astype(jnp.float32)
   # both scalars live in SMEM: scalar compare, then broadcast
   cont = (sid_smem[0, 0] == carry_id[0, 0]).astype(jnp.float32)
   if op == 'adagrad_sq':
@@ -204,6 +225,17 @@ def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, g_ref, lr_smem,
   jax.lax.fori_loop(0, nval, wait_write, 0)
 
 
+def packed_ids(ids: jax.Array, pack: int, rows: int):
+  """Map row ids to (packed row, lane slot): ``id // pack`` with
+  sentinels (``>= rows``) going to packed-sentinel ``rows // pack`` at
+  slot 0.  Single source of the packed-view convention, shared with
+  ``parallel/sparse.py:_lane_pack``."""
+  sent = ids >= rows
+  pids = jnp.where(sent, rows // pack, ids // pack)
+  slots = jnp.where(sent, 0, jax.lax.rem(ids, pack))
+  return pids, slots
+
+
 def supported(table: jax.Array) -> bool:
   """f32 2-D tables at width 128 or a narrow width dividing 128 (>= 8),
   mirroring ops/pallas_rowwise.py."""
@@ -244,30 +276,53 @@ def segwalk_apply(table: jax.Array,
   if (op == 'sgd') != (acc is None):
     raise ValueError('acc must be provided iff op is an adagrad variant')
   num_rows, w = table.shape
-  tile = _tile_rows(w)
+  # Lane packing for narrow rows: view the table as [rows/pack, 128]
+  # (free row-major reshape) so each unique-row DMA moves a full 512 B
+  # burst serving up to `pack` original rows.  The id stream divides by
+  # `pack` (merging adjacent uids into one packed segment) and each
+  # row's original lane slot rides along for the in-kernel expansion.
+  pack = 128 // w if (w < 128 and num_rows % (128 // w) == 0) else 1
+  kw = w * pack
+  prows = num_rows // pack
+  tile = _tile_rows(kw)
   n = sorted_ids.shape[0]
   n_pad = -(-n // tile) * tile
   if n_pad != n:
     pad = n_pad - n
     sorted_ids = jnp.pad(sorted_ids, (0, pad), constant_values=num_rows)
     sorted_g = jnp.pad(sorted_g, ((0, pad), (0, 0)))
-  # global segment-last flags (the one lookahead the kernel cannot do)
+  sorted_ids = sorted_ids.astype(jnp.int32)
+  if pack > 1:
+    kids, slots = packed_ids(sorted_ids, pack, num_rows)
+    table_k = table.reshape(prows, kw)
+    acc_k = acc.reshape(prows, kw) if acc is not None else None
+  else:
+    # the kernel statically never reads slots when pack == 1: reuse the
+    # id stream as the operand instead of materializing a zeros array
+    kids, slots = sorted_ids, sorted_ids
+    table_k, acc_k = table, acc
+  # global segment-last flags (the one lookahead the kernel cannot do),
+  # over the PACKED ids: adjacent uids sharing a packed row are one
+  # segment whose lanes carry their per-uid totals disjointly
   is_last = jnp.concatenate([
-      (sorted_ids[1:] != sorted_ids[:-1]),
+      (kids[1:] != kids[:-1]),
       jnp.ones((1,), bool)
   ]).astype(jnp.int32)
   num_tiles = n_pad // tile
   lr_arr = jnp.stack([jnp.asarray(lr, jnp.float32),
                       jnp.asarray(eps, jnp.float32)]).reshape(1, 2)
-  ids2d = sorted_ids.astype(jnp.int32)[:, None]
+  ids2d = kids[:, None]
   # 'sgd' has no accumulator: a small dummy keeps the operand/alias
   # structure uniform (the kernel never issues DMAs against it)
-  acc_operand = acc if acc is not None else jnp.zeros((8, w), jnp.float32)
+  acc_operand = (acc_k if acc_k is not None
+                 else jnp.zeros((8, kw), jnp.float32))
 
   kernel = functools.partial(_segwalk_kernel,
-                             num_rows=num_rows,
+                             num_rows=prows,
                              tile=tile,
-                             width=w,
+                             width=kw,
+                             gw=w,
+                             pack=pack,
                              op=op)
   outs = pl.pallas_call(
       kernel,
@@ -279,6 +334,8 @@ def segwalk_apply(table: jax.Array,
                        memory_space=pltpu.SMEM),   # is_last (walk)
           pl.BlockSpec((tile, 1), lambda t: (t, 0),
                        memory_space=pltpu.VMEM),   # ids (vector scan)
+          pl.BlockSpec((tile, 1), lambda t: (t, 0),
+                       memory_space=pltpu.VMEM),   # lane slots
           pl.BlockSpec((tile, w), lambda t: (t, 0),
                        memory_space=pltpu.VMEM),   # sorted grads
           pl.BlockSpec(memory_space=pltpu.SMEM),   # [lr, eps]
@@ -290,14 +347,14 @@ def segwalk_apply(table: jax.Array,
           pl.BlockSpec(memory_space=pl.ANY),
       ],
       out_shape=[
-          jax.ShapeDtypeStruct(table.shape, table.dtype),
+          jax.ShapeDtypeStruct(table_k.shape, table_k.dtype),
           jax.ShapeDtypeStruct(acc_operand.shape, acc_operand.dtype),
       ],
-      input_output_aliases={5: 0, 6: 1},
+      input_output_aliases={6: 0, 7: 1},
       scratch_shapes=[
-          pltpu.VMEM((tile, w), jnp.float32),      # tbuf
-          pltpu.VMEM((tile, w), jnp.float32),      # abuf
-          pltpu.VMEM((2, w), jnp.float32),         # carry (sum, sum_sq)
+          pltpu.VMEM((tile, kw), jnp.float32),     # tbuf
+          pltpu.VMEM((tile, kw), jnp.float32),     # abuf
+          pltpu.VMEM((2, kw), jnp.float32),        # carry (sum, sum_sq)
           pltpu.SMEM((1, 1), jnp.int32),           # carry id
           pltpu.SemaphoreType.DMA,                 # read semaphore
           pltpu.SemaphoreType.DMA,                 # write semaphore
@@ -305,7 +362,9 @@ def segwalk_apply(table: jax.Array,
       compiler_params=pltpu.CompilerParams(
           dimension_semantics=('arbitrary',)),
       interpret=interpret,
-  )(ids2d, is_last[:, None], ids2d, sorted_g, lr_arr, table, acc_operand)
+  )(ids2d, is_last[:, None], ids2d, slots[:, None], sorted_g, lr_arr,
+    table_k, acc_operand)
+  new_table = outs[0].reshape(num_rows, w)
   if op == 'sgd':
-    return outs[0]
-  return outs[0], outs[1]
+    return new_table
+  return new_table, outs[1].reshape(num_rows, w)

@@ -369,11 +369,11 @@ def _lane_pack(uids, sum_g, sum_sq, pack: int, rows_cap: int):
   Returns ``(pids, g_packed, sq_packed)`` sized
   ``min(len(uids), rows_cap // pack + 2)``.
   """
+  from distributed_embeddings_tpu.ops.pallas_segwalk import packed_ids
   c, w = sum_g.shape
   lanes = pack * w
   psent = rows_cap // pack
-  pids = jnp.where(uids >= rows_cap, psent, uids // pack)
-  slot = jnp.where(uids >= rows_cap, 0, jax.lax.rem(uids, pack))
+  pids, slot = packed_ids(uids, pack, rows_cap)
   lane = jnp.arange(lanes, dtype=jnp.int32) // w
   mask = (lane[None, :] == slot[:, None]).astype(sum_g.dtype)
   g_lanes = jnp.tile(sum_g, (1, pack)) * mask

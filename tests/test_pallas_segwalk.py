@@ -184,3 +184,34 @@ def test_integration_through_hybrid_step_interpreted(opt_kind):
       pallas_segwalk.FORCE_INTERPRET = False
   for a, b in zip(results[False], results[True]):
     np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize('op', ['sgd', 'adagrad_dedup', 'adagrad_sq'])
+def test_lane_packed_adjacent_uids_one_burst(op):
+  # rows divisible by pack: adjacent uids sharing a packed row merge
+  # into one segment whose lanes carry their totals disjointly
+  rows, w = 32, 8  # pack 16 -> 2 packed rows
+  rng = np.random.default_rng(3)
+  table = rng.normal(size=(rows, w)).astype(np.float32)
+  acc = None if op == 'sgd' else np.full((rows, w), 0.1, np.float32)
+  ids = np.array([0, 0, 1, 2, 15, 16, 17, 31, 31, rows], np.int32)
+  grads = rng.normal(size=(len(ids), w)).astype(np.float32)
+  want_t, want_a = oracle(op, table, acc, ids, grads)
+  got_t, got_a = run_kernel(op, table, acc, ids, grads)
+  np.testing.assert_allclose(got_t, want_t, rtol=2e-5, atol=2e-5)
+  if acc is not None:
+    np.testing.assert_allclose(got_a, want_a, rtol=2e-5, atol=2e-5)
+
+
+def test_natural_width_fallback_when_rows_not_divisible():
+  # rows % pack != 0: the narrow width runs unpacked and stays exact
+  rows, w = 67, 8
+  rng = np.random.default_rng(4)
+  table = rng.normal(size=(rows, w)).astype(np.float32)
+  acc = np.full((rows, w), 0.1, np.float32)
+  ids = rng.integers(0, rows, 500).astype(np.int32)
+  grads = rng.normal(size=(500, w)).astype(np.float32)
+  want_t, want_a = oracle('adagrad_dedup', table, acc, ids, grads)
+  got_t, got_a = run_kernel('adagrad_dedup', table, acc, ids, grads)
+  np.testing.assert_allclose(got_t, want_t, rtol=2e-5, atol=2e-5)
+  np.testing.assert_allclose(got_a, want_a, rtol=2e-5, atol=2e-5)
