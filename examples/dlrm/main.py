@@ -36,6 +36,9 @@ def parse_args():
   parser.add_argument('--dp_input', action='store_true')
   parser.add_argument('--dist_strategy', default='memory_balanced')
   parser.add_argument('--column_slice_threshold', type=int, default=None)
+  parser.add_argument('--segwalk_apply', action='store_true',
+                      help='opt into the fused segment-walk table apply '
+                      '(ops/pallas_segwalk.py) on TPU')
   parser.add_argument('--row_slice', type=int, default=None,
                       help='element threshold above which tables shard '
                       'along rows (fits tables bigger than one chip)')
@@ -149,7 +152,8 @@ def main():
       return bce_with_logits(model.head(dense_params, numerical, emb_outs),
                              labels)
 
-    emb_opt = SparseSGD(learning_rate=args.learning_rate)
+    emb_opt = SparseSGD(learning_rate=args.learning_rate,
+                        use_segwalk_apply=args.segwalk_apply)
     step = make_hybrid_train_step(dist, head_loss_fn, optimizer, emb_opt,
                                   lr_schedule=schedule)
     state = init_hybrid_train_state(dist, params, optimizer, emb_opt)
