@@ -215,3 +215,44 @@ def test_natural_width_fallback_when_rows_not_divisible():
   got_t, got_a = run_kernel('adagrad_dedup', table, acc, ids, grads)
   np.testing.assert_allclose(got_t, want_t, rtol=2e-5, atol=2e-5)
   np.testing.assert_allclose(got_a, want_a, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize('op', ['adagrad_dedup', 'adagrad_sq'])
+def test_lane_packed_segment_spans_tiles(op):
+  # a PACKED segment (several uids sharing one packed row) longer than a
+  # grid tile: the carry threads the lane-separated partial sums
+  rows, w = 32, 8                    # pack 16, kw 128 -> tile 256
+  tile = pallas_segwalk._tile_rows(128)
+  rng = np.random.default_rng(9)
+  table = rng.normal(size=(rows, w)).astype(np.float32)
+  acc = np.full((rows, w), 0.1, np.float32)
+  # packed row 0 covers uids 0..15: a run far longer than one tile,
+  # alternating uids so lanes interleave within the packed segment
+  ids = np.concatenate([
+      np.tile(np.array([0, 3, 7, 15], np.int32), 2 * tile),
+      np.array([16, 31, rows], np.int32),
+  ])
+  grads = rng.normal(size=(len(ids), w)).astype(np.float32)
+  want_t, want_a = oracle(op, table, acc, ids, grads)
+  got_t, got_a = run_kernel(op, table, acc, ids, grads)
+  np.testing.assert_allclose(got_t, want_t, rtol=1e-3, atol=1e-3)
+  np.testing.assert_allclose(got_a, want_a, rtol=1e-3, atol=1e-3)
+
+
+def test_seg_scan_matches_numpy():
+  # the in-kernel segmented Hillis-Steele scan against a numpy oracle
+  rng = np.random.default_rng(10)
+  t, w = 64, 4
+  vals = rng.normal(size=(t, w)).astype(np.float32)
+  starts = (rng.random((t, 1)) < 0.3).astype(np.float32)
+  starts[0, 0] = 1.0
+  got = np.asarray(pallas_segwalk._seg_scan(jnp.asarray(vals),
+                                            jnp.asarray(starts)))
+  want = np.zeros_like(vals)
+  run = np.zeros(w, np.float32)
+  for i in range(t):
+    if starts[i, 0] == 1.0:
+      run = np.zeros(w, np.float32)
+    run = run + vals[i]
+    want[i] = run
+  np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
