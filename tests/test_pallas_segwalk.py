@@ -226,10 +226,16 @@ def test_lane_packed_segment_spans_tiles(op):
   rng = np.random.default_rng(9)
   table = rng.normal(size=(rows, w)).astype(np.float32)
   acc = np.full((rows, w), 0.1, np.float32)
-  # packed row 0 covers uids 0..15: a run far longer than one tile,
-  # alternating uids so lanes interleave within the packed segment
+  # packed row 0 covers uids 0..15.  After the sort the stream is one
+  # packed segment of contiguous per-uid runs; UNEQUAL run lengths put
+  # the lane changes mid-tile and stretch the segment across several
+  # tiles, exercising both the in-tile lane switch and the cross-tile
+  # carry of lane-separated partials
   ids = np.concatenate([
-      np.tile(np.array([0, 3, 7, 15], np.int32), 2 * tile),
+      np.zeros(2 * tile + 17, np.int32),
+      np.full(37, 3, np.int32),
+      np.full(tile + 5, 7, np.int32),
+      np.full(91, 15, np.int32),
       np.array([16, 31, rows], np.int32),
   ])
   grads = rng.normal(size=(len(ids), w)).astype(np.float32)
