@@ -127,7 +127,27 @@ def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, slot_vmem, g_ref,
     carry_id[0, 0] = -1
     carry[...] = jnp.zeros((2, width), jnp.float32)
 
-  # ----- vector side: segmented totals ---------------------------------
+  # ----- scalar walk 1: burst-read rows at segment-last positions ------
+  # Issued FIRST so the random-row DMAs fly while the vector core runs
+  # the segmented scan below: the read latency hides behind compute
+  # instead of serializing after it.
+  def read_row(k, cnt):
+    def do(c):
+      rid = jnp.clip(sid_smem[k, 0], 0, num_rows - 1)
+      pltpu.make_async_copy(table_ref.at[pl.ds(rid, 1)],
+                            tbuf.at[pl.ds(k, 1)], rsem).start()
+      if has_acc:
+        pltpu.make_async_copy(acc_ref.at[pl.ds(rid, 1)],
+                              abuf.at[pl.ds(k, 1)], rsem).start()
+      return c + 1
+
+    return jax.lax.cond(
+        (islast_smem[k, 0] == 1) & (sid_smem[k, 0] < num_rows), do,
+        lambda c: c, cnt)
+
+  nval = jax.lax.fori_loop(0, tile, read_row, 0)
+
+  # ----- vector side: segmented totals (reads in flight) ---------------
   sid_col = sid_vmem[:]                                 # [tile, 1] int32
   prev = jnp.concatenate(
       [jnp.full((1, 1), -2, jnp.int32), sid_col[:-1]], axis=0)
@@ -150,23 +170,6 @@ def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, slot_vmem, g_ref,
       [payload[0:1] + cont * carry_row, payload[1:]], axis=0)
   seg = _seg_scan(inject, starts)                       # [tile, w|2w]
   tot = seg[:, :width]
-
-  # ----- scalar walk 1: burst-read rows at segment-last positions ------
-  def read_row(k, cnt):
-    def do(c):
-      rid = jnp.clip(sid_smem[k, 0], 0, num_rows - 1)
-      pltpu.make_async_copy(table_ref.at[pl.ds(rid, 1)],
-                            tbuf.at[pl.ds(k, 1)], rsem).start()
-      if has_acc:
-        pltpu.make_async_copy(acc_ref.at[pl.ds(rid, 1)],
-                              abuf.at[pl.ds(k, 1)], rsem).start()
-      return c + 1
-
-    return jax.lax.cond(
-        (islast_smem[k, 0] == 1) & (sid_smem[k, 0] < num_rows), do,
-        lambda c: c, cnt)
-
-  nval = jax.lax.fori_loop(0, tile, read_row, 0)
 
   def wait_read(k, _):
     pltpu.make_async_copy(table_ref.at[pl.ds(0, 1)],

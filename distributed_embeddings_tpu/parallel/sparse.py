@@ -787,6 +787,37 @@ def make_hybrid_train_step(dist: DistributedEmbedding,
   return run
 
 
+def _calibration_mirror(dist: DistributedEmbedding, cpus):
+  """A CPU flat-mesh twin of ``dist``'s plan plus zero-valued params.
+
+  The plan is deterministic in (configs, world_size, strategy,
+  thresholds, input map), so the mirror routes ids identically to the
+  real mesh — including for two-axis dists, where the flat mirror over
+  the INNER world size sees the full batch exactly like the post-gather
+  union stream the apply consumes.  Parameter VALUES don't affect the
+  routing, so zeros suffice.
+  """
+  import numpy as np
+  from distributed_embeddings_tpu.parallel.mesh import create_mesh
+  mirror = DistributedEmbedding(
+      dist.table_configs,
+      strategy=dist.plan.strategy,
+      column_slice_threshold=dist.plan.column_slice_threshold,
+      row_slice=dist.plan.row_slice_threshold,
+      dp_input=dist.dp_input,
+      input_table_map=dist.plan.input_table_map,
+      mesh=create_mesh(cpus[:dist.world_size], axis_name=dist.axis_name),
+      axis_name=dist.axis_name,
+      param_dtype=dist.param_dtype,
+      compute_dtype=dist.compute_dtype)
+  zeros = {
+      f'group_{gi}': np.zeros((dist.world_size, g.rows_cap, g.width),
+                              dist.param_dtype)
+      for gi, g in enumerate(mirror.plan.groups)
+  }
+  return mirror, zeros
+
+
 def calibrate_capacity_rows(dist: DistributedEmbedding, cats,
                             margin: float = 1.3,
                             params=None,
@@ -850,24 +881,7 @@ def calibrate_capacity_rows(dist: DistributedEmbedding, cats,
           len(cpus), dist.world_size,
           dist.mesh.devices.ravel()[0].platform, dist.world_size)
     else:
-      from distributed_embeddings_tpu.parallel.mesh import create_mesh
-      mirror = DistributedEmbedding(
-          dist.table_configs,
-          strategy=dist.plan.strategy,
-          column_slice_threshold=dist.plan.column_slice_threshold,
-          row_slice=dist.plan.row_slice_threshold,
-          dp_input=dist.dp_input,
-          input_table_map=dist.plan.input_table_map,
-          mesh=create_mesh(cpus[:dist.world_size],
-                           axis_name=dist.axis_name),
-          axis_name=dist.axis_name,
-          param_dtype=dist.param_dtype,
-          compute_dtype=dist.compute_dtype)
-      zeros = {
-          f'group_{gi}': np.zeros((dist.world_size, g.rows_cap, g.width),
-                                  dist.param_dtype)
-          for gi, g in enumerate(mirror.plan.groups)
-      }
+      mirror, zeros = _calibration_mirror(dist, cpus)
 
       def to_host(x):
         if isinstance(x, RaggedBatch):
