@@ -42,15 +42,16 @@ Reference analog: the CUDA backward's sort->segment-reduce feeding
 the optimizer itself because TPU scatters are scalar-issued rather than
 atomic-parallel.
 
-Hazard discipline (v1, deliberately simple): each grid step issues its
-RMW reads as one async burst, waits, updates in VMEM, issues the write
-burst, and drains it before the step ends — so no writes are in flight
-across grid steps and the single staging buffer pair is trivially safe.
-Sorted unique segment-lasts mean no two steps ever touch the same row
-anyway; the cross-step write/read overlap that `ops/pallas_rowwise.py`
-adds with parity buffers is a latency optimization (~one DMA round trip
-per tile, ~5-10 ms over a 3M-row stream) left for a v2 once hardware
-numbers exist.  Like that kernel this one is OPT-IN
+Hazard discipline: reads are issued first and land while the vector
+core runs the segmented scan (latency hidden behind compute); writes
+are issued at tile end and stay in flight through the NEXT tile's
+reads/compute, draining only when their parity's staging buffers are
+about to be reused two steps later (`ops/pallas_rowwise.py`'s parity
+protocol, with the per-tile in-flight count carried in SMEM because
+the valid-row count here is data-dependent).  This is safe because
+each unique row is touched at exactly one grid step (its segment-last
+position in the sorted stream), so in-flight writes can never alias a
+later step's reads.  Like the rowwise kernel this one is OPT-IN
 (``use_segwalk_apply=True``) until measured on chip.
 """
 
@@ -70,9 +71,9 @@ FORCE_INTERPRET = False
 
 
 def _tile_rows(width: int) -> int:
-  """Stream rows per grid step: sized so the two [tile, width] f32
-  staging arrays plus the gradient block stay ~100-400 KiB of VMEM,
-  capped at 512 scalar-walk iterations."""
+  """Stream rows per grid step: sized so the parity pairs of
+  [tile, width] f32 staging arrays plus the gradient block stay under
+  ~1 MiB of VMEM, capped at 512 scalar-walk iterations."""
   return max(128, min(512, 32768 // width))
 
 
@@ -100,8 +101,8 @@ def _seg_scan(vals: jax.Array, starts: jax.Array) -> jax.Array:
 
 def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, slot_vmem, g_ref,
                     lr_smem, table_in, acc_in, table_ref, acc_ref, tbuf,
-                    abuf, carry, carry_id, rsem, wsem, *, num_rows, tile,
-                    width, gw, pack, op):
+                    abuf, carry, carry_id, wcount, rsem, wsem, *,
+                    num_rows, num_tiles, tile, width, gw, pack, op):
   """One [tile, gw] block of the sorted stream against [*, width] rows.
 
   ``op``: 'sgd' | 'adagrad_dedup' | 'adagrad_sq' (static).  ``carry``
@@ -121,11 +122,33 @@ def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, slot_vmem, g_ref,
   del table_in, acc_in  # same memory as the aliased output refs
   has_acc = op != 'sgd'
   t = pl.program_id(0)
+  p = jax.lax.rem(t, 2)
 
   @pl.when(t == 0)
   def _init():
     carry_id[0, 0] = -1
     carry[...] = jnp.zeros((2, width), jnp.float32)
+    wcount[0, 0] = 0
+    wcount[1, 0] = 0
+
+  def drain_writes(pp, count):
+    """Wait ``count`` write pairs issued on parity ``pp``."""
+    def w(k, _):
+      pltpu.make_async_copy(tbuf.at[pp, pl.ds(k, 1)],
+                            table_ref.at[pl.ds(0, 1)], wsem.at[pp]).wait()
+      if has_acc:
+        pltpu.make_async_copy(abuf.at[pp, pl.ds(k, 1)],
+                              acc_ref.at[pl.ds(0, 1)], wsem.at[pp]).wait()
+      return 0
+
+    jax.lax.fori_loop(0, count, w, 0)
+    return 0
+
+  # reuse of this parity's staging buffers: the writes issued two grid
+  # steps ago (same parity) must have landed — tile t-1's writes stay in
+  # flight through this tile's reads/compute (rows are globally unique,
+  # so no read below can touch a row still being written)
+  drain_writes(p, wcount[p, 0])
 
   # ----- scalar walk 1: burst-read rows at segment-last positions ------
   # Issued FIRST so the random-row DMAs fly while the vector core runs
@@ -135,10 +158,10 @@ def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, slot_vmem, g_ref,
     def do(c):
       rid = jnp.clip(sid_smem[k, 0], 0, num_rows - 1)
       pltpu.make_async_copy(table_ref.at[pl.ds(rid, 1)],
-                            tbuf.at[pl.ds(k, 1)], rsem).start()
+                            tbuf.at[p, pl.ds(k, 1)], rsem).start()
       if has_acc:
         pltpu.make_async_copy(acc_ref.at[pl.ds(rid, 1)],
-                              abuf.at[pl.ds(k, 1)], rsem).start()
+                              abuf.at[p, pl.ds(k, 1)], rsem).start()
       return c + 1
 
     return jax.lax.cond(
@@ -173,10 +196,10 @@ def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, slot_vmem, g_ref,
 
   def wait_read(k, _):
     pltpu.make_async_copy(table_ref.at[pl.ds(0, 1)],
-                          tbuf.at[pl.ds(k, 1)], rsem).wait()
+                          tbuf.at[p, pl.ds(k, 1)], rsem).wait()
     if has_acc:
       pltpu.make_async_copy(acc_ref.at[pl.ds(0, 1)],
-                            abuf.at[pl.ds(k, 1)], rsem).wait()
+                            abuf.at[p, pl.ds(k, 1)], rsem).wait()
     return 0
 
   jax.lax.fori_loop(0, nval, wait_read, 0)
@@ -184,13 +207,13 @@ def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, slot_vmem, g_ref,
   # ----- vector update (garbage at non-last rows is never written) -----
   lr = lr_smem[0, 0]
   if op == 'sgd':
-    tbuf[...] = tbuf[...] - lr * tot
+    tbuf[p] = tbuf[p] - lr * tot
   else:
     add = tot * tot if op == 'adagrad_dedup' else seg[:, width:]
-    acc_new = abuf[...] + add
+    acc_new = abuf[p] + add
     eps = lr_smem[0, 1]
-    tbuf[...] = tbuf[...] - lr * tot * jax.lax.rsqrt(acc_new + eps)
-    abuf[...] = acc_new
+    tbuf[p] = tbuf[p] - lr * tot * jax.lax.rsqrt(acc_new + eps)
+    abuf[p] = acc_new
 
   # ----- update carries (AFTER the scan consumed the old values) -------
   if op == 'adagrad_sq':
@@ -199,15 +222,16 @@ def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, slot_vmem, g_ref,
     carry[0:1] = seg[tile - 1:tile]
   carry_id[0, 0] = sid_smem[tile - 1, 0]
 
-  # ----- scalar walk 2: burst-write, then drain before the step ends ---
+  # ----- scalar walk 2: issue writes; they stay in flight through the
+  # NEXT tile's reads/compute and drain when this parity comes up again
   def write_row(k, _):
     def do(_):
       rid = jnp.clip(sid_smem[k, 0], 0, num_rows - 1)
-      pltpu.make_async_copy(tbuf.at[pl.ds(k, 1)],
-                            table_ref.at[pl.ds(rid, 1)], wsem).start()
+      pltpu.make_async_copy(tbuf.at[p, pl.ds(k, 1)],
+                            table_ref.at[pl.ds(rid, 1)], wsem.at[p]).start()
       if has_acc:
-        pltpu.make_async_copy(abuf.at[pl.ds(k, 1)],
-                              acc_ref.at[pl.ds(rid, 1)], wsem).start()
+        pltpu.make_async_copy(abuf.at[p, pl.ds(k, 1)],
+                              acc_ref.at[pl.ds(rid, 1)], wsem.at[p]).start()
       return 0
 
     jax.lax.cond(
@@ -216,16 +240,14 @@ def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, slot_vmem, g_ref,
     return 0
 
   jax.lax.fori_loop(0, tile, write_row, 0)
+  wcount[p, 0] = nval
 
-  def wait_write(k, _):
-    pltpu.make_async_copy(tbuf.at[pl.ds(k, 1)],
-                          table_ref.at[pl.ds(0, 1)], wsem).wait()
-    if has_acc:
-      pltpu.make_async_copy(abuf.at[pl.ds(k, 1)],
-                            acc_ref.at[pl.ds(0, 1)], wsem).wait()
-    return 0
-
-  jax.lax.fori_loop(0, nval, wait_write, 0)
+  # last grid step: nothing runs after the kernel — drain everything
+  # still in flight (the other parity's tile t-1 writes, then our own)
+  @pl.when(t == num_tiles - 1)
+  def _drain_all():
+    drain_writes(1 - p, wcount[1 - p, 0])
+    drain_writes(p, nval)
 
 
 def packed_ids(ids: jax.Array, pack: int, rows: int):
@@ -322,6 +344,7 @@ def segwalk_apply(table: jax.Array,
 
   kernel = functools.partial(_segwalk_kernel,
                              num_rows=prows,
+                             num_tiles=num_tiles,
                              tile=tile,
                              width=kw,
                              gw=w,
@@ -355,12 +378,13 @@ def segwalk_apply(table: jax.Array,
       ],
       input_output_aliases={6: 0, 7: 1},
       scratch_shapes=[
-          pltpu.VMEM((tile, kw), jnp.float32),     # tbuf
-          pltpu.VMEM((tile, kw), jnp.float32),     # abuf
+          pltpu.VMEM((2, tile, kw), jnp.float32),  # tbuf (parity pair)
+          pltpu.VMEM((2, tile, kw), jnp.float32),  # abuf (parity pair)
           pltpu.VMEM((2, kw), jnp.float32),        # carry (sum, sum_sq)
           pltpu.SMEM((1, 1), jnp.int32),           # carry id
+          pltpu.SMEM((2, 1), jnp.int32),           # in-flight write counts
           pltpu.SemaphoreType.DMA,                 # read semaphore
-          pltpu.SemaphoreType.DMA,                 # write semaphore
+          pltpu.SemaphoreType.DMA((2,)),           # write semaphores
       ],
       compiler_params=pltpu.CompilerParams(
           dimension_semantics=('arbitrary',)),
