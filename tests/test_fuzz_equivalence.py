@@ -171,14 +171,24 @@ def test_fuzz_sparse_train_step(seed):
     h = jnp.concatenate(list(emb_outs), axis=-1)
     return jnp.mean((h @ dense_params['kernel'] - b)**2)
 
-  opt = SparseSGD(learning_rate=lr)
-  step = make_hybrid_train_step(dist, head_loss_fn, optax.sgd(lr), opt,
-                                donate=False)
-  state = init_hybrid_train_state(dist, {
-      'embedding': set_weights(dist, weights),
-      'kernel': kernel
-  }, optax.sgd(lr), opt)
-  state, loss = step(state, [jnp.asarray(x) for x in ids], labels)
+  # sometimes route the apply through the segment-walk kernel (interpret
+  # hook): the randomized layouts/streams then exercise its packed and
+  # natural paths against the same dense oracle
+  use_segwalk = bool(rng.random() < 0.4)
+  from distributed_embeddings_tpu.ops import pallas_segwalk
+  opt = SparseSGD(learning_rate=lr, use_segwalk_apply=use_segwalk)
+  if use_segwalk:
+    pallas_segwalk.FORCE_INTERPRET = True
+  try:
+    step = make_hybrid_train_step(dist, head_loss_fn, optax.sgd(lr), opt,
+                                  donate=False)
+    state = init_hybrid_train_state(dist, {
+        'embedding': set_weights(dist, weights),
+        'kernel': kernel
+    }, optax.sgd(lr), opt)
+    state, loss = step(state, [jnp.asarray(x) for x in ids], labels)
+  finally:
+    pallas_segwalk.FORCE_INTERPRET = False
   assert np.isfinite(float(loss))
   got = get_weights(dist, state.params['embedding'])
 
