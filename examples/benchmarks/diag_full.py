@@ -19,6 +19,8 @@ def main():
   p.add_argument('--model', default='tiny')
   p.add_argument('--trace', default='')
   p.add_argument('--param_dtype', default='float32')
+  p.add_argument('--fused_apply', action='store_true')
+  p.add_argument('--segwalk_apply', action='store_true')
   args = p.parse_args()
 
   import jax
@@ -55,7 +57,9 @@ def main():
                            labels)
 
   opt = optax.adagrad(0.01, initial_accumulator_value=0.1, eps=1e-7)
-  emb_opt = SparseAdagrad(learning_rate=0.01)
+  emb_opt = SparseAdagrad(learning_rate=0.01,
+                          use_pallas_apply=args.fused_apply,
+                          use_segwalk_apply=args.segwalk_apply)
   step = make_hybrid_train_step(dist, head_loss_fn, opt, emb_opt, jit=False)
 
   def run(st):

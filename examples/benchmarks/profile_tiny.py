@@ -26,6 +26,8 @@ def main():
   p.add_argument('--model', default='tiny')
   p.add_argument('--batch', type=int, default=65536)
   p.add_argument('--steps', type=int, default=5)
+  p.add_argument('--fused_apply', action='store_true')
+  p.add_argument('--segwalk_apply', action='store_true')
   args = p.parse_args()
 
   import jax
@@ -63,7 +65,9 @@ def main():
                            labels)
 
   opt = optax.adagrad(0.01, initial_accumulator_value=0.1, eps=1e-7)
-  emb_opt = SparseAdagrad(learning_rate=0.01)
+  emb_opt = SparseAdagrad(learning_rate=0.01,
+                          use_pallas_apply=args.fused_apply,
+                          use_segwalk_apply=args.segwalk_apply)
 
   if args.phase == 'fwd':
     def run(ep):
