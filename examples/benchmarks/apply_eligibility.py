@@ -1,0 +1,35 @@
+"""Shared eligibility report for the fused sparse-apply kernels.
+
+An A/B run that silently measures the XLA fallback (off-TPU, bf16
+tables, unsupported widths) reads as "the kernel is no faster" —
+`bench.py` embeds this check in its artifact line for exactly that
+reason; the diagnostic harnesses print it via this helper.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def eligibility_line(dist, param_dtype, fused_apply: bool,
+                     segwalk_apply: bool) -> str:
+  """One human-readable line saying which groups each requested fused
+  kernel would actually serve (empty string when neither is on)."""
+  parts = []
+  dt = jnp.dtype(param_dtype)
+  groups = dist.plan.groups
+  backend = jax.default_backend()
+  suffix = '' if backend == 'tpu' else f', inactive on {backend}'
+  if fused_apply:
+    from distributed_embeddings_tpu.ops import pallas_rowwise
+    ok = sum(1 for g in groups if pallas_rowwise.supported(
+        jax.ShapeDtypeStruct((8, g.width), dt),
+        jax.ShapeDtypeStruct((8, g.width), jnp.float32)))
+    parts.append(f'fused_apply: {ok}/{len(groups)} groups eligible'
+                 f'{suffix}')
+  if segwalk_apply:
+    from distributed_embeddings_tpu.ops import pallas_segwalk
+    ok = sum(1 for g in groups if pallas_segwalk.supported(
+        jax.ShapeDtypeStruct((8, g.width), dt)))
+    parts.append(f'segwalk_apply: {ok}/{len(groups)} groups eligible'
+                 f'{suffix}')
+  return '; '.join(parts)

@@ -9,6 +9,8 @@ Phases isolate the three candidate bottlenecks of the sparse trainer
   dense    - autodiff + optax dense-grad step (O(vocab) updates)
 
 Usage: python examples/benchmarks/profile_tiny.py --phase fwd [--model tiny]
+       [--fused_apply | --segwalk_apply]   (only --phase full runs the
+                                            sparse apply these select)
 """
 
 import argparse
@@ -29,6 +31,9 @@ def main():
   p.add_argument('--fused_apply', action='store_true')
   p.add_argument('--segwalk_apply', action='store_true')
   args = p.parse_args()
+  if (args.fused_apply or args.segwalk_apply) and args.phase != 'full':
+    p.error('--fused_apply/--segwalk_apply only affect --phase full '
+            '(the other phases never run the sparse apply)')
 
   import jax
   if os.environ.get('JAX_PLATFORMS') == 'cpu':
@@ -68,6 +73,10 @@ def main():
   emb_opt = SparseAdagrad(learning_rate=0.01,
                           use_pallas_apply=args.fused_apply,
                           use_segwalk_apply=args.segwalk_apply)
+  if args.fused_apply or args.segwalk_apply:
+    from apply_eligibility import eligibility_line
+    print(eligibility_line(dist, 'float32', args.fused_apply,
+                           args.segwalk_apply))
 
   if args.phase == 'fwd':
     def run(ep):
