@@ -196,11 +196,20 @@ def main():
   optimizer = optax.adagrad(0.01, initial_accumulator_value=0.1, eps=1e-7)
   capacity_rows = None
   if args.auto_capacity and args.trainer == 'sparse':
-    from distributed_embeddings_tpu.parallel import calibrate_capacity_rows
-    (_, cats0), _ = gen.pool[0]
-    capacity_rows = calibrate_capacity_rows(model.dist_embedding,
-                                            [jnp.asarray(c) for c in cats0],
-                                            params=params['embedding'])
+    segwalk_all = False
+    if args.segwalk_apply:
+      # the segment-walk kernel has no compaction capacities: when it
+      # serves every group on THIS backend, calibration is dead work
+      from distributed_embeddings_tpu.utils.apply_eligibility import (
+          segwalk_serves_all_groups)
+      segwalk_all = segwalk_serves_all_groups(model.dist_embedding,
+                                              args.param_dtype)
+    if not segwalk_all:
+      from distributed_embeddings_tpu.parallel import calibrate_capacity_rows
+      (_, cats0), _ = gen.pool[0]
+      capacity_rows = calibrate_capacity_rows(
+          model.dist_embedding, [jnp.asarray(c) for c in cats0],
+          params=params['embedding'])
   emb_opt = SparseAdagrad(learning_rate=0.01,
                           capacity_fraction=args.capacity_fraction,
                           capacity_rows=capacity_rows,
@@ -274,29 +283,14 @@ def main():
     # a shape proxy, not the Criteo-1TB vocabularies.
     metric += (f' [throughput {args.batch_size / (step_ms / 1000) / 1e6:.3f}'
                f'M samples/s; reference DLRM 8xA100 TF32: 9.158M]')
-  def eligibility_note(flag_name, is_supported):
-    # per-group static eligibility for a fused Pallas apply (the
-    # runtime guard in parallel/sparse.py can still decline at trace
-    # time); without this note an A/B run can silently measure the XLA
-    # path and read as "kernel is no faster".  Asks the kernel's own
-    # supported() on the group's row signature (single source of truth).
-    groups = model.dist_embedding.plan.groups
-    ok = sum(1 for g in groups if is_supported(g))
-    return (f' [{flag_name}: {ok}/{len(groups)} groups eligible'
-            f'{"" if backend == "tpu" else ", inactive off-TPU"}]')
-
-  dt = jnp.dtype(args.param_dtype)
-  if args.fused_apply and args.trainer == 'sparse':
-    from distributed_embeddings_tpu.ops import pallas_rowwise
-    metric += eligibility_note(
-        'fused_apply', lambda g: pallas_rowwise.supported(
-            jax.ShapeDtypeStruct((8, g.width), dt),
-            jax.ShapeDtypeStruct((8, g.width), jnp.float32)))
-  if args.segwalk_apply and args.trainer == 'sparse':
-    from distributed_embeddings_tpu.ops import pallas_segwalk
-    metric += eligibility_note(
-        'segwalk_apply', lambda g: pallas_segwalk.supported(
-            jax.ShapeDtypeStruct((8, g.width), dt)))
+  if (args.fused_apply or args.segwalk_apply) and args.trainer == 'sparse':
+    # without this note an A/B run can silently measure the XLA
+    # fallback and read as "kernel is no faster"
+    from distributed_embeddings_tpu.utils.apply_eligibility import (
+        eligibility_line)
+    metric += ' [' + eligibility_line(model.dist_embedding,
+                                      args.param_dtype, args.fused_apply,
+                                      args.segwalk_apply) + ']'
   emit({
       'metric': metric,
       'value': round(step_ms, 3),

@@ -1,0 +1,60 @@
+"""Eligibility report for the fused sparse-apply kernels.
+
+An A/B run that silently measures the XLA fallback (wrong backend, bf16
+tables, unsupported widths) reads as "the kernel is no faster" —
+`bench.py` embeds this check in its artifact line and the diagnostic
+harnesses print it, all through this single helper so the semantics
+cannot drift between them.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _active_suffix(force_interpret: bool) -> str:
+  backend = jax.default_backend()
+  if backend == 'tpu':
+    return ''
+  if force_interpret:
+    return ' (interpret mode)'
+  return f', inactive on {backend}'
+
+
+def eligibility_line(dist, param_dtype, fused_apply: bool,
+                     segwalk_apply: bool) -> str:
+  """One line saying which fusion groups each requested fused kernel
+  would actually serve, and whether it engages on this backend at all
+  (empty string when neither kernel is requested)."""
+  parts = []
+  dt = jnp.dtype(param_dtype)
+  groups = dist.plan.groups
+  if fused_apply:
+    from distributed_embeddings_tpu.ops import pallas_rowwise
+    ok = sum(1 for g in groups if pallas_rowwise.supported(
+        jax.ShapeDtypeStruct((8, g.width), dt),
+        jax.ShapeDtypeStruct((8, g.width), jnp.float32)))
+    parts.append(f'fused_apply: {ok}/{len(groups)} groups eligible'
+                 f'{_active_suffix(pallas_rowwise.FORCE_INTERPRET)}')
+  if segwalk_apply:
+    from distributed_embeddings_tpu.ops import pallas_segwalk
+    ok = sum(1 for g in groups if pallas_segwalk.supported(
+        jax.ShapeDtypeStruct((8, g.width), dt)))
+    parts.append(f'segwalk_apply: {ok}/{len(groups)} groups eligible'
+                 f'{_active_suffix(pallas_segwalk.FORCE_INTERPRET)}')
+  return '; '.join(parts)
+
+
+def segwalk_serves_all_groups(dist, param_dtype) -> bool:
+  """True when the segment-walk kernel will handle EVERY fusion group on
+  the active backend — in which case compaction capacities are dead
+  weight (the kernel has none)."""
+  from distributed_embeddings_tpu.ops import pallas_segwalk
+  if not (jax.default_backend() == 'tpu'
+          or pallas_segwalk.FORCE_INTERPRET):
+    return False
+  dt = jnp.dtype(param_dtype)
+  return all(
+      pallas_segwalk.supported(jax.ShapeDtypeStruct((8, g.width), dt))
+      for g in dist.plan.groups)

@@ -61,7 +61,8 @@ def main():
                           use_pallas_apply=args.fused_apply,
                           use_segwalk_apply=args.segwalk_apply)
   if args.fused_apply or args.segwalk_apply:
-    from apply_eligibility import eligibility_line
+    from distributed_embeddings_tpu.utils.apply_eligibility import (
+        eligibility_line)
     print(eligibility_line(dist, args.param_dtype, args.fused_apply,
                            args.segwalk_apply))
   step = make_hybrid_train_step(dist, head_loss_fn, opt, emb_opt, jit=False)
