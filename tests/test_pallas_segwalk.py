@@ -174,8 +174,11 @@ def test_integration_through_hybrid_step_interpreted(opt_kind):
           'embedding': params,
           'kernel': kernel
       }, optax.sgd(0.1), opt)
-      state, loss = step(state, inputs, labels)
-      assert np.isfinite(float(loss))
+      # several steps: catches state threading / accumulator carry
+      # issues between calls, not just single-step math
+      for _ in range(3):
+        state, loss = step(state, inputs, labels)
+        assert np.isfinite(float(loss))
       results[fused] = [
           np.asarray(t)
           for t in get_weights(dist, state.params['embedding'])
