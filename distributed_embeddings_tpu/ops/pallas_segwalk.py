@@ -376,6 +376,9 @@ def segwalk_apply(table: jax.Array,
           jax.ShapeDtypeStruct(table_k.shape, table_k.dtype),
           jax.ShapeDtypeStruct(acc_operand.shape, acc_operand.dtype),
       ],
+      # REQUIRED for correctness, not just memory: rows the kernel never
+      # touches must retain their input values, which only the aliased
+      # output buffer provides
       input_output_aliases={6: 0, 7: 1},
       scratch_shapes=[
           pltpu.VMEM((2, tile, kw), jnp.float32),  # tbuf (parity pair)
