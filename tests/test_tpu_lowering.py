@@ -1,0 +1,93 @@
+"""TPU-target lowering gate, runnable WITHOUT TPU hardware.
+
+``jax.export(platforms=['tpu'])`` runs the full JAX->StableHLO->Mosaic
+MLIR pipeline for the TPU backend on any host, so kernel constructions
+that the Mosaic lowering rejects (layouts, unsupported ops, shape
+casts — see the hard-won constraint list in ops/pallas_lookup.py) fail
+HERE in CI instead of on the first healthy chip.  The later
+Mosaic->hardware compile stage can still reject on-device (covered by
+tests/test_pallas_tpu.py); this gate removes the cheapest failure
+class.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import export
+
+from distributed_embeddings_tpu.ops import (pallas_lookup, pallas_rowwise,
+                                            pallas_segwalk)
+
+
+def _lower_tpu(fn, *args):
+  exp = export.export(jax.jit(fn), platforms=['tpu'])(*args)
+  assert len(exp.mlir_module_serialized) > 0
+
+
+@pytest.mark.parametrize('op', ['sgd', 'adagrad_dedup', 'adagrad_sq'])
+@pytest.mark.parametrize('w', [8, 16, 32, 64, 128])
+def test_segwalk_lowers_for_tpu(op, w):
+  rows, n = 1024, 2048  # rows divisible by every pack factor: packed path
+
+  def fn(table, acc, sid, sg):
+    if op == 'sgd':
+      return pallas_segwalk.segwalk_apply(table, None, sid, sg, 0.01,
+                                          op=op, eps=1e-7)
+    return pallas_segwalk.segwalk_apply(table, acc, sid, sg, 0.01,
+                                        op=op, eps=1e-7)
+
+  _lower_tpu(fn,
+             jax.ShapeDtypeStruct((rows, w), jnp.float32),
+             jax.ShapeDtypeStruct((rows, w), jnp.float32),
+             jax.ShapeDtypeStruct((n,), jnp.int32),
+             jax.ShapeDtypeStruct((n, w), jnp.float32))
+
+
+def test_segwalk_natural_narrow_lowers_for_tpu():
+  # rows NOT divisible by the pack factor: the natural-width path
+  rows, w, n = 1021, 16, 512
+
+  def fn(table, acc, sid, sg):
+    return pallas_segwalk.segwalk_apply(table, acc, sid, sg, 0.01,
+                                        op='adagrad_dedup', eps=1e-7)
+
+  _lower_tpu(fn,
+             jax.ShapeDtypeStruct((rows, w), jnp.float32),
+             jax.ShapeDtypeStruct((rows, w), jnp.float32),
+             jax.ShapeDtypeStruct((n,), jnp.int32),
+             jax.ShapeDtypeStruct((n, w), jnp.float32))
+
+
+@pytest.mark.parametrize('dedup', [True, False])
+@pytest.mark.parametrize('w', [8, 16, 32, 64, 128])
+def test_rowwise_apply_lowers_for_tpu(w, dedup):
+  rows, c = 4096, 512
+
+  def fn(table, acc, uids, g, sq):
+    return pallas_rowwise.adagrad_apply(table, acc, uids, g,
+                                        None if dedup else sq, 0.01,
+                                        dedup=dedup, eps=1e-7)
+
+  _lower_tpu(fn,
+             jax.ShapeDtypeStruct((rows, w), jnp.float32),
+             jax.ShapeDtypeStruct((rows, w), jnp.float32),
+             jax.ShapeDtypeStruct((c,), jnp.int32),
+             jax.ShapeDtypeStruct((c, w), jnp.float32),
+             jax.ShapeDtypeStruct((c, w), jnp.float32))
+
+
+@pytest.mark.parametrize('w,dtype', [(8, jnp.float32), (16, jnp.float32),
+                                     (128, jnp.float32), (256, jnp.float32),
+                                     (16, jnp.bfloat16), (128, jnp.bfloat16)])
+def test_lookup_lowers_for_tpu(w, dtype):
+  vocab, m, h = 4096, 256, 4
+
+  def fn(table, ids):
+    return pallas_lookup.dense_lookup(table, ids, 'sum',
+                                      out_dtype=jnp.float32)
+
+  _lower_tpu(fn,
+             jax.ShapeDtypeStruct((vocab, w), dtype),
+             jax.ShapeDtypeStruct((m, h), jnp.int32))
