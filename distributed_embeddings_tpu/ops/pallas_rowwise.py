@@ -12,12 +12,13 @@ floor instead of three serialized scatter passes.  OPT-IN
 (`SparseAdagrad(use_pallas_apply=True)`) until hardware measurement
 confirms the win; the XLA path stays the default.
 
-Supported row widths: 128 (native lane count) and any narrow width
-dividing 128 with at least 8 lanes (8/16/32/64) — the big fused groups
-of the synthetic benchmarks are width 8-16 and too tall to lane-pack,
-so the kernel must take them at natural width (narrow rows waste VPU
-lanes, but the math is trivial; the cost is DMA issue).  f32 tables
-only: bf16 single-sublane HBM slices are rejected by Mosaic (see
+Supported row width: 128 (the native lane count) ONLY.  Narrow tables
+reach the kernel through the producer's lane-packed
+``[rows/pack, 128]`` view (`parallel/sparse.py:_lane_pack`); the
+natural narrow-width variant originally planned here cannot compile on
+v5e — Mosaic rejects sub-128-lane VMEM slices — which the
+tests/test_tpu_lowering.py compile gate proves without hardware.  f32
+tables only: bf16 single-sublane HBM slices are rejected by Mosaic (see
 ops/pallas_lookup.py), and the bf16 pair-fetch trick is unsafe here
 because WRITING a fetched pair back would race a neighbouring unique
 row's read-modify-write in another grid step.
@@ -150,12 +151,17 @@ def _adagrad_kernel(count_smem, ids_smem, g_ref, sq_ref, lr_smem, table_in,
 
 
 def supported(table: jax.Array, acc: jax.Array) -> bool:
-  """Whether the fused apply path handles these arrays."""
-  if not (table.ndim == 2 and table.dtype == jnp.float32
-          and acc.shape == table.shape and acc.dtype == jnp.float32):
-    return False
-  w = table.shape[1]
-  return w == 128 or (8 <= w < 128 and 128 % w == 0)
+  """Whether the fused apply path handles these arrays: f32 at width
+  128 ONLY.  Narrow widths reach the kernel exclusively through the
+  producer's lane-packed ``[rows/pack, 128]`` view
+  (`parallel/sparse.py:_lane_pack`): the v5e Mosaic backend rejects
+  sub-128-lane VMEM slices ("Slice shape along dimension 2 must be
+  aligned to tiling (128)"), so the natural narrow-width variant this
+  function used to accept could never have compiled on hardware —
+  caught by tests/test_tpu_lowering.py."""
+  return (table.ndim == 2 and table.dtype == jnp.float32
+          and acc.shape == table.shape and acc.dtype == jnp.float32
+          and table.shape[1] == 128)
 
 
 @functools.partial(jax.jit,
@@ -170,7 +176,9 @@ def adagrad_apply(table: jax.Array,
                   dedup: bool,
                   eps: float,
                   interpret: bool = False):
-  """Fused in-place Adagrad step at unique rows (width 8/16/32/64/128).
+  """Fused in-place Adagrad step at unique rows (width 128 only; pack
+  narrow tables to a ``[rows/pack, 128]`` view first — see
+  ``supported``).
 
   Args:
     table/acc: ``[num_rows, w]`` f32 (donate for true in-place).

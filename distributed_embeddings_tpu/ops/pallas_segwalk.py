@@ -262,12 +262,23 @@ def packed_ids(ids: jax.Array, pack: int, rows: int):
 
 
 def supported(table: jax.Array) -> bool:
-  """f32 2-D tables at width 128 or a narrow width dividing 128 (>= 8),
-  mirroring ops/pallas_rowwise.py."""
+  """f32 2-D tables at width 128, or a narrow width dividing 128 whose
+  row count the packed view can absorb (``rows % (128 // w) == 0`` —
+  always true for the runtime's fused groups, whose ``rows_cap``
+  granularity guarantees it).
+
+  Narrow rows are served ONLY through the [rows/pack, 128] packed view:
+  the v5e Mosaic backend rejects sub-128-lane VMEM slices outright
+  ("Slice shape along dimension 2 must be aligned to tiling (128)"),
+  caught by tests/test_tpu_lowering.py — a natural narrow-width kernel
+  cannot compile on this hardware.
+  """
   if not (table.ndim == 2 and table.dtype == jnp.float32):
     return False
-  w = table.shape[1]
-  return w == 128 or (8 <= w < 128 and 128 % w == 0)
+  rows, w = table.shape
+  if w == 128:
+    return True
+  return 8 <= w < 128 and 128 % w == 0 and rows % (128 // w) == 0
 
 
 @functools.partial(jax.jit, static_argnames=('op', 'eps', 'interpret'))
@@ -306,7 +317,9 @@ def segwalk_apply(table: jax.Array,
   # burst serving up to `pack` original rows.  The id stream divides by
   # `pack` (merging adjacent uids into one packed segment) and each
   # row's original lane slot rides along for the in-kernel expansion.
-  pack = 128 // w if (w < 128 and num_rows % (128 // w) == 0) else 1
+  # supported() guarantees divisibility, so narrow widths ALWAYS pack
+  # (sub-128-lane VMEM slices do not compile on v5e, see supported()).
+  pack = 128 // w if w < 128 else 1
   kw = w * pack
   prows = num_rows // pack
   tile = _tile_rows(kw)

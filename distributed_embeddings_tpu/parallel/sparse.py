@@ -229,9 +229,9 @@ class SparseAdagrad:
   capacity_rows: Optional[Tuple[Optional[int], ...]] = None
   # opt-in fused Pallas apply (ops/pallas_rowwise.py): one DMA pass over
   # the unique rows instead of three XLA random passes; takes effect on
-  # TPU for f32 tables of width 128 or widths 8..64 dividing 128
-  # (natural-width or lane-packed), silently falling back to the XLA
-  # path elsewhere
+  # TPU for f32 tables at the 128-lane width — narrow widths engage it
+  # only through the lane-packed [rows/pack, 128] view (_lane_pack),
+  # silently falling back to the XLA path elsewhere
   use_pallas_apply: bool = False
   # opt-in fused segment-walk apply (ops/pallas_segwalk.py): consumes
   # the SORTED raw stream directly — segment-sum + update in one pass,

@@ -37,8 +37,7 @@ def make_case(rng, rows, c, valid, width=128):
                                            (True, False)])
 @pytest.mark.parametrize('rows,c,valid,width',
                          [(512, 128, 100, 128), (1000, 300, 256, 128),
-                          (64, 64, 64, 128), (5000, 300, 280, 16),
-                          (2000, 200, 150, 8), (777, 140, 130, 64)])
+                          (64, 64, 64, 128), (777, 140, 130, 128)])
 def test_matches_xla(rows, c, valid, width, dedup, with_sq):
   rng = np.random.default_rng(rows + c + valid)
   table, acc, uids, g, sq = make_case(rng, rows, c, valid, width)
@@ -68,18 +67,17 @@ def test_untouched_rows_unchanged():
 
 
 def test_unsupported_shapes_raise():
-  # widths 8..128 dividing 128 are supported; others are not
-  for w in (8, 16, 32, 64, 128):
-    arr = jnp.zeros((32, w), jnp.float32)
-    assert pallas_rowwise.supported(arr, arr)
-  t3 = jnp.zeros((32, 3), jnp.float32)
-  assert not pallas_rowwise.supported(t3, t3)       # sub-8 degenerate
-  t48 = jnp.zeros((32, 48), jnp.float32)
-  assert not pallas_rowwise.supported(t48, t48)     # does not divide 128
-  t256 = jnp.zeros((32, 256), jnp.float32)
-  assert not pallas_rowwise.supported(t256, t256)   # wide: XLA fallback
+  # width 128 ONLY: the v5e Mosaic backend rejects sub-128-lane VMEM
+  # slices (tests/test_tpu_lowering.py proved the narrow variant could
+  # never compile), so narrow tables must arrive lane-packed to 128
+  arr = jnp.zeros((32, 128), jnp.float32)
+  assert pallas_rowwise.supported(arr, arr)
+  for w in (3, 8, 16, 32, 48, 64, 256):
+    t = jnp.zeros((32, w), jnp.float32)
+    assert not pallas_rowwise.supported(t, t), w
   tb = jnp.zeros((32, 128), jnp.bfloat16)
   assert not pallas_rowwise.supported(tb, jnp.zeros((32, 128), jnp.float32))
+  t48 = jnp.zeros((32, 48), jnp.float32)
   with pytest.raises(ValueError, match='unsupported'):
     pallas_rowwise.adagrad_apply(t48, t48, jnp.zeros((8,), jnp.int32),
                                  jnp.zeros((8, 48)), None, 0.1,

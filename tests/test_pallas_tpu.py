@@ -72,12 +72,14 @@ def test_compiled_matches_oracle(w, dtype):
 
 
 @requires_tpu
-@pytest.mark.parametrize('w', [8, 16, 32, 64, 128])
+@pytest.mark.parametrize('w', [128])
 @pytest.mark.parametrize('dedup', [True, False])
 def test_rowwise_apply_compiled_matches_xla(w, dedup):
   """Fused row-wise Adagrad apply (ops/pallas_rowwise.py) compiled on
   the chip: the parity double-buffered DMA pipeline only exists on
-  hardware."""
+  hardware.  Width 128 only — narrow tables reach the kernel through
+  the producer's lane-packed view (sub-128-lane VMEM slices fail the
+  v5e compile, proven by tests/test_tpu_lowering.py)."""
   from distributed_embeddings_tpu.ops import pallas_rowwise
   rng = np.random.default_rng(2)
   rows, c, valid = 100_000, 4096, 3777
@@ -103,14 +105,15 @@ def test_rowwise_apply_compiled_matches_xla(w, dedup):
 
 
 @requires_tpu
-@pytest.mark.parametrize('w,c', [(16, 1 << 20), (128, 1 << 17)])
+@pytest.mark.parametrize('w,c', [(128, 1 << 17)])
 def test_rowwise_apply_microbench(w, c):
   """Fused apply vs the XLA gather+scatter-set+scatter-add formulation
-  at synthetic-tiny-like scale (1M unique rows of width 16 against a
-  70M-row table is the dominant per-step cost, docs/perf_notes.md)."""
+  at synthetic-tiny-like scale: [1M, 128] at 2^17 packed update rows is
+  exactly the lane-packed view of tiny's 8M-row width-16 big group
+  (the shape the production path feeds the kernel)."""
   from distributed_embeddings_tpu.ops import pallas_rowwise
   rng = np.random.default_rng(3)
-  rows = 8_000_000 if w == 16 else 1_000_000
+  rows = 1_000_000
   iters = 5
   table = jnp.zeros((rows, w), jnp.float32) + 0.5
   acc = jnp.ones((rows, w), jnp.float32)
