@@ -22,6 +22,20 @@ def _active_suffix(force_interpret: bool) -> str:
   return f', inactive on {backend}'
 
 
+def _group_table_aval(g, dt):
+  """The shape the KERNEL actually sees for this group: both kernels
+  are width-128-only at the kernel boundary, so narrow groups engage
+  through the lane-packed ``[rows_cap/pack, 128]`` view (the runtime's
+  ``_lane_pack`` for the rowwise apply, the in-kernel packed path for
+  the segment-walk) — the probe must mirror that or it misreports
+  exactly the fallback confusion it exists to prevent."""
+  w = g.width
+  if w < 128 and 128 % w == 0 and g.rows_cap % (128 // w) == 0:
+    pack = 128 // w
+    return jax.ShapeDtypeStruct((g.rows_cap // pack, 128), dt)
+  return jax.ShapeDtypeStruct((g.rows_cap, w), dt)
+
+
 def eligibility_line(dist, param_dtype, fused_apply: bool,
                      segwalk_apply: bool) -> str:
   """One line saying which fusion groups each requested fused kernel
@@ -33,14 +47,14 @@ def eligibility_line(dist, param_dtype, fused_apply: bool,
   if fused_apply:
     from distributed_embeddings_tpu.ops import pallas_rowwise
     ok = sum(1 for g in groups if pallas_rowwise.supported(
-        jax.ShapeDtypeStruct((8, g.width), dt),
-        jax.ShapeDtypeStruct((8, g.width), jnp.float32)))
+        _group_table_aval(g, dt),
+        _group_table_aval(g, jnp.float32)))
     parts.append(f'fused_apply: {ok}/{len(groups)} groups eligible'
                  f'{_active_suffix(pallas_rowwise.FORCE_INTERPRET)}')
   if segwalk_apply:
     from distributed_embeddings_tpu.ops import pallas_segwalk
     ok = sum(1 for g in groups if pallas_segwalk.supported(
-        jax.ShapeDtypeStruct((8, g.width), dt)))
+        jax.ShapeDtypeStruct((g.rows_cap, g.width), dt)))
     parts.append(f'segwalk_apply: {ok}/{len(groups)} groups eligible'
                  f'{_active_suffix(pallas_segwalk.FORCE_INTERPRET)}')
   return '; '.join(parts)
@@ -56,5 +70,6 @@ def segwalk_serves_all_groups(dist, param_dtype) -> bool:
     return False
   dt = jnp.dtype(param_dtype)
   return all(
-      pallas_segwalk.supported(jax.ShapeDtypeStruct((8, g.width), dt))
+      pallas_segwalk.supported(
+          jax.ShapeDtypeStruct((g.rows_cap, g.width), dt))
       for g in dist.plan.groups)

@@ -1,0 +1,115 @@
+"""Compile the FULL-SIZE synthetic train step for a v5e target — no chip.
+
+The locally installed libtpu runs the entire compile stack against an
+abstract topology (`jax.experimental.topologies`), so this validates
+that the full-scale program (real table sizes, global batch 65536)
+compiles for v5e and reports its REAL memory analysis (does it fit
+16 GiB HBM per chip?) without touching the tunnel.  Small-shape
+variants of the same check run in CI (tests/test_tpu_lowering.py);
+this script is the full-size version whose compile takes minutes.
+
+Usage: python examples/benchmarks/compile_check.py [--model tiny]
+       [--chips 4] [--batch 65536] [--segwalk_apply]
+
+NOTE: libtpu allows one topology user per host at a time
+(/tmp/libtpu_lockfile) — don't run concurrently with the
+test_tpu_lowering.py gate.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+
+def main():
+  p = argparse.ArgumentParser()
+  p.add_argument('--model', default='tiny')
+  p.add_argument('--chips', type=int, default=4)
+  p.add_argument('--batch', type=int, default=65536)
+  p.add_argument('--segwalk_apply', action='store_true')
+  p.add_argument('--topology', default='v5e:2x2',
+                 help='compile-only topology (chips must divide it)')
+  args = p.parse_args()
+
+  import jax
+  jax.config.update('jax_platforms', 'cpu')
+  import jax.numpy as jnp
+  import optax
+  from jax.experimental import topologies
+  from jax.sharding import NamedSharding, PartitionSpec as P
+  from distributed_embeddings_tpu.models.synthetic import (SYNTHETIC_MODELS,
+                                                           SyntheticModel,
+                                                           expand_tables)
+  from distributed_embeddings_tpu.models.dlrm import bce_with_logits
+  from distributed_embeddings_tpu.parallel import (SparseAdagrad,
+                                                   make_hybrid_train_step)
+  from distributed_embeddings_tpu.parallel.grad import TrainState
+
+  topo = topologies.get_topology_desc(args.topology, 'tpu')
+  mesh = topologies.make_mesh(topo, (args.chips,), ('data',))
+  config = SYNTHETIC_MODELS[args.model]
+  model = SyntheticModel(config, mesh=mesh, dp_input=True)
+  dist = model.dist_embedding
+  opt = SparseAdagrad(learning_rate=0.01,
+                      use_segwalk_apply=args.segwalk_apply)
+  dense_opt = optax.adagrad(0.01, initial_accumulator_value=0.1, eps=1e-7)
+
+  def head_loss_fn(dp, eo, b):
+    num, labels = b
+    return bce_with_logits(model.head(dp, num, eo), labels)
+
+  step = make_hybrid_train_step(dist, head_loss_fn, dense_opt, opt,
+                                donate=False, jit=False)
+  GB = args.batch
+  bsh = NamedSharding(mesh, P('data'))
+  rep = NamedSharding(mesh, P())
+  tsh = NamedSharding(mesh, P('data', None, None))
+
+  def sds(shape, dt, sh):
+    return jax.ShapeDtypeStruct(shape, dt, sharding=sh)
+
+  W = args.chips
+  emb = {
+      f'group_{gi}': sds((W, g.rows_cap, g.width), jnp.float32, tsh)
+      for gi, g in enumerate(dist.plan.groups)
+  }
+  acc = {
+      f'group_{gi}': {
+          'acc': sds((W, g.rows_cap, g.width), jnp.float32, tsh)
+      } for gi, g in enumerate(dist.plan.groups)
+  }
+  mlp_shapes = jax.eval_shape(
+      lambda k: model.mlp.init(k, model._mlp_input_dim), jax.random.key(0))
+  mlp = jax.tree.map(lambda x: sds(x.shape, x.dtype, rep), mlp_shapes)
+  dense_state_shapes = jax.eval_shape(
+      lambda m: dense_opt.init({'mlp': m}), mlp_shapes)
+  dense_state = jax.tree.map(lambda x: sds(x.shape, x.dtype, rep),
+                             dense_state_shapes)
+  state = TrainState(params={'embedding': emb, 'mlp': mlp},
+                     opt_state=(dense_state, acc),
+                     step=sds((), jnp.int32, rep))
+  _, _, hotness = expand_tables(config)
+  cats = [sds((GB, h) if h > 1 else (GB,), jnp.int32, bsh) for h in hotness]
+  num = sds((GB, config.num_numerical_features), jnp.float32, bsh)
+  labels = sds((GB, 1), jnp.float32, bsh)
+
+  t0 = time.time()
+  compiled = jax.jit(step).lower(state, cats, (num, labels)).compile()
+  print(f'{args.model} {args.chips}-chip v5e train step compiled in '
+        f'{time.time() - t0:.0f}s '
+        f'({"segwalk" if args.segwalk_apply else "xla"} apply)',
+        flush=True)
+  ma = compiled.memory_analysis()
+  if ma is not None:
+    for attr in ('temp_size_in_bytes', 'argument_size_in_bytes',
+                 'output_size_in_bytes', 'alias_size_in_bytes'):
+      v = getattr(ma, attr, None)
+      if v is not None:
+        print(f'  {attr}: {v / 2**30:.3f} GiB', flush=True)
+
+
+if __name__ == '__main__':
+  main()
