@@ -68,6 +68,12 @@ from jax.experimental.pallas import tpu as pltpu
 # Test hook, as in ops/pallas_rowwise.py: engage the kernel in
 # interpreter mode on any backend so CI exercises the real producers.
 FORCE_INTERPRET = False
+# AOT hook: compile-only flows (jax.experimental.topologies) trace on a
+# CPU default backend while targeting TPU, so the runtime's
+# backend-sniffing dispatch would silently select the XLA path; setting
+# this engages the REAL kernel (interpret=False) regardless of the
+# traced-on backend.  Used by compile_check.py / test_tpu_lowering.py.
+ASSUME_TPU = False
 
 
 def _tile_rows(width: int) -> int:

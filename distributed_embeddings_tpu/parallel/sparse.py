@@ -459,7 +459,10 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
   pack = 128 // w if (w < 128 and 128 % w == 0) else 1
   packable = (pack > 1 and rows_cap % pack == 0
               and getattr(optimizer, 'supports_lane_packing', False)
-              and rows_cap // pack + 2 < cap)
+              and rows_cap // pack + 2 < cap
+              # the packed table view risks a lane-padded param layout
+              # on huge narrow groups (see packed_dispatch_ok)
+              and packed_dispatch_ok(rows_cap, w))
 
   order = jnp.argsort(flat_ids) if cap < cap_safe else None
   if with_sq and flat_sq is not None:
@@ -516,6 +519,26 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
                       (t2, s2))
 
 
+# Ceiling on the POTENTIAL lane-padded parameter size a packed-view
+# apply may provoke.  Compile-only v5e validation (compile_check.py,
+# docs/perf_notes.md round 3) showed XLA can materialize a narrow
+# group's parameter in a lane-padded layout to serve the packed
+# reshape — 8x expansion on synthetic-tiny's 29.1M-row width-16 group
+# (1.73 -> 13.88 GiB), blowing HBM.  Until the layout is pinned, the
+# packed dispatch declines narrow groups whose padded form could
+# exceed this many bytes; width-128 groups reshape-free and unaffected.
+PACKED_PARAM_BYTES_LIMIT = 2 << 30
+
+
+def packed_dispatch_ok(rows_cap: int, width: int) -> bool:
+  """Whether a narrow group may take a packed-view fused apply without
+  risking the lane-padded-layout HBM blowup (width-128 groups always
+  may)."""
+  if width >= 128:
+    return True
+  return rows_cap * 128 * 4 <= PACKED_PARAM_BYTES_LIMIT
+
+
 def _use_segwalk(optimizer, table) -> bool:
   """Whether the fused segment-walk kernel serves this group's apply."""
   if not getattr(optimizer, 'use_segwalk_apply', False):
@@ -523,8 +546,11 @@ def _use_segwalk(optimizer, table) -> bool:
   from distributed_embeddings_tpu.ops import pallas_segwalk
   if not pallas_segwalk.supported(table):
     return False
+  if not packed_dispatch_ok(table.shape[0], table.shape[1]):
+    return False
   return (jax.default_backend() == 'tpu'
-          or pallas_segwalk.FORCE_INTERPRET)
+          or pallas_segwalk.FORCE_INTERPRET
+          or pallas_segwalk.ASSUME_TPU)
 
 
 def _segwalk_apply(optimizer, table, state, flat_ids, flat_g, lr):

@@ -13,13 +13,27 @@ import jax
 import jax.numpy as jnp
 
 
-def _active_suffix(force_interpret: bool) -> str:
+def _active_suffix(force_interpret: bool, assume_tpu: bool = False) -> str:
   backend = jax.default_backend()
   if backend == 'tpu':
     return ''
   if force_interpret:
     return ' (interpret mode)'
+  if assume_tpu:
+    return ' (AOT, assumed TPU)'
   return f', inactive on {backend}'
+
+
+def _segwalk_group_ok(g, dt) -> bool:
+  """The ONE predicate deciding whether the segment-walk kernel serves a
+  fusion group — shared by the report and the all-groups check so they
+  can never drift from each other (the dispatch in parallel/sparse.py
+  applies the same two gates)."""
+  from distributed_embeddings_tpu.ops import pallas_segwalk
+  from distributed_embeddings_tpu.parallel.sparse import packed_dispatch_ok
+  return (pallas_segwalk.supported(
+      jax.ShapeDtypeStruct((g.rows_cap, g.width), dt))
+          and packed_dispatch_ok(g.rows_cap, g.width))
 
 
 def _group_table_aval(g, dt):
@@ -53,10 +67,9 @@ def eligibility_line(dist, param_dtype, fused_apply: bool,
                  f'{_active_suffix(pallas_rowwise.FORCE_INTERPRET)}')
   if segwalk_apply:
     from distributed_embeddings_tpu.ops import pallas_segwalk
-    ok = sum(1 for g in groups if pallas_segwalk.supported(
-        jax.ShapeDtypeStruct((g.rows_cap, g.width), dt)))
+    ok = sum(1 for g in groups if _segwalk_group_ok(g, dt))
     parts.append(f'segwalk_apply: {ok}/{len(groups)} groups eligible'
-                 f'{_active_suffix(pallas_segwalk.FORCE_INTERPRET)}')
+                 f'{_active_suffix(pallas_segwalk.FORCE_INTERPRET, pallas_segwalk.ASSUME_TPU)}')
   return '; '.join(parts)
 
 
@@ -66,10 +79,8 @@ def segwalk_serves_all_groups(dist, param_dtype) -> bool:
   weight (the kernel has none)."""
   from distributed_embeddings_tpu.ops import pallas_segwalk
   if not (jax.default_backend() == 'tpu'
-          or pallas_segwalk.FORCE_INTERPRET):
+          or pallas_segwalk.FORCE_INTERPRET
+          or pallas_segwalk.ASSUME_TPU):
     return False
   dt = jnp.dtype(param_dtype)
-  return all(
-      pallas_segwalk.supported(
-          jax.ShapeDtypeStruct((g.rows_cap, g.width), dt))
-      for g in dist.plan.groups)
+  return all(_segwalk_group_ok(g, dt) for g in dist.plan.groups)

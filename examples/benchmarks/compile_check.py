@@ -48,6 +48,11 @@ def main():
                                                    make_hybrid_train_step)
   from distributed_embeddings_tpu.parallel.grad import TrainState
 
+  if args.segwalk_apply:
+    # compile-only flows trace on the CPU backend: without this the
+    # backend-sniffing dispatch would silently compile the XLA path
+    from distributed_embeddings_tpu.ops import pallas_segwalk
+    pallas_segwalk.ASSUME_TPU = True
   topo = topologies.get_topology_desc(args.topology, 'tpu')
   mesh = topologies.make_mesh(topo, (args.chips,), ('data',))
   config = SYNTHETIC_MODELS[args.model]
@@ -109,6 +114,16 @@ def main():
       v = getattr(ma, attr, None)
       if v is not None:
         print(f'  {attr}: {v / 2**30:.3f} GiB', flush=True)
+  try:
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):  # older jax wraps in a list
+      ca = ca[0] if ca else {}
+    if ca:
+      for k in ('flops', 'bytes accessed', 'transcendentals'):
+        if k in ca:
+          print(f'  cost {k}: {ca[k]:.3e}', flush=True)
+  except Exception as e:  # cost analysis is best-effort per backend
+    print(f'  cost_analysis unavailable: {e}', flush=True)
 
 
 if __name__ == '__main__':

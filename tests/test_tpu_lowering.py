@@ -157,7 +157,16 @@ def test_full_hybrid_train_step_compiles_for_v5e(v5e, two_axis):
   step = make_hybrid_train_step(dist, head, dense_opt, opt, donate=False,
                                 jit=False)
   state, cats, labels = _step_avals(dist, mesh, configs, 512, dense_opt)
-  compiled = jax.jit(step).lower(state, cats, labels).compile()
+  # the AOT trace runs on the CPU backend: ASSUME_TPU makes the dispatch
+  # include the real segwalk kernel in the compiled program
+  pallas_segwalk.ASSUME_TPU = True
+  try:
+    compiled = jax.jit(step).lower(state, cats, labels).compile()
+  finally:
+    pallas_segwalk.ASSUME_TPU = False
+  hlo = compiled.as_text() if hasattr(compiled, 'as_text') else ''
+  if hlo:
+    assert 'tpu_custom_call' in hlo, 'segwalk kernel missing from program'
   ma = compiled.memory_analysis()
   if ma is not None:
     # real v5e memory numbers: this toy program must fit one chip's
