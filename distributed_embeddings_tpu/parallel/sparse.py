@@ -187,8 +187,11 @@ class SparseSGD:
   # opt-in fused segment-walk apply (ops/pallas_segwalk.py): one
   # streaming pass does segment-sum + update together, skipping the
   # whole compaction pipeline; takes effect on TPU for f32 tables of
-  # width 128 or widths 8..64 dividing 128, silently falling back to
-  # the XLA path elsewhere
+  # width 128 or widths 8..64 dividing 128.  Narrow widths additionally
+  # require rows_cap divisible by the pack factor AND the
+  # packed_dispatch_ok HBM bound (PACKED_PARAM_BYTES_LIMIT) — a very
+  # large narrow group (>~4M rows) falls back to the XLA path to avoid
+  # the lane-padded-layout blowup, as does any other unsupported case.
   use_segwalk_apply: bool = False
 
   needs_sq = False
@@ -235,8 +238,11 @@ class SparseAdagrad:
   use_pallas_apply: bool = False
   # opt-in fused segment-walk apply (ops/pallas_segwalk.py): consumes
   # the SORTED raw stream directly — segment-sum + update in one pass,
-  # no compaction pipeline at all; same width/dtype support as above.
-  # Takes precedence over use_pallas_apply when both are set.
+  # no compaction pipeline at all; same width/dtype support as above,
+  # plus (for narrow widths) rows_cap divisibility by the pack factor
+  # and the packed_dispatch_ok HBM bound (PACKED_PARAM_BYTES_LIMIT) —
+  # huge narrow groups fall back to XLA to avoid the lane-padded-layout
+  # blowup.  Takes precedence over use_pallas_apply when both are set.
   use_segwalk_apply: bool = False
 
   supports_lane_packing = True
@@ -456,13 +462,13 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
   cap = _capacity(optimizer, n, rows_cap, cap_rows)
   with_sq = bool(getattr(optimizer, 'needs_sq', True))
   w = flat_g.shape[1]
-  pack = 128 // w if (w < 128 and 128 % w == 0) else 1
-  packable = (pack > 1 and rows_cap % pack == 0
-              and getattr(optimizer, 'supports_lane_packing', False)
-              and rows_cap // pack + 2 < cap
-              # the packed table view risks a lane-padded param layout
-              # on huge narrow groups (see packed_dispatch_ok)
-              and packed_dispatch_ok(rows_cap, w))
+  # packed_view_ok folds in the lane-padded-layout HBM bound shared with
+  # the eligibility probe; the extra clauses here are runtime-only facts
+  # (optimizer support, compaction capacity headroom).
+  packable = (packed_view_ok(rows_cap, w)
+              and getattr(optimizer, 'supports_lane_packing', False))
+  pack = 128 // w if packable else 1
+  packable = packable and rows_cap // pack + 2 < cap
 
   order = jnp.argsort(flat_ids) if cap < cap_safe else None
   if with_sq and flat_sq is not None:
@@ -537,6 +543,18 @@ def packed_dispatch_ok(rows_cap: int, width: int) -> bool:
   if width >= 128:
     return True
   return rows_cap * 128 * 4 <= PACKED_PARAM_BYTES_LIMIT
+
+
+def packed_view_ok(rows_cap: int, width: int) -> bool:
+  """Whether a NARROW group can engage the fused kernels through the
+  lane-packed ``[rows_cap/pack, 128]`` view: width must divide 128,
+  rows must divide by the pack factor, and the padded layout must fit
+  the HBM bound.  The single predicate shared by the runtime dispatch
+  (``_dedup_and_apply``) and the eligibility probe
+  (``utils/apply_eligibility.py``) so the two can never drift."""
+  return (width < 128 and 128 % width == 0
+          and rows_cap % (128 // width) == 0
+          and packed_dispatch_ok(rows_cap, width))
 
 
 def _use_segwalk(optimizer, table) -> bool:

@@ -42,9 +42,14 @@ def _group_table_aval(g, dt):
   through the lane-packed ``[rows_cap/pack, 128]`` view (the runtime's
   ``_lane_pack`` for the rowwise apply, the in-kernel packed path for
   the segment-walk) — the probe must mirror that or it misreports
-  exactly the fallback confusion it exists to prevent."""
+  exactly the fallback confusion it exists to prevent.  The runtime's
+  packed dispatch additionally declines huge narrow groups whose
+  lane-padded layout would blow HBM (``packed_dispatch_ok``); those
+  groups are probed at their natural narrow width — which the kernels
+  reject — so the reported count matches the actual dispatch."""
+  from distributed_embeddings_tpu.parallel.sparse import packed_view_ok
   w = g.width
-  if w < 128 and 128 % w == 0 and g.rows_cap % (128 // w) == 0:
+  if packed_view_ok(g.rows_cap, w):
     pack = 128 // w
     return jax.ShapeDtypeStruct((g.rows_cap // pack, 128), dt)
   return jax.ShapeDtypeStruct((g.rows_cap, w), dt)

@@ -1,0 +1,42 @@
+#!/bin/bash
+# Background tunnel watcher: probe every 5 min; on the first healthy
+# window, run the full measurement sweep (tpu_sweep.sh), then keep
+# probing so later windows re-run any still-missing pieces.
+# Usage: bash examples/benchmarks/tpu_watch.sh [probe_interval_s]
+set -u
+INTERVAL=${1:-300}
+cd "$(dirname "$0")/../.."
+PROBE_LOG=/tmp/tpu_probe.log
+SWEEP_LOG=/tmp/tpu_sweep.log
+echo "watch start $(date)" >> "$PROBE_LOG"
+while true; do
+  if timeout 120 python - <<'EOF' >> "$PROBE_LOG" 2>&1
+import jax
+devs = jax.devices()
+assert any(d.platform == 'tpu' for d in devs), devs
+print('TPU OK:', devs)
+EOF
+  then
+    if [ -z "${SWEEP_DONE:-}" ]; then
+      echo "=== tunnel healthy $(date) — launching sweep ===" | tee -a "$PROBE_LOG"
+      bash examples/benchmarks/tpu_sweep.sh "$SWEEP_LOG"
+      echo "=== sweep exited $(date) ===" | tee -a "$PROBE_LOG"
+      # Only count the sweep as done once the official bench artifact
+      # line actually landed (the tunnel can die mid-sweep); otherwise a
+      # later healthy window retries the whole thing — steps append to
+      # the log, so partial data from a dead window is never lost.
+      if grep -q '"comparable": true' "$SWEEP_LOG"; then
+        SWEEP_DONE=1
+        INTERVAL=1800
+      else
+        echo "sweep incomplete (no comparable bench line) — will retry" \
+          | tee -a "$PROBE_LOG"
+      fi
+    else
+      echo "probe ok (sweep already ran) $(date)" >> "$PROBE_LOG"
+    fi
+  else
+    echo "probe failed $(date)" >> "$PROBE_LOG"
+  fi
+  sleep "$INTERVAL"
+done
