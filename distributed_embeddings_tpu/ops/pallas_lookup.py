@@ -96,6 +96,29 @@ def _tile_m_for(h: int, width: int, dtype=jnp.float32) -> int:
   return max(8, min(TILE_M, budget // 8 * 8))
 
 
+def is_prepacked(table_shape, logical_width: Optional[int]) -> bool:
+  """Whether an operand arrives as the PREPACKED physical view (its
+  logical width differs from the physical one).  The detection half of
+  the prepacked contract — one definition for every kernel entry, with
+  ``validate_prepacked`` as the enforcement half."""
+  return logical_width is not None and logical_width != table_shape[1]
+
+
+def validate_prepacked(table_shape, logical_width: int):
+  """Validate a PREPACKED physical operand (``GroupSpec.storage_pack``)
+  against the kernels' shared contract — physical width 128, logical
+  width 8..64 dividing 128 — and return the natural ``(rows, width)``.
+  The ONE definition both the lookup and apply kernels use, so they can
+  never disagree on which groups are prepacked-servable."""
+  prows, width = table_shape
+  if width != 128 or not (8 <= logical_width < 128
+                          and 128 % logical_width == 0):
+    raise ValueError(f'prepacked table must be [rows/pack, 128] with '
+                     f'logical width 8..64 dividing 128, got '
+                     f'{tuple(table_shape)} logical {logical_width}')
+  return prows * (128 // logical_width), logical_width
+
+
 def _dense_lookup_kernel(ids_smem, ids_vmem, table_ref, out_ref, posbuf,
                          sem, *, num_rows, tile_m, h, width, pack, stripes,
                          pair, out_dtype):
@@ -203,13 +226,23 @@ def _dense_lookup_kernel(ids_smem, ids_vmem, table_ref, out_ref, posbuf,
   out_ref[:] = acc.astype(out_dtype)
 
 
-@functools.partial(jax.jit, static_argnames=('interpret',))
+@functools.partial(jax.jit, static_argnames=('interpret', 'logical_width'))
 def _dense_lookup_sum(table: jax.Array, ids: jax.Array,
-                      interpret: bool = False) -> jax.Array:
+                      interpret: bool = False,
+                      logical_width: Optional[int] = None) -> jax.Array:
   """Sum-combine ``table[ids[m, :]]`` -> ``[M, width]`` f32; invalid ids
   (negative or >= vocab) contribute nothing.  ``M`` must be a multiple of
-  the tile height ``_tile_m_for(h, width)``."""
+  the tile height ``_tile_m_for(h, width)``.
+
+  ``logical_width``: set when ``table`` arrives as the PHYSICAL packed
+  view ``[vocab/pack, 128]`` of a narrow ``[vocab, logical_width]``
+  table (``GroupSpec.storage_pack``) — ids stay in natural row space and
+  the kernel's packed view is the operand itself, no reshape.
+  """
   num_rows, width = table.shape
+  prepacked = is_prepacked(table.shape, logical_width)
+  if prepacked:
+    num_rows, width = validate_prepacked(table.shape, logical_width)
   m, h = ids.shape
   is_bf16 = table.dtype == jnp.bfloat16
   if width % 128 == 0:
@@ -230,9 +263,11 @@ def _dense_lookup_sum(table: jax.Array, ids: jax.Array,
   tile_m = _tile_m_for(h, width, table.dtype)
   if m % tile_m != 0:
     raise ValueError(f'M ({m}) must be a multiple of tile_m ({tile_m})')
-  # row-major [vocab, w] -> packed view is free (see kernel docstring)
+  # row-major [vocab, w] -> packed view is free (see kernel docstring);
+  # prepacked tables ARE the packed view already (all further reshapes
+  # of them regroup along the untiled row dim only)
   if stripes == 1 and pair == 1:
-    packed = table.reshape(num_rows // pack, 128)
+    packed = table if prepacked else table.reshape(num_rows // pack, 128)
     posbuf_shape = (tile_m * h, 128)
   elif stripes == 1:
     packed = table.reshape(num_rows // (2 * pack), 2, 128)
@@ -280,28 +315,48 @@ def _dense_lookup_sum(table: jax.Array, ids: jax.Array,
   return out.reshape(m, width)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
-def _dense_lookup_vjp(table, ids, interpret):
-  return _dense_lookup_sum(table, ids, interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _dense_lookup_vjp(table, ids, interpret, logical_width=None):
+  return _dense_lookup_sum(table, ids, interpret=interpret,
+                           logical_width=logical_width)
 
 
-def _dl_fwd(table, ids, interpret):
-  return _dense_lookup_sum(table, ids, interpret=interpret), (table, ids)
+def _dl_fwd(table, ids, interpret, logical_width=None):
+  return _dense_lookup_sum(table, ids, interpret=interpret,
+                           logical_width=logical_width), (table, ids)
 
 
-def _dl_bwd(interpret, res, g):
+def _dl_bwd(interpret, logical_width, res, g):
   """d(table) = scatter-add of cotangent rows at the looked-up ids.
 
   Shape-static XLA segment-sum; the analog of the reference backward
   (`embedding_lookup_kernels.cu:463-635`) without the dynamic
-  ``num_unique`` output (SURVEY.md §2.2 item 2).
+  ``num_unique`` output (SURVEY.md §2.2 item 2).  For prepacked tables
+  the cotangent is built DIRECTLY in the packed layout (ids merge to
+  packed rows, grads expand to their lane slots) — never materialising
+  the natural narrow shape whose relayout the packed storage exists to
+  avoid.
   """
   del interpret
   table, ids = res
-  vocab = table.shape[0]
   m, h = ids.shape
   grows = jnp.repeat(g, h, axis=0)  # position k gets cotangent of row k//h
   flat = ids.reshape(-1)
+  if is_prepacked(table.shape, logical_width):
+    # the packed-row/lane-slot convention is packed_ids/lane_expand's
+    # (the ONE definition shared with the apply paths); negative ids
+    # fold into the sentinel before the mapping
+    from distributed_embeddings_tpu.ops.pallas_segwalk import (lane_expand,
+                                                               packed_ids)
+    pack = 128 // logical_width
+    prows = table.shape[0]
+    vocab = prows * pack
+    valid = (flat >= 0) & (flat < vocab)
+    pid, slot = packed_ids(jnp.where(valid, flat, vocab), pack, vocab)
+    payload = lane_expand(jnp.where(valid[:, None], grows, 0), slot, pack)
+    dtable = jax.ops.segment_sum(payload, pid, num_segments=prows + 1)[:-1]
+    return (dtable.astype(table.dtype), None)
+  vocab = table.shape[0]
   valid = (flat >= 0) & (flat < vocab)
   seg = jnp.where(valid, flat, vocab)
   dtable = jax.ops.segment_sum(
@@ -357,7 +412,8 @@ def dense_lookup(table: jax.Array,
                  ids: jax.Array,
                  combiner: Optional[str],
                  out_dtype=None,
-                 interpret: bool = False) -> jax.Array:
+                 interpret: bool = False,
+                 logical_width: Optional[int] = None) -> jax.Array:
   """Fused lookup+combine over the dense padded layout.
 
   Args:
@@ -371,19 +427,29 @@ def dense_lookup(table: jax.Array,
   Returns:
     ``[M, width]`` combined embeddings; rows with no valid id are zero.
   """
-  if not supported(table, combiner, ids.shape[1]):
+  prepacked = is_prepacked(table.shape, logical_width)
+  if prepacked:
+    pack = 128 // logical_width
+    nat = jax.ShapeDtypeStruct((table.shape[0] * pack, logical_width),
+                               table.dtype)
+    vocab, w = nat.shape
+  else:
+    nat = table
+    vocab, w = table.shape
+  if not supported(nat, combiner, ids.shape[1]):
     raise ValueError(
-        f'pallas dense_lookup unsupported: width {table.shape[1]}, '
+        f'pallas dense_lookup unsupported: width {w}, '
         f'dtype {table.dtype}, combiner {combiner}, hotness {ids.shape[1]}')
   out_dtype = out_dtype or table.dtype
   m, h = ids.shape
-  tile_m = _tile_m_for(h, table.shape[1], table.dtype)
+  tile_m = _tile_m_for(h, w, table.dtype)
   m_pad = -(-m // tile_m) * tile_m
   if m_pad != m:
     ids = jnp.pad(ids, ((0, m_pad - m), (0, 0)), constant_values=-1)
-  out = _dense_lookup_vjp(table, ids, interpret)[:m]
+  out = _dense_lookup_vjp(table, ids, interpret,
+                          logical_width if prepacked else None)[:m]
   if combiner == 'mean':
-    counts = jnp.sum((ids[:m] >= 0) & (ids[:m] < table.shape[0]),
+    counts = jnp.sum((ids[:m] >= 0) & (ids[:m] < vocab),
                      axis=1).astype(jnp.float32)
     out = out / jnp.maximum(counts, 1)[:, None]
   return out.astype(out_dtype)
@@ -393,12 +459,16 @@ def fused_lookup(table: jax.Array,
                  routed: jax.Array,
                  combiner: Optional[str],
                  compute_dtype,
-                 interpret: bool = False) -> jax.Array:
+                 interpret: bool = False,
+                 logical_width: Optional[int] = None) -> jax.Array:
   """Pallas drop-in for the runtime's ``_fused_lookup`` hot path.
 
-  ``table``: ``[rows_cap, w]`` fused local table; ``routed``:
-  ``[n_cap, GB, h]`` fused row ids (``>= rows_cap`` marks padding, see
-  `parallel/dist_embedding.py:_route_ids`).  Returns ``[n_cap, GB, w]``.
+  ``table``: ``[rows_cap, w]`` fused local table — or, when
+  ``logical_width`` is set, the physical packed view
+  ``[rows_cap/pack, 128]`` of a narrow group (``GroupSpec.storage_pack``);
+  ``routed``: ``[n_cap, GB, h]`` NATURAL fused row ids (``>= rows_cap``
+  marks padding, see `parallel/dist_embedding.py:_route_ids`).
+  Returns ``[n_cap, GB, w]``.
   """
   n_cap, gb, h = routed.shape
   if combiner is None and h != 1:
@@ -408,5 +478,6 @@ def fused_lookup(table: jax.Array,
     raise ValueError(f'combiner=None requires hotness 1, got {h}')
   out = dense_lookup(table, routed.reshape(n_cap * gb, h),
                      'sum' if combiner is None else combiner,
-                     out_dtype=compute_dtype, interpret=interpret)
+                     out_dtype=compute_dtype, interpret=interpret,
+                     logical_width=logical_width)
   return out.reshape(n_cap, gb, -1)

@@ -260,11 +260,25 @@ def packed_ids(ids: jax.Array, pack: int, rows: int):
   """Map row ids to (packed row, lane slot): ``id // pack`` with
   sentinels (``>= rows``) going to packed-sentinel ``rows // pack`` at
   slot 0.  Single source of the packed-view convention, shared with
-  ``parallel/sparse.py:_lane_pack``."""
+  ``parallel/sparse.py:_lane_pack`` and the lookup backward
+  (``pallas_lookup._dl_bwd``)."""
   sent = ids >= rows
   pids = jnp.where(sent, rows // pack, ids // pack)
   slots = jnp.where(sent, 0, jax.lax.rem(ids, pack))
   return pids, slots
+
+
+def lane_expand(rows_w: jax.Array, slots: jax.Array, pack: int) -> jax.Array:
+  """Expand natural ``[n, w]`` payload rows to packed ``[n, pack*w]``
+  lanes, each row occupying the lane block of its slot (zeros
+  elsewhere).  The other half of the ``packed_ids`` convention — one
+  definition shared by ``parallel/sparse.py:_lane_pack`` and the
+  lookup backward, so the lane layout can never drift between the
+  forward, apply, and gradient paths."""
+  w = rows_w.shape[1]
+  lane = jnp.arange(pack * w, dtype=jnp.int32) // w
+  mask = (lane[None, :] == slots[:, None]).astype(rows_w.dtype)
+  return jnp.tile(rows_w, (1, pack)) * mask
 
 
 def supported(table: jax.Array) -> bool:
@@ -287,7 +301,8 @@ def supported(table: jax.Array) -> bool:
   return 8 <= w < 128 and 128 % w == 0 and rows % (128 // w) == 0
 
 
-@functools.partial(jax.jit, static_argnames=('op', 'eps', 'interpret'))
+@functools.partial(jax.jit, static_argnames=('op', 'eps', 'interpret',
+                                             'logical_width'))
 def segwalk_apply(table: jax.Array,
                   acc: Optional[jax.Array],
                   sorted_ids: jax.Array,
@@ -296,19 +311,29 @@ def segwalk_apply(table: jax.Array,
                   *,
                   op: str,
                   eps: float = 1e-7,
-                  interpret: bool = False):
+                  interpret: bool = False,
+                  logical_width: Optional[int] = None):
   """Apply one optimizer step from a SORTED per-occurrence stream.
 
   Args:
-    table: ``[num_rows, w]`` f32 (donate for in-place).
-    acc: Adagrad accumulator ``[num_rows, w]`` f32, or None for 'sgd'.
-    sorted_ids: ``[n]`` int32 ascending; sentinels (>= num_rows) last.
-    sorted_g: ``[n, w]`` f32 gradient rows in the same order.
+    table: ``[num_rows, w]`` f32 (donate for in-place) — or, when
+      ``logical_width`` is set, the PHYSICAL packed view
+      ``[num_rows/pack, 128]`` of a narrow group
+      (``GroupSpec.storage_pack``): the kernel's packed path runs on the
+      operand itself with no reshape, so the lane-padded relayout that
+      barred huge narrow groups (``packed_dispatch_ok``) cannot occur.
+    acc: Adagrad accumulator (same shape as ``table``), or None for 'sgd'.
+    sorted_ids: ``[n]`` int32 ascending NATURAL row ids; sentinels
+      (>= natural num_rows) last.
+    sorted_g: ``[n, w]`` f32 gradient rows in the same order (natural w).
     lr: scalar learning rate.
     op: 'sgd' | 'adagrad_dedup' | 'adagrad_sq'.
+    logical_width: natural width when ``table`` is prepacked; None (or
+      equal to ``table.shape[1]``) for natural tables.
 
   Returns:
-    ``new_table`` ('sgd') or ``(new_table, new_acc)``.
+    ``new_table`` ('sgd') or ``(new_table, new_acc)`` — in the same
+    (packed or natural) layout the table arrived in.
   """
   if op not in ('sgd', 'adagrad_dedup', 'adagrad_sq'):
     raise ValueError(f'unknown op {op!r}')
@@ -318,13 +343,19 @@ def segwalk_apply(table: jax.Array,
   if (op == 'sgd') != (acc is None):
     raise ValueError('acc must be provided iff op is an adagrad variant')
   num_rows, w = table.shape
+  from distributed_embeddings_tpu.ops.pallas_lookup import (is_prepacked,
+                                                            validate_prepacked)
+  prepacked = is_prepacked(table.shape, logical_width)
+  if prepacked:
+    num_rows, w = validate_prepacked(table.shape, logical_width)
   # Lane packing for narrow rows: view the table as [rows/pack, 128]
-  # (free row-major reshape) so each unique-row DMA moves a full 512 B
-  # burst serving up to `pack` original rows.  The id stream divides by
-  # `pack` (merging adjacent uids into one packed segment) and each
-  # row's original lane slot rides along for the in-kernel expansion.
-  # supported() guarantees divisibility, so narrow widths ALWAYS pack
-  # (sub-128-lane VMEM slices do not compile on v5e, see supported()).
+  # (free row-major reshape — the operand itself when prepacked) so each
+  # unique-row DMA moves a full 512 B burst serving up to `pack`
+  # original rows.  The id stream divides by `pack` (merging adjacent
+  # uids into one packed segment) and each row's original lane slot
+  # rides along for the in-kernel expansion.  supported() guarantees
+  # divisibility, so narrow widths ALWAYS pack (sub-128-lane VMEM
+  # slices do not compile on v5e, see supported()).
   pack = 128 // w if w < 128 else 1
   kw = w * pack
   prows = num_rows // pack
@@ -338,8 +369,9 @@ def segwalk_apply(table: jax.Array,
   sorted_ids = sorted_ids.astype(jnp.int32)
   if pack > 1:
     kids, slots = packed_ids(sorted_ids, pack, num_rows)
-    table_k = table.reshape(prows, kw)
-    acc_k = acc.reshape(prows, kw) if acc is not None else None
+    table_k = table if prepacked else table.reshape(prows, kw)
+    acc_k = (acc if prepacked else
+             acc.reshape(prows, kw)) if acc is not None else None
   else:
     # the kernel statically never reads slots when pack == 1: reuse the
     # id stream as the operand instead of materializing a zeros array
@@ -413,6 +445,10 @@ def segwalk_apply(table: jax.Array,
       interpret=interpret,
   )(ids2d, is_last[:, None], ids2d, slots[:, None], sorted_g, lr_arr,
     table_k, acc_operand)
+  if prepacked:
+    # hand back the physical packed layout the table arrived in
+    new_table = outs[0]
+    return new_table if op == 'sgd' else (new_table, outs[1])
   new_table = outs[0].reshape(num_rows, w)
   if op == 'sgd':
     return new_table

@@ -134,7 +134,10 @@ def set_weights(dist: DistributedEmbedding,
 
   params = {}
   for gi, g in enumerate(plan.groups):
-    shape = (dist.world_size, g.rows_cap, g.width)
+    # packed-storage groups live device-side as [rows_cap/pack, 128]
+    # (GroupSpec.storage_pack); the host-side regrouping reshape is free
+    # (row-major) and keeps the checkpoint contract natural-space
+    shape = (dist.world_size, g.param_rows, g.param_width)
     sharding = NamedSharding(dist.mesh, P(dist.axis_name, None, None))
 
     def make_shard(index, g=g):
@@ -149,7 +152,8 @@ def set_weights(dist: DistributedEmbedding,
       pad_rows = g.rows_cap - g.rows[dev]
       if pad_rows or not chunks:
         chunks.append(np.zeros((pad_rows, g.width), dist.param_dtype))
-      return np.concatenate(chunks, axis=0)[None]
+      full = np.concatenate(chunks, axis=0)
+      return full.reshape(g.param_rows, g.param_width)[None]
 
     params[f'group_{gi}'] = jax.make_array_from_callback(
         shape, sharding, make_shard)
@@ -178,8 +182,9 @@ def get_weights(dist: DistributedEmbedding,
   plan = dist.plan
   group_index = {g.key: gi for gi, g in enumerate(plan.groups)}
   host_shards = {
-      gi: _host_shards(dist, params[f'group_{gi}'], gather, chunk_elems)
-      for gi in range(len(plan.groups))
+      gi: [s.reshape(g.rows_cap, g.width) for s in
+           _host_shards(dist, params[f'group_{gi}'], gather, chunk_elems)]
+      for gi, g in enumerate(plan.groups)
   }
 
   result = []
@@ -218,9 +223,11 @@ def get_optimizer_state(dist: DistributedEmbedding,
   tables only; optimizer state is an extension): a state checkpoint
   written under one world size / strategy loads under any other.
 
-  Leaf handling: per-element leaves ``[D, rows_cap, width]`` (Adagrad
-  ``acc``, Adam ``m``/``v``) un-fuse and un-column-slice exactly like
-  weights; per-row leaves ``[D, rows_cap]`` (Adam ``t``) are IDENTICAL
+  Leaf handling: per-element leaves ``[D, param_rows, param_width]``
+  (Adagrad ``acc``, Adam ``m``/``v`` — the params' possibly packed
+  physical layout, regrouped to natural rows on gather) un-fuse and
+  un-column-slice exactly like weights; per-row leaves ``[D, rows_cap]``
+  (Adam ``t``) are IDENTICAL
   across column slices of a table (a lookup touches every slice of a
   row), so the first slice is canonical and yields a ``[rows]`` vector.
 
@@ -233,10 +240,17 @@ def get_optimizer_state(dist: DistributedEmbedding,
   group_index = {g.key: gi for gi, g in enumerate(plan.groups)}
   leaf_names = sorted({k for gs in opt_state.values() for k in gs})
   host: Dict[tuple, List[np.ndarray]] = {}
-  for gi in range(len(plan.groups)):
+  for gi, g in enumerate(plan.groups):
     for k in opt_state.get(f'group_{gi}', {}):
-      host[(gi, k)] = _host_shards(dist, opt_state[f'group_{gi}'][k],
-                                   gather, chunk_elems)
+      shards = _host_shards(dist, opt_state[f'group_{gi}'][k],
+                            gather, chunk_elems)
+      # elementwise leaves follow the params' (possibly packed) physical
+      # layout — regroup to natural rows; per-row leaves are natural
+      host[(gi, k)] = [
+          s.reshape(g.rows_cap, g.width)
+          if s.shape == (g.param_rows, g.param_width) else s
+          for s in shards
+      ]
 
   result = []
   for tid, shards in enumerate(plan.shard_layout()):
@@ -308,7 +322,13 @@ def set_optimizer_state(dist: DistributedEmbedding,
           pad_shape = ((pad_rows, g.width) if tmpl.ndim == 3
                        else (pad_rows,))
           chunks.append(np.zeros(pad_shape, dtype))
-        return np.concatenate(chunks, axis=0)[None]
+        full = np.concatenate(chunks, axis=0)
+        if tmpl.ndim == 3 and tmpl.shape[1:] == (g.param_rows,
+                                                 g.param_width):
+          # elementwise leaf of a packed-storage group: regroup to the
+          # physical packed layout (free row-major reshape)
+          full = full.reshape(g.param_rows, g.param_width)
+        return full[None]
 
       # canonical device-major sharding (the template may still carry the
       # single-device sharding optimizer.init created it with)

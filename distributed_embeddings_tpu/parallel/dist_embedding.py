@@ -18,8 +18,12 @@ restructured for XLA SPMD instead of Horovod MPMD:
   gradient (SURVEY.md §2.4) falls out of JAX autodiff: the transpose of
   ``all_to_all`` is ``all_to_all``.
 - Embedding parameters are stacked per fusion group as
-  ``[num_devices, rows_cap, width]`` arrays sharded over the mesh axis, so
-  a parameter pytree stays an ordinary pytree under `jit`/`grad`/optax.
+  ``[num_devices, param_rows, param_width]`` arrays sharded over the mesh
+  axis (qualifying narrow groups store physically LANE-PACKED as
+  ``[rows_cap/pack, 128]`` — ``GroupSpec.storage_pack`` — so every HBM
+  transaction is a full 512 B burst and no per-step packing reshape can
+  provoke a lane-padded relayout), and a parameter pytree stays an
+  ordinary pytree under `jit`/`grad`/optax.
 
 Variable hotness in the distributed path is expressed as dense ids padded
 with ``-1`` (see `ops/ragged.py:RaggedBatch.to_padded_dense`), keeping every
@@ -104,7 +108,8 @@ class DistributedEmbedding:
                axis_name: str = mesh_lib.DEFAULT_AXIS,
                param_dtype: Any = jnp.float32,
                compute_dtype: Any = None,
-               lookup_impl: str = 'auto'):
+               lookup_impl: str = 'auto',
+               packed_storage: bool = True):
     if row_slice is not None and (isinstance(row_slice, bool)
                                   or not isinstance(row_slice,
                                                     (int, np.integer))):
@@ -112,7 +117,7 @@ class DistributedEmbedding:
           f'row_slice must be an int element-count threshold or None, '
           f'got {row_slice!r}')
     row_slice = None if row_slice is None else int(row_slice)
-    if lookup_impl not in ('auto', 'xla', 'pallas'):
+    if lookup_impl not in ('auto', 'xla', 'pallas', 'sparsecore'):
       raise ValueError(f'Unknown lookup_impl {lookup_impl!r}')
     self.lookup_impl = lookup_impl
     self.mesh = mesh if mesh is not None else mesh_lib.create_mesh(
@@ -145,14 +150,15 @@ class DistributedEmbedding:
                              strategy=strategy,
                              input_table_map=input_table_map,
                              column_slice_threshold=column_slice_threshold,
-                             row_slice_threshold=row_slice)
+                             row_slice_threshold=row_slice,
+                             packed_storage=packed_storage)
     self.num_inputs = len(self.plan.input_table_map)
     # compiled-function cache, keyed by shape signature; lives on the
     # instance so dropping the layer frees its traced executables
     self._fn_cache: Dict[Any, Any] = {}
 
   def _lookup(self, table: jax.Array, routed: jax.Array,
-              combiner: Optional[str]) -> jax.Array:
+              combiner: Optional[str], pack: int = 1) -> jax.Array:
     """Fused lookup+combine for one subgroup, XLA or Pallas.
 
     'auto' currently always takes the XLA gather+segment-sum path: on
@@ -168,24 +174,49 @@ class DistributedEmbedding:
     """
     from distributed_embeddings_tpu.ops import pallas_lookup
     impl = self.lookup_impl
+    if impl == 'sparsecore':
+      # Staged seam, hardware-gated: the concrete contract (mod-sharded
+      # tables behind a ShardingPlan variant, routed ids -> static CSR
+      # buffers for jax-tpu-embedding's tpu_sparse_dense_matmul custom
+      # calls, fused SC grad+optimizer RMW dispatched like
+      # use_segwalk_apply) is specified in docs/design.md §8.  This
+      # environment has neither SparseCore hardware (v5e) nor the
+      # library, so requesting it is an explicit error, never a silent
+      # TensorCore fallback.
+      raise NotImplementedError(
+          "lookup_impl='sparsecore' is a staged seam: see docs/design.md "
+          "§8 for the integration contract (requires SparseCore hardware "
+          "(v5p/v6e) and the jax-tpu-embedding custom-call surface). Use "
+          "'auto' on TensorCore-only targets.")
     hotness = routed.shape[2]
-    ok = pallas_lookup.supported(table, combiner, hotness)
+    # packed-storage groups (GroupSpec.storage_pack): table arrives as
+    # the physical [rows_cap/pack, 128] view; probe support at the
+    # NATURAL shape the kernel semantics are defined over
+    w = table.shape[1] // pack
+    nat = (jax.ShapeDtypeStruct((table.shape[0] * pack, w), table.dtype)
+           if pack > 1 else table)
+    ok = pallas_lookup.supported(nat, combiner, hotness)
     if impl == 'auto':
       impl = 'xla'
     if impl == 'pallas':
       if not ok:
         raise ValueError(
-            f'lookup_impl=pallas unsupported for width {table.shape[1]} '
+            f'lookup_impl=pallas unsupported for width {w} '
             f'dtype {table.dtype} combiner {combiner} hotness {hotness}')
       return pallas_lookup.fused_lookup(table, routed, combiner,
-                                        self.compute_dtype)
+                                        self.compute_dtype,
+                                        logical_width=w if pack > 1 else None)
+    if pack > 1:
+      return _fused_lookup_packed(table, routed, pack, combiner,
+                                  self.compute_dtype)
     return _fused_lookup(table, routed, combiner, self.compute_dtype)
 
 
   # ------------------------------------------------------------------ init
 
   def init(self, rng: Union[int, jax.Array]) -> Dict[str, jax.Array]:
-    """Create sharded fused tables ``{group_i: [D, rows_cap, width]}``.
+    """Create sharded fused tables ``{group_i: [D, param_rows,
+    param_width]}`` (packed physical layout for narrow groups).
 
     Each member table slice is initialised with its own initializer at its
     sliced shape, preserving the per-table init distribution the reference
@@ -200,7 +231,8 @@ class DistributedEmbedding:
       rng = jax.random.key(rng)
 
     def make_shard(key, dev, g):
-      """One device's [1, rows_cap, width] shard of group ``g``."""
+      """One device's ``[1, param_rows, param_width]`` shard of group
+      ``g`` (packed physical layout for narrow groups)."""
       chunks = []
       for lt in g.member_tables[dev]:
         cfg = self.table_configs[lt.table_id]
@@ -221,7 +253,12 @@ class DistributedEmbedding:
       pad_rows = g.rows_cap - g.rows[dev]
       if pad_rows or not chunks:
         chunks.append(jnp.zeros((pad_rows, g.width), self.param_dtype))
-      return jnp.concatenate(chunks, axis=0)[None]
+      full = jnp.concatenate(chunks, axis=0)
+      if g.storage_pack > 1:
+        # physical packed layout [rows_cap/pack, 128] — a free row-major
+        # regrouping of the freshly built value (GroupSpec.storage_pack)
+        full = full.reshape(g.param_rows, g.param_width)
+      return full[None]
 
     def build_all(key):
       # Per-device structure is data under SPMD: every device runs the
@@ -597,7 +634,8 @@ class DistributedEmbedding:
                             jnp.asarray(sub.row_lo)[me],
                             jnp.asarray(sub.row_hi)[me])
         out = self._lookup(params[f'group_{sub.gi}'][0], routed,
-                           sub.lookup_combiner)
+                           sub.lookup_combiner,
+                           pack=self.plan.groups[sub.gi].storage_pack)
         if sub.mean_row_sliced:
           # mean row shards look up with 'sum'; divide by the TRUE
           # per-sample id count HERE, where the full raw ids are in hand
@@ -687,7 +725,8 @@ class DistributedEmbedding:
                             jnp.asarray(sub.row_lo)[me],
                             jnp.asarray(sub.row_hi)[me])
         out = self._lookup(params[f'group_{sub.gi}'][0], routed,
-                           sub.lookup_combiner)
+                           sub.lookup_combiner,
+                           pack=self.plan.groups[sub.gi].storage_pack)
         if sub.mean_row_sliced:
           # owner-side division by the true count (see the dp path)
           out = out / _valid_count(ids)[..., None].astype(out.dtype)
@@ -938,8 +977,17 @@ def _fused_lookup(table: jax.Array, routed: jax.Array,
   mask = routed < rows_cap
   safe = jnp.where(mask, routed, 0)
   rows = jnp.take(table, safe, axis=0)  # [n_cap, GB, h, w]
-  acc = jnp.float32 if table.dtype in (jnp.bfloat16, jnp.float16) \
-      else table.dtype
+  return _combine_rows(rows, mask, combiner, table.dtype, compute_dtype)
+
+
+def _combine_rows(rows: jax.Array, mask: jax.Array,
+                  combiner: Optional[str], table_dtype,
+                  compute_dtype) -> jax.Array:
+  """Shared combine tail of the fused lookups: mask invalid slots, sum /
+  mean / pass-through over the hotness axis, cast.  One definition so
+  the natural and packed gathers can never drift semantically."""
+  acc = jnp.float32 if table_dtype in (jnp.bfloat16, jnp.float16) \
+      else table_dtype
   rows = rows.astype(acc)
   if combiner is None:
     out = jnp.where(mask[:, :, 0, None], rows[:, :, 0, :], 0)
@@ -950,3 +998,27 @@ def _fused_lookup(table: jax.Array, routed: jax.Array,
       counts = jnp.sum(mask, axis=2).astype(acc)
       out = out / jnp.maximum(counts, 1)[..., None]
   return out.astype(compute_dtype)
+
+
+def _fused_lookup_packed(table: jax.Array, routed: jax.Array, pack: int,
+                         combiner: Optional[str], compute_dtype) -> jax.Array:
+  """``_fused_lookup`` against a PACKED group table (storage_pack > 1).
+
+  ``table``: ``[rows_cap/pack, 128]`` physical view; ``routed`` ids stay
+  in NATURAL fused-row space with sentinel ``rows_cap``.  Each lookup
+  gathers one full-burst packed row (the same 512 B HBM transaction a
+  narrow gather pays anyway) and isolates its ``w = 128/pack`` target
+  lanes in-register — the table itself is never reshaped, so no
+  lane-padded relayout can materialise (GroupSpec.storage_pack).
+  """
+  prows, lanes = table.shape
+  w = lanes // pack
+  rows_cap = prows * pack
+  mask = routed < rows_cap
+  safe = jnp.where(mask, routed, 0)
+  prow = jnp.take(table, safe // pack, axis=0)  # [n_cap, GB, h, 128]
+  # lane-select the target slot: [..., 128] -> [..., pack, w] -> [..., w]
+  slot = (safe % pack)[..., None, None]
+  rows = jnp.take_along_axis(
+      prow.reshape(prow.shape[:-1] + (pack, w)), slot, axis=-2)[..., 0, :]
+  return _combine_rows(rows, mask, combiner, table.dtype, compute_dtype)

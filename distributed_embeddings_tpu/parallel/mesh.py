@@ -67,7 +67,9 @@ def batch_sharding(mesh: Mesh, axis_name: str = DEFAULT_AXIS,
 
 def table_sharding(mesh: Mesh, axis_name: str = DEFAULT_AXIS,
                    ndim: int = 3) -> NamedSharding:
-  """Sharding for stacked per-device tables ``[D, rows_cap, width]``."""
+  """Sharding for stacked per-device tables ``[D, param_rows,
+  param_width]`` (packed physical layout for narrow groups,
+  ``GroupSpec.storage_pack``)."""
   return NamedSharding(mesh, P(axis_name, *([None] * (ndim - 1))))
 
 

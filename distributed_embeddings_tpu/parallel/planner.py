@@ -22,8 +22,10 @@ runs on every device of the mesh, so per-device differences must live in *data*
 (uniformly shaped, padded arrays), never in code structure.  The plan therefore
 describes, for every fusion-group signature ``(width, combiner)``:
 
-- a fused parameter array of shape ``[num_devices, rows_cap, width]`` (rows
-  padded per device to the max over devices) sharded over the mesh axis,
+- a fused parameter array of shape ``[num_devices, param_rows,
+  param_width]`` (rows padded per device to the max over devices; narrow
+  groups store physically lane-packed as ``[rows_cap/pack, 128]`` — see
+  ``GroupSpec.storage_pack``) sharded over the mesh axis,
 - a request table: each (input, column-slice) pair becomes a *request* routed
   to one (device, group, slot), with padded slot capacity ``n_cap`` so the
   all-to-all send buffer ``[num_devices, n_cap, local_batch, hot_cap]`` has the
@@ -161,6 +163,27 @@ class GroupSpec:
   n_cap: int
   requests: List[List[Request]]
   member_tables: List[List[LocalTable]]
+  # Physical storage pack factor.  TPU HBM/VMEM move 128-lane (512 B f32)
+  # bursts and the (8,128) tile padding makes narrow minor dimensions
+  # hostile to the whole memory system, so qualifying narrow groups store
+  # their parameter shard PACKED as ``[rows_cap/pack, width*pack]``
+  # (pack = 128/width, a pure row-major regrouping — byte-identical to
+  # the natural ``[rows_cap, width]`` array).  Every consumer (gather,
+  # scatter, fused kernels, checkpoint) works through this view, which
+  # kills the lane-padded relayout XLA otherwise materialises to serve
+  # per-step packing reshapes (8x HBM on synthetic-tiny's 29.1M-row
+  # width-16 group, docs/perf_notes.md round 3).  1 = natural storage.
+  storage_pack: int = 1
+
+  @property
+  def param_rows(self) -> int:
+    """Physical per-device parameter rows (``rows_cap`` when natural)."""
+    return self.rows_cap // self.storage_pack
+
+  @property
+  def param_width(self) -> int:
+    """Physical parameter width (128 lanes for packed storage)."""
+    return self.width * self.storage_pack
 
 
 def _round_up(x: int, m: int) -> int:
@@ -310,6 +333,11 @@ class ShardingPlan:
       count shard along ROWS instead of columns (shard partial outputs are
       summed at assembly).  ``None`` disables row slicing.  Beyond the
       reference, whose ``row_slice`` raises NotImplementedError.
+    packed_storage: store qualifying narrow fusion groups (width 8..64
+      dividing 128) physically lane-packed as ``[rows_cap/pack, 128]``
+      (see ``GroupSpec.storage_pack``).  Default on; the escape hatch
+      exists for A/B tests and for optimizers without lane-packed apply
+      support on huge narrow groups (``SparseAdam``).
   """
 
   def __init__(self,
@@ -318,7 +346,8 @@ class ShardingPlan:
                strategy: str = 'basic',
                input_table_map: Optional[Sequence[int]] = None,
                column_slice_threshold: Optional[int] = None,
-               row_slice_threshold: Optional[int] = None):
+               row_slice_threshold: Optional[int] = None,
+               packed_storage: bool = True):
     if strategy not in ('basic', 'memory_balanced', 'memory_optimized'):
       raise ValueError(f'Unsupported shard strategy {strategy}')
     # Single-process case may skip collectives; mirror the reference's
@@ -339,6 +368,7 @@ class ShardingPlan:
         raise ValueError(f'{name} must be positive, got {thr}')
     self.column_slice_threshold = column_slice_threshold
     self.row_slice_threshold = row_slice_threshold
+    self.packed_storage = bool(packed_storage)
 
     # --- 1a. row slicing (beyond the reference; see slice_table_row) -----
     # A qualifying table is sliced along rows only (its shards span every
@@ -517,14 +547,23 @@ class ShardingPlan:
       # always take the XLA fallback, so only sublane alignment applies
       gran = max(8, 2 * (128 // width)) if (width >= 8
                                             and 128 % width == 0) else 8
+      rows_cap = max(gran, _round_up(max(rows), gran))
+      # packed storage qualifies exactly where the kernels' lane packing
+      # does: width 8..64 dividing 128 (gran guarantees rows_cap
+      # divisibility by 2*pack); widths < 8 or non-divisors stay natural
+      pack = 1
+      if packed_storage and 8 <= width < 128 and 128 % width == 0:
+        pack = 128 // width
+        assert rows_cap % pack == 0, (rows_cap, width)
       spec = GroupSpec(key=key,
                        width=width,
                        combiner=combiner,
                        rows=rows,
-                       rows_cap=max(gran, _round_up(max(rows), gran)),
+                       rows_cap=rows_cap,
                        n_cap=max(len(r) for r in reqs),
                        requests=reqs,
-                       member_tables=members)
+                       member_tables=members,
+                       storage_pack=pack)
       self.groups.append(spec)
       for dev_reqs in reqs:
         self.requests.extend(dev_reqs)

@@ -187,11 +187,13 @@ class SparseSGD:
   # opt-in fused segment-walk apply (ops/pallas_segwalk.py): one
   # streaming pass does segment-sum + update together, skipping the
   # whole compaction pipeline; takes effect on TPU for f32 tables of
-  # width 128 or widths 8..64 dividing 128.  Narrow widths additionally
-  # require rows_cap divisible by the pack factor AND the
-  # packed_dispatch_ok HBM bound (PACKED_PARAM_BYTES_LIMIT) — a very
-  # large narrow group (>~4M rows) falls back to the XLA path to avoid
-  # the lane-padded-layout blowup, as does any other unsupported case.
+  # width 128 or widths 8..64 dividing 128 (at ANY group size under the
+  # default packed storage, which the kernel consumes reshape-free).
+  # Only with packed_storage=False do narrow groups additionally need
+  # rows_cap divisible by the pack factor AND the packed_dispatch_ok
+  # HBM bound (PACKED_PARAM_BYTES_LIMIT) — there a very large narrow
+  # group (>~4M rows) falls back to XLA to avoid the lane-padded
+  # relayout, as does any other unsupported case.
   use_segwalk_apply: bool = False
 
   needs_sq = False
@@ -239,10 +241,10 @@ class SparseAdagrad:
   # opt-in fused segment-walk apply (ops/pallas_segwalk.py): consumes
   # the SORTED raw stream directly — segment-sum + update in one pass,
   # no compaction pipeline at all; same width/dtype support as above,
-  # plus (for narrow widths) rows_cap divisibility by the pack factor
-  # and the packed_dispatch_ok HBM bound (PACKED_PARAM_BYTES_LIMIT) —
-  # huge narrow groups fall back to XLA to avoid the lane-padded-layout
-  # blowup.  Takes precedence over use_pallas_apply when both are set.
+  # serving narrow groups of ANY size under the default packed storage
+  # (only packed_storage=False adds the pack-divisibility and
+  # packed_dispatch_ok HBM gates, where huge narrow groups fall back to
+  # XLA).  Takes precedence over use_pallas_apply when both are set.
   use_segwalk_apply: bool = False
 
   supports_lane_packing = True
@@ -324,12 +326,14 @@ class SparseAdam:
 
   def init(self, dist: DistributedEmbedding, params) -> Dict:
     out = {}
-    for gi in range(len(dist.plan.groups)):
+    for gi, g in enumerate(dist.plan.groups):
       p = params[f'group_{gi}']
       out[f'group_{gi}'] = {
           'm': jnp.zeros_like(p, dtype=jnp.float32),
           'v': jnp.zeros_like(p, dtype=jnp.float32),
-          't': jnp.zeros(p.shape[:1] + p.shape[1:2], jnp.int32),
+          # per NATURAL row, regardless of packed storage (the packed
+          # fallback in _dedup_and_apply applies Adam in natural space)
+          't': jnp.zeros(p.shape[:1] + (g.rows_cap,), jnp.int32),
       }
     return out
 
@@ -375,16 +379,15 @@ def _lane_pack(uids, sum_g, sum_sq, pack: int, rows_cap: int):
   Returns ``(pids, g_packed, sq_packed)`` sized
   ``min(len(uids), rows_cap // pack + 2)``.
   """
-  from distributed_embeddings_tpu.ops.pallas_segwalk import packed_ids
+  from distributed_embeddings_tpu.ops.pallas_segwalk import (lane_expand,
+                                                             packed_ids)
   c, w = sum_g.shape
   lanes = pack * w
   psent = rows_cap // pack
   pids, slot = packed_ids(uids, pack, rows_cap)
-  lane = jnp.arange(lanes, dtype=jnp.int32) // w
-  mask = (lane[None, :] == slot[:, None]).astype(sum_g.dtype)
-  g_lanes = jnp.tile(sum_g, (1, pack)) * mask
+  g_lanes = lane_expand(sum_g, slot, pack)
   payload = (g_lanes if sum_sq is None else jnp.concatenate(
-      [g_lanes, jnp.tile(sum_sq, (1, pack)) * mask], axis=1))
+      [g_lanes, lane_expand(sum_sq, slot, pack)], axis=1))
   cap2 = min(c, psent + 2)
   # uids come rank-ordered (ascending, sentinels last) from the outer
   # compact_segments, so pids is already sorted: skip the argsort
@@ -419,9 +422,16 @@ def _capacity(optimizer, n: int, rows_cap: int,
 
 def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
                      rows_cap: int, cap_rows: Optional[int] = None,
-                     flat_sq=None):
+                     flat_sq=None, storage_pack: int = 1):
   """Compact duplicate update rows, then run the optimizer on the unique
   rows only.
+
+  ``storage_pack > 1``: ``table`` (and elementwise state leaves) arrive
+  in the group's PHYSICAL packed layout ``[rows_cap/pack, 128]``
+  (``GroupSpec.storage_pack``); updates are lane-packed against the
+  operand itself and the results return in the same layout — no reshape
+  of the parameter ever exists in the step, so the lane-padded relayout
+  (``packed_dispatch_ok``) cannot occur at any group size.
 
   ``flat_sq``: optional pre-accumulated per-occurrence squared-gradient
   rows aligned with ``flat_g`` (the cross-slice gather pre-compacts per
@@ -462,13 +472,35 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
   cap = _capacity(optimizer, n, rows_cap, cap_rows)
   with_sq = bool(getattr(optimizer, 'needs_sq', True))
   w = flat_g.shape[1]
-  # packed_view_ok folds in the lane-padded-layout HBM bound shared with
-  # the eligibility probe; the extra clauses here are runtime-only facts
-  # (optimizer support, compaction capacity headroom).
-  packable = (packed_view_ok(rows_cap, w)
-              and getattr(optimizer, 'supports_lane_packing', False))
-  pack = 128 // w if packable else 1
-  packable = packable and rows_cap // pack + 2 < cap
+  storage_packed = storage_pack > 1
+  if (storage_packed
+      and not getattr(optimizer, 'supports_lane_packing', False)):
+    # optimizer without lane-wise apply semantics (SparseAdam's per-row
+    # step counter): unpack to natural views, apply, repack.  The
+    # natural reshape CAN provoke the lane-padded relayout on huge
+    # narrow groups — the documented cost of pairing Adam with
+    # packed_storage; disable packed_storage on the layer to avoid it.
+    packed_shape = table.shape
+    tn = table.reshape(rows_cap, w)
+    sn = {k: (v.reshape(rows_cap, w) if v.shape == packed_shape else v)
+          for k, v in state.items()}
+    t2, s2 = _dedup_and_apply(optimizer, tn, sn, flat_ids, flat_g, lr,
+                              rows_cap, cap_rows=cap_rows, flat_sq=flat_sq)
+    return t2.reshape(packed_shape), {
+        k: (v.reshape(packed_shape) if v.shape == (rows_cap, w) else v)
+        for k, v in s2.items()
+    }
+  if storage_packed:
+    pack, packable = storage_pack, False
+  else:
+    # packed_view_ok folds in the lane-padded-layout HBM bound shared
+    # with the eligibility probe; the extra clauses here are
+    # runtime-only facts (optimizer support, compaction capacity
+    # headroom).
+    packable = (packed_view_ok(rows_cap, w)
+                and getattr(optimizer, 'supports_lane_packing', False))
+    pack = 128 // w if packable else 1
+    packable = packable and rows_cap // pack + 2 < cap
 
   order = jnp.argsort(flat_ids) if cap < cap_safe else None
   if with_sq and flat_sq is not None:
@@ -483,7 +515,11 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
   else:
     uids, sum_g, sum_sq, num_unique = compact_segments(
         flat_ids, flat_g, cap, sentinel, with_sq=with_sq, order=order)
-  if packable:
+  if storage_packed:
+    # updates lane-pack against the physically packed operand directly
+    pids, g_p, sq_p = _lane_pack(uids, sum_g, sum_sq, pack, rows_cap)
+    t2, s2 = optimizer.apply_unique(table, state, pids, g_p, sq_p, lr)
+  elif packable:
     pids, g_p, sq_p = _lane_pack(uids, sum_g, sum_sq, pack, rows_cap)
     ptable = table.reshape(rows_cap // pack, pack * w)
     pstate = {
@@ -519,6 +555,12 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
       tot_sq = jnp.where(valid3[:, None], seg_total(sq_src)[order3], 0.0)
     else:
       tot_sq = None
+    if storage_packed:
+      # correction rows lane-pack too (uids2 is ascending-with-sentinels
+      # like the main wave's compacted buffer, so _lane_pack's
+      # sorted-pids shortcut holds)
+      pids2, g_p2, sq_p2 = _lane_pack(uids2, tot_g, tot_sq, pack, rows_cap)
+      return optimizer.apply_unique(t3, s3, pids2, g_p2, sq_p2, lr)
     return optimizer.apply_unique(t3, s3, uids2, tot_g, tot_sq, lr)
 
   return jax.lax.cond(num_unique > cap, correction, lambda args: args,
@@ -530,9 +572,11 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
 # docs/perf_notes.md round 3) showed XLA can materialize a narrow
 # group's parameter in a lane-padded layout to serve the packed
 # reshape — 8x expansion on synthetic-tiny's 29.1M-row width-16 group
-# (1.73 -> 13.88 GiB), blowing HBM.  Until the layout is pinned, the
-# packed dispatch declines narrow groups whose padded form could
-# exceed this many bytes; width-128 groups reshape-free and unaffected.
+# (1.73 -> 13.88 GiB), blowing HBM.  Round 4 removed the reshape from
+# the DEFAULT path entirely: qualifying narrow groups store physically
+# packed (GroupSpec.storage_pack), where this bound does not apply.
+# It still guards the legacy reshape path — packed_storage=False
+# layers, and widths outside 8..64 — where the relayout risk remains.
 PACKED_PARAM_BYTES_LIMIT = 2 << 30
 
 
@@ -571,23 +615,28 @@ def _use_segwalk(optimizer, table) -> bool:
           or pallas_segwalk.ASSUME_TPU)
 
 
-def _segwalk_apply(optimizer, table, state, flat_ids, flat_g, lr):
+def _segwalk_apply(optimizer, table, state, flat_ids, flat_g, lr,
+                   storage_pack: int = 1):
   """Sort the raw stream and hand it to the fused segment-walk kernel
   (ops/pallas_segwalk.py) — no compaction, no capacity, no correction
-  wave: every segment is applied exactly once."""
+  wave: every segment is applied exactly once.  ``storage_pack > 1``:
+  the table arrives (and returns) in the physical packed layout; the
+  kernel runs its packed path on the operand itself."""
   from distributed_embeddings_tpu.ops import pallas_segwalk
   interp = pallas_segwalk.FORCE_INTERPRET
+  lw = flat_g.shape[1] if storage_pack > 1 else None
   order = jnp.argsort(flat_ids)
   sid = flat_ids[order].astype(jnp.int32)
   sg = flat_g[order].astype(jnp.float32)
   if isinstance(optimizer, SparseSGD):
     t2 = pallas_segwalk.segwalk_apply(
-        table, None, sid, sg, lr, op='sgd', interpret=interp)
+        table, None, sid, sg, lr, op='sgd', interpret=interp,
+        logical_width=lw)
     return t2, state
   op = 'adagrad_dedup' if optimizer.dedup else 'adagrad_sq'
   t2, a2 = pallas_segwalk.segwalk_apply(
       table, state['acc'], sid, sg, lr, op=op, eps=optimizer.epsilon,
-      interpret=interp)
+      interpret=interp, logical_width=lw)
   return t2, {'acc': a2}
 
 
@@ -677,17 +726,20 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
         flat_g = gathered[:, 1:1 + w]
         if needs_sq:
           flat_sq = gathered[:, 1 + w:]
+      spack = getattr(group, 'storage_pack', 1)
       if flat_sq is None and _use_segwalk(optimizer, params[key][0]):
         # fused segment-walk path (flat_sq present means the stream
         # carries pre-accumulated squares the kernel cannot consume —
         # multi-slice per-occurrence Adagrad falls back to XLA)
         table, state2 = _segwalk_apply(optimizer, params[key][0],
-                                       state_g, flat_ids, flat_g, lr)
+                                       state_g, flat_ids, flat_g, lr,
+                                       storage_pack=spack)
       else:
         table, state2 = _dedup_and_apply(optimizer, params[key][0],
                                          state_g, flat_ids, flat_g, lr,
                                          rows_cap, cap_rows=cap_rows,
-                                         flat_sq=flat_sq)
+                                         flat_sq=flat_sq,
+                                         storage_pack=spack)
       new_params[key] = table[None]
       new_state[key] = {k: v[None] for k, v in state2.items()}
       fence = table[0, 0]
@@ -853,10 +905,13 @@ def _calibration_mirror(dist: DistributedEmbedding, cpus):
       mesh=create_mesh(cpus[:dist.world_size], axis_name=dist.axis_name),
       axis_name=dist.axis_name,
       param_dtype=dist.param_dtype,
-      compute_dtype=dist.compute_dtype)
+      compute_dtype=dist.compute_dtype,
+      packed_storage=dist.plan.packed_storage)
+  # the mirror's params must match ITS plan's physical layout (packed
+  # [param_rows, param_width] for storage-packed groups)
   zeros = {
-      f'group_{gi}': np.zeros((dist.world_size, g.rows_cap, g.width),
-                              dist.param_dtype)
+      f'group_{gi}': np.zeros((dist.world_size, g.param_rows,
+                               g.param_width), dist.param_dtype)
       for gi, g in enumerate(mirror.plan.groups)
   }
   return mirror, zeros

@@ -28,9 +28,15 @@ def _segwalk_group_ok(g, dt) -> bool:
   """The ONE predicate deciding whether the segment-walk kernel serves a
   fusion group — shared by the report and the all-groups check so they
   can never drift from each other (the dispatch in parallel/sparse.py
-  applies the same two gates)."""
+  applies the same gates)."""
   from distributed_embeddings_tpu.ops import pallas_segwalk
   from distributed_embeddings_tpu.parallel.sparse import packed_dispatch_ok
+  if getattr(g, 'storage_pack', 1) > 1:
+    # packed storage: the kernel consumes the physical [rows/pack, 128]
+    # operand with no reshape, so the lane-padded-layout HBM bound
+    # (packed_dispatch_ok) does not apply at any group size
+    return pallas_segwalk.supported(
+        jax.ShapeDtypeStruct((g.param_rows, g.param_width), dt))
   return (pallas_segwalk.supported(
       jax.ShapeDtypeStruct((g.rows_cap, g.width), dt))
           and packed_dispatch_ok(g.rows_cap, g.width))
@@ -48,6 +54,10 @@ def _group_table_aval(g, dt):
   groups are probed at their natural narrow width — which the kernels
   reject — so the reported count matches the actual dispatch."""
   from distributed_embeddings_tpu.parallel.sparse import packed_view_ok
+  if getattr(g, 'storage_pack', 1) > 1:
+    # packed storage: the kernel sees the physical layout itself — no
+    # reshape, so no packed_dispatch_ok gate at any group size
+    return jax.ShapeDtypeStruct((g.param_rows, g.param_width), dt)
   w = g.width
   if packed_view_ok(g.rows_cap, w):
     pack = 128 // w

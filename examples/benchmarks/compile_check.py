@@ -78,12 +78,12 @@ def main():
 
   W = args.chips
   emb = {
-      f'group_{gi}': sds((W, g.rows_cap, g.width), jnp.float32, tsh)
+      f'group_{gi}': sds((W, g.param_rows, g.param_width), jnp.float32, tsh)
       for gi, g in enumerate(dist.plan.groups)
   }
   acc = {
       f'group_{gi}': {
-          'acc': sds((W, g.rows_cap, g.width), jnp.float32, tsh)
+          'acc': sds((W, g.param_rows, g.param_width), jnp.float32, tsh)
       } for gi, g in enumerate(dist.plan.groups)
   }
   mlp_shapes = jax.eval_shape(

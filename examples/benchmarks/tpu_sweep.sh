@@ -46,4 +46,7 @@ T=900 run python examples/benchmarks/scatter_probe.py
 # 8. remaining hardware correctness gates (full TPU-gated suite)
 T=1800 run python -m pytest tests/test_pallas_tpu.py -q -s -k "not microbench"
 
+# logged completion marker: the watcher keys retry-vs-done on seeing
+# BOTH the step-0 artifact line and this marker in its run's log slice
+echo "=== sweep complete $(date) ===" | tee -a "$LOG"
 echo "sweep done: $LOG"

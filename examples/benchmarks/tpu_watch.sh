@@ -19,13 +19,20 @@ EOF
   then
     if [ -z "${SWEEP_DONE:-}" ]; then
       echo "=== tunnel healthy $(date) — launching sweep ===" | tee -a "$PROBE_LOG"
+      # remember where this run's sweep output starts: the log is
+      # append-only across watcher restarts, and a stale comparable
+      # line from an earlier day must not mark THIS sweep as done
+      OFFSET=$(wc -c < "$SWEEP_LOG" 2>/dev/null || echo 0)
       bash examples/benchmarks/tpu_sweep.sh "$SWEEP_LOG"
       echo "=== sweep exited $(date) ===" | tee -a "$PROBE_LOG"
-      # Only count the sweep as done once the official bench artifact
-      # line actually landed (the tunnel can die mid-sweep); otherwise a
-      # later healthy window retries the whole thing — steps append to
-      # the log, so partial data from a dead window is never lost.
-      if grep -q '"comparable": true' "$SWEEP_LOG"; then
+      # Only count the sweep as done once BOTH the official bench
+      # artifact line landed AND the sweep ran to its end (the tunnel
+      # can die mid-sweep, stranding the A/B and correctness steps);
+      # otherwise a later healthy window retries the whole thing —
+      # steps append to the log, so partial data is never lost.
+      SLICE=$(tail -c +$((OFFSET + 1)) "$SWEEP_LOG" 2>/dev/null)
+      if echo "$SLICE" | grep -q '"comparable": true' \
+          && echo "$SLICE" | grep -q 'sweep complete'; then
         SWEEP_DONE=1
         INTERVAL=1800
       else

@@ -649,7 +649,10 @@ class TestInit:
     params = dist.init(42)
     for gi, g in enumerate(dist.plan.groups):
       arr = params[f'group_{gi}']
-      assert arr.shape == (WORLD, g.rows_cap, g.width)
+      # physical layout: packed [rows_cap/pack, 128] for qualifying
+      # narrow groups (GroupSpec.storage_pack), natural otherwise
+      assert arr.shape == (WORLD, g.param_rows, g.param_width)
+      assert g.param_rows * g.param_width == g.rows_cap * g.width
     # get_weights returns correctly-shaped global tables
     tables = get_weights(dist, params)
     for cfg, t in zip(configs, tables):
@@ -667,3 +670,19 @@ class TestInit:
     from distributed_embeddings_tpu.parallel import broadcast_variables
     params = {'a': jnp.ones(3)}
     assert broadcast_variables(params) is params
+
+
+class TestSparseCoreSeam:
+
+  def test_sparsecore_stub_raises_with_contract(self):
+    """lookup_impl='sparsecore' is a staged, hardware-gated seam
+    (docs/design.md §8): constructing the layer works (so configs can be
+    written portably), but any lookup raises — never a silent
+    TensorCore fallback."""
+    mesh = create_mesh(jax.devices()[:4])
+    dist = DistributedEmbedding([TableConfig(64, 16, 'sum')] * 4,
+                                mesh=mesh, lookup_impl='sparsecore')
+    params = dist.init(0)
+    ids = [np.zeros((8, 2), np.int32)] * 4
+    with pytest.raises(NotImplementedError, match='sparsecore'):
+      dist.apply(params, ids)

@@ -109,12 +109,12 @@ def _step_avals(dist, mesh, configs, GB, dense_opt):
   tsh = NamedSharding(mesh, P(dist.axis_name, None, None))
   W = dist.world_size
   emb = {
-      f'group_{gi}': _sds((W, g.rows_cap, g.width), jnp.float32, tsh)
+      f'group_{gi}': _sds((W, g.param_rows, g.param_width), jnp.float32, tsh)
       for gi, g in enumerate(dist.plan.groups)
   }
   acc = {
       f'group_{gi}': {
-          'acc': _sds((W, g.rows_cap, g.width), jnp.float32, tsh)
+          'acc': _sds((W, g.param_rows, g.param_width), jnp.float32, tsh)
       } for gi, g in enumerate(dist.plan.groups)
   }
   kernel = _sds((sum(c.output_dim for c in configs), 1), jnp.float32, rep)

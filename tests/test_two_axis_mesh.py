@@ -334,6 +334,8 @@ def test_calibration_mirror_matches_plan():
                                     dist.plan.groups)):
     assert g2.key == g1.key and g2.rows == g1.rows
     assert g2.rows_cap == g1.rows_cap
+    assert g2.storage_pack == g1.storage_pack
     assert [len(r) for r in g2.requests] == [len(r) for r in g1.requests]
-    assert zeros[f'group_{gi}'].shape == (dist.world_size, g1.rows_cap,
-                                          g1.width)
+    # the mirror's zeros match its PHYSICAL (possibly packed) layout
+    assert zeros[f'group_{gi}'].shape == (dist.world_size, g1.param_rows,
+                                          g1.param_width)
