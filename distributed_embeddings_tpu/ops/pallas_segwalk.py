@@ -105,14 +105,14 @@ def _seg_scan(vals: jax.Array, starts: jax.Array) -> jax.Array:
   return vals
 
 
-def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, slot_vmem, g_ref,
-                    lr_smem, table_in, acc_in, table_ref, acc_ref, tbuf,
-                    abuf, carry, carry_id, wcount, rsem, wsem, *,
-                    num_rows, num_tiles, tile, width, gw, pack, op):
+def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, half_vmem, slot_vmem,
+                    g_ref, lr_smem, table_in, acc_in, table_ref, acc_ref,
+                    tbuf, abuf, carry, carry_id, wcount, rsem, wsem, *,
+                    num_rows, num_tiles, tile, width, gw, pack, pair, op):
   """One [tile, gw] block of the sorted stream against [*, width] rows.
 
   ``op``: 'sgd' | 'adagrad_dedup' | 'adagrad_sq' (static).  ``carry``
-  [2, width] VMEM scratch holds the running (sum, sum_sq) of the
+  [2, pair*width] VMEM scratch holds the running (sum, sum_sq) of the
   segment spanning the tile boundary; ``carry_id`` [1, 1] SMEM its id.
   For 'sgd' the acc refs point at a dummy buffer and are never DMA'd.
 
@@ -124,16 +124,33 @@ def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, slot_vmem, g_ref,
   serving up to ``pack`` original rows, and the scan/optimizer math is
   unchanged (untouched lanes carry zero gradient; Adagrad is
   elementwise, the exact argument of ``parallel/sparse.py:_lane_pack``).
+
+  Pair fetch (``pair == 2``, bf16 tables): Mosaic rejects
+  single-sublane bf16 slices (the packed-sublane layout pairs rows
+  2k/2k+1 in one 32-bit word), so ids arrive FURTHER divided by 2 —
+  ``sid`` indexes fetch PAIRS of the 3-D table view
+  ``[rows/(2*pack), 2, width]`` and ``half_vmem`` carries each row's
+  ``packed_id % 2``.  The payload expands to ``pair*width`` lanes (one
+  block per half) and the scan/carry machinery runs unchanged at that
+  superrow width; the optimizer update runs per half on f32-converted
+  staging values and rounds to bf16 once at write.  The write-back of a
+  whole fetched pair is SAFE here — unlike the rowwise kernel
+  (ops/pallas_rowwise.py header) — because the segment key IS the pair:
+  both rows of a pair merge into one segment applied at exactly one
+  grid position, so no other step can race the untouched half (which is
+  rewritten byte-identically: zero gradient lanes give a zero update,
+  and f32(bf16) round-trips exactly).
   """
   del table_in, acc_in  # same memory as the aliased output refs
   has_acc = op != 'sgd'
+  pw = pair * width
   t = pl.program_id(0)
   p = jax.lax.rem(t, 2)
 
   @pl.when(t == 0)
   def _init():
     carry_id[0, 0] = -1
-    carry[...] = jnp.zeros((2, width), jnp.float32)
+    carry[...] = jnp.zeros((2, pw), jnp.float32)
     wcount[0, 0] = 0
     wcount[1, 0] = 0
 
@@ -187,18 +204,25 @@ def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, slot_vmem, g_ref,
   if pack > 1:
     lane = jax.lax.broadcasted_iota(jnp.int32, (tile, width), 1) // gw
     g = jnp.tile(g, (1, pack)) * (lane == slot_vmem[:]).astype(jnp.float32)
+  if pair > 1:
+    # expand to the pair superrow: one `width`-lane block per half,
+    # masked by the row's half index (zeros in the untouched half)
+    hf = (half_vmem[:] == 0).astype(jnp.float32)        # [tile, 1]
+    g = jnp.concatenate([g * hf, g * (1.0 - hf)], axis=1)  # [tile, pw]
   # both scalars live in SMEM: scalar compare, then broadcast
   cont = (sid_smem[0, 0] == carry_id[0, 0]).astype(jnp.float32)
   if op == 'adagrad_sq':
-    payload = jnp.concatenate([g, g * g], axis=1)       # [tile, 2w]
-    carry_row = carry[...].reshape(1, 2 * width)
+    payload = jnp.concatenate([g, g * g], axis=1)       # [tile, 2*pw]
+    # lane-concat, not reshape: splitting [1, 2*pw] into [2, pw] is a
+    # lane-splitting shape cast Mosaic rejects past 128 lanes
+    carry_row = jnp.concatenate([carry[0:1], carry[1:2]], axis=1)
   else:
     payload = g
     carry_row = carry[0:1]
   inject = jnp.concatenate(
       [payload[0:1] + cont * carry_row, payload[1:]], axis=0)
-  seg = _seg_scan(inject, starts)                       # [tile, w|2w]
-  tot = seg[:, :width]
+  seg = _seg_scan(inject, starts)                       # [tile, pw|2pw]
+  tot = seg[:, :pw]
 
   def wait_read(k, _):
     pltpu.make_async_copy(table_ref.at[pl.ds(0, 1)],
@@ -212,18 +236,41 @@ def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, slot_vmem, g_ref,
 
   # ----- vector update (garbage at non-last rows is never written) -----
   lr = lr_smem[0, 0]
-  if op == 'sgd':
-    tbuf[p] = tbuf[p] - lr * tot
+  if pair == 1:
+    if op == 'sgd':
+      tbuf[p] = tbuf[p] - lr * tot
+    else:
+      add = tot * tot if op == 'adagrad_dedup' else seg[:, width:]
+      acc_new = abuf[p] + add
+      eps = lr_smem[0, 1]
+      tbuf[p] = tbuf[p] - lr * tot * jax.lax.rsqrt(acc_new + eps)
+      abuf[p] = acc_new
   else:
-    add = tot * tot if op == 'adagrad_dedup' else seg[:, width:]
-    acc_new = abuf[p] + add
-    eps = lr_smem[0, 1]
-    tbuf[p] = tbuf[p] - lr * tot * jax.lax.rsqrt(acc_new + eps)
-    abuf[p] = acc_new
+    # per half: f32 math on the converted bf16 staging rows, one
+    # rounding at the write.  Halves with no stream contributions see a
+    # zero total (and zero acc add), so they rewrite byte-identically —
+    # f32(bf16) round-trips exactly.  Slices address the REF with a
+    # static middle index (fresh loads/stores; value-slicing a loaded
+    # 3-D block leaves layout offsets Mosaic rejects — see
+    # ops/pallas_lookup.py's `unit`).
+    for s in range(2):
+      tots = tot[:, s * width:(s + 1) * width]
+      ts = tbuf[p, :, s, :].astype(jnp.float32)
+      if op == 'sgd':
+        ns = ts - lr * tots
+      else:
+        adds = (tots * tots if op == 'adagrad_dedup'
+                else seg[:, pw + s * width:pw + (s + 1) * width])
+        acc_new = abuf[p, :, s, :] + adds
+        eps = lr_smem[0, 1]
+        ns = ts - lr * tots * jax.lax.rsqrt(acc_new + eps)
+        abuf[p, :, s, :] = acc_new
+      tbuf[p, :, s, :] = ns.astype(tbuf.dtype)
 
   # ----- update carries (AFTER the scan consumed the old values) -------
   if op == 'adagrad_sq':
-    carry[...] = seg[tile - 1:tile].reshape(2, width)
+    carry[0:1] = seg[tile - 1:tile, :pw]
+    carry[1:2] = seg[tile - 1:tile, pw:]
   else:
     carry[0:1] = seg[tile - 1:tile]
   carry_id[0, 0] = sid_smem[tile - 1, 0]
@@ -282,23 +329,33 @@ def lane_expand(rows_w: jax.Array, slots: jax.Array, pack: int) -> jax.Array:
 
 
 def supported(table: jax.Array) -> bool:
-  """f32 2-D tables at width 128, or a narrow width dividing 128 whose
-  row count the packed view can absorb (``rows % (128 // w) == 0`` —
-  always true for the runtime's fused groups, whose ``rows_cap``
-  granularity guarantees it).
+  """f32 or bf16 2-D tables at width 128, or a narrow width dividing
+  128 whose row count the packed view can absorb (``rows % (128 // w)
+  == 0`` — always true for the runtime's fused groups, whose
+  ``rows_cap`` granularity guarantees it; bf16 additionally needs pair
+  divisibility, which the planner's doubled granularity provides).
 
   Narrow rows are served ONLY through the [rows/pack, 128] packed view:
   the v5e Mosaic backend rejects sub-128-lane VMEM slices outright
   ("Slice shape along dimension 2 must be aligned to tiling (128)"),
   caught by tests/test_tpu_lowering.py — a natural narrow-width kernel
-  cannot compile on this hardware.
+  cannot compile on this hardware.  bf16 rows additionally fetch in
+  PAIRS of packed rows (single-sublane bf16 slices are rejected too);
+  the pair-merged segment key keeps the whole-pair write-back race-free
+  (see the kernel docstring).
   """
-  if not (table.ndim == 2 and table.dtype == jnp.float32):
+  if not (table.ndim == 2
+          and table.dtype in (jnp.float32, jnp.bfloat16)):
     return False
   rows, w = table.shape
+  pair = 2 if table.dtype == jnp.bfloat16 else 1
   if w == 128:
-    return True
-  return 8 <= w < 128 and 128 % w == 0 and rows % (128 // w) == 0
+    pack = 1
+  elif 8 <= w < 128 and 128 % w == 0:
+    pack = 128 // w
+  else:
+    return False
+  return rows % (pair * pack) == 0
 
 
 @functools.partial(jax.jit, static_argnames=('op', 'eps', 'interpret',
@@ -359,7 +416,13 @@ def segwalk_apply(table: jax.Array,
   pack = 128 // w if w < 128 else 1
   kw = w * pack
   prows = num_rows // pack
-  tile = _tile_rows(kw)
+  # bf16 fetches in PAIRS of (packed) rows — see the kernel docstring;
+  # the accumulator stays f32 (the runtime always creates it f32)
+  pair = 2 if table.dtype == jnp.bfloat16 else 1
+  if pair == 2 and acc is not None and acc.dtype != jnp.float32:
+    raise ValueError(f'bf16 segwalk requires an f32 accumulator, got '
+                     f'{acc.dtype}')
+  tile = _tile_rows(pair * kw)
   n = sorted_ids.shape[0]
   n_pad = -(-n // tile) * tile
   if n_pad != n:
@@ -377,9 +440,24 @@ def segwalk_apply(table: jax.Array,
     # id stream as the operand instead of materializing a zeros array
     kids, slots = sorted_ids, sorted_ids
     table_k, acc_k = table, acc
+  if pair == 2:
+    # fetch-unit ids: the segment key merges to the PAIR (both rows of
+    # a fetched pair apply at one grid position — the race-freedom
+    # argument), halves ride along for the in-kernel expansion.
+    # supported() guarantees prows is even; the packed sentinel prows
+    # maps to fetch id nfetch, out of range, skipped by the walks.
+    nfetch = prows // 2
+    halves = jax.lax.rem(kids, 2)
+    kids = kids // 2
+    table_k = table_k.reshape(nfetch, 2, kw)
+    acc_k = acc_k.reshape(nfetch, 2, kw) if acc_k is not None else None
+  else:
+    nfetch = prows
+    halves = kids  # statically never read when pair == 1
   # global segment-last flags (the one lookahead the kernel cannot do),
-  # over the PACKED ids: adjacent uids sharing a packed row are one
-  # segment whose lanes carry their per-uid totals disjointly
+  # over the FETCH-unit ids: adjacent uids sharing a packed row (or
+  # bf16 pair) are one segment whose lanes (or halves) carry their
+  # per-uid totals disjointly
   is_last = jnp.concatenate([
       (kids[1:] != kids[:-1]),
       jnp.ones((1,), bool)
@@ -390,16 +468,21 @@ def segwalk_apply(table: jax.Array,
   ids2d = kids[:, None]
   # 'sgd' has no accumulator: a small dummy keeps the operand/alias
   # structure uniform (the kernel never issues DMAs against it)
-  acc_operand = (acc_k if acc_k is not None
-                 else jnp.zeros((8, kw), jnp.float32))
+  if acc_k is not None:
+    acc_operand = acc_k
+  else:
+    acc_operand = jnp.zeros((8, 2, kw) if pair == 2 else (8, kw),
+                            jnp.float32)
 
+  stage = (2, tile, 2, kw) if pair == 2 else (2, tile, kw)
   kernel = functools.partial(_segwalk_kernel,
-                             num_rows=prows,
+                             num_rows=nfetch,
                              num_tiles=num_tiles,
                              tile=tile,
                              width=kw,
                              gw=w,
                              pack=pack,
+                             pair=pair,
                              op=op)
   outs = pl.pallas_call(
       kernel,
@@ -411,6 +494,8 @@ def segwalk_apply(table: jax.Array,
                        memory_space=pltpu.SMEM),   # is_last (walk)
           pl.BlockSpec((tile, 1), lambda t: (t, 0),
                        memory_space=pltpu.VMEM),   # ids (vector scan)
+          pl.BlockSpec((tile, 1), lambda t: (t, 0),
+                       memory_space=pltpu.VMEM),   # pair halves
           pl.BlockSpec((tile, 1), lambda t: (t, 0),
                        memory_space=pltpu.VMEM),   # lane slots
           pl.BlockSpec((tile, w), lambda t: (t, 0),
@@ -430,11 +515,11 @@ def segwalk_apply(table: jax.Array,
       # REQUIRED for correctness, not just memory: rows the kernel never
       # touches must retain their input values, which only the aliased
       # output buffer provides
-      input_output_aliases={6: 0, 7: 1},
+      input_output_aliases={7: 0, 8: 1},
       scratch_shapes=[
-          pltpu.VMEM((2, tile, kw), jnp.float32),  # tbuf (parity pair)
-          pltpu.VMEM((2, tile, kw), jnp.float32),  # abuf (parity pair)
-          pltpu.VMEM((2, kw), jnp.float32),        # carry (sum, sum_sq)
+          pltpu.VMEM(stage, table_k.dtype),        # tbuf (parity pair)
+          pltpu.VMEM(stage, jnp.float32),          # abuf (parity pair)
+          pltpu.VMEM((2, pair * kw), jnp.float32),  # carry (sum, sum_sq)
           pltpu.SMEM((1, 1), jnp.int32),           # carry id
           pltpu.SMEM((2, 1), jnp.int32),           # in-flight write counts
           pltpu.SemaphoreType.DMA,                 # read semaphore
@@ -443,13 +528,17 @@ def segwalk_apply(table: jax.Array,
       compiler_params=pltpu.CompilerParams(
           dimension_semantics=('arbitrary',)),
       interpret=interpret,
-  )(ids2d, is_last[:, None], ids2d, slots[:, None], sorted_g, lr_arr,
-    table_k, acc_operand)
+  )(ids2d, is_last[:, None], ids2d, halves[:, None], slots[:, None],
+    sorted_g, lr_arr, table_k, acc_operand)
+  new_table, new_acc = outs[0], outs[1]
+  if pair == 2:
+    new_table = new_table.reshape(prows, kw)
+    if acc_k is not None:
+      new_acc = new_acc.reshape(prows, kw)
   if prepacked:
     # hand back the physical packed layout the table arrived in
-    new_table = outs[0]
-    return new_table if op == 'sgd' else (new_table, outs[1])
-  new_table = outs[0].reshape(num_rows, w)
+    return new_table if op == 'sgd' else (new_table, new_acc)
+  new_table = new_table.reshape(num_rows, w)
   if op == 'sgd':
     return new_table
-  return new_table, outs[1].reshape(num_rows, w)
+  return new_table, new_acc.reshape(num_rows, w)

@@ -8,9 +8,17 @@
 set -u
 LOG=${1:-/tmp/tpu_sweep.log}
 cd "$(dirname "$0")/../.."
+FAIL=0
 run() {
   echo "=== $* ($(date +%H:%M:%S)) ===" | tee -a "$LOG"
   timeout "${T:-900}" "$@" 2>&1 | grep -v WARNING | tail -6 | tee -a "$LOG"
+  local rc=${PIPESTATUS[0]}
+  if [ "$rc" -ne 0 ]; then
+    # a dead tunnel times steps out (rc 124): record it and withhold
+    # the completion marker so the watcher retries in a later window
+    FAIL=1
+    echo "--- step failed rc=$rc: $* ---" | tee -a "$LOG"
+  fi
 }
 
 # 0. THE official artifact line: steady-state tiny step time on the chip
@@ -33,8 +41,9 @@ T=1200 run python examples/benchmarks/trace_step.py --calls 3 --auto_capacity
 T=1200 run python examples/benchmarks/trace_step.py --calls 3 --auto_capacity --fused_apply
 T=1200 run python examples/benchmarks/trace_step.py --calls 3 --segwalk_apply
 
-# 5. bf16 tables variant
+# 5. bf16 tables variant, XLA apply vs pair-fetch segwalk A/B
 T=1200 run python bench.py --model tiny --steps 10 --auto_capacity --param_dtype bfloat16
+T=1200 run python bench.py --model tiny --steps 10 --param_dtype bfloat16 --segwalk_apply
 
 # 6. DLRM-shaped criteo model (width 128, hotness 1: kernel sweet spot)
 T=1200 run python bench.py --model criteo --steps 10 --auto_capacity --fused_apply
@@ -47,6 +56,12 @@ T=900 run python examples/benchmarks/scatter_probe.py
 T=1800 run python -m pytest tests/test_pallas_tpu.py -q -s -k "not microbench"
 
 # logged completion marker: the watcher keys retry-vs-done on seeing
-# BOTH the step-0 artifact line and this marker in its run's log slice
-echo "=== sweep complete $(date) ===" | tee -a "$LOG"
+# BOTH the step-0 artifact line and this marker in its run's log slice;
+# any failed step withholds it so the next healthy window retries
+if [ "$FAIL" -eq 0 ]; then
+  echo "=== sweep complete $(date) ===" | tee -a "$LOG"
+else
+  echo "=== sweep finished WITH FAILED STEPS $(date) — will retry ===" \
+    | tee -a "$LOG"
+fi
 echo "sweep done: $LOG"

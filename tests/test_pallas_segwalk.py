@@ -271,3 +271,193 @@ def test_seg_scan_matches_numpy():
     run = run + vals[i]
     want[i] = run
   np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------- bf16
+# bf16 tables fetch in PAIRS of (packed) rows with the segment key
+# merged to the pair (kernel docstring: the pair write-back is
+# race-free because a pair is RMW'd at exactly one grid position).
+# Math runs in f32 on staged values, rounding to bf16 once at write —
+# the oracle mirrors that exactly: f32 math, one final bf16 cast.
+
+
+def bf16_oracle(op, table_bf16, acc, ids, grads):
+  t32, a32 = oracle(op, np.asarray(table_bf16, np.float32), acc, ids,
+                    grads)
+  return jnp.asarray(t32).astype(jnp.bfloat16), a32
+
+
+def run_kernel_bf16(op, table_bf16, acc, ids, grads):
+  order = np.argsort(ids, kind='stable')
+  sid = jnp.asarray(ids[order], jnp.int32)
+  sg = jnp.asarray(grads[order], jnp.float32)
+  t = jnp.asarray(table_bf16, jnp.bfloat16)
+  if op == 'sgd':
+    t2 = pallas_segwalk.segwalk_apply(t, None, sid, sg, LR, op=op,
+                                      eps=EPS, interpret=True)
+    return t2, None
+  t2, a2 = pallas_segwalk.segwalk_apply(t, jnp.asarray(acc), sid, sg, LR,
+                                        op=op, eps=EPS, interpret=True)
+  return t2, np.asarray(a2)
+
+
+@pytest.mark.parametrize('op', ['sgd', 'adagrad_dedup', 'adagrad_sq'])
+@pytest.mark.parametrize('width', [8, 16, 128])
+def test_bf16_random_stream(op, width):
+  import zlib
+  rng = np.random.default_rng(zlib.crc32(f'bf16-{op}-{width}'.encode()))
+  rows, n = 64, 1000
+  table = jnp.asarray(rng.normal(size=(rows, width)),
+                      jnp.bfloat16)
+  acc = None if op == 'sgd' else rng.uniform(
+      0.05, 0.2, size=(rows, width)).astype(np.float32)
+  ids = rng.integers(0, rows, n).astype(np.int32)
+  ids[rng.random(n) < 0.2] = rows
+  grads = rng.normal(size=(n, width)).astype(np.float32)
+  want_t, want_a = bf16_oracle(op, table, acc, ids, grads)
+  got_t, got_a = run_kernel_bf16(op, table, acc, ids, grads)
+  # one bf16 ulp of slack: scan-order f32 differences can flip the
+  # final rounding
+  np.testing.assert_allclose(np.asarray(got_t, np.float32),
+                             np.asarray(want_t, np.float32),
+                             rtol=1e-2, atol=1e-2)
+  if acc is not None:
+    np.testing.assert_allclose(got_a, want_a, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize('op', ['sgd', 'adagrad_dedup'])
+def test_bf16_adjacent_rows_share_fetch_pair(op):
+  """The race case the rowwise kernel cannot handle: rows 2k and 2k+1
+  (and, packed, 2 adjacent packed rows) updated in the same step — the
+  pair-merged segment applies both halves at one grid position, and
+  untouched neighbours pass through bit-exactly."""
+  rng = np.random.default_rng(7)
+  rows, width = 32, 128
+  table = jnp.asarray(rng.normal(size=(rows, width)), jnp.bfloat16)
+  acc = rng.uniform(0.05, 0.2, size=(rows, width)).astype(np.float32)
+  # every update hits pairs (2k, 2k+1) plus some isolated odd/even rows
+  ids = np.array([0, 1, 0, 1, 6, 7, 9, 12, 20, 21, 21, 21],
+                 np.int32)
+  n = ids.size
+  grads = rng.normal(size=(n, width)).astype(np.float32)
+  a = None if op == 'sgd' else acc
+  want_t, want_a = bf16_oracle(op, table, a, ids, grads)
+  got_t, got_a = run_kernel_bf16(op, table, a, ids, grads)
+  np.testing.assert_allclose(np.asarray(got_t, np.float32),
+                             np.asarray(want_t, np.float32),
+                             rtol=1e-2, atol=1e-2)
+  # untouched rows are byte-identical (the fetched-pair write-back of a
+  # zero-update half must round-trip exactly)
+  untouched = sorted(set(range(rows)) - set(ids.tolist()))
+  np.testing.assert_array_equal(
+      np.asarray(got_t)[untouched].view(np.uint16),
+      np.asarray(table)[untouched].view(np.uint16))
+  if got_a is not None:
+    np.testing.assert_allclose(got_a, want_a, rtol=2e-5, atol=2e-5)
+
+
+def test_bf16_prepacked_matches_natural():
+  rng = np.random.default_rng(11)
+  rows, w = 256, 16
+  pack = 128 // w
+  table = jnp.asarray(rng.normal(size=(rows, w)), jnp.bfloat16)
+  acc = rng.uniform(0.05, 0.2, size=(rows, w)).astype(np.float32)
+  n = 512
+  ids = np.sort(rng.integers(0, rows, n)).astype(np.int32)
+  g = rng.normal(size=(n, w)).astype(np.float32)
+  nat_t, nat_a = pallas_segwalk.segwalk_apply(
+      table, jnp.asarray(acc), jnp.asarray(ids), jnp.asarray(g), LR,
+      op='adagrad_dedup', eps=EPS, interpret=True)
+  pre_t, pre_a = pallas_segwalk.segwalk_apply(
+      table.reshape(rows // pack, 128),
+      jnp.asarray(acc).reshape(rows // pack, 128), jnp.asarray(ids),
+      jnp.asarray(g), LR, op='adagrad_dedup', eps=EPS, interpret=True,
+      logical_width=w)
+  np.testing.assert_array_equal(
+      np.asarray(pre_t).view(np.uint16),
+      np.asarray(nat_t).reshape(rows // pack, 128).view(np.uint16))
+  np.testing.assert_allclose(np.asarray(pre_a).reshape(rows, w),
+                             np.asarray(nat_a), rtol=0, atol=0)
+
+
+def test_bf16_unsupported_shapes():
+  # odd (packed) row count: pair fetch cannot cover it
+  t = jax.ShapeDtypeStruct((24, 16), jnp.bfloat16)   # 24 % (2*8) != 0
+  assert not pallas_segwalk.supported(t)
+  assert pallas_segwalk.supported(
+      jax.ShapeDtypeStruct((32, 16), jnp.bfloat16))
+  assert pallas_segwalk.supported(
+      jax.ShapeDtypeStruct((30, 128), jnp.bfloat16))
+  assert not pallas_segwalk.supported(
+      jax.ShapeDtypeStruct((31, 128), jnp.bfloat16))
+  # f32 acc required for bf16 adagrad
+  with pytest.raises(ValueError, match='f32 accumulator'):
+    pallas_segwalk.segwalk_apply(
+        jnp.zeros((32, 128), jnp.bfloat16),
+        jnp.zeros((32, 128), jnp.bfloat16),
+        jnp.zeros((8,), jnp.int32), jnp.zeros((8, 128), jnp.float32),
+        0.1, op='adagrad_dedup', interpret=True)
+
+
+@pytest.mark.parametrize('opt_kind', ['sgd', 'adagrad'])
+def test_bf16_integration_through_hybrid_step_interpreted(opt_kind):
+  """bf16 tables end-to-end: the pair-fetch kernel through the real
+  distributed producer (packed storage default on), vs the XLA apply.
+  Tolerance is bf16-scale: the two paths round at different points."""
+  import optax
+  from distributed_embeddings_tpu.parallel import (DistributedEmbedding,
+                                                   TableConfig, create_mesh,
+                                                   SparseAdagrad, SparseSGD,
+                                                   init_hybrid_train_state,
+                                                   make_hybrid_train_step,
+                                                   set_weights, get_weights)
+  rng = np.random.default_rng(13)
+  specs = [(40, 128, 'sum', 2), (64, 16, 'sum', 2), (48, 16, 'mean', 1)]
+  configs = [TableConfig(r, w, c) for r, w, c, _ in specs]
+  mesh = create_mesh(jax.devices()[:4])
+  weights = [rng.normal(size=(r, w)).astype(np.float32)
+             for r, w, _, _ in specs]
+  inputs = [jnp.asarray(rng.integers(0, r, size=(16, h)).astype(np.int32))
+            for r, _, _, h in specs]
+  labels = (jnp.zeros((16, 3), jnp.float32),
+            jnp.asarray(rng.integers(0, 2, (16, 1)).astype(np.float32)))
+  kernel = jnp.asarray(
+      rng.standard_normal((sum(w for _, w, _, _ in specs), 1)) * 0.1,
+      jnp.float32)
+
+  def head_loss_fn(dense_params, emb_outs, batch):
+    h = jnp.concatenate([o.astype(jnp.float32) for o in emb_outs],
+                        axis=-1)
+    return jnp.mean((h @ dense_params['kernel'] - batch[1])**2)
+
+  def make_opt(fused):
+    if opt_kind == 'sgd':
+      return SparseSGD(learning_rate=0.01, use_segwalk_apply=fused)
+    return SparseAdagrad(learning_rate=0.01, use_segwalk_apply=fused)
+
+  results = {}
+  for fused in (False, True):
+    pallas_segwalk.FORCE_INTERPRET = fused
+    try:
+      dist = DistributedEmbedding(configs, mesh=mesh,
+                                  param_dtype=jnp.bfloat16,
+                                  compute_dtype=jnp.float32)
+      opt = make_opt(fused)
+      step = make_hybrid_train_step(dist, head_loss_fn, optax.sgd(0.01),
+                                    opt, donate=False)
+      params = set_weights(dist, weights)
+      state = init_hybrid_train_state(dist, {
+          'embedding': params,
+          'kernel': kernel
+      }, optax.sgd(0.01), opt)
+      for _ in range(2):
+        state, loss = step(state, inputs, labels)
+        assert np.isfinite(float(loss))
+      results[fused] = [
+          np.asarray(t, np.float32)
+          for t in get_weights(dist, state.params['embedding'])
+      ]
+    finally:
+      pallas_segwalk.FORCE_INTERPRET = False
+  for a, b in zip(results[False], results[True]):
+    np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-2)

@@ -58,8 +58,9 @@ def _compile_single(v5e_topo, fn, *shapes_dtypes):
 
 @pytest.mark.parametrize('op', ['sgd', 'adagrad_dedup', 'adagrad_sq'])
 @pytest.mark.parametrize('w', [8, 16, 32, 64, 128])
-def test_segwalk_compiles_for_v5e(v5e, op, w):
-  rows, n = 1024, 2048  # rows divisible by every pack factor
+@pytest.mark.parametrize('dtype', [jnp.float32, jnp.bfloat16])
+def test_segwalk_compiles_for_v5e(v5e, op, w, dtype):
+  rows, n = 1024, 2048  # rows divisible by every pack (and pair) factor
 
   def fn(table, acc, sid, sg):
     if op == 'sgd':
@@ -68,8 +69,29 @@ def test_segwalk_compiles_for_v5e(v5e, op, w):
     return pallas_segwalk.segwalk_apply(table, acc, sid, sg, 0.01,
                                         op=op, eps=1e-7)
 
-  _compile_single(v5e, fn, ((rows, w), jnp.float32),
+  # bf16 tables keep an f32 accumulator (pair-fetch path)
+  _compile_single(v5e, fn, ((rows, w), dtype),
                   ((rows, w), jnp.float32), ((n,), jnp.int32),
+                  ((n, w), jnp.float32))
+
+
+@pytest.mark.parametrize('op', ['sgd', 'adagrad_dedup'])
+def test_segwalk_prepacked_bf16_compiles_for_v5e(v5e, op):
+  """The packed-storage bf16 path: physical [rows/pack, 128] bf16
+  operand + f32 acc through the pair-fetch kernel."""
+  rows, w, n = 2048, 16, 1024
+  pack = 128 // w
+
+  def fn(table, acc, sid, sg):
+    if op == 'sgd':
+      return pallas_segwalk.segwalk_apply(table, None, sid, sg, 0.01,
+                                          op=op, eps=1e-7,
+                                          logical_width=w)
+    return pallas_segwalk.segwalk_apply(table, acc, sid, sg, 0.01,
+                                        op=op, eps=1e-7, logical_width=w)
+
+  _compile_single(v5e, fn, ((rows // pack, 128), jnp.bfloat16),
+                  ((rows // pack, 128), jnp.float32), ((n,), jnp.int32),
                   ((n, w), jnp.float32))
 
 
