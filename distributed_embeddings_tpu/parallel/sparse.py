@@ -327,6 +327,20 @@ class SparseAdam:
   def init(self, dist: DistributedEmbedding, params) -> Dict:
     out = {}
     for gi, g in enumerate(dist.plan.groups):
+      if (g.storage_pack > 1
+          and not packed_dispatch_ok(g.rows_cap, g.width)):
+        # Adam applies in NATURAL space (the per-row step counter is
+        # not a lane-wise quantity), so packed storage forces an
+        # unpack/repack reshape around every apply — on a group this
+        # large that reshape risks the lane-padded relayout HBM blowup
+        # (docs/perf_notes.md round 3).  Fail HERE, actionably, instead
+        # of OOMing mid-step.
+        raise ValueError(
+            f'SparseAdam with packed storage on group {gi} '
+            f'({g.rows_cap} rows x {g.width}): the natural-space apply '
+            f'reshape risks a lane-padded relayout past '
+            f'PACKED_PARAM_BYTES_LIMIT. Construct the layer with '
+            f'packed_storage=False to train this model with SparseAdam.')
       p = params[f'group_{gi}']
       out[f'group_{gi}'] = {
           'm': jnp.zeros_like(p, dtype=jnp.float32),

@@ -8,7 +8,23 @@ INTERVAL=${1:-300}
 cd "$(dirname "$0")/../.."
 PROBE_LOG=/tmp/tpu_probe.log
 SWEEP_LOG=/tmp/tpu_sweep.log
-echo "watch start $(date)" >> "$PROBE_LOG"
+# pid file so restarts can kill the old instance by PID — a pkill -f
+# pattern match also kills the restarting shell itself (its command
+# line contains the script name).  Verify the pid still names a watcher
+# (not a reused pid) and kill its whole PROCESS GROUP so an in-flight
+# sweep dies with it (the launcher uses setsid, making the watcher a
+# group leader) — otherwise two sweeps could contend for the one chip.
+PIDFILE=/tmp/tpu_watch.pid
+if [ -f "$PIDFILE" ]; then
+  OLD=$(cat "$PIDFILE")
+  if [ "$OLD" != "$$" ] \
+      && ps -o args= -p "$OLD" 2>/dev/null | grep -q tpu_watch; then
+    kill -- "-$OLD" 2>/dev/null || kill "$OLD" 2>/dev/null
+    sleep 1
+  fi
+fi
+echo $$ > "$PIDFILE"
+echo "watch start $(date) pid $$" >> "$PROBE_LOG"
 while true; do
   if timeout 120 python - <<'EOF' >> "$PROBE_LOG" 2>&1
 import jax

@@ -156,9 +156,65 @@ def test_sparse_and_dense_trainers_converge_to_same_auc(dataset):
 
   # --- AUC parity between trainers on the held-out split ----------------
   # the rule's Bayes AUC is ~0.776 (rank by the true sampling
-  # probability); two epochs land within ~0.04 of it
+  # probability); two epochs land within ~0.04 of it.  The parity bar is
+  # the published reference claim (AUC parity with the non-distributed
+  # model, examples/dlrm/README.md:7): 0.005, not "roughly equal".
   auc_sparse = _eval_auc(model, sstate.params, dataset)
   auc_dense = _eval_auc(model, dstate.params, dataset)
   assert auc_sparse > 0.74, auc_sparse
   assert auc_dense > 0.74, auc_dense
-  assert abs(auc_sparse - auc_dense) < 0.02, (auc_sparse, auc_dense)
+  assert abs(auc_sparse - auc_dense) < 0.005, (auc_sparse, auc_dense)
+
+
+def test_multi_seed_auc_parity_and_improvement(dataset):
+  """3 init seeds (VERDICT r3 item 7), one shared split and ONE pair of
+  compiled train steps: per seed, eval AUC improves monotonically over
+  training checkpoints (small eval-noise slack), and the sparse trainer
+  ends within 0.005 AUC of the dense trainer started from the same
+  init."""
+  mesh = create_mesh(jax.devices()[:8])
+  model = _model(mesh)
+  ds = _reader(dataset)
+  n_batches = len(ds)
+  phases, phase_steps = 3, 64
+
+  def head_loss_fn(dense_params, emb_outs, hbatch):
+    numerical, labels = hbatch
+    return bce_with_logits(model.head(dense_params, numerical, emb_outs),
+                           labels)
+
+  def loss_fn(p, batch_data):
+    numerical, cats, labels = batch_data
+    return bce_with_logits(model.apply(p, numerical, list(cats)), labels)
+
+  emb_opt = SparseSGD(learning_rate=LR)
+  sstep = make_hybrid_train_step(model.dist_embedding, head_loss_fn,
+                                 optax.sgd(LR), emb_opt, donate=False)
+  dstep = make_train_step(loss_fn, optax.sgd(LR), donate=False)
+
+  for seed in (1, 2, 3):
+    params0 = model.init(seed)
+    sstate = init_hybrid_train_state(model.dist_embedding,
+                                     jax.tree.map(jnp.copy, params0),
+                                     optax.sgd(LR), emb_opt)
+    dstate = init_train_state(jax.tree.map(jnp.copy, params0),
+                              optax.sgd(LR))
+    aucs = [_eval_auc(model, sstate.params, dataset)]
+    step = 0
+    for _ in range(phases):
+      for _ in range(phase_steps):
+        num, cats, labels = ds[step % n_batches]
+        sstate, _ = sstep(sstate, [jnp.asarray(c) for c in cats],
+                          (jnp.asarray(num), jnp.asarray(labels)))
+        dstate, _ = dstep(dstate, (jnp.asarray(num),
+                                   tuple(jnp.asarray(c) for c in cats),
+                                   jnp.asarray(labels)))
+        step += 1
+      aucs.append(_eval_auc(model, sstate.params, dataset))
+    # monotone improvement across checkpoints (eval-noise slack), and a
+    # real gain over the random init
+    for a, b in zip(aucs, aucs[1:]):
+      assert b >= a - 0.005, (seed, aucs)
+    assert aucs[-1] > aucs[0] + 0.02, (seed, aucs)
+    auc_dense = _eval_auc(model, dstate.params, dataset)
+    assert abs(aucs[-1] - auc_dense) < 0.005, (seed, aucs[-1], auc_dense)

@@ -302,3 +302,26 @@ def test_calibration_mirror_matches_packed_layout():
   cats = _inputs(rng, batch=WORLD * 4)
   _, residuals, _ = mirror.forward_with_residuals(zeros, cats)
   assert len(residuals) > 0
+
+
+def test_adam_packed_over_limit_fails_fast():
+  """SparseAdam + packed storage on a group whose natural-space apply
+  reshape could provoke the lane-padded relayout must fail at INIT with
+  an actionable message, not OOM mid-step."""
+  from distributed_embeddings_tpu.parallel import sparse
+  mesh = _mesh()
+  big_rows = (sparse.PACKED_PARAM_BYTES_LIMIT // (128 * 4)) * WORLD * 8
+  cfgs = [TableConfig(big_rows, 16, 'sum')] + [
+      TableConfig(64, 16, 'sum') for _ in range(WORLD - 1)
+  ]
+  dist = DistributedEmbedding(cfgs, mesh=mesh, packed_storage=True)
+  fake_params = {
+      f'group_{gi}': jnp.zeros((WORLD, 8, g.param_width))
+      for gi, g in enumerate(dist.plan.groups)
+  }
+  with pytest.raises(ValueError, match='packed_storage=False'):
+    SparseAdam().init(dist, fake_params)
+  # the escape hatch works, and small packed groups stay fine
+  nat = DistributedEmbedding(cfgs, mesh=mesh, packed_storage=False)
+  small = DistributedEmbedding(CONFIGS, mesh=mesh, packed_storage=True)
+  SparseAdam().init(small, small.init(0))
