@@ -16,13 +16,30 @@ import jax.numpy as jnp
 Initializer = Callable[[jax.Array, tuple, Any], jax.Array]
 
 
+def _flat_draw_invariant(init):
+  """Mark ``init`` as filling row-major by flat element count.
+
+  ``jax.random`` generates bits over ``iota(prod(shape))`` and reshapes, so
+  for these initializers ``init(key, (n, w))`` equals
+  ``init(key, (n // p, w * p))`` reshaped — bit-exactly.  The distributed
+  runtime exploits this to draw packed-storage groups directly at their
+  physical ``[rows/pack, 128]`` shape: materialising the natural
+  ``[rows, width]`` value first costs ``128/width``x its logical bytes in
+  TPU tiled layout (T(8,128) lane padding), which exceeds HBM for
+  multi-10M-row narrow groups.  Custom initializers without this marker
+  are drawn at their natural shape (document the memory implication).
+  """
+  init.flat_draw_invariant = True
+  return init
+
+
 def uniform_initializer(minval=-0.05, maxval=0.05) -> Initializer:
   """Keras-default 'uniform' (RandomUniform(-0.05, 0.05))."""
 
   def init(key, shape, dtype=jnp.float32):
     return jax.random.uniform(key, shape, dtype, minval, maxval)
 
-  return init
+  return _flat_draw_invariant(init)
 
 
 def scaled_uniform_initializer() -> Initializer:
@@ -41,18 +58,31 @@ def scaled_uniform_initializer() -> Initializer:
     return jax.random.uniform(key, shape, dtype, -maxval, maxval)
 
   init.row_scale_sensitive = True
-  return init
+  return _flat_draw_invariant(init)
+
+
+def _zeros_initializer() -> Initializer:
+  return _flat_draw_invariant(
+      lambda key, shape, dtype=jnp.float32: jnp.zeros(shape, dtype))
+
+
+def _ones_initializer() -> Initializer:
+  return _flat_draw_invariant(
+      lambda key, shape, dtype=jnp.float32: jnp.ones(shape, dtype))
+
+
+def _normal_initializer() -> Initializer:
+  return _flat_draw_invariant(
+      lambda key, shape, dtype=jnp.float32: 0.05 * jax.random.normal(
+          key, shape, dtype))
 
 
 _INITIALIZERS: Dict[str, Callable[[], Initializer]] = {
     'uniform': uniform_initializer,
     'scaled_uniform': scaled_uniform_initializer,
-    'zeros': lambda: (lambda key, shape, dtype=jnp.float32: jnp.zeros(
-        shape, dtype)),
-    'ones': lambda: (lambda key, shape, dtype=jnp.float32: jnp.ones(
-        shape, dtype)),
-    'normal': lambda: (lambda key, shape, dtype=jnp.float32: 0.05 * jax.random
-                       .normal(key, shape, dtype)),
+    'zeros': _zeros_initializer,
+    'ones': _ones_initializer,
+    'normal': _normal_initializer,
 }
 
 

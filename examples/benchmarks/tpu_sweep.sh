@@ -11,7 +11,10 @@ cd "$(dirname "$0")/../.."
 FAIL=0
 run() {
   echo "=== $* ($(date +%H:%M:%S)) ===" | tee -a "$LOG"
-  timeout "${T:-900}" "$@" 2>&1 | grep -v WARNING | tail -6 | tee -a "$LOG"
+  # anchor the filter to line START: bench.py's single-line failure JSON
+  # embeds backend log text that can contain "WARNING", and an unanchored
+  # grep -v silently swallowed the whole artifact line (round 4)
+  timeout "${T:-900}" "$@" 2>&1 | grep -v '^WARNING' | tail -6 | tee -a "$LOG"
   local rc=${PIPESTATUS[0]}
   if [ "$rc" -ne 0 ]; then
     # a dead tunnel times steps out (rc 124): record it and withhold

@@ -4,6 +4,14 @@
 # probing so later windows re-run any still-missing pieces.
 # Usage: bash examples/benchmarks/tpu_watch.sh [probe_interval_s]
 set -u
+# self-enforce process-group leadership: the restart logic below kills the
+# OLD watcher's whole group so an in-flight sweep dies with it — which only
+# works if every watcher actually IS a group leader, launcher discipline
+# notwithstanding
+PGID=$(ps -o pgid= -p $$ 2>/dev/null | tr -d ' ')
+if [ -n "$PGID" ] && [ "$$" != "$PGID" ] && command -v setsid >/dev/null; then
+  exec setsid "$0" "$@"
+fi
 INTERVAL=${1:-300}
 cd "$(dirname "$0")/../.."
 PROBE_LOG=/tmp/tpu_probe.log

@@ -321,7 +321,13 @@ def test_adam_packed_over_limit_fails_fast():
   }
   with pytest.raises(ValueError, match='packed_storage=False'):
     SparseAdam().init(dist, fake_params)
-  # the escape hatch works, and small packed groups stay fine
+  # the escape hatch works (init accepts the same huge group natural),
+  # and small packed groups stay fine
   nat = DistributedEmbedding(cfgs, mesh=mesh, packed_storage=False)
+  nat_params = {
+      f'group_{gi}': jnp.zeros((WORLD, 8, g.param_width))
+      for gi, g in enumerate(nat.plan.groups)
+  }
+  SparseAdam().init(nat, nat_params)
   small = DistributedEmbedding(CONFIGS, mesh=mesh, packed_storage=True)
   SparseAdam().init(small, small.init(0))
