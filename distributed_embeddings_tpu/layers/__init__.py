@@ -1,3 +1,8 @@
-"""Single-device embedding layers."""
+"""Embedding layers: single-device functional layers + the flax adapter.
+
+``DistEmbed`` (the linen integration) imports lazily — ``from
+distributed_embeddings_tpu.layers.flax_embedding import DistEmbed`` — so
+the core package never hard-depends on flax.
+"""
 
 from distributed_embeddings_tpu.layers.embedding import Embedding, ConcatOneHotEmbedding

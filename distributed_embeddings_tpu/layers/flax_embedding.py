@@ -1,0 +1,117 @@
+"""Flax (linen) integration for the distributed embedding runtime.
+
+The reference completes its "3-line change" story inside Keras: the
+distributed layer drops into a `tf.keras` model and trains through plain
+``model.fit`` (`/root/reference/distributed_embeddings/python/layers/
+dist_model_parallel_test.py:303-335`).  The JAX ecosystem's analog of that
+host framework is flax — this module is the same story for linen users:
+
+    emb = DistEmbed.build(table_configs, strategy='memory_balanced')
+    ...
+    x = emb(cat_inputs)          # inside any linen module
+
+Two training routes compose with it:
+
+- **Plain autodiff** (this module alone): the wrapper's parameters are
+  ordinary linen params, so any optax optimizer / existing train step works
+  unchanged.  Gradients w.r.t. the tables are *dense* ``[rows, width]``
+  arrays — fine for small tables, the simplest migration path.
+- **Sparse hybrid step** (the performant path): pass the same wrapped
+  ``DistributedEmbedding`` to ``make_hybrid_train_step``
+  (parallel/sparse.py) with the linen head as ``head_loss_fn`` and the
+  wrapper's table params as ``params['embedding']`` — O(nnz) scatter
+  updates, never a table-shaped gradient.  ``tables_of`` / ``merge_tables``
+  re-plumb between the two layouts.
+
+A Keras-like ``fit`` driver for either step lives in
+``distributed_embeddings_tpu.parallel.grad.fit``.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any, Sequence
+
+import flax.linen as nn
+
+from distributed_embeddings_tpu.parallel.dist_embedding import (
+    DistributedEmbedding)
+
+# linen param-dict key the wrapper stores the fused group tables under
+TABLES = 'tables'
+
+
+class DistEmbed(nn.Module):
+  """Linen wrapper around a :class:`DistributedEmbedding`.
+
+  The wrapped runtime holds only static configuration (plan, mesh); the
+  fused group tables become linen parameters under ``TABLES``, initialised
+  by the runtime's own sharded on-device init.  ``__call__`` takes the
+  layer's input list (see ``DistributedEmbedding.apply``) and returns the
+  per-input ``[batch, output_dim]`` activations.
+
+  Attributes:
+    dist: the configured runtime (shared, static — safe to reference from
+      several modules or from ``make_hybrid_train_step``).
+  """
+  dist: DistributedEmbedding
+
+  @classmethod
+  def build(cls, embeddings: Sequence[Any], **kwargs) -> 'DistEmbed':
+    """Construct wrapper + runtime in one call; ``kwargs`` forward to
+    ``DistributedEmbedding`` (strategy, column_slice_threshold, mesh, ...)."""
+    return cls(dist=DistributedEmbedding(embeddings, **kwargs))
+
+  @nn.compact
+  def __call__(self, inputs):
+    tables = self.param(TABLES, self.dist.init)
+    return self.dist.apply(tables, inputs)
+
+
+def tables_of(variables) -> dict:
+  """Extract the fused group-table pytree (``params['embedding']`` of the
+  hybrid train state) from a linen variable collection containing one
+  :class:`DistEmbed` (searched by its ``TABLES`` param key)."""
+  params = variables.get('params', variables)
+  found = []
+
+  # Mapping, not dict: linen variables may arrive as FrozenDict
+  def walk(node):
+    if isinstance(node, Mapping):
+      if TABLES in node and isinstance(node[TABLES], Mapping):
+        found.append(node[TABLES])
+      else:
+        for v in node.values():
+          walk(v)
+
+  walk(params)
+  if len(found) != 1:
+    raise ValueError(
+        f'expected exactly one DistEmbed ({TABLES!r} param subtree) in the '
+        f'variables, found {len(found)}')
+  return found[0]
+
+
+def merge_tables(variables, tables) -> dict:
+  """Inverse of :func:`tables_of`: return a copy of ``variables`` with the
+  (possibly updated) fused tables written back — e.g. to run linen
+  ``model.apply`` for eval after hybrid-step training."""
+  params = variables.get('params', variables)
+  hit = [0]
+
+  def walk(node):
+    if isinstance(node, Mapping):
+      if TABLES in node and isinstance(node[TABLES], Mapping):
+        hit[0] += 1
+        return {**node, TABLES: tables}
+      return {k: walk(v) for k, v in node.items()}
+    return node
+
+  new_params = walk(params)
+  if hit[0] != 1:
+    raise ValueError(
+        f'expected exactly one DistEmbed ({TABLES!r} param subtree) in the '
+        f'variables, found {hit[0]}')
+  if 'params' in variables:
+    return {**variables, 'params': new_params}
+  return new_params

@@ -24,6 +24,7 @@ from distributed_embeddings_tpu.parallel.checkpoint import (
 from distributed_embeddings_tpu.parallel.grad import (broadcast_variables,
                                                       DistributedGradientTape,
                                                       TrainState,
+                                                      fit,
                                                       make_train_step,
                                                       init_train_state)
 from distributed_embeddings_tpu.parallel.mesh import (create_mesh,

@@ -97,3 +97,93 @@ def init_train_state(params, optimizer) -> TrainState:
   return TrainState(params=params,
                     opt_state=optimizer.init(params),
                     step=jnp.zeros((), jnp.int32))
+
+
+def fit(step_fn: Callable,
+        state: TrainState,
+        data,
+        steps: Optional[int] = None,
+        *,
+        log_every: int = 100,
+        eval_fn: Optional[Callable] = None,
+        eval_every: Optional[int] = None,
+        callbacks=(),
+        verbose: bool = True,
+        print_fn: Callable = print):
+  """Keras-``fit``-like driver for the train steps built here.
+
+  The reference's integration test trains its distributed layer through
+  plain ``model.fit``
+  (`/root/reference/distributed_embeddings/python/layers/
+  dist_model_parallel_test.py:303-335`); its DLRM example hand-rolls the
+  same loop (`examples/dlrm/main.py:201-210`).  This is that driver for the
+  functional steps: iterate, keep losses on-device between log points (one
+  host sync per ``log_every``, not per step), run periodic eval, invoke
+  callbacks — while the state stays an explicit value the caller owns.
+
+  Args:
+    step_fn: from ``make_train_step`` / ``make_hybrid_train_step`` — called
+      as ``step_fn(state, *batch_args)``.
+    state: initial ``TrainState``.
+    data: iterable yielding per-step *argument tuples* (everything after
+      ``state``): ``(batch,)`` for ``make_train_step``, ``(cats, batch)``
+      for the hybrid step.
+    steps: stop after this many steps (``None`` drains ``data``).
+    log_every: steps between loss syncs / history entries / callbacks.
+    eval_fn: optional ``eval_fn(state) -> dict`` of python metrics.
+    eval_every: steps between ``eval_fn`` calls (default: ``log_every``).
+    callbacks: callables ``cb(step: int, state, logs: dict)`` run at every
+      log/eval point (mutating ``logs`` is allowed; e.g. early stopping by
+      raising ``StopIteration``).
+    verbose: print one line per log point via ``print_fn``.
+
+  Returns:
+    ``(state, history)`` — ``history['step']`` / ``history['loss']`` hold
+    one entry per log point; eval metrics land in their own lists aligned
+    with ``history['eval_step']`` (eval cadence can differ from the log
+    cadence).
+  """
+  eval_every = eval_every or log_every
+  history: dict = {'step': [], 'loss': [], 'eval_step': []}
+  window = []  # on-device losses since the last sync
+  it = iter(data)
+  i = 0
+
+  def flush(i, final=False):
+    if not window:
+      return None
+    mean = float(jnp.mean(jnp.stack(window)))
+    window.clear()
+    logs = {'loss': mean}
+    history['step'].append(i)
+    history['loss'].append(mean)
+    # final covers both exits (steps reached, data drained): the run always
+    # ends with an eval of the returned state
+    if eval_fn is not None and (i % eval_every == 0 or final):
+      evals = eval_fn(state)
+      logs.update(evals)
+      history['eval_step'].append(i)
+      for k, v in evals.items():
+        history.setdefault(k, []).append(v)
+    if verbose:
+      print_fn('step %d: ' % i +
+               ' '.join(f'{k}={v:.6g}' for k, v in logs.items()))
+    for cb in callbacks:
+      cb(i, state, logs)
+    return logs
+
+  try:
+    while steps is None or i < steps:
+      try:
+        args = next(it)
+      except StopIteration:
+        break
+      state, loss = step_fn(state, *args)
+      window.append(loss)
+      i += 1
+      if i % log_every == 0:
+        flush(i, final=(steps == i))
+    flush(i, final=True)
+  except StopIteration:  # raised by a callback: early stop
+    pass
+  return state, history

@@ -10,7 +10,9 @@ set -u
 # notwithstanding
 PGID=$(ps -o pgid= -p $$ 2>/dev/null | tr -d ' ')
 if [ -n "$PGID" ] && [ "$$" != "$PGID" ] && command -v setsid >/dev/null; then
-  exec setsid "$0" "$@"
+  # re-exec via bash: the script file is not +x, so exec'ing $0 directly
+  # would EACCES and (because of exec) kill the watcher on the spot
+  exec setsid bash "$0" "$@"
 fi
 INTERVAL=${1:-300}
 cd "$(dirname "$0")/../.."
