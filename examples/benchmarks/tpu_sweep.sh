@@ -2,8 +2,10 @@
 # One-shot measurement sweep for a healthy TPU tunnel, highest-value first.
 # Each step is independently killable; results append to the log.
 # Ordering principle: tunnel windows can be SHORT — the official bench
-# artifact line comes first (it alone closes VERDICT item 1), then the
-# kernel A/Bs that decide defaults, then correctness gates, then extras.
+# artifact line comes first (it alone closes VERDICT item 1), then ONE
+# process measures every apply-variant A/B (sweep_oneproc.py: the tunnel
+# plugin can't deserialize cached executables, so separate processes
+# re-pay init+compile per data point), then correctness gates, then extras.
 # Usage: bash examples/benchmarks/tpu_sweep.sh [logfile]
 set -u
 LOG=${1:-/tmp/tpu_sweep.log}
@@ -14,7 +16,7 @@ run() {
   # anchor the filter to line START: bench.py's single-line failure JSON
   # embeds backend log text that can contain "WARNING", and an unanchored
   # grep -v silently swallowed the whole artifact line (round 4)
-  timeout "${T:-900}" "$@" 2>&1 | grep -v '^WARNING' | tail -6 | tee -a "$LOG"
+  timeout "${T:-900}" "$@" 2>&1 | grep -v '^WARNING' | tail -12 | tee -a "$LOG"
   local rc=${PIPESTATUS[0]}
   if [ "$rc" -ne 0 ]; then
     # a dead tunnel times steps out (rc 124): record it and withhold
@@ -24,39 +26,32 @@ run() {
   fi
 }
 
-# 0. THE official artifact line: steady-state tiny step time on the chip
-# (two ~50s compiles then 10 timed steps; .jax_cache makes reruns fast)
-T=1200 run python bench.py --model tiny --steps 10 --auto_capacity
+# 0. THE official artifact line: steady-state tiny step time on the chip.
+# Cold cache through the tunnel = 2 long compiles + full-size init +
+# capacity calibration before the 10 timed steps: >20 min observed
+# (a 1200s timeout killed a run that had already compiled, round 4).
+T=2700 run python bench.py --model tiny --steps 10 --auto_capacity
 
-# 1. the round-3 perf bets A/B'd at the same shape
-T=1200 run python bench.py --model tiny --steps 10 --segwalk_apply
-T=1200 run python bench.py --model tiny --steps 10 --auto_capacity --fused_apply
+# 1. ALL apply-variant A/Bs in one backend session: xla/segwalk/fused
+# at f32 + bf16 for tiny, plus the criteo trio; one JSON line each,
+# flushed as they land, SIGALRM per phase.
+T=9000 run python examples/benchmarks/sweep_oneproc.py --steps 10
 
 # 2. kernel microbenches at the exact dominant shapes (decide defaults)
-T=1200 run python -m pytest tests/test_pallas_tpu.py -q -s -k segwalk_apply_microbench
-T=1200 run python -m pytest tests/test_pallas_tpu.py -q -s -k rowwise_apply_microbench
+T=1800 run python -m pytest tests/test_pallas_tpu.py -q -s -k segwalk_apply_microbench
+T=1800 run python -m pytest tests/test_pallas_tpu.py -q -s -k rowwise_apply_microbench
 
 # 3. segment-walk kernel correctness compiled (gates flipping any default)
-T=1200 run python -m pytest tests/test_pallas_tpu.py -q -s -k segwalk_apply_compiled
+T=1800 run python -m pytest tests/test_pallas_tpu.py -q -s -k segwalk_apply_compiled
 
-# 4. steady-state trace decomposition, XLA vs fused vs segwalk apply
-T=1200 run python examples/benchmarks/trace_step.py --calls 3 --auto_capacity
-T=1200 run python examples/benchmarks/trace_step.py --calls 3 --auto_capacity --fused_apply
-T=1200 run python examples/benchmarks/trace_step.py --calls 3 --segwalk_apply
+# 4. steady-state trace decomposition of the default path
+T=2400 run python examples/benchmarks/trace_step.py --calls 3 --auto_capacity
 
-# 5. bf16 tables variant, XLA apply vs pair-fetch segwalk A/B
-T=1200 run python bench.py --model tiny --steps 10 --auto_capacity --param_dtype bfloat16
-T=1200 run python bench.py --model tiny --steps 10 --param_dtype bfloat16 --segwalk_apply
-
-# 6. DLRM-shaped criteo model (width 128, hotness 1: kernel sweet spot)
-T=1200 run python bench.py --model criteo --steps 10 --auto_capacity --fused_apply
-T=1200 run python bench.py --model criteo --steps 10 --segwalk_apply
-
-# 7. primitive scatter/gather hint A/B (informs perf notes)
+# 5. primitive scatter/gather hint A/B (informs perf notes)
 T=900 run python examples/benchmarks/scatter_probe.py
 
-# 8. remaining hardware correctness gates (full TPU-gated suite)
-T=1800 run python -m pytest tests/test_pallas_tpu.py -q -s -k "not microbench"
+# 6. remaining hardware correctness gates (full TPU-gated suite)
+T=2400 run python -m pytest tests/test_pallas_tpu.py -q -s -k "not microbench"
 
 # logged completion marker: the watcher keys retry-vs-done on seeing
 # BOTH the step-0 artifact line and this marker in its run's log slice;
