@@ -102,10 +102,15 @@ def main():
   labels = sds((GB, 1), jnp.float32, bsh)
 
   t0 = time.time()
-  compiled = jax.jit(step).lower(state, cats, (num, labels)).compile()
+  lowered = jax.jit(step).lower(state, cats, (num, labels))
+  t_lower = time.time() - t0
+  t0 = time.time()
+  compiled = lowered.compile()
+  t_compile = time.time() - t0
   print(f'{args.model} {args.chips}-chip v5e train step compiled in '
-        f'{time.time() - t0:.0f}s '
-        f'({"segwalk" if args.segwalk_apply else "xla"} apply)',
+        f'{t_lower + t_compile:.0f}s (trace+lower {t_lower:.0f}s, '
+        f'XLA {t_compile:.0f}s; '
+        f'{"segwalk" if args.segwalk_apply else "xla"} apply)',
         flush=True)
   ma = compiled.memory_analysis()
   if ma is not None:

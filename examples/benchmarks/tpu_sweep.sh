@@ -37,6 +37,10 @@ T=2700 run python bench.py --model tiny --steps 10 --auto_capacity
 # flushed as they land, SIGALRM per phase.
 T=9000 run python examples/benchmarks/sweep_oneproc.py --steps 10
 
+# 1b. Criteo-shaped DLRM end-to-end: loader throughput, steady-state
+# samples/s, AUC-vs-step curve (VERDICT r3 item 4)
+T=3600 run bash examples/dlrm/chip_run.sh
+
 # 2. kernel microbenches at the exact dominant shapes (decide defaults)
 T=1800 run python -m pytest tests/test_pallas_tpu.py -q -s -k segwalk_apply_microbench
 T=1800 run python -m pytest tests/test_pallas_tpu.py -q -s -k rowwise_apply_microbench
