@@ -32,10 +32,24 @@ def main():
   p.add_argument('--segwalk_apply', action='store_true')
   p.add_argument('--topology', default='v5e:2x2',
                  help='compile-only topology (chips must divide it)')
+  p.add_argument('--compiler_option', action='append', default=[],
+                 help='k=v XLA compiler option (repeatable), e.g. '
+                 'xla_exec_time_optimization_effort=-1.0')
+  p.add_argument('--no_cache', action='store_true',
+                 help='skip the persistent compilation cache')
   args = p.parse_args()
 
   import jax
   jax.config.update('jax_platforms', 'cpu')
+  if not args.no_cache:
+    # measure whether the persistent cache serves AOT topology compiles
+    # (the tunnel plugin can't deserialize cached executables; this path
+    # compiles via local libtpu, which may)
+    jax.config.update(
+        'jax_compilation_cache_dir',
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), '..',
+                     '..', '.jax_cache'))
+    jax.config.update('jax_persistent_cache_min_compile_time_secs', 5)
   import jax.numpy as jnp
   import optax
   from jax.experimental import topologies
@@ -101,11 +115,15 @@ def main():
   num = sds((GB, config.num_numerical_features), jnp.float32, bsh)
   labels = sds((GB, 1), jnp.float32, bsh)
 
+  copts = {}
+  for kv in args.compiler_option:
+    k, _, v = kv.partition('=')
+    copts[k] = v
   t0 = time.time()
   lowered = jax.jit(step).lower(state, cats, (num, labels))
   t_lower = time.time() - t0
   t0 = time.time()
-  compiled = lowered.compile()
+  compiled = lowered.compile(compiler_options=copts or None)
   t_compile = time.time() - t0
   print(f'{args.model} {args.chips}-chip v5e train step compiled in '
         f'{t_lower + t_compile:.0f}s (trace+lower {t_lower:.0f}s, '
