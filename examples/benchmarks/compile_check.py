@@ -118,6 +118,15 @@ def main():
   copts = {}
   for kv in args.compiler_option:
     k, _, v = kv.partition('=')
+    # numeric-typed options (e.g. exec_time_optimization_effort) reject
+    # string values outright
+    try:
+      v = int(v)
+    except ValueError:
+      try:
+        v = float(v)
+      except ValueError:
+        pass
     copts[k] = v
   t0 = time.time()
   lowered = jax.jit(step).lower(state, cats, (num, labels))
