@@ -28,3 +28,22 @@ python examples/dlrm/main.py \
   --loader_bench \
   --eval_every 32 --eval_batches 4 \
   --eval
+
+# AMP-analog variant (reference examples/dlrm/README.md:8, 10.4M
+# samples/s 8xA100 fp16 = f32 variables + half-precision compute):
+# f32 tables, bf16 activations
+python examples/dlrm/main.py \
+  --dataset_path "$DATA" \
+  --batch_size "$BATCH" \
+  --dp_input \
+  --compute_dtype bfloat16 \
+  --eval_every 64 --eval_batches 4
+
+# bf16 STORAGE variant (beyond the reference's AMP: halves table HBM,
+# the scaling model's binding resource; f32 accumulation in the step)
+python examples/dlrm/main.py \
+  --dataset_path "$DATA" \
+  --batch_size "$BATCH" \
+  --dp_input \
+  --param_dtype bfloat16 \
+  --eval_every 64 --eval_batches 4

@@ -42,8 +42,14 @@ def parse_args():
   parser.add_argument('--row_slice', type=int, default=None,
                       help='element threshold above which tables shard '
                       'along rows (fits tables bigger than one chip)')
-  parser.add_argument('--compute_dtype', default='float32',
-                      choices=['float32', 'bfloat16'])
+  parser.add_argument('--param_dtype', default='float32',
+                      choices=['float32', 'bfloat16'],
+                      help='table + MLP storage dtype (bfloat16 halves '
+                      'table HBM: the AMP-baseline analog, reference '
+                      'examples/dlrm/README.md:8)')
+  parser.add_argument('--compute_dtype', default=None,
+                      choices=['float32', 'bfloat16'],
+                      help='activation dtype (default: param_dtype)')
   parser.add_argument('--eval', action='store_true',
                       help='run AUC evaluation after training')
   parser.add_argument('--eval_every', type=int, default=0,
@@ -114,7 +120,9 @@ def main():
                column_slice_threshold=args.column_slice_threshold,
                row_slice=args.row_slice,
                dp_input=args.dp_input,
-               compute_dtype=jnp.dtype(args.compute_dtype))
+               param_dtype=jnp.dtype(args.param_dtype),
+               compute_dtype=jnp.dtype(args.compute_dtype
+                                       or args.param_dtype))
   params = model.init(0)
 
   if args.dp_input:
