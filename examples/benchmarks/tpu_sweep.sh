@@ -41,12 +41,15 @@ T=9000 run python examples/benchmarks/sweep_oneproc.py --steps 10
 # samples/s, AUC-vs-step curve (VERDICT r3 item 4)
 T=3600 run bash examples/dlrm/chip_run.sh
 
-# 2. kernel microbenches at the exact dominant shapes (decide defaults)
-T=1800 run python -m pytest tests/test_pallas_tpu.py -q -s -k segwalk_apply_microbench
-T=1800 run python -m pytest tests/test_pallas_tpu.py -q -s -k rowwise_apply_microbench
+# 2. kernel microbenches at the exact dominant shapes (decide defaults).
+# DET_TESTS_REAL_TPU=1 stops conftest pinning the CPU backend — without
+# it every TPU-gated test silently SKIPS and the step reads as green
+# (wiring bug caught in round-4 rehearsal).
+T=1800 run env DET_TESTS_REAL_TPU=1 python -m pytest tests/test_pallas_tpu.py -q -s -k segwalk_apply_microbench
+T=1800 run env DET_TESTS_REAL_TPU=1 python -m pytest tests/test_pallas_tpu.py -q -s -k rowwise_apply_microbench
 
 # 3. segment-walk kernel correctness compiled (gates flipping any default)
-T=1800 run python -m pytest tests/test_pallas_tpu.py -q -s -k segwalk_apply_compiled
+T=1800 run env DET_TESTS_REAL_TPU=1 python -m pytest tests/test_pallas_tpu.py -q -s -k segwalk_apply_compiled
 
 # 4. steady-state trace decomposition of the default path
 T=2400 run python examples/benchmarks/trace_step.py --calls 3 --auto_capacity
@@ -55,7 +58,7 @@ T=2400 run python examples/benchmarks/trace_step.py --calls 3 --auto_capacity
 T=900 run python examples/benchmarks/scatter_probe.py
 
 # 6. remaining hardware correctness gates (full TPU-gated suite)
-T=2400 run python -m pytest tests/test_pallas_tpu.py -q -s -k "not microbench"
+T=2400 run env DET_TESTS_REAL_TPU=1 python -m pytest tests/test_pallas_tpu.py -q -s -k "not microbench"
 
 # logged completion marker: the watcher keys retry-vs-done on seeing
 # BOTH the step-0 artifact line and this marker in its run's log slice;
