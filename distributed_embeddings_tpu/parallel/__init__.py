@@ -27,6 +27,8 @@ from distributed_embeddings_tpu.parallel.grad import (broadcast_variables,
                                                       fit,
                                                       make_train_step,
                                                       init_train_state)
+from distributed_embeddings_tpu.parallel.callbacks import (CheckpointCallback,
+                                                           EarlyStopping)
 from distributed_embeddings_tpu.parallel.mesh import (create_mesh,
                                                       init_distributed,
                                                       make_global_batch)

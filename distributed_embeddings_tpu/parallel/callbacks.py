@@ -1,0 +1,123 @@
+"""Callbacks for the Keras-like ``fit`` driver (parallel/grad.py).
+
+The reference's integration surface is Keras ``model.fit``
+(`/root/reference/distributed_embeddings/python/layers/
+dist_model_parallel_test.py:303-335`), whose users lean on two stock
+callbacks: periodic checkpointing and early stopping.  These are those
+two for the functional ``fit`` loop; both follow its callback contract
+``cb(step, state, logs)`` and early-stop by raising ``StopIteration``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from distributed_embeddings_tpu.parallel.checkpoint import (
+    get_optimizer_state, get_weights, save_train_npz)
+
+
+class CheckpointCallback:
+  """Periodically write a resumable ``save_train_npz`` checkpoint.
+
+  Saves the embedding tables in the global canonical layout (so the file
+  reloads under any world size / strategy), the sparse-optimizer state
+  when the hybrid step is in use, and the dense params/opt-state under
+  flattened ``extra/`` keys (the same scheme ``examples/dlrm/main.py``
+  resumes from).
+
+  Args:
+    dist: the model's ``DistributedEmbedding``.
+    path: target ``.npz`` path; ``{step}`` is formatted in when present
+      (``'ckpt_{step}.npz'``), otherwise the file is overwritten in
+      place (atomic: written to ``path + '.tmp'`` then renamed).
+    every: save every this-many steps (checked at ``fit``'s log points,
+      so the effective cadence is ``lcm(every, log_every)``-ish: the
+      callback fires at the first log point where ``step`` advanced past
+      the next save mark).
+    sparse: whether ``state`` is a hybrid-step state whose
+      ``opt_state[1]`` is the sparse table optimizer (default: detect).
+  """
+
+  def __init__(self, dist, path: str, every: int = 1000,
+               sparse: Optional[bool] = None):
+    self.dist = dist
+    self.path = path
+    self.every = every
+    self.sparse = sparse
+    self._next = every
+
+  def __call__(self, step: int, state, logs: Dict):
+    if step < self._next:
+      return
+    self._next = (step // self.every + 1) * self.every
+    import jax
+
+    params = state.params
+    emb = params.get('embedding') if isinstance(params, dict) else None
+    if emb is None:
+      raise ValueError(
+          "CheckpointCallback expects state.params['embedding'] (the "
+          'hybrid train-state layout)')
+    weights = get_weights(self.dist, emb)
+    sparse = self.sparse
+    if sparse is None:
+      sparse = (isinstance(state.opt_state, tuple)
+                and len(state.opt_state) == 2
+                and isinstance(state.opt_state[1], dict))
+    st_tables = (get_optimizer_state(self.dist, state.opt_state[1])
+                 if sparse else None)
+    extras = {'step': np.int64(step)}
+    dense = {k: v for k, v in params.items() if k != 'embedding'}
+    flat, _ = jax.tree_util.tree_flatten_with_path(dense)
+    for p, v in flat:
+      extras['dense:' + jax.tree_util.keystr(p)] = np.asarray(v)
+    dense_opt = state.opt_state[0] if sparse else state.opt_state
+    flat, _ = jax.tree_util.tree_flatten_with_path(dense_opt)
+    for p, v in flat:
+      extras['opt:' + jax.tree_util.keystr(p)] = np.asarray(v)
+    path = self.path.format(step=step)
+    if path == self.path:  # no {step} placeholder: atomic overwrite
+      import os
+      # the tmp name must keep the .npz suffix: np.savez appends it
+      tmp = path + '.tmp.npz'
+      save_train_npz(tmp, weights, st_tables, extras=extras)
+      os.replace(tmp, path)
+    else:
+      save_train_npz(path, weights, st_tables, extras=extras)
+    logs['checkpoint'] = path
+
+
+class EarlyStopping:
+  """Stop ``fit`` when a monitored metric stops improving.
+
+  Args:
+    monitor: key in ``logs`` (``'loss'`` or any eval metric).
+    patience: log/eval points without improvement before stopping.
+    min_delta: required improvement margin.
+    mode: ``'min'`` (default, loss-like) or ``'max'`` (AUC-like).
+  """
+
+  def __init__(self, monitor: str = 'loss', patience: int = 3,
+               min_delta: float = 0.0, mode: str = 'min'):
+    if mode not in ('min', 'max'):
+      raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+    self.monitor = monitor
+    self.patience = patience
+    self.min_delta = min_delta
+    self.sign = 1.0 if mode == 'min' else -1.0
+    self.best: Optional[float] = None
+    self.stale = 0
+
+  def __call__(self, step: int, state, logs: Dict):
+    if self.monitor not in logs:
+      return  # metric not produced at this point (e.g. eval cadence)
+    v = self.sign * float(logs[self.monitor])
+    if self.best is None or v < self.best - self.min_delta:
+      self.best = v
+      self.stale = 0
+      return
+    self.stale += 1
+    if self.stale >= self.patience:
+      raise StopIteration
