@@ -1090,6 +1090,17 @@ def _fused_lookup_packed(table: jax.Array, routed: jax.Array, pack: int,
   narrow gather pays anyway) and isolates its ``w = 128/pack`` target
   lanes in-register — the table itself is never reshaped, so no
   lane-padded relayout can materialise (GroupSpec.storage_pack).
+
+  The lane isolation is a MASK + lane-group fold, not a second gather:
+  ``take_along_axis`` after the row gather is gather-of-gather, which
+  XLA cannot fuse — at tiny/D=1 full size the first gather's result
+  materialised as a ``[n_cap, GB, h, pack, w]`` HLO temp whose narrow
+  trailing dim lane-pads 8x (5.00 GiB for 640 MiB of data, the largest
+  temp in the program).  Masking the unwanted lane groups to zero and
+  summing every ``w``-th lane stays elementwise+reduce, so it fuses
+  into the gather's consumer and the padded temp never exists.  For
+  'sum'/'mean' the h-axis reduction commutes with the fold; combiner
+  ``None`` is the h==1 special case of the same expression.
   """
   prows, lanes = table.shape
   w = lanes // pack
@@ -1097,8 +1108,22 @@ def _fused_lookup_packed(table: jax.Array, routed: jax.Array, pack: int,
   mask = routed < rows_cap
   safe = jnp.where(mask, routed, 0)
   prow = jnp.take(table, safe // pack, axis=0)  # [n_cap, GB, h, 128]
-  # lane-select the target slot: [..., 128] -> [..., pack, w] -> [..., w]
-  slot = (safe % pack)[..., None, None]
-  rows = jnp.take_along_axis(
-      prow.reshape(prow.shape[:-1] + (pack, w)), slot, axis=-2)[..., 0, :]
-  return _combine_rows(rows, mask, combiner, table.dtype, compute_dtype)
+  acc = jnp.float32 if table.dtype in (jnp.bfloat16, jnp.float16) \
+      else table.dtype
+  # zero every lane outside the target slot's lane group (and the whole
+  # row for sentinel/invalid positions), in the gather's own fusion
+  lane_group = jax.lax.broadcasted_iota(jnp.int32, (lanes,), 0) // w
+  keep = (lane_group[None, None, None, :] == (safe % pack)[..., None])
+  contrib = jnp.where(keep & mask[..., None], prow.astype(acc), 0)
+  if combiner is None:
+    summed = contrib[:, :, 0, :]            # h == 1 enforced upstream
+  else:
+    summed = jnp.sum(contrib, axis=2)       # [n_cap, GB, 128]
+  # fold the pack lane groups: exactly one group per (slot, sample, h)
+  # was kept, so the fold is the lane-select (and, summed over h, the
+  # 'sum' combine)
+  out = jnp.sum(summed.reshape(summed.shape[:-1] + (pack, w)), axis=-2)
+  if combiner == 'mean':
+    counts = jnp.sum(mask, axis=2).astype(acc)
+    out = out / jnp.maximum(counts, 1)[..., None]
+  return out.astype(compute_dtype)

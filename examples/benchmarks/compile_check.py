@@ -68,7 +68,16 @@ def main():
     from distributed_embeddings_tpu.ops import pallas_segwalk
     pallas_segwalk.ASSUME_TPU = True
   topo = topologies.get_topology_desc(args.topology, 'tpu')
-  mesh = topologies.make_mesh(topo, (args.chips,), ('data',))
+  # plain Mesh over the first N topology devices: unlike
+  # topologies.make_mesh it permits a SUBSET, so --chips 1 (the exact
+  # D=1 bench program) compiles against the 2x2 minimum topology
+  import numpy as np
+  tdevs = np.asarray(topo.devices).ravel()
+  if args.chips > tdevs.size:
+    raise SystemExit(f'--chips {args.chips} exceeds topology '
+                     f'{args.topology} ({tdevs.size} devices)')
+  from jax.sharding import Mesh
+  mesh = Mesh(tdevs[:args.chips], ('data',))
   config = SYNTHETIC_MODELS[args.model]
   model = SyntheticModel(config, mesh=mesh, dp_input=True)
   dist = model.dist_embedding
@@ -129,7 +138,12 @@ def main():
         pass
     copts[k] = v
   t0 = time.time()
-  lowered = jax.jit(step).lower(state, cats, (num, labels))
+  # donate the state like the real bench step (bench.py
+  # donate_argnums=(0,)): without it the updated tables appear as
+  # full-size HLO-temp copies and D=1 reads as a 6 GiB HBM overshoot
+  # the runtime never has
+  lowered = jax.jit(step, donate_argnums=(0,)).lower(
+      state, cats, (num, labels))
   t_lower = time.time() - t0
   t0 = time.time()
   compiled = lowered.compile(compiler_options=copts or None)
