@@ -76,10 +76,20 @@ FORCE_INTERPRET = False
 ASSUME_TPU = False
 
 
+# 1-D s32 SMEM operands must block at Mosaic's SMEM tile: XLA lays
+# s32[n] out as T(1024)S(1) and any other block shape fails layout
+# verification.  The grid tile stays smaller (VMEM: the segmented
+# scan's unrolled temps scale with it), so several grid steps share
+# one SMEM block via index_map t -> (t*tile)//1024 with an in-kernel
+# base offset.
+_SMEM_BLOCK = 1024
+
+
 def _tile_rows(width: int) -> int:
   """Stream rows per grid step: sized so the parity pairs of
-  [tile, width] f32 staging arrays plus the gradient block stay under
-  ~1 MiB of VMEM, capped at 512 scalar-walk iterations."""
+  [tile, width] f32 staging arrays plus the segmented scan's unrolled
+  shift temps stay inside scoped VMEM, capped at 512 scalar-walk
+  iterations.  Always divides ``_SMEM_BLOCK``."""
   return max(128, min(512, 32768 // width))
 
 
@@ -105,47 +115,71 @@ def _seg_scan(vals: jax.Array, starts: jax.Array) -> jax.Array:
   return vals
 
 
-def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, half_vmem, slot_vmem,
-                    g_ref, lr_smem, table_in, acc_in, table_ref, acc_ref,
+def _segwalk_kernel(sid_smem, islast_smem, g_ref, idv_ref, lr_smem,
+                    table_in, acc_in, table_ref, acc_ref,
                     tbuf, abuf, carry, carry_id, wcount, rsem, wsem, *,
-                    num_rows, num_tiles, tile, width, gw, pack, pair, op):
-  """One [tile, gw] block of the sorted stream against [*, width] rows.
+                    natural_rows, nfetch, prows, num_tiles, tile, width,
+                    gw, pack, pair, sideband, op):
+  """One [tile] block of the sorted stream against [*, width] rows.
 
   ``op``: 'sgd' | 'adagrad_dedup' | 'adagrad_sq' (static).  ``carry``
   [2, pair*width] VMEM scratch holds the running (sum, sum_sq) of the
   segment spanning the tile boundary; ``carry_id`` [1, 1] SMEM its id.
   For 'sgd' the acc refs point at a dummy buffer and are never DMA'd.
 
-  Lane packing (``pack > 1``): ids arrive PRE-divided by ``pack`` (the
-  table is viewed as ``[rows/pack, pack*gw]``, a free row-major
-  reshape), ``slot_vmem`` carries each row's original ``id % pack``,
-  and the gradient block expands in-register to the packed width with a
-  lane mask — so each unique PACKED row costs one full-burst DMA pair
-  serving up to ``pack`` original rows, and the scan/optimizer math is
-  unchanged (untouched lanes carry zero gradient; Adagrad is
-  elementwise, the exact argument of ``parallel/sparse.py:_lane_pack``).
+  Operand layout (round 4 — the padding rework): the sorted ORIGINAL
+  ids arrive once as a 1-D SMEM stream (untiled in HBM: a [N, 1] s32
+  column stores T(8,128)-padded at 128x, measured as multi-GiB temps at
+  synthetic scale) plus, for the vector side, either as a bitcast f32
+  SIDEBAND LANE of the gradient block (``sideband``, narrow widths:
+  lanes [0, gw) gradient, lane gw the ids — the block is exactly the
+  128 lanes the padded narrow block already paid for) or as one
+  [tile, 1] VMEM column (width-128 tables, whose gradient block has no
+  spare lane).  Packed row ids, lane slots, pair halves and segment
+  starts are all DERIVED in-kernel (scalar ops in the walks, vector
+  div/rem/compare on the id column) instead of travelling as four more
+  padded streams.
+
+  Lane packing (``pack > 1``): the table is viewed as
+  ``[rows/pack, 128]`` (free row-major reshape — the operand itself
+  when prepacked); ids divide by ``pack`` in-kernel (adjacent uids
+  sharing a packed row merge into one segment) and the gradient block
+  expands in-register to the packed width with a lane mask — each
+  unique PACKED row costs one full-burst DMA pair serving up to
+  ``pack`` original rows (untouched lanes carry zero gradient; Adagrad
+  is elementwise, the exact argument of
+  ``parallel/sparse.py:_lane_pack``).
 
   Pair fetch (``pair == 2``, bf16 tables): Mosaic rejects
   single-sublane bf16 slices (the packed-sublane layout pairs rows
-  2k/2k+1 in one 32-bit word), so ids arrive FURTHER divided by 2 —
-  ``sid`` indexes fetch PAIRS of the 3-D table view
-  ``[rows/(2*pack), 2, width]`` and ``half_vmem`` carries each row's
-  ``packed_id % 2``.  The payload expands to ``pair*width`` lanes (one
-  block per half) and the scan/carry machinery runs unchanged at that
-  superrow width; the optimizer update runs per half on f32-converted
-  staging values and rounds to bf16 once at write.  The write-back of a
-  whole fetched pair is SAFE here — unlike the rowwise kernel
-  (ops/pallas_rowwise.py header) — because the segment key IS the pair:
-  both rows of a pair merge into one segment applied at exactly one
-  grid position, so no other step can race the untouched half (which is
-  rewritten byte-identically: zero gradient lanes give a zero update,
-  and f32(bf16) round-trips exactly).
+  2k/2k+1 in one 32-bit word), so fetch ids further divide by 2 —
+  indexing PAIRS of the 3-D table view ``[rows/(2*pack), 2, width]``
+  with each row's ``packed_id % 2`` selecting its half.  The payload
+  expands to ``pair*width`` lanes (one block per half) and the
+  scan/carry machinery runs unchanged at that superrow width; the
+  optimizer update runs per half on f32-converted staging values and
+  rounds to bf16 once at write.  The write-back of a whole fetched
+  pair is SAFE here — unlike the rowwise kernel
+  (ops/pallas_rowwise.py header) — because the segment key IS the
+  pair: both rows of a pair merge into one segment applied at exactly
+  one grid position, so no other step can race the untouched half
+  (which is rewritten byte-identically: zero gradient lanes give a
+  zero update, and f32(bf16) round-trips exactly).
   """
   del table_in, acc_in  # same memory as the aliased output refs
   has_acc = op != 'sgd'
   pw = pair * width
   t = pl.program_id(0)
   p = jax.lax.rem(t, 2)
+  # several grid steps share one _SMEM_BLOCK-sized id/flag block (see
+  # _tile_rows): this step's rows start at `base` within it
+  base = jax.lax.rem(t * tile, _SMEM_BLOCK)
+
+  def kid_of(oid):
+    """Scalar/vector: original id -> fetch-unit id (sentinels land at
+    ``nfetch``, out of range, skipped by the walks)."""
+    pid = jnp.where(oid >= natural_rows, prows, oid // pack)
+    return pid // pair if pair > 1 else pid
 
   @pl.when(t == 0)
   def _init():
@@ -178,8 +212,10 @@ def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, half_vmem, slot_vmem,
   # the segmented scan below: the read latency hides behind compute
   # instead of serializing after it.
   def read_row(k, cnt):
+    kid = kid_of(sid_smem[base + k])
+
     def do(c):
-      rid = jnp.clip(sid_smem[k, 0], 0, num_rows - 1)
+      rid = jnp.clip(kid, 0, nfetch - 1)
       pltpu.make_async_copy(table_ref.at[pl.ds(rid, 1)],
                             tbuf.at[p, pl.ds(k, 1)], rsem).start()
       if has_acc:
@@ -188,29 +224,39 @@ def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, half_vmem, slot_vmem,
       return c + 1
 
     return jax.lax.cond(
-        (islast_smem[k, 0] == 1) & (sid_smem[k, 0] < num_rows), do,
+        (islast_smem[base + k] == 1) & (kid < nfetch), do,
         lambda c: c, cnt)
 
   nval = jax.lax.fori_loop(0, tile, read_row, 0)
 
   # ----- vector side: segmented totals (reads in flight) ---------------
-  sid_col = sid_vmem[:]                                 # [tile, 1] int32
+  blk = g_ref[:]                             # [tile, 128] f32
+  if sideband:
+    # ids ride lane gw of the gradient block as raw bits
+    oid_col = jax.lax.bitcast_convert_type(blk[:, gw:gw + 1], jnp.int32)
+    g = blk[:, :gw]                          # [tile, gw]
+  else:
+    oid_col = idv_ref[:]                     # [tile, 1] int32
+    g = blk
+  sent_col = oid_col >= natural_rows
+  pid_col = jnp.where(sent_col, prows, oid_col // pack)
+  kid_col = pid_col // pair if pair > 1 else pid_col
   prev = jnp.concatenate(
-      [jnp.full((1, 1), -2, jnp.int32), sid_col[:-1]], axis=0)
+      [jnp.full((1, 1), -2, jnp.int32), kid_col[:-1]], axis=0)
   starts = jnp.concatenate(
       [jnp.ones((1, 1), jnp.float32),
-       (sid_col[1:] != prev[1:]).astype(jnp.float32)], axis=0)
-  g = g_ref[:]                                          # [tile, gw] f32
+       (kid_col[1:] != prev[1:]).astype(jnp.float32)], axis=0)
   if pack > 1:
+    slot_col = jnp.where(sent_col, 0, jax.lax.rem(oid_col, pack))
     lane = jax.lax.broadcasted_iota(jnp.int32, (tile, width), 1) // gw
-    g = jnp.tile(g, (1, pack)) * (lane == slot_vmem[:]).astype(jnp.float32)
+    g = jnp.tile(g, (1, pack)) * (lane == slot_col).astype(jnp.float32)
   if pair > 1:
     # expand to the pair superrow: one `width`-lane block per half,
     # masked by the row's half index (zeros in the untouched half)
-    hf = (half_vmem[:] == 0).astype(jnp.float32)        # [tile, 1]
+    hf = (jax.lax.rem(pid_col, 2) == 0).astype(jnp.float32)  # [tile, 1]
     g = jnp.concatenate([g * hf, g * (1.0 - hf)], axis=1)  # [tile, pw]
   # both scalars live in SMEM: scalar compare, then broadcast
-  cont = (sid_smem[0, 0] == carry_id[0, 0]).astype(jnp.float32)
+  cont = (kid_of(sid_smem[base]) == carry_id[0, 0]).astype(jnp.float32)
   if op == 'adagrad_sq':
     payload = jnp.concatenate([g, g * g], axis=1)       # [tile, 2*pw]
     # lane-concat, not reshape: splitting [1, 2*pw] into [2, pw] is a
@@ -273,13 +319,15 @@ def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, half_vmem, slot_vmem,
     carry[1:2] = seg[tile - 1:tile, pw:]
   else:
     carry[0:1] = seg[tile - 1:tile]
-  carry_id[0, 0] = sid_smem[tile - 1, 0]
+  carry_id[0, 0] = kid_of(sid_smem[base + tile - 1])
 
   # ----- scalar walk 2: issue writes; they stay in flight through the
   # NEXT tile's reads/compute and drain when this parity comes up again
   def write_row(k, _):
+    kid = kid_of(sid_smem[base + k])
+
     def do(_):
-      rid = jnp.clip(sid_smem[k, 0], 0, num_rows - 1)
+      rid = jnp.clip(kid, 0, nfetch - 1)
       pltpu.make_async_copy(tbuf.at[p, pl.ds(k, 1)],
                             table_ref.at[pl.ds(rid, 1)], wsem.at[p]).start()
       if has_acc:
@@ -288,7 +336,7 @@ def _segwalk_kernel(sid_smem, islast_smem, sid_vmem, half_vmem, slot_vmem,
       return 0
 
     jax.lax.cond(
-        (islast_smem[k, 0] == 1) & (sid_smem[k, 0] < num_rows), do,
+        (islast_smem[base + k] == 1) & (kid < nfetch), do,
         lambda _: 0, 0)
     return 0
 
@@ -359,7 +407,7 @@ def supported(table: jax.Array) -> bool:
 
 
 @functools.partial(jax.jit, static_argnames=('op', 'eps', 'interpret',
-                                             'logical_width'))
+                                             'logical_width', 'presorted'))
 def segwalk_apply(table: jax.Array,
                   acc: Optional[jax.Array],
                   sorted_ids: jax.Array,
@@ -369,8 +417,9 @@ def segwalk_apply(table: jax.Array,
                   op: str,
                   eps: float = 1e-7,
                   interpret: bool = False,
-                  logical_width: Optional[int] = None):
-  """Apply one optimizer step from a SORTED per-occurrence stream.
+                  logical_width: Optional[int] = None,
+                  presorted: bool = True):
+  """Apply one optimizer step from a per-occurrence update stream.
 
   Args:
     table: ``[num_rows, w]`` f32 (donate for in-place) — or, when
@@ -380,13 +429,19 @@ def segwalk_apply(table: jax.Array,
       operand itself with no reshape, so the lane-padded relayout that
       barred huge narrow groups (``packed_dispatch_ok``) cannot occur.
     acc: Adagrad accumulator (same shape as ``table``), or None for 'sgd'.
-    sorted_ids: ``[n]`` int32 ascending NATURAL row ids; sentinels
-      (>= natural num_rows) last.
-    sorted_g: ``[n, w]`` f32 gradient rows in the same order (natural w).
+    sorted_ids: ``[n]`` int32 NATURAL row ids; sentinels (>= natural
+      num_rows) mark padding.  Ascending when ``presorted`` (sentinels
+      last); arbitrary order with ``presorted=False``, in which case
+      the sort happens HERE so the payload gathers once, directly into
+      the dense kernel operand (callers sorting separately pay an
+      extra lane-padded materialisation of the narrow payload).
+    sorted_g: ``[n, w]`` f32 gradient rows aligned with ``sorted_ids``
+      (natural w).
     lr: scalar learning rate.
     op: 'sgd' | 'adagrad_dedup' | 'adagrad_sq'.
     logical_width: natural width when ``table`` is prepacked; None (or
       equal to ``table.shape[1]``) for natural tables.
+    presorted: whether ``sorted_ids``/``sorted_g`` are already sorted.
 
   Returns:
     ``new_table`` ('sgd') or ``(new_table, new_acc)`` — in the same
@@ -424,40 +479,66 @@ def segwalk_apply(table: jax.Array,
                      f'{acc.dtype}')
   tile = _tile_rows(pair * kw)
   n = sorted_ids.shape[0]
-  n_pad = -(-n // tile) * tile
+  # pad to whole _SMEM_BLOCKs (tile divides _SMEM_BLOCK), so the shared
+  # 1-D SMEM id/flag blocks are always full
+  n_pad = -(-n // _SMEM_BLOCK) * _SMEM_BLOCK
   if n_pad != n:
     pad = n_pad - n
     sorted_ids = jnp.pad(sorted_ids, (0, pad), constant_values=num_rows)
     sorted_g = jnp.pad(sorted_g, ((0, pad), (0, 0)))
   sorted_ids = sorted_ids.astype(jnp.int32)
+  sorted_g = sorted_g.astype(jnp.float32)
+  # sort HERE (presorted=False) so the one big materialisation is the
+  # dense gather of the combined block below (sentinels = num_rows
+  # sort to the end); ids themselves gather 1-D, untiled, cheap
+  order = None if presorted else jnp.argsort(sorted_ids)
   if pack > 1:
-    kids, slots = packed_ids(sorted_ids, pack, num_rows)
     table_k = table if prepacked else table.reshape(prows, kw)
     acc_k = (acc if prepacked else
              acc.reshape(prows, kw)) if acc is not None else None
   else:
-    # the kernel statically never reads slots when pack == 1: reuse the
-    # id stream as the operand instead of materializing a zeros array
-    kids, slots = sorted_ids, sorted_ids
     table_k, acc_k = table, acc
   if pair == 2:
-    # fetch-unit ids: the segment key merges to the PAIR (both rows of
-    # a fetched pair apply at one grid position — the race-freedom
-    # argument), halves ride along for the in-kernel expansion.
-    # supported() guarantees prows is even; the packed sentinel prows
-    # maps to fetch id nfetch, out of range, skipped by the walks.
+    # fetch-unit granularity: the segment key merges to the PAIR (both
+    # rows of a fetched pair apply at one grid position — the
+    # race-freedom argument).  supported() guarantees prows is even;
+    # the packed sentinel prows maps to fetch id nfetch, out of range,
+    # skipped by the walks.
     nfetch = prows // 2
-    halves = jax.lax.rem(kids, 2)
-    kids = kids // 2
     table_k = table_k.reshape(nfetch, 2, kw)
     acc_k = acc_k.reshape(nfetch, 2, kw) if acc_k is not None else None
   else:
     nfetch = prows
-    halves = kids  # statically never read when pair == 1
-  # global segment-last flags (the one lookahead the kernel cannot do),
-  # over the FETCH-unit ids: adjacent uids sharing a packed row (or
-  # bf16 pair) are one segment whose lanes (or halves) carry their
-  # per-uid totals disjointly
+  # Operand layout (see the kernel docstring): ids travel ONCE as a
+  # 1-D untiled SMEM stream; the vector side reads them either from a
+  # bitcast sideband lane of the [n, 128] gradient block (narrow
+  # widths: the padded narrow block already paid for those lanes) or,
+  # for width-128 tables, from one [n, 1] VMEM column.  Fetch ids,
+  # lane slots, halves and starts are derived in-kernel.
+  sid1d = sorted_ids if order is None else jnp.take(sorted_ids, order)
+  sideband = w < 128
+  if sideband:
+    # lane-iota select, not concat of a [n, 1] column: a unit-width f32
+    # column materialises T(8,128)-padded at 128x (a 2 GiB temp at
+    # synthetic scale), while this form is elementwise over the dense
+    # [n, 128] block and fuses into its one materialisation
+    lane = jax.lax.broadcasted_iota(jnp.int32, (n_pad, 128), 1)
+    comb = jnp.where(
+        lane == w,
+        jax.lax.bitcast_convert_type(sorted_ids, jnp.float32)[:, None],
+        jnp.pad(sorted_g, ((0, 0), (0, 128 - w))))
+    g_operand = comb if order is None else jnp.take(comb, order, axis=0)
+    idv_operand = jnp.zeros((1, 1), jnp.int32)  # statically never read
+  else:
+    g_operand = (sorted_g if order is None else
+                 jnp.take(sorted_g, order, axis=0))
+    idv_operand = sid1d[:, None]
+  # fetch-unit ids for the global segment-last flags (the one lookahead
+  # the kernel cannot do): adjacent uids sharing a packed row (or bf16
+  # pair) are one segment whose lanes (or halves) carry their per-uid
+  # totals disjointly.  1-D untiled arrays: cheap.
+  sent = sid1d >= num_rows
+  kids = jnp.where(sent, prows, sid1d // pack) // pair
   is_last = jnp.concatenate([
       (kids[1:] != kids[:-1]),
       jnp.ones((1,), bool)
@@ -465,7 +546,6 @@ def segwalk_apply(table: jax.Array,
   num_tiles = n_pad // tile
   lr_arr = jnp.stack([jnp.asarray(lr, jnp.float32),
                       jnp.asarray(eps, jnp.float32)]).reshape(1, 2)
-  ids2d = kids[:, None]
   # 'sgd' has no accumulator: a small dummy keeps the operand/alias
   # structure uniform (the kernel never issues DMAs against it)
   if acc_k is not None:
@@ -476,30 +556,32 @@ def segwalk_apply(table: jax.Array,
 
   stage = (2, tile, 2, kw) if pair == 2 else (2, tile, kw)
   kernel = functools.partial(_segwalk_kernel,
-                             num_rows=nfetch,
+                             natural_rows=num_rows,
+                             nfetch=nfetch,
+                             prows=prows,
                              num_tiles=num_tiles,
                              tile=tile,
                              width=kw,
                              gw=w,
                              pack=pack,
                              pair=pair,
+                             sideband=sideband,
                              op=op)
   outs = pl.pallas_call(
       kernel,
       grid=(num_tiles,),
       in_specs=[
-          pl.BlockSpec((tile, 1), lambda t: (t, 0),
-                       memory_space=pltpu.SMEM),   # ids (scalar walk)
-          pl.BlockSpec((tile, 1), lambda t: (t, 0),
-                       memory_space=pltpu.SMEM),   # is_last (walk)
-          pl.BlockSpec((tile, 1), lambda t: (t, 0),
-                       memory_space=pltpu.VMEM),   # ids (vector scan)
-          pl.BlockSpec((tile, 1), lambda t: (t, 0),
-                       memory_space=pltpu.VMEM),   # pair halves
-          pl.BlockSpec((tile, 1), lambda t: (t, 0),
-                       memory_space=pltpu.VMEM),   # lane slots
-          pl.BlockSpec((tile, w), lambda t: (t, 0),
-                       memory_space=pltpu.VMEM),   # sorted grads
+          pl.BlockSpec((_SMEM_BLOCK,),
+                       lambda t, _tl=tile: ((t * _tl) // _SMEM_BLOCK,),
+                       memory_space=pltpu.SMEM),   # ids (scalar walks)
+          pl.BlockSpec((_SMEM_BLOCK,),
+                       lambda t, _tl=tile: ((t * _tl) // _SMEM_BLOCK,),
+                       memory_space=pltpu.SMEM),   # is_last (walks)
+          pl.BlockSpec((tile, 128 if sideband else kw), lambda t: (t, 0),
+                       memory_space=pltpu.VMEM),   # grads (+ id sideband)
+          (pl.BlockSpec(memory_space=pltpu.SMEM) if sideband else
+           pl.BlockSpec((tile, 1), lambda t: (t, 0),
+                        memory_space=pltpu.VMEM)),  # ids (vector, w=128)
           pl.BlockSpec(memory_space=pltpu.SMEM),   # [lr, eps]
           pl.BlockSpec(memory_space=pl.ANY),       # table
           pl.BlockSpec(memory_space=pl.ANY),       # acc (or dummy)
@@ -515,7 +597,7 @@ def segwalk_apply(table: jax.Array,
       # REQUIRED for correctness, not just memory: rows the kernel never
       # touches must retain their input values, which only the aliased
       # output buffer provides
-      input_output_aliases={7: 0, 8: 1},
+      input_output_aliases={5: 0, 6: 1},
       scratch_shapes=[
           pltpu.VMEM(stage, table_k.dtype),        # tbuf (parity pair)
           pltpu.VMEM(stage, jnp.float32),          # abuf (parity pair)
@@ -528,8 +610,8 @@ def segwalk_apply(table: jax.Array,
       compiler_params=pltpu.CompilerParams(
           dimension_semantics=('arbitrary',)),
       interpret=interpret,
-  )(ids2d, is_last[:, None], ids2d, halves[:, None], slots[:, None],
-    sorted_g, lr_arr, table_k, acc_operand)
+  )(sid1d, is_last, g_operand, idv_operand, lr_arr, table_k,
+    acc_operand)
   new_table, new_acc = outs[0], outs[1]
   if pair == 2:
     new_table = new_table.reshape(prows, kw)

@@ -639,18 +639,21 @@ def _segwalk_apply(optimizer, table, state, flat_ids, flat_g, lr,
   from distributed_embeddings_tpu.ops import pallas_segwalk
   interp = pallas_segwalk.FORCE_INTERPRET
   lw = flat_g.shape[1] if storage_pack > 1 else None
-  order = jnp.argsort(flat_ids)
-  sid = flat_ids[order].astype(jnp.int32)
-  sg = flat_g[order].astype(jnp.float32)
+  # RAW stream in: the kernel wrapper sorts internally so the payload
+  # gathers once, directly into its dense [n, 128] operand (sorting
+  # here first would materialise an extra lane-padded narrow gather —
+  # the multi-GiB [n, w<128] temps of the round-4 memory audit)
+  ids = flat_ids.astype(jnp.int32)
+  g = flat_g.astype(jnp.float32)
   if isinstance(optimizer, SparseSGD):
     t2 = pallas_segwalk.segwalk_apply(
-        table, None, sid, sg, lr, op='sgd', interpret=interp,
-        logical_width=lw)
+        table, None, ids, g, lr, op='sgd', interpret=interp,
+        logical_width=lw, presorted=False)
     return t2, state
   op = 'adagrad_dedup' if optimizer.dedup else 'adagrad_sq'
   t2, a2 = pallas_segwalk.segwalk_apply(
-      table, state['acc'], sid, sg, lr, op=op, eps=optimizer.epsilon,
-      interpret=interp, logical_width=lw)
+      table, state['acc'], ids, g, lr, op=op, eps=optimizer.epsilon,
+      interpret=interp, logical_width=lw, presorted=False)
   return t2, {'acc': a2}
 
 
@@ -698,9 +701,13 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
       # schedule every group's sort/gather/scatter pipeline concurrently,
       # keeping all their multi-hundred-MB compaction temporaries live at
       # once — on a chip already holding params + accumulator that tips
-      # peak HBM over the edge (docs/perf_notes.md, train-step section)
-      (flat_ids, flat_g, fence) = jax.lax.optimization_barrier(
-          (flat_ids, flat_g, fence))
+      # peak HBM over the edge (docs/perf_notes.md, train-step section).
+      # Only the IDS pass the barrier: everything downstream (sort,
+      # gathers, applies) depends on them, which orders the pipelines,
+      # while the gradient stream stays fusible into its consumer (a
+      # barriered flat_g materialises as a full lane-padded narrow temp
+      # — 2 GiB at synthetic-small scale, round-4 memory audit)
+      (flat_ids, fence) = jax.lax.optimization_barrier((flat_ids, fence))
       state_g = {k: v[0] for k, v in opt_state[key].items()}
       cap_rows = None
       caps = getattr(optimizer, 'capacity_rows', None)

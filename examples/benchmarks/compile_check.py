@@ -30,6 +30,14 @@ def main():
   p.add_argument('--chips', type=int, default=4)
   p.add_argument('--batch', type=int, default=65536)
   p.add_argument('--segwalk_apply', action='store_true')
+  p.add_argument('--param_dtype', default='float32',
+                 choices=['float32', 'bfloat16'],
+                 help='table storage dtype: bfloat16 halves the argument '
+                 'HBM, the binding resource for models whose state '
+                 'approaches chip memory (e.g. small at 8 chips)')
+  p.add_argument('--capacity_fraction', type=float, default=0.5,
+                 help='compaction capacity fraction (bench.py default '
+                 '0.5); temps scale with it')
   p.add_argument('--topology', default='v5e:2x2',
                  help='compile-only topology (chips must divide it)')
   p.add_argument('--compiler_option', action='append', default=[],
@@ -79,9 +87,11 @@ def main():
   from jax.sharding import Mesh
   mesh = Mesh(tdevs[:args.chips], ('data',))
   config = SYNTHETIC_MODELS[args.model]
-  model = SyntheticModel(config, mesh=mesh, dp_input=True)
+  pdt = jnp.dtype(args.param_dtype)
+  model = SyntheticModel(config, mesh=mesh, dp_input=True, param_dtype=pdt)
   dist = model.dist_embedding
   opt = SparseAdagrad(learning_rate=0.01,
+                      capacity_fraction=args.capacity_fraction,
                       use_segwalk_apply=args.segwalk_apply)
   dense_opt = optax.adagrad(0.01, initial_accumulator_value=0.1, eps=1e-7)
 
@@ -101,7 +111,7 @@ def main():
 
   W = args.chips
   emb = {
-      f'group_{gi}': sds((W, g.param_rows, g.param_width), jnp.float32, tsh)
+      f'group_{gi}': sds((W, g.param_rows, g.param_width), pdt, tsh)
       for gi, g in enumerate(dist.plan.groups)
   }
   acc = {
