@@ -23,6 +23,16 @@ ops/pallas_lookup.py), and the bf16 pair-fetch trick is unsafe here
 because WRITING a fetched pair back would race a neighbouring unique
 row's read-modify-write in another grid step.
 
+Known memory caveat (round-4 audit): the ``uids`` operand travels as a
+``[cap, 1]`` s32 column, which the TPU stores T(8,128)-padded at 128x
+(``cap * 512`` bytes of HBM).  Bounded by the COMPACTED capacity — not
+the raw stream — so it is ~100x smaller than the pre-rework segwalk
+blowup, but on capacity-bound groups it can still reach ~1.5 GiB.
+``ops/pallas_segwalk.py`` carries its ids in a 1-D untiled SMEM stream
+plus a sideband lane and has none of this; prefer it (it also needs no
+compaction pipeline at all).  A matching rework here is only worth
+doing if the on-chip A/B ever favors this kernel.
+
 Correctness preconditions (the sparse path guarantees both):
 - ``uids`` hold UNIQUE row ids with all sentinels (>= num_rows) in a
   contiguous tail (``compact_segments`` rank order) — uniqueness
