@@ -38,6 +38,12 @@ def main():
   p.add_argument('--capacity_fraction', type=float, default=0.5,
                  help='compaction capacity fraction (bench.py default '
                  '0.5); temps scale with it')
+  p.add_argument('--column_slice', default=None,
+                 help="element threshold for column slicing, or "
+                 "'balance' = total_elems/chips: without it a single "
+                 "100M-row table lands whole on one chip and capacity "
+                 "padding bloats every other chip to match (medium+ "
+                 "models at multi-chip)")
   p.add_argument('--topology', default='v5e:2x2',
                  help='compile-only topology (chips must divide it)')
   p.add_argument('--compiler_option', action='append', default=[],
@@ -88,7 +94,15 @@ def main():
   mesh = Mesh(tdevs[:args.chips], ('data',))
   config = SYNTHETIC_MODELS[args.model]
   pdt = jnp.dtype(args.param_dtype)
-  model = SyntheticModel(config, mesh=mesh, dp_input=True, param_dtype=pdt)
+  cst = args.column_slice
+  if cst == 'balance':
+    tconfigs, _, _ = expand_tables(config)
+    cst = -(-sum(c.input_dim * c.output_dim for c in tconfigs)
+            // args.chips)
+  elif cst is not None:
+    cst = int(cst)
+  model = SyntheticModel(config, mesh=mesh, dp_input=True, param_dtype=pdt,
+                         column_slice_threshold=cst)
   dist = model.dist_embedding
   opt = SparseAdagrad(learning_rate=0.01,
                       capacity_fraction=args.capacity_fraction,
