@@ -229,3 +229,38 @@ class TestShardingPlan:
     plan = ShardingPlan(configs, world_size=2)
     assert len(plan.widths_list_flat) == 3
     assert all(w == 4 for w in plan.widths_list_flat)
+
+
+class TestCapacityPaddingFootprint:
+  """Capacity padding (rows_cap = max over devices) multiplies the
+  PHYSICAL per-chip bytes by the placement imbalance: one dominant
+  table landing whole on a device bloats EVERY chip's group array to
+  match (78.9 GiB/chip measured on synthetic-medium at 32 chips,
+  round-4 memory audit).  Column slicing is the cure; these pin both
+  the failure mode and the fix at unit scale."""
+
+  def physical_per_chip(self, plan):
+    # what DistributedEmbedding.init actually allocates per chip
+    return sum(g.param_rows * g.param_width for g in plan.groups)
+
+  def test_dominant_table_bloats_capacity(self):
+    # 33 tables on 8 devices: no slicing at threshold None (tables >
+    # devices), so the 8192-row table lands whole on one chip and
+    # rows_cap drags every chip to ~the big table's size
+    sizes = [8192] + [8] * 32
+    plan = ShardingPlan(make_configs(sizes, width=128), world_size=8,
+                        strategy='memory_balanced')
+    phys = self.physical_per_chip(plan)
+    ideal = -(-sum(sizes) // 8) * 128
+    assert phys * 8 > 4 * sum(sizes) * 128  # >4x blowup without slicing
+
+  def test_column_slice_restores_balance(self):
+    sizes = [8192] + [8] * 32
+    total = sum(sizes) * 128
+    plan = ShardingPlan(make_configs(sizes, width=128), world_size=8,
+                        strategy='memory_balanced',
+                        column_slice_threshold=total // 8)
+    phys = self.physical_per_chip(plan)
+    ideal = -(-total // 8)
+    # within 2x of a perfect split (padding granularity + fusion caps)
+    assert phys <= 2 * ideal, (phys, ideal)
