@@ -230,14 +230,26 @@ def _segwalk_kernel(sid_smem, islast_smem, g_ref, idv_ref, lr_smem,
   nval = jax.lax.fori_loop(0, tile, read_row, 0)
 
   # ----- vector side: segmented totals (reads in flight) ---------------
-  blk = g_ref[:]                             # [tile, 128] f32
+  blk = g_ref[:]                             # [tile, 128] f32|bf16
+  stream_bf16 = blk.dtype == jnp.bfloat16
   if sideband:
-    # ids ride lane gw of the gradient block as raw bits
-    oid_col = jax.lax.bitcast_convert_type(blk[:, gw:gw + 1], jnp.int32)
-    g = blk[:, :gw]                          # [tile, gw]
+    if stream_bf16:
+      # ids ride lanes gw (low 16 bits) and gw+1 (high) as raw bf16
+      # bits; cross-bitwidth bitcast with a shape change is not
+      # lowerable on v5e, so reassemble via same-width u16 bitcasts +
+      # integer shift/or (compile-gated pattern)
+      lo = jax.lax.bitcast_convert_type(blk[:, gw:gw + 1],
+                                        jnp.uint16).astype(jnp.int32)
+      hi = jax.lax.bitcast_convert_type(blk[:, gw + 1:gw + 2],
+                                        jnp.uint16).astype(jnp.int32)
+      oid_col = jnp.left_shift(hi, 16) | lo
+    else:
+      # ids ride lane gw of the gradient block as raw bits
+      oid_col = jax.lax.bitcast_convert_type(blk[:, gw:gw + 1], jnp.int32)
+    g = blk[:, :gw].astype(jnp.float32)      # [tile, gw]
   else:
     oid_col = idv_ref[:]                     # [tile, 1] int32
-    g = blk
+    g = blk.astype(jnp.float32)
   sent_col = oid_col >= natural_rows
   pid_col = jnp.where(sent_col, prows, oid_col // pack)
   kid_col = pid_col // pair if pair > 1 else pid_col
@@ -407,7 +419,8 @@ def supported(table: jax.Array) -> bool:
 
 
 @functools.partial(jax.jit, static_argnames=('op', 'eps', 'interpret',
-                                             'logical_width', 'presorted'))
+                                             'logical_width', 'presorted',
+                                             'stream_dtype'))
 def segwalk_apply(table: jax.Array,
                   acc: Optional[jax.Array],
                   sorted_ids: jax.Array,
@@ -418,7 +431,8 @@ def segwalk_apply(table: jax.Array,
                   eps: float = 1e-7,
                   interpret: bool = False,
                   logical_width: Optional[int] = None,
-                  presorted: bool = True):
+                  presorted: bool = True,
+                  stream_dtype=jnp.float32):
   """Apply one optimizer step from a per-occurrence update stream.
 
   Args:
@@ -442,6 +456,14 @@ def segwalk_apply(table: jax.Array,
     logical_width: natural width when ``table`` is prepacked; None (or
       equal to ``table.shape[1]``) for natural tables.
     presorted: whether ``sorted_ids``/``sorted_g`` are already sorted.
+    stream_dtype: dtype of the gradient-stream operand (f32 default).
+      ``bfloat16`` HALVES the stream's HBM footprint and traffic (the
+      binding temps at pod scale are the comb + sorted-gather pair,
+      2x stream bytes — docs/perf_notes.md fits-ladder); gradients are
+      rounded to bf16 once before the f32 segment summation, a
+      quantisation the optimizer sums absorb (opt-in:
+      ``SparseSGD/SparseAdagrad(stream_dtype='bfloat16')``).  Exact
+      for gradients already representable in bf16.
 
   Returns:
     ``new_table`` ('sgd') or ``(new_table, new_acc)`` — in the same
@@ -515,6 +537,10 @@ def segwalk_apply(table: jax.Array,
   # widths: the padded narrow block already paid for those lanes) or,
   # for width-128 tables, from one [n, 1] VMEM column.  Fetch ids,
   # lane slots, halves and starts are derived in-kernel.
+  sdt = jnp.dtype(stream_dtype)
+  if sdt not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+    raise ValueError(f'stream_dtype must be float32 or bfloat16, '
+                     f'got {sdt}')
   sid1d = sorted_ids if order is None else jnp.take(sorted_ids, order)
   sideband = w < 128
   if sideband:
@@ -523,15 +549,26 @@ def segwalk_apply(table: jax.Array,
     # synthetic scale), while this form is elementwise over the dense
     # [n, 128] block and fuses into its one materialisation
     lane = jax.lax.broadcasted_iota(jnp.int32, (n_pad, 128), 1)
-    comb = jnp.where(
-        lane == w,
-        jax.lax.bitcast_convert_type(sorted_ids, jnp.float32)[:, None],
-        jnp.pad(sorted_g, ((0, 0), (0, 128 - w))))
+    gpad = jnp.pad(sorted_g.astype(sdt), ((0, 0), (0, 128 - w)))
+    if sdt == jnp.bfloat16:
+      # 32-bit ids split over two raw-bits bf16 lanes: [n, 2] with
+      # element 0 the low half (little-endian bitcast order — the
+      # kernel reassembles lo | hi<<16, round-tripped bit-exact in
+      # tests)
+      ids_bf = jax.lax.bitcast_convert_type(sorted_ids, jnp.bfloat16)
+      comb = jnp.where(
+          lane == w, ids_bf[:, 0:1],
+          jnp.where(lane == w + 1, ids_bf[:, 1:2], gpad))
+    else:
+      comb = jnp.where(
+          lane == w,
+          jax.lax.bitcast_convert_type(sorted_ids, jnp.float32)[:, None],
+          gpad)
     g_operand = comb if order is None else jnp.take(comb, order, axis=0)
     idv_operand = jnp.zeros((1, 1), jnp.int32)  # statically never read
   else:
-    g_operand = (sorted_g if order is None else
-                 jnp.take(sorted_g, order, axis=0))
+    gs = sorted_g.astype(sdt)  # convert BEFORE the gather: the gather
+    g_operand = gs if order is None else jnp.take(gs, order, axis=0)
     idv_operand = sid1d[:, None]
   # fetch-unit ids for the global segment-last flags (the one lookahead
   # the kernel cannot do): adjacent uids sharing a packed row (or bf16

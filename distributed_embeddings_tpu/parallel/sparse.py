@@ -195,6 +195,11 @@ class SparseSGD:
   # group (>~4M rows) falls back to XLA to avoid the lane-padded
   # relayout, as does any other unsupported case.
   use_segwalk_apply: bool = False
+  # stream payload dtype for the segwalk kernel: 'bfloat16' halves the
+  # update stream's HBM footprint and traffic (the comb + sorted-gather
+  # pair are the binding temps at pod scale — docs/perf_notes.md);
+  # gradients round to bf16 once before the f32 segment summation
+  stream_dtype: str = 'float32'
 
   needs_sq = False
   supports_lane_packing = True
@@ -246,6 +251,8 @@ class SparseAdagrad:
   # packed_dispatch_ok HBM gates, where huge narrow groups fall back to
   # XLA).  Takes precedence over use_pallas_apply when both are set.
   use_segwalk_apply: bool = False
+  # stream payload dtype for the segwalk kernel (see SparseSGD)
+  stream_dtype: str = 'float32'
 
   supports_lane_packing = True
 
@@ -645,15 +652,17 @@ def _segwalk_apply(optimizer, table, state, flat_ids, flat_g, lr,
   # the multi-GiB [n, w<128] temps of the round-4 memory audit)
   ids = flat_ids.astype(jnp.int32)
   g = flat_g.astype(jnp.float32)
+  sdt = getattr(optimizer, 'stream_dtype', 'float32')
   if isinstance(optimizer, SparseSGD):
     t2 = pallas_segwalk.segwalk_apply(
         table, None, ids, g, lr, op='sgd', interpret=interp,
-        logical_width=lw, presorted=False)
+        logical_width=lw, presorted=False, stream_dtype=sdt)
     return t2, state
   op = 'adagrad_dedup' if optimizer.dedup else 'adagrad_sq'
   t2, a2 = pallas_segwalk.segwalk_apply(
       table, state['acc'], ids, g, lr, op=op, eps=optimizer.epsilon,
-      interpret=interp, logical_width=lw, presorted=False)
+      interpret=interp, logical_width=lw, presorted=False,
+      stream_dtype=sdt)
   return t2, {'acc': a2}
 
 

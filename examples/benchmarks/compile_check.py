@@ -38,6 +38,11 @@ def main():
   p.add_argument('--capacity_fraction', type=float, default=0.5,
                  help='compaction capacity fraction (bench.py default '
                  '0.5); temps scale with it')
+  p.add_argument('--stream_dtype', default='float32',
+                 choices=['float32', 'bfloat16'],
+                 help='segwalk update-stream payload dtype: bfloat16 '
+                 'halves the comb + sorted-gather temp pair, the '
+                 'binding allocation at pod scale')
   p.add_argument('--column_slice', default=None,
                  help="element threshold for column slicing, or "
                  "'balance' = total_elems/chips: without it a single "
@@ -106,7 +111,8 @@ def main():
   dist = model.dist_embedding
   opt = SparseAdagrad(learning_rate=0.01,
                       capacity_fraction=args.capacity_fraction,
-                      use_segwalk_apply=args.segwalk_apply)
+                      use_segwalk_apply=args.segwalk_apply,
+                      stream_dtype=args.stream_dtype)
   dense_opt = optax.adagrad(0.01, initial_accumulator_value=0.1, eps=1e-7)
 
   def head_loss_fn(dp, eo, b):

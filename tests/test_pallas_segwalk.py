@@ -461,3 +461,67 @@ def test_bf16_integration_through_hybrid_step_interpreted(opt_kind):
       pallas_segwalk.FORCE_INTERPRET = False
   for a, b in zip(results[False], results[True]):
     np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-2)
+
+
+# ------------------------------------------------------- bf16 STREAM
+# stream_dtype='bfloat16' halves the update-stream operand; gradients
+# round to bf16 once before the f32 segment summation.  With gradients
+# already exactly representable in bf16 the result must be BIT-EXACT
+# against the f32 stream — which also proves the two-lane raw-bits id
+# sideband round-trips exactly (a wrong lane order or bit split would
+# scatter to wrong rows, not just lose precision).
+
+
+@pytest.mark.parametrize('op', ['sgd', 'adagrad_dedup', 'adagrad_sq'])
+@pytest.mark.parametrize('width', [8, 32, 128])
+def test_bf16_stream_bit_exact_on_representable_grads(op, width):
+  import zlib
+  rng = np.random.default_rng(zlib.crc32(f'sdt-{op}-{width}'.encode()))
+  rows, n = 64, 800
+  table = jnp.asarray(rng.normal(size=(rows, width)), jnp.float32)
+  acc = (None if op == 'sgd' else
+         jnp.asarray(rng.uniform(0.05, 0.2, size=(rows, width)),
+                     jnp.float32))
+  # ids cover the full range incl. sentinels; grads are small integers
+  # scaled by a power of two: exactly representable in bf16
+  ids = rng.integers(0, rows + 6, size=(n,)).astype(np.int32)
+  grads = (rng.integers(-8, 9, size=(n, width)) * 0.125).astype(np.float32)
+
+  def run(sdt):
+    a = None if acc is None else acc
+    if op == 'sgd':
+      t2 = pallas_segwalk.segwalk_apply(
+          table, None, jnp.asarray(ids), jnp.asarray(grads), LR, op=op,
+          eps=EPS, interpret=True, presorted=False, stream_dtype=sdt)
+      return np.asarray(t2), None
+    t2, a2 = pallas_segwalk.segwalk_apply(
+        table, a, jnp.asarray(ids), jnp.asarray(grads), LR, op=op,
+        eps=EPS, interpret=True, presorted=False, stream_dtype=sdt)
+    return np.asarray(t2), np.asarray(a2)
+
+  tf, af = run('float32')
+  tb, ab = run('bfloat16')
+  np.testing.assert_array_equal(tf, tb)
+  if af is not None:
+    np.testing.assert_array_equal(af, ab)
+
+
+def test_bf16_stream_equals_prequantized_f32_stream():
+  """The bf16 stream's ONLY effect is one bf16 rounding of each
+  gradient row before the f32 segment summation: running the f32
+  stream on pre-quantized gradients must match bit for bit."""
+  rng = np.random.default_rng(7)
+  rows, n, width = 32, 400, 16
+  table = jnp.asarray(rng.normal(size=(rows, width)), jnp.float32)
+  ids = rng.integers(0, rows, size=(n,)).astype(np.int32)
+  grads = rng.normal(size=(n, width)).astype(np.float32)
+  gq = jnp.asarray(grads).astype(jnp.bfloat16).astype(jnp.float32)
+  t_q = pallas_segwalk.segwalk_apply(
+      table, None, jnp.asarray(ids), gq, LR, op='sgd',
+      eps=EPS, interpret=True, presorted=False, stream_dtype='float32')
+  t_b = pallas_segwalk.segwalk_apply(
+      table, None, jnp.asarray(ids), jnp.asarray(grads), LR, op='sgd',
+      eps=EPS, interpret=True, presorted=False, stream_dtype='bfloat16')
+  np.testing.assert_array_equal(np.asarray(t_b), np.asarray(t_q))
+  # and the update actually moved the touched rows
+  assert float(np.abs(np.asarray(t_b) - np.asarray(table)).max()) > 0.01

@@ -196,3 +196,26 @@ def test_full_hybrid_train_step_compiles_for_v5e(v5e, two_axis):
     temps = getattr(ma, 'temp_size_in_bytes', 0) or 0
     args_b = getattr(ma, 'argument_size_in_bytes', 0) or 0
     assert temps + args_b < 16 * 2**30, (temps, args_b)
+
+
+@pytest.mark.parametrize('op', ['sgd', 'adagrad_sq'])
+@pytest.mark.parametrize('w', [16, 128])
+def test_segwalk_bf16_stream_compiles_for_v5e(v5e, op, w):
+  """stream_dtype='bfloat16': the halved-stream operand layout (two
+  raw-bits bf16 id lanes reassembled via u16 shifts in-kernel for the
+  sideband case; a bf16 gradient block + s32 id column at width 128)
+  must lower on the real v5e backend."""
+  rows, n = 1024, 2048
+
+  def fn(table, acc, ids, g):
+    if op == 'sgd':
+      return pallas_segwalk.segwalk_apply(
+          table, None, ids, g, 0.01, op=op, eps=1e-7, presorted=False,
+          stream_dtype='bfloat16')
+    return pallas_segwalk.segwalk_apply(
+        table, acc, ids, g, 0.01, op=op, eps=1e-7, presorted=False,
+        stream_dtype='bfloat16')
+
+  _compile_single(v5e, fn, ((rows, w), jnp.float32),
+                  ((rows, w), jnp.float32), ((n,), jnp.int32),
+                  ((n, w), jnp.float32))
