@@ -119,6 +119,10 @@ def main():
                       help='opt into the fused segment-walk apply '
                       '(ops/pallas_segwalk.py): sorted raw stream in, '
                       'no compaction pipeline')
+  parser.add_argument('--stream_dtype', default='float32',
+                      choices=['float32', 'bfloat16'],
+                      help='segwalk update-stream payload dtype '
+                      '(bfloat16 halves stream HBM bytes/traffic)')
   parser.add_argument('--row_slice', type=int, default=None,
                       help='element threshold for row-sharding big tables '
                       '(multi-chip; beyond the reference)')
@@ -214,7 +218,8 @@ def main():
                           capacity_fraction=args.capacity_fraction,
                           capacity_rows=capacity_rows,
                           use_pallas_apply=args.fused_apply,
-                          use_segwalk_apply=args.segwalk_apply)
+                          use_segwalk_apply=args.segwalk_apply,
+                          stream_dtype=args.stream_dtype)
   if args.trainer == 'sparse':
     state = init_hybrid_train_state(model.dist_embedding, params, optimizer,
                                     emb_opt)

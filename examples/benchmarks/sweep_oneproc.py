@@ -112,6 +112,8 @@ def main():
     variants = [
         ('xla', {}),
         ('segwalk', {'use_segwalk_apply': True}),
+        ('segwalk-bf16stream', {'use_segwalk_apply': True,
+                                'stream_dtype': 'bfloat16'}),
         ('fused', {'use_pallas_apply': True}),
     ]
     baseline, baseline_ndev = bench.pick_baseline(model_name, len(devices))
