@@ -176,10 +176,8 @@ def _segwalk_kernel(sid_smem, islast_smem, g_ref, idv_ref, lr_smem,
   base = jax.lax.rem(t * tile, _SMEM_BLOCK)
 
   def kid_of(oid):
-    """Scalar/vector: original id -> fetch-unit id (sentinels land at
-    ``nfetch``, out of range, skipped by the walks)."""
-    pid = jnp.where(oid >= natural_rows, prows, oid // pack)
-    return pid // pair if pair > 1 else pid
+    """Scalar/vector original id -> fetch-unit id (see ``fetch_ids``)."""
+    return fetch_ids(oid, natural_rows, prows, pack, pair)
 
   @pl.when(t == 0)
   def _init():
@@ -251,8 +249,7 @@ def _segwalk_kernel(sid_smem, islast_smem, g_ref, idv_ref, lr_smem,
     oid_col = idv_ref[:]                     # [tile, 1] int32
     g = blk.astype(jnp.float32)
   sent_col = oid_col >= natural_rows
-  pid_col = jnp.where(sent_col, prows, oid_col // pack)
-  kid_col = pid_col // pair if pair > 1 else pid_col
+  kid_col = kid_of(oid_col)
   prev = jnp.concatenate(
       [jnp.full((1, 1), -2, jnp.int32), kid_col[:-1]], axis=0)
   starts = jnp.concatenate(
@@ -265,6 +262,7 @@ def _segwalk_kernel(sid_smem, islast_smem, g_ref, idv_ref, lr_smem,
   if pair > 1:
     # expand to the pair superrow: one `width`-lane block per half,
     # masked by the row's half index (zeros in the untouched half)
+    pid_col = jnp.where(sent_col, prows, oid_col // pack)
     hf = (jax.lax.rem(pid_col, 2) == 0).astype(jnp.float32)  # [tile, 1]
     g = jnp.concatenate([g * hf, g * (1.0 - hf)], axis=1)  # [tile, pw]
   # both scalars live in SMEM: scalar compare, then broadcast
@@ -361,6 +359,16 @@ def _segwalk_kernel(sid_smem, islast_smem, g_ref, idv_ref, lr_smem,
   def _drain_all():
     drain_writes(1 - p, wcount[1 - p, 0])
     drain_writes(p, nval)
+
+
+def fetch_ids(ids, natural_rows: int, prows: int, pack: int, pair: int):
+  """Original row id -> fetch-unit id (the DMA-indexable granularity):
+  sentinels (>= ``natural_rows``) land at ``prows // pair`` = nfetch,
+  out of range, skipped by the walks.  ONE definition used by the host
+  (global segment-last flags) and the kernel (both scalar walks and the
+  vector segment keys) so the two can never drift."""
+  pid = jnp.where(ids >= natural_rows, prows, ids // pack)
+  return pid // pair if pair > 1 else pid
 
 
 def packed_ids(ids: jax.Array, pack: int, rows: int):
@@ -567,15 +575,16 @@ def segwalk_apply(table: jax.Array,
     g_operand = comb if order is None else jnp.take(comb, order, axis=0)
     idv_operand = jnp.zeros((1, 1), jnp.int32)  # statically never read
   else:
-    gs = sorted_g.astype(sdt)  # convert BEFORE the gather: the gather
+    # convert BEFORE the gather so its output buffer is already
+    # sdt-sized (half the bytes for a bf16 stream)
+    gs = sorted_g.astype(sdt)
     g_operand = gs if order is None else jnp.take(gs, order, axis=0)
     idv_operand = sid1d[:, None]
   # fetch-unit ids for the global segment-last flags (the one lookahead
   # the kernel cannot do): adjacent uids sharing a packed row (or bf16
   # pair) are one segment whose lanes (or halves) carry their per-uid
   # totals disjointly.  1-D untiled arrays: cheap.
-  sent = sid1d >= num_rows
-  kids = jnp.where(sent, prows, sid1d // pack) // pair
+  kids = fetch_ids(sid1d, num_rows, prows, pack, pair)
   is_last = jnp.concatenate([
       (kids[1:] != kids[:-1]),
       jnp.ones((1,), bool)
