@@ -114,8 +114,11 @@ def main():
         ('segwalk', {'use_segwalk_apply': True}),
         ('segwalk-bf16stream', {'use_segwalk_apply': True,
                                 'stream_dtype': 'bfloat16'}),
-        ('fused', {'use_pallas_apply': True}),
     ]
+    if param_dtype == 'float32':
+      # the rowwise kernel is f32-only: a bf16 'fused' phase would
+      # spend ~5 min of a tunnel window measuring its XLA fallback
+      variants.append(('fused', {'use_pallas_apply': True}))
     baseline, baseline_ndev = bench.pick_baseline(model_name, len(devices))
     for vname, flags in variants:
       label = f'{model_name}-{param_dtype}-{vname}'
