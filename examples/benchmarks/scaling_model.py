@@ -48,8 +48,17 @@ COMPACT_NS = 20.0           # sort + cumsum + compaction gathers per raw row
 ICI_BYTES_PER_S = 90e9      # usable per-chip all_to_all bandwidth, v5e
 MLP_MS = {'tiny': 2.0, 'small': 4.0}  # measured fwd+bwd head cost, tiny
 
+# segwalk-apply pricing (the round-3/4 kernel; docs/perf_notes.md):
+SORT_NS = 5.0               # argsort of the raw id stream
+HBM_BYTES_PER_S = 819e9     # v5e HBM bandwidth (stream passes)
+STREAM_PASSES = 4           # comb write + sorted-gather read/write +
+                            # kernel sequential read
+DMA_ISSUE_NS = 47.0         # measured scalar-core DMA issue floor
+DMA_PER_UNIQUE = 4          # table r/w + acc r/w per unique packed row
 
-def analyze(name: str, world: int, batch: int, row_slice=None):
+
+def analyze(name: str, world: int, batch: int, row_slice=None,
+            apply='xla', stream_bytes_per_elem=4):
   config = SYNTHETIC_MODELS[name]
   tables, input_table_map, hotness = expand_tables(config)
   plan = ShardingPlan(tables, world_size=world,
@@ -59,17 +68,22 @@ def analyze(name: str, world: int, batch: int, row_slice=None):
 
   # per-device walk over the plan's request slots (the runtime's
   # _subgroups classes requests by (group, hotness); volumes only need
-  # the per-slot hotness/width, so the walk below is equivalent)
+  # the per-slot hotness/width, so the walk below is equivalent).
+  # Per-GROUP streams are kept so the segwalk pricing can apply each
+  # group's pack factor to its unique bound.
   hot_of = {i: hotness[i] for i in range(len(input_table_map))}
-  per_dev = [dict(lookup=0, in_bytes=0, out_bytes=0, stream=0, rows=0)
-             for _ in range(D)]
+  per_dev = [dict(lookup=0, in_bytes=0, out_bytes=0, stream=0, rows=0,
+                  groups=[]) for _ in range(D)]
   for g in plan.groups:
+    pack = 128 // g.width if g.width < 128 else 1
     for dev in range(D):
       per_dev[dev]['rows'] += g.rows[dev]
+      gstream = 0
       for r in g.requests[dev]:
         h = hot_of[r.input_id]
         per_dev[dev]['lookup'] += batch * h
         per_dev[dev]['stream'] += batch * h
+        gstream += batch * h
         per_dev[dev]['in_bytes'] += batch * h * 4
         row_sliced = (r.row_start, r.row_end) != (
             0, tables[r.table_id].input_dim)
@@ -77,12 +91,27 @@ def analyze(name: str, world: int, batch: int, row_slice=None):
         # slot shared by all shards — charge it once, on the first shard
         if not row_sliced or r.row_start == 0:
           per_dev[dev]['out_bytes'] += batch * g.width * 4
+      per_dev[dev]['groups'].append(
+          dict(stream=gstream, rows=g.rows[dev], pack=pack,
+               width=g.width))
   off_chip = (D - 1) / D if D > 1 else 0.0
   worst = max(per_dev, key=lambda d: d['lookup'] + d['stream'])
   unique_bound = min(worst['stream'], worst['rows'])
   lookup_ms = worst['lookup'] * GATHER_NS * 1e-6
-  compact_ms = worst['stream'] * COMPACT_NS * 1e-6
-  scatter_ms = unique_bound * SCATTER_NS * SCATTER_PASSES * 1e-6
+  if apply == 'segwalk':
+    # sort + STREAM_PASSES sequential passes over the dense [*, 128]
+    # stream + the kernel's random DMAs, one set per unique PACKED row
+    compact_ms = worst['stream'] * SORT_NS * 1e-6
+    stream_bytes = worst['stream'] * 128 * stream_bytes_per_elem
+    compact_ms += (stream_bytes * STREAM_PASSES / HBM_BYTES_PER_S) * 1e3
+    uniq_packed = sum(
+        min(gr['stream'], -(-gr['rows'] // gr['pack']))
+        for gr in worst['groups'])
+    scatter_ms = uniq_packed * DMA_ISSUE_NS * DMA_PER_UNIQUE * 1e-6
+    unique_bound = uniq_packed
+  else:
+    compact_ms = worst['stream'] * COMPACT_NS * 1e-6
+    scatter_ms = unique_bound * SCATTER_NS * SCATTER_PASSES * 1e-6
   a2a_bytes = (worst['in_bytes'] + worst['out_bytes']) * off_chip
   a2a_ms = a2a_bytes / ICI_BYTES_PER_S * 1e3
   mlp_ms = MLP_MS.get(name, 2.0)
@@ -105,6 +134,13 @@ def main(argv=None):
   p.add_argument('--row_slice', type=int, default=None,
                  help='row-slice element threshold (needed to spread '
                  'width-capped tables past ~64 chips)')
+  p.add_argument('--apply', default='xla', choices=['xla', 'segwalk'],
+                 help='price the XLA compaction+scatter apply or the '
+                 'fused segment-walk kernel')
+  p.add_argument('--stream_dtype', default='float32',
+                 choices=['float32', 'bfloat16'],
+                 help='segwalk stream payload dtype (halves stream '
+                 'passes for bfloat16)')
   args = p.parse_args(argv)
   print(f'# {args.model}, global batch {args.batch}, per-chip estimates '
         f'(worst chip)')
@@ -114,7 +150,10 @@ def main(argv=None):
   print(' | '.join(cols))
   for w in args.worlds:
     try:
-      r = analyze(args.model, w, args.batch, row_slice=args.row_slice)
+      r = analyze(args.model, w, args.batch, row_slice=args.row_slice,
+                  apply=args.apply,
+                  stream_bytes_per_elem=(
+                      2 if args.stream_dtype == 'bfloat16' else 4))
     except (ValueError, AssertionError) as e:
       print(f'{w} | plan failed: {e}')
       continue
