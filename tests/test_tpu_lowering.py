@@ -151,11 +151,14 @@ def _step_avals(dist, mesh, configs, GB, dense_opt):
   return state, cats, labels
 
 
-@pytest.mark.parametrize('two_axis', [False, True])
-def test_full_hybrid_train_step_compiles_for_v5e(v5e, two_axis):
+@pytest.mark.parametrize('two_axis,stream_dtype', [
+    (False, 'float32'), (True, 'float32'), (False, 'bfloat16')])
+def test_full_hybrid_train_step_compiles_for_v5e(v5e, two_axis,
+                                                 stream_dtype):
   """The COMPLETE 4-chip sparse train step — routing all_to_alls,
-  lookups, psum_scatter, manual backward, and the segment-walk apply —
-  compiled for a real v5e 2x2 target (two-axis: 2 slices x 2 chips)."""
+  lookups, psum_scatter, manual backward, and the segment-walk apply
+  (incl. the halved bf16 stream payload) — compiled for a real v5e 2x2
+  target (two-axis: 2 slices x 2 chips)."""
   import optax
   from jax.experimental import topologies
   from distributed_embeddings_tpu.parallel import (DistributedEmbedding,
@@ -169,7 +172,8 @@ def test_full_hybrid_train_step_compiles_for_v5e(v5e, two_axis):
   configs = [TableConfig(512, 16, 'sum'), TableConfig(300, 16, 'sum'),
              TableConfig(200, 128, 'sum'), TableConfig(100, 8, 'mean')]
   dist = DistributedEmbedding(configs, mesh=mesh)
-  opt = SparseAdagrad(learning_rate=0.01, use_segwalk_apply=True)
+  opt = SparseAdagrad(learning_rate=0.01, use_segwalk_apply=True,
+                      stream_dtype=stream_dtype)
   dense_opt = optax.sgd(0.01)
 
   def head(dp, eo, b):
