@@ -237,6 +237,7 @@ class SyntheticModel:
     row_slice: element threshold for ROW sharding (beyond the reference).
     dp_input: data-parallel input (reference benchmark default is False).
     param_dtype / compute_dtype: storage and activation dtypes.
+    packed_storage: forwarded to the planner (lane-packed narrow groups).
   """
   config: ModelConfig
   mesh: Optional[Mesh] = None
@@ -246,6 +247,7 @@ class SyntheticModel:
   strategy: str = 'memory_balanced'
   param_dtype: Any = jnp.float32
   compute_dtype: Any = jnp.float32
+  packed_storage: bool = True
 
   def __post_init__(self):
     tables, input_table_map, hotness = expand_tables(self.config)
@@ -260,7 +262,8 @@ class SyntheticModel:
         input_table_map=input_table_map,
         mesh=self.mesh,
         param_dtype=self.param_dtype,
-        compute_dtype=self.compute_dtype)
+        compute_dtype=self.compute_dtype,
+        packed_storage=self.packed_storage)
     total_width = sum(
         tables[t].output_dim for t in input_table_map)
     if self.config.interact_stride is not None:

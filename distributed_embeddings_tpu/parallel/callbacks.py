@@ -63,9 +63,18 @@ class CheckpointCallback:
     weights = get_weights(self.dist, emb)
     sparse = self.sparse
     if sparse is None:
-      sparse = (isinstance(state.opt_state, tuple)
-                and len(state.opt_state) == 2
-                and isinstance(state.opt_state[1], dict))
+      # structural detection: the hybrid layout's second element is the
+      # sparse table-optimizer state — a dict keyed exactly by the plan's
+      # fusion-group names.  A plain isinstance(tuple) check is ambiguous
+      # (optax states are namedtuples and can carry dict fields) —
+      # advisor r4.
+      st = state.opt_state
+      group_names = {
+          f'group_{gi}' for gi in range(len(self.dist.plan.groups))
+      }
+      sparse = (isinstance(st, tuple) and len(st) == 2
+                and isinstance(st[1], dict)
+                and set(st[1].keys()) == group_names)
     st_tables = (get_optimizer_state(self.dist, state.opt_state[1])
                  if sparse else None)
     extras = {'step': np.int64(step)}

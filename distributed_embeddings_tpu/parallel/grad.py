@@ -141,30 +141,44 @@ def fit(step_fn: Callable,
     ``(state, history)`` — ``history['step']`` / ``history['loss']`` hold
     one entry per log point; eval metrics land in their own lists aligned
     with ``history['eval_step']`` (eval cadence can differ from the log
-    cadence).
+    cadence).  An eval metric named like a reserved train series
+    (``step`` / ``loss`` / ``eval_step``) is namespaced to ``eval_<name>``
+    instead of corrupting that series' alignment.
   """
   eval_every = eval_every or log_every
+  _RESERVED = ('step', 'loss', 'eval_step')
   history: dict = {'step': [], 'loss': [], 'eval_step': []}
   window = []  # on-device losses since the last sync
   it = iter(data)
   i = 0
+  last_eval_at = None  # step of the last eval: the exit flush must not
+  #                      re-eval a state already evaluated at this step
 
   def flush(i, final=False):
-    if not window:
+    nonlocal last_eval_at
+    if not window and not final:
       return None
-    mean = float(jnp.mean(jnp.stack(window)))
-    window.clear()
-    logs = {'loss': mean}
-    history['step'].append(i)
-    history['loss'].append(mean)
+    logs = {}
+    if window:
+      mean = float(jnp.mean(jnp.stack(window)))
+      window.clear()
+      logs['loss'] = mean
+      history['step'].append(i)
+      history['loss'].append(mean)
     # final covers both exits (steps reached, data drained): the run always
-    # ends with an eval of the returned state
-    if eval_fn is not None and (i % eval_every == 0 or final):
+    # ends with an eval of the returned state — even when the iterator
+    # drained exactly at a log boundary and the loss window is empty
+    if (eval_fn is not None and (i % eval_every == 0 or final)
+        and last_eval_at != i):
       evals = eval_fn(state)
-      logs.update(evals)
       history['eval_step'].append(i)
       for k, v in evals.items():
-        history.setdefault(k, []).append(v)
+        kk = 'eval_' + k if k in _RESERVED else k
+        logs[kk] = v
+        history.setdefault(kk, []).append(v)
+      last_eval_at = i
+    if not logs:
+      return None
     if verbose:
       print_fn('step %d: ' % i +
                ' '.join(f'{k}={v:.6g}' for k, v in logs.items()))

@@ -88,8 +88,12 @@ def main():
   def run_model(model_name, param_dtype):
     """Init tables once, then time each apply variant on the same state."""
     config = SYNTHETIC_MODELS[model_name]
+    # packed narrow-group storage is a TPU HBM-tiling remedy; on the CPU
+    # fallback it is pure ~2.5x overhead (bench.py's measured r04
+    # regression) and would skew every phase against its SIGALRM budget
     model = SyntheticModel(config, mesh=mesh, dp_input=True,
-                           param_dtype=jnp.dtype(param_dtype))
+                           param_dtype=jnp.dtype(param_dtype),
+                           packed_storage=not on_cpu)
     dist = model.dist_embedding
     params = model.init(0)
     gen = InputGenerator(config, args.batch_size, alpha=1.05,
@@ -176,6 +180,17 @@ def main():
         emit({'phase': label, 'value': None,
               'error': f'{type(e).__name__}: {e}',
               'trace_tail': traceback.format_exc()[-800:]})
+        # a failure AFTER the first donated step call has already consumed
+        # the buffers backing `params`; rebind from the last live state
+        # (or re-init) so later variants don't die on deleted arrays
+        # (advisor r4)
+        try:
+          st = locals().get('state')
+          cand = st.params if st is not None else params
+          jax.block_until_ready(cand)
+          params = cand
+        except Exception:
+          params = model.init(0)
     del params
     gc.collect()
 

@@ -1,22 +1,30 @@
 #!/bin/bash
-# One-shot measurement sweep for a healthy TPU tunnel, highest-value first.
+# One-shot measurement sweep for a healthy TPU tunnel, CHEAPEST-FIRST.
 # Each step is independently killable; results append to the log.
-# Ordering principle: tunnel windows can be SHORT — the official bench
-# artifact line comes first (it alone closes VERDICT item 1), then ONE
-# process measures every apply-variant A/B (sweep_oneproc.py: the tunnel
-# plugin can't deserialize cached executables, so separate processes
-# re-pay init+compile per data point), then correctness gates, then extras.
+# Ordering principle (VERDICT r4 item 1): the only healthy window round 4
+# was ~13 minutes and the then-first step needed >20 min cold, so the
+# window produced NOTHING.  Now the quick, high-information steps run
+# first — kernel microbenches + probes that calibrate the whole scaling
+# model land in minutes — then a reduced-batch bench line guaranteed to
+# finish short, and only then the long full-size artifact + A/B ladder.
+# Every step's output is flushed to the log as it lands: a window that
+# dies mid-sweep keeps everything already measured.
 # Usage: bash examples/benchmarks/tpu_sweep.sh [logfile]
 set -u
 LOG=${1:-/tmp/tpu_sweep.log}
 cd "$(dirname "$0")/../.."
+SHA=$(cat SNAPSHOT_SHA 2>/dev/null || git rev-parse --short HEAD 2>/dev/null || echo unknown)
+echo "=== sweep start $(date) sha=$SHA ===" | tee -a "$LOG"
 FAIL=0
 run() {
-  echo "=== $* ($(date +%H:%M:%S)) ===" | tee -a "$LOG"
+  echo "=== $* ($(date +%H:%M:%S) sha=$SHA) ===" | tee -a "$LOG"
   # anchor the filter to line START: bench.py's single-line failure JSON
   # embeds backend log text that can contain "WARNING", and an unanchored
-  # grep -v silently swallowed the whole artifact line (round 4)
-  timeout "${T:-900}" "$@" 2>&1 | grep -v '^WARNING' | tail -12 | tee -a "$LOG"
+  # grep -v silently swallowed the whole artifact line (round 4).
+  # Stream STRAIGHT into the log (line-buffered, no tail): a window that
+  # dies mid-step must keep every line already emitted — a `tail -N`
+  # stage buffers the whole step's output and loses it all on kill.
+  timeout "${T:-900}" "$@" 2>&1 | stdbuf -oL grep -v '^WARNING' | tee -a "$LOG"
   local rc=${PIPESTATUS[0]}
   if [ "$rc" -ne 0 ]; then
     # a dead tunnel times steps out (rc 124): record it and withhold
@@ -26,43 +34,69 @@ run() {
   fi
 }
 
-# 0. THE official artifact line: steady-state tiny step time on the chip.
-# Cold cache through the tunnel = 2 long compiles + full-size init +
-# capacity calibration before the 10 timed steps: >20 min observed
-# (a 1200s timeout killed a run that had already compiled, round 4).
-T=2700 run python bench.py --model tiny --steps 10 --auto_capacity
+# ---- QUICK LADDER: everything here lands inside a ~13-min window ----
 
-# 1. ALL apply-variant A/Bs in one backend session: xla/segwalk/fused
-# at f32 + bf16 for tiny, plus the criteo trio; one JSON line each,
-# flushed as they land, SIGALRM per phase.
-T=9000 run python examples/benchmarks/sweep_oneproc.py --steps 10
-
-# 1b. Criteo-shaped DLRM end-to-end: loader throughput, steady-state
-# samples/s, AUC-vs-step curve (VERDICT r3 item 4)
-T=3600 run bash examples/dlrm/chip_run.sh
+# 1. primitive scatter/gather hint A/B — calibrates the scaling model's
+# per-row costs (minutes; small programs)
+T=540 run python examples/benchmarks/scatter_probe.py
 
 # 2. kernel microbenches at the exact dominant shapes (decide defaults).
 # DET_TESTS_REAL_TPU=1 stops conftest pinning the CPU backend — without
 # it every TPU-gated test silently SKIPS and the step reads as green
 # (wiring bug caught in round-4 rehearsal).
-T=1800 run env DET_TESTS_REAL_TPU=1 python -m pytest tests/test_pallas_tpu.py -q -s -k segwalk_apply_microbench
-T=1800 run env DET_TESTS_REAL_TPU=1 python -m pytest tests/test_pallas_tpu.py -q -s -k rowwise_apply_microbench
+T=900 run env DET_TESTS_REAL_TPU=1 python -m pytest tests/test_pallas_tpu.py -q -s -k segwalk_apply_microbench
+T=900 run env DET_TESTS_REAL_TPU=1 python -m pytest tests/test_pallas_tpu.py -q -s -k rowwise_apply_microbench
 
-# 3. segment-walk kernel correctness compiled (gates flipping any default)
-T=1800 run env DET_TESTS_REAL_TPU=1 python -m pytest tests/test_pallas_tpu.py -q -s -k segwalk_apply_compiled
+# 3. lookup microbenchmark (fwd/grad/apply at the reference's 1Mx128
+# shape — the pallas_lookup keep-or-demote decision, VERDICT r4 item 8)
+T=900 run python examples/benchmarks/lookup_benchmark.py
 
-# 4. steady-state trace decomposition of the default path
+# 4. segment-walk kernel correctness COMPILED on chip (gates flipping
+# any default; includes the f32-id-sideband bit-roundtrip check)
+T=1200 run env DET_TESTS_REAL_TPU=1 python -m pytest tests/test_pallas_tpu.py -q -s -k "segwalk_apply_compiled or sideband"
+
+# 5. reduced-batch bench line: same full-size tables + program shape at
+# global batch 8192, no calibration — an ON-CHIP step-time number
+# (clearly comparable:false — baselines are at batch 65536) that lands
+# even if the window closes before the full artifact compiles
+T=600 run python bench.py --model tiny --batch_size 8192 --steps 10 --no-auto_capacity
+
+# ---- FULL LADDER: long compiles; needs a wide window ----
+
+# 6. THE official artifact line: steady-state tiny step time on the chip.
+# Cold cache through the tunnel = 2 long compiles + full-size init +
+# capacity calibration before the 10 timed steps: >20 min observed
+# (a 1200s timeout killed a run that had already compiled, round 4).
+# bench.py deliberately exits 0 even on failure (the driver's artifact
+# must stay parseable), so rc alone can't gate the completion marker:
+# require the official comparable line itself in this step's output.
+OFF0=$(wc -c < "$LOG" 2>/dev/null || echo 0)
+T=2700 run python bench.py --model tiny --steps 10 --auto_capacity
+if ! tail -c +$((OFF0 + 1)) "$LOG" \
+    | grep -q '"metric": "synthetic-tiny.*"comparable": true'; then
+  FAIL=1
+  echo "--- official bench line missing/non-comparable: will retry ---" \
+    | tee -a "$LOG"
+fi
+
+# 7. ALL apply-variant A/Bs in one backend session: xla/segwalk/fused
+# at f32 + bf16 for tiny, plus the criteo trio; one JSON line each,
+# flushed as they land, SIGALRM per phase.
+T=9000 run python examples/benchmarks/sweep_oneproc.py --steps 10
+
+# 8. Criteo-shaped DLRM end-to-end: loader throughput, steady-state
+# samples/s, AUC-vs-step curve (VERDICT r3 item 4)
+T=3600 run bash examples/dlrm/chip_run.sh
+
+# 9. steady-state trace decomposition of the default path
 T=2400 run python examples/benchmarks/trace_step.py --calls 3 --auto_capacity
 
-# 5. primitive scatter/gather hint A/B (informs perf notes)
-T=900 run python examples/benchmarks/scatter_probe.py
-
-# 6. remaining hardware correctness gates (full TPU-gated suite)
+# 10. remaining hardware correctness gates (full TPU-gated suite)
 T=2400 run env DET_TESTS_REAL_TPU=1 python -m pytest tests/test_pallas_tpu.py -q -s -k "not microbench"
 
 # logged completion marker: the watcher keys retry-vs-done on seeing
-# BOTH the step-0 artifact line and this marker in its run's log slice;
-# any failed step withholds it so the next healthy window retries
+# BOTH the official bench artifact line and this marker in its run's log
+# slice; any failed step withholds it so the next healthy window retries
 if [ "$FAIL" -eq 0 ]; then
   echo "=== sweep complete $(date) ===" | tee -a "$LOG"
 else

@@ -57,7 +57,10 @@ EOF
       # otherwise a later healthy window retries the whole thing —
       # steps append to the log, so partial data is never lost.
       SLICE=$(tail -c +$((OFFSET + 1)) "$SWEEP_LOG" 2>/dev/null)
-      if echo "$SLICE" | grep -q '"comparable": true' \
+      # the OFFICIAL bench line (key "metric", synthetic model) — a
+      # sweep_oneproc phase line also carries "comparable": true and
+      # must not satisfy this check
+      if echo "$SLICE" | grep -q '"metric": "synthetic-.*"comparable": true' \
           && echo "$SLICE" | grep -q 'sweep complete'; then
         SWEEP_DONE=1
         INTERVAL=1800

@@ -114,3 +114,65 @@ def test_early_stopping_max_mode_keeps_improving():
   # missing metric (off-cadence log point) is ignored, not an error
   es2 = EarlyStopping(monitor='auc', patience=1)
   es2(1, None, {'loss': 1.0})
+
+
+def test_fit_final_eval_at_drained_log_boundary():
+  """Advisor r4 (grad.py): when the iterator drains EXACTLY at a log
+  boundary, the boundary flush empties the window with final=False; the
+  exit flush must still run the promised final eval (without
+  re-evaluating a state already evaluated at that step)."""
+  dist, step, state, batches = _hybrid_setup()
+  calls = []
+
+  def eval_fn(state):
+    calls.append(1)
+    return {'metric': 42.0}
+
+  # 4 batches, log_every=2, eval_every=4: boundary flush at step 4 runs
+  # the eval (4 % 4 == 0); the exit flush must then NOT duplicate it
+  _, hist = fit(step, state, batches(1, 4), log_every=2,
+                eval_fn=eval_fn, eval_every=4, verbose=False)
+  assert hist['eval_step'] == [4]
+  assert len(calls) == 1
+  # 4 batches, eval_every=3: no boundary eval at step 4 — the exit
+  # flush (empty window) must run the final eval
+  calls.clear()
+  _, hist = fit(step, _hybrid_setup()[2], batches(1, 4), log_every=2,
+                eval_fn=eval_fn, eval_every=3, verbose=False)
+  assert hist['eval_step'] == [4]
+  assert len(calls) == 1
+  assert hist['metric'] == [42.0]
+
+
+def test_fit_eval_metric_name_collision_namespaced():
+  """Advisor r4 (grad.py): an eval metric named 'loss'/'step' must not
+  append into the train-loss/step history series."""
+  dist, step, state, batches = _hybrid_setup()
+
+  def eval_fn(state):
+    return {'loss': 123.0, 'auc': 0.5}
+
+  _, hist = fit(step, state, batches(1, 4), log_every=2,
+                eval_fn=eval_fn, eval_every=2, verbose=False)
+  assert len(hist['loss']) == len(hist['step']) == 2
+  assert all(v < 100 for v in hist['loss'])  # train losses, not 123.0
+  assert hist['eval_loss'] == [123.0, 123.0]
+  assert hist['auc'] == [0.5, 0.5]
+
+
+def test_checkpoint_callback_detects_dense_only_ambiguous_state(tmp_path):
+  """Advisor r4 (callbacks.py): a 2-tuple opt_state whose second element
+  is a dict — but NOT the plan's group dict — must be detected as
+  dense-only, not indexed as the hybrid layout's sparse half."""
+  dist, _, _, _ = _hybrid_setup()
+  path = str(tmp_path / 'dense_only.npz')
+  cb = CheckpointCallback(dist, path, every=1)
+  fake_state = type('S', (), {})()
+  fake_state.params = {'embedding': dist.init(0)}
+  fake_state.opt_state = ({'count': jnp.zeros(())},
+                          {'not_a_group': jnp.zeros(())})
+  cb(1, fake_state, {})
+  _, st_tables, extras = load_train_npz(path)
+  # dense-only: no sparse table state; BOTH tuple halves live under opt:
+  assert not any(st_tables)
+  assert any('not_a_group' in k for k in extras if k.startswith('opt:'))

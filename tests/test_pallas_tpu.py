@@ -285,3 +285,101 @@ def test_segwalk_apply_microbench(w, n):
   print(f'\nsegwalk apply w={w} n={n}: segwalk {t_sw:.1f} ms, '
         f'xla pipeline {t_xla:.1f} ms ({t_xla / t_sw:.2f}x)')
   assert t_sw < 5 * t_xla
+
+
+# Raw int32 bit patterns the f32 id sideband must carry unscathed
+# (advisor r4, pallas_segwalk.py:573): every practical id (< 2^23) is a
+# DENORMAL f32, and synthetic patterns cover NaN/inf/sign-bit encodings —
+# FTZ or NaN canonicalization anywhere in the select -> DMA -> bitcast
+# chain would silently scatter updates to wrong rows.
+_SIDEBAND_PATTERNS = np.array(
+    [
+        0, 1, 2, 3, 7, 255, 65535, 123456,      # denormal patterns
+        (1 << 23) - 1,                          # largest denormal
+        1 << 23,                                # smallest normal
+        0x7F800000,                             # +inf pattern
+        0x7F800001, 0x7FC00000, 0x7FFFFFFF,     # sNaN / qNaN / max-NaN
+        -0x80000000, -1,                        # -0.0 / -NaN patterns
+        0x00400001, 0x007FFFFF,                 # mid/top denormals
+    ],
+    dtype=np.int64).astype(np.int32)
+
+
+@requires_tpu
+@pytest.mark.parametrize('stream_dtype', ['float32', 'bfloat16'])
+def test_sideband_bit_roundtrip_compiled(stream_dtype):
+  """Round-trip the EXACT host sideband encoding through a compiled
+  kernel using the EXACT in-kernel decoding (pallas_segwalk.py:233-246):
+  lane-iota select into the padded gradient block, DMA to VMEM, bitcast
+  back.  Bit-exact or the segwalk path is unsafe on this hardware."""
+  from jax.experimental import pallas as pl
+  from jax.experimental.pallas import tpu as pltpu
+  gw, n = 16, 256
+  ids = jnp.asarray(np.resize(_SIDEBAND_PATTERNS, n))
+  sdt = jnp.dtype(stream_dtype)
+
+  def kernel(g_ref, out_ref):
+    blk = g_ref[:]
+    if sdt == jnp.bfloat16:
+      lo = jax.lax.bitcast_convert_type(blk[:, gw:gw + 1],
+                                        jnp.uint16).astype(jnp.int32)
+      hi = jax.lax.bitcast_convert_type(blk[:, gw + 1:gw + 2],
+                                        jnp.uint16).astype(jnp.int32)
+      oid = jnp.left_shift(hi, 16) | lo
+    else:
+      oid = jax.lax.bitcast_convert_type(blk[:, gw:gw + 1], jnp.int32)
+    out_ref[:] = jnp.broadcast_to(oid, (n, 128))
+
+  @jax.jit
+  def roundtrip(ids):
+    grads = jnp.full((n, gw), 0.25, sdt)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (n, 128), 1)
+    gpad = jnp.pad(grads, ((0, 0), (0, 128 - gw)))
+    if sdt == jnp.bfloat16:
+      ids_bf = jax.lax.bitcast_convert_type(ids, jnp.bfloat16)
+      comb = jnp.where(
+          lane == gw, ids_bf[:, 0:1],
+          jnp.where(lane == gw + 1, ids_bf[:, 1:2], gpad))
+    else:
+      comb = jnp.where(
+          lane == gw,
+          jax.lax.bitcast_convert_type(ids, jnp.float32)[:, None], gpad)
+    return pl.pallas_call(
+        kernel,
+        grid=(1,),
+        in_specs=[pl.BlockSpec((n, 128), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((n, 128), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, 128), jnp.int32))(comb)
+
+  got = np.asarray(roundtrip(ids))
+  np.testing.assert_array_equal(got[:, 0], np.asarray(ids))
+  np.testing.assert_array_equal(got[:, 77], np.asarray(ids))
+
+
+@requires_tpu
+@pytest.mark.parametrize('stream_dtype', ['float32', 'bfloat16'])
+def test_segwalk_sideband_denormal_ids_end_to_end(stream_dtype):
+  """Drive the REAL segwalk apply with id-coded gradients: if any
+  denormal id pattern is flushed, its update lands on row 0 instead of
+  its own row and the comparison fails loudly."""
+  from test_pallas_segwalk import oracle, LR, EPS
+  from distributed_embeddings_tpu.ops import pallas_segwalk
+  w, rows, n = 16, 4096, 2048
+  rng = np.random.default_rng(7)
+  ids = np.sort(rng.integers(0, rows, n)).astype(np.int32)
+  grads = ((ids[:, None] % 97 + 1) / 97.0 *
+           np.ones((n, w))).astype(np.float32)
+  if stream_dtype == 'bfloat16':
+    # the bf16 stream is bit-identical on PRE-QUANTIZED gradients
+    # (ROUND4_NOTES): quantize both kernel input and oracle input
+    grads = np.asarray(jnp.asarray(grads, jnp.bfloat16).astype(jnp.float32))
+  table = rng.normal(size=(rows, w)).astype(np.float32)
+  want_t, _ = oracle('sgd', table, None, ids, grads)
+  got_t = np.asarray(
+      pallas_segwalk.segwalk_apply(jnp.asarray(table), None,
+                                   jnp.asarray(ids), jnp.asarray(grads),
+                                   LR, op='sgd', eps=EPS,
+                                   stream_dtype=stream_dtype))
+  np.testing.assert_allclose(got_t, want_t, rtol=1e-5, atol=1e-5)
