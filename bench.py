@@ -181,6 +181,11 @@ def main():
                       choices=['float32', 'bfloat16'],
                       help='segwalk update-stream payload dtype '
                       '(bfloat16 halves stream HBM bytes/traffic)')
+  parser.add_argument('--accum_dtype', default='float32',
+                      choices=['float32', 'bfloat16'],
+                      help='Adagrad accumulator STORAGE dtype: bfloat16 '
+                      'halves accumulator HBM (the jumbo-scale lever; '
+                      'arithmetic stays f32)')
   parser.add_argument('--row_slice', type=int, default=None,
                       help='element threshold for row-sharding big tables '
                       '(multi-chip; beyond the reference)')
@@ -279,7 +284,8 @@ def main():
       from distributed_embeddings_tpu.utils.apply_eligibility import (
           segwalk_serves_all_groups)
       segwalk_all = segwalk_serves_all_groups(model.dist_embedding,
-                                              args.param_dtype)
+                                              args.param_dtype,
+                                              accum_dtype=args.accum_dtype)
     if not segwalk_all:
       from distributed_embeddings_tpu.parallel import calibrate_capacity_rows
       (_, cats0), _ = gen.pool[0]
@@ -291,7 +297,8 @@ def main():
                           capacity_rows=capacity_rows,
                           use_pallas_apply=args.fused_apply,
                           use_segwalk_apply=args.segwalk_apply,
-                          stream_dtype=args.stream_dtype)
+                          stream_dtype=args.stream_dtype,
+                          accum_dtype=args.accum_dtype)
   if args.trainer == 'sparse':
     state = init_hybrid_train_state(model.dist_embedding, params, optimizer,
                                     emb_opt)
@@ -371,7 +378,8 @@ def main():
         eligibility_line)
     metric += ' [' + eligibility_line(model.dist_embedding,
                                       args.param_dtype, args.fused_apply,
-                                      args.segwalk_apply) + ']'
+                                      args.segwalk_apply,
+                                      accum_dtype=args.accum_dtype) + ']'
   result = {
       'metric': metric,
       'value': round(step_ms, 3),

@@ -339,10 +339,22 @@ def set_optimizer_state(dist: DistributedEmbedding,
   return new_state
 
 
+def _portable(a) -> np.ndarray:
+  """Canonical on-disk dtype: ``np.savez`` writes ml_dtypes arrays
+  (bfloat16 tables / accumulators) as raw void bytes that load back as
+  ``V2`` and lose their dtype — up-cast them to f32 (exact: f32 is a
+  superset of bf16) so the file stays portable; ``set_weights`` /
+  ``set_optimizer_state`` cast back to the live template dtype on load."""
+  a = np.asarray(a)
+  if a.dtype.kind not in 'fiub':
+    return a.astype(np.float32)
+  return a
+
+
 def save_npz(path: str, weights: Sequence[np.ndarray]):
   """Save global weights the way the DLRM example does
   (reference `examples/dlrm/main.py:246-248`)."""
-  np.savez(path, *weights)
+  np.savez(path, *[_portable(w) for w in weights])
 
 
 def load_npz(path: str) -> List[np.ndarray]:
@@ -365,12 +377,12 @@ def save_train_npz(path: str,
   if table_states is not None and len(table_states) != len(weights):
     raise ValueError(f'got {len(table_states)} per-table states for '
                      f'{len(weights)} weight tables')
-  payload = {f'table{i}': np.asarray(w) for i, w in enumerate(weights)}
+  payload = {f'table{i}': _portable(w) for i, w in enumerate(weights)}
   for i, entry in enumerate(table_states or []):
     for k, v in entry.items():
-      payload[f'table{i}/{k}'] = np.asarray(v)
+      payload[f'table{i}/{k}'] = _portable(v)
   for k, v in (extras or {}).items():
-    payload[f'extra/{k}'] = np.asarray(v)
+    payload[f'extra/{k}'] = _portable(v)
   np.savez(path, **payload)
 
 

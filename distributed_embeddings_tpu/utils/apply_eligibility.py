@@ -66,33 +66,42 @@ def _group_table_aval(g, dt):
 
 
 def eligibility_line(dist, param_dtype, fused_apply: bool,
-                     segwalk_apply: bool) -> str:
+                     segwalk_apply: bool,
+                     accum_dtype: str = 'float32') -> str:
   """One line saying which fusion groups each requested fused kernel
   would actually serve, and whether it engages on this backend at all
-  (empty string when neither kernel is requested)."""
+  (empty string when neither kernel is requested).  ``accum_dtype``
+  mirrors the dispatch's low-precision-accumulator gate
+  (``sparse._use_segwalk`` / ``pallas_rowwise.supported``): neither
+  kernel serves non-f32 accumulators."""
   parts = []
   dt = jnp.dtype(param_dtype)
+  adt = jnp.dtype(accum_dtype)
   groups = dist.plan.groups
   if fused_apply:
     from distributed_embeddings_tpu.ops import pallas_rowwise
     ok = sum(1 for g in groups if pallas_rowwise.supported(
         _group_table_aval(g, dt),
-        _group_table_aval(g, jnp.float32)))
+        _group_table_aval(g, adt)))
     parts.append(f'fused_apply: {ok}/{len(groups)} groups eligible'
                  f'{_active_suffix(pallas_rowwise.FORCE_INTERPRET)}')
   if segwalk_apply:
     from distributed_embeddings_tpu.ops import pallas_segwalk
-    ok = sum(1 for g in groups if _segwalk_group_ok(g, dt))
+    ok = (0 if adt != jnp.dtype(jnp.float32) else
+          sum(1 for g in groups if _segwalk_group_ok(g, dt)))
     parts.append(f'segwalk_apply: {ok}/{len(groups)} groups eligible'
                  f'{_active_suffix(pallas_segwalk.FORCE_INTERPRET, pallas_segwalk.ASSUME_TPU)}')
   return '; '.join(parts)
 
 
-def segwalk_serves_all_groups(dist, param_dtype) -> bool:
+def segwalk_serves_all_groups(dist, param_dtype,
+                              accum_dtype: str = 'float32') -> bool:
   """True when the segment-walk kernel will handle EVERY fusion group on
   the active backend — in which case compaction capacities are dead
   weight (the kernel has none)."""
   from distributed_embeddings_tpu.ops import pallas_segwalk
+  if jnp.dtype(accum_dtype) != jnp.dtype(jnp.float32):
+    return False  # mirrors sparse._use_segwalk's accumulator gate
   if not (jax.default_backend() == 'tpu'
           or pallas_segwalk.FORCE_INTERPRET
           or pallas_segwalk.ASSUME_TPU):

@@ -43,6 +43,10 @@ def main():
                  help='segwalk update-stream payload dtype: bfloat16 '
                  'halves the comb + sorted-gather temp pair, the '
                  'binding allocation at pod scale')
+  p.add_argument('--accum_dtype', default='float32',
+                 choices=['float32', 'bfloat16'],
+                 help='Adagrad accumulator storage dtype: bfloat16 '
+                 'halves the accumulator argument HBM (the jumbo lever)')
   p.add_argument('--column_slice', default=None,
                  help="element threshold for column slicing, or "
                  "'balance' = total_elems/chips: without it a single "
@@ -112,7 +116,8 @@ def main():
   opt = SparseAdagrad(learning_rate=0.01,
                       capacity_fraction=args.capacity_fraction,
                       use_segwalk_apply=args.segwalk_apply,
-                      stream_dtype=args.stream_dtype)
+                      stream_dtype=args.stream_dtype,
+                      accum_dtype=args.accum_dtype)
   dense_opt = optax.adagrad(0.01, initial_accumulator_value=0.1, eps=1e-7)
 
   def head_loss_fn(dp, eo, b):
@@ -134,9 +139,10 @@ def main():
       f'group_{gi}': sds((W, g.param_rows, g.param_width), pdt, tsh)
       for gi, g in enumerate(dist.plan.groups)
   }
+  adt = jnp.dtype(args.accum_dtype)
   acc = {
       f'group_{gi}': {
-          'acc': sds((W, g.param_rows, g.param_width), jnp.float32, tsh)
+          'acc': sds((W, g.param_rows, g.param_width), adt, tsh)
       } for gi, g in enumerate(dist.plan.groups)
   }
   mlp_shapes = jax.eval_shape(

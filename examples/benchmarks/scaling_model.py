@@ -56,9 +56,56 @@ STREAM_PASSES = 4           # comb write + sorted-gather read/write +
 DMA_ISSUE_NS = 47.0         # measured scalar-core DMA issue floor
 DMA_PER_UNIQUE = 4          # table r/w + acc r/w per unique packed row
 
+# ---------------------------------------------------------------------------
+# Chip parameter sets (VERDICT r4 item 7: price the v5p north star, don't
+# wave at it).  Every v5e number is MEASURED on the tunnel chip
+# (docs/perf_notes.md); the v5p numbers are DERIVED from public specs with
+# the scaling rule stated per line:
+#
+#   - issue-bound costs (random-row gather/scatter, the scalar-core DMA
+#     issue floor): v5e's measured 29 ns/row gather moves only ~17.6 GB/s,
+#     far under HBM bandwidth — these are core-clock-bound, so they scale
+#     with the clock ratio 1.75 GHz (v5p) / 0.94 GHz (v5e) = 1.86x.
+#   - streaming costs (compaction passes, sort, segwalk stream passes):
+#     HBM-bandwidth-bound, scale with 2765 / 819 GB/s = 3.38x.
+#   - ICI: v5p has 4800 Gbps/chip vs v5e's 1600 (3x); usable all_to_all
+#     scales the measured 90 GB/s to 270 GB/s.
+#   - MLP: MXU-bound, scales with bf16 peak 459 / 197 TFLOPs = 2.33x.
+#
+# 'v5p_sc' additionally models SparseCore offload (docs/design.md §8): the
+# DMA-issue floor — the residual that keeps v5e behind A100 — moves to the
+# 4 SparseCores' independent fetch units.  ASSUMPTION (stated, unmeasured):
+# 4 cores issue concurrently, so every random-access per-row cost (gather,
+# scatter, DMA issue) divides by 4 on top of the clock scaling.  The
+# streaming and ICI sides are unchanged — SC accelerates random access
+# only.
+_V5E_V5P_CLOCK = 1.75 / 0.94
+_V5E_V5P_HBM = 2765e9 / 819e9
+CHIPS = {
+    'v5e': dict(gather_ns=GATHER_NS, scatter_ns=SCATTER_NS,
+                compact_ns=COMPACT_NS, sort_ns=SORT_NS,
+                ici_Bps=ICI_BYTES_PER_S, hbm_Bps=HBM_BYTES_PER_S,
+                dma_issue_ns=DMA_ISSUE_NS, mlp_scale=1.0,
+                hbm_gib=15.75),
+    'v5p': dict(gather_ns=GATHER_NS / _V5E_V5P_CLOCK,
+                scatter_ns=SCATTER_NS / _V5E_V5P_CLOCK,
+                compact_ns=COMPACT_NS / _V5E_V5P_HBM,
+                sort_ns=SORT_NS / _V5E_V5P_HBM,
+                ici_Bps=270e9,
+                hbm_Bps=2765e9,
+                dma_issue_ns=DMA_ISSUE_NS / _V5E_V5P_CLOCK,
+                mlp_scale=197.0 / 459.0,
+                hbm_gib=95.0),
+}
+CHIPS['v5p_sc'] = dict(CHIPS['v5p'],
+                       dma_issue_ns=CHIPS['v5p']['dma_issue_ns'] / 4,
+                       gather_ns=CHIPS['v5p']['gather_ns'] / 4,
+                       scatter_ns=CHIPS['v5p']['scatter_ns'] / 4)
+
 
 def analyze(name: str, world: int, batch: int, row_slice=None,
-            apply='xla', stream_bytes_per_elem=4):
+            apply='xla', stream_bytes_per_elem=4, chip='v5e'):
+  hw = CHIPS[chip]
   config = SYNTHETIC_MODELS[name]
   tables, input_table_map, hotness = expand_tables(config)
   plan = ShardingPlan(tables, world_size=world,
@@ -97,24 +144,24 @@ def analyze(name: str, world: int, batch: int, row_slice=None,
   off_chip = (D - 1) / D if D > 1 else 0.0
   worst = max(per_dev, key=lambda d: d['lookup'] + d['stream'])
   unique_bound = min(worst['stream'], worst['rows'])
-  lookup_ms = worst['lookup'] * GATHER_NS * 1e-6
+  lookup_ms = worst['lookup'] * hw['gather_ns'] * 1e-6
   if apply == 'segwalk':
     # sort + STREAM_PASSES sequential passes over the dense [*, 128]
     # stream + the kernel's random DMAs, one set per unique PACKED row
-    compact_ms = worst['stream'] * SORT_NS * 1e-6
+    compact_ms = worst['stream'] * hw['sort_ns'] * 1e-6
     stream_bytes = worst['stream'] * 128 * stream_bytes_per_elem
-    compact_ms += (stream_bytes * STREAM_PASSES / HBM_BYTES_PER_S) * 1e3
+    compact_ms += (stream_bytes * STREAM_PASSES / hw['hbm_Bps']) * 1e3
     uniq_packed = sum(
         min(gr['stream'], -(-gr['rows'] // gr['pack']))
         for gr in worst['groups'])
-    scatter_ms = uniq_packed * DMA_ISSUE_NS * DMA_PER_UNIQUE * 1e-6
+    scatter_ms = uniq_packed * hw['dma_issue_ns'] * DMA_PER_UNIQUE * 1e-6
     unique_bound = uniq_packed
   else:
-    compact_ms = worst['stream'] * COMPACT_NS * 1e-6
-    scatter_ms = unique_bound * SCATTER_NS * SCATTER_PASSES * 1e-6
+    compact_ms = worst['stream'] * hw['compact_ns'] * 1e-6
+    scatter_ms = unique_bound * hw['scatter_ns'] * SCATTER_PASSES * 1e-6
   a2a_bytes = (worst['in_bytes'] + worst['out_bytes']) * off_chip
-  a2a_ms = a2a_bytes / ICI_BYTES_PER_S * 1e3
-  mlp_ms = MLP_MS.get(name, 2.0)
+  a2a_ms = a2a_bytes / hw['ici_Bps'] * 1e3
+  mlp_ms = MLP_MS.get(name, 2.0) * hw['mlp_scale']
   total_ms = lookup_ms + compact_ms + scatter_ms + a2a_ms + mlp_ms
   mem_gib = plan.padded_memory_elements() * 4 / 2**30
   return dict(D=D, tables_per_chip=max(len(t) for t in plan.table_ids),
@@ -141,9 +188,45 @@ def main(argv=None):
                  choices=['float32', 'bfloat16'],
                  help='segwalk stream payload dtype (halves stream '
                  'passes for bfloat16)')
+  p.add_argument('--chip', default='v5e', choices=sorted(CHIPS),
+                 help='hardware parameter set (v5p derived from public '
+                 'specs; v5p_sc adds the SparseCore-offload scenario)')
+  p.add_argument('--compare', action='store_true',
+                 help='one row per world with v5e / v5p / v5p_sc totals '
+                 'side by side against the published A100 baseline at '
+                 'that device count (the BASELINE.md north star)')
   args = p.parse_args(argv)
-  print(f'# {args.model}, global batch {args.batch}, per-chip estimates '
-        f'(worst chip)')
+  sbe = 2 if args.stream_dtype == 'bfloat16' else 4
+
+  if args.compare:
+    import bench  # repo-root baselines table
+    print(f'# {args.model}, global batch {args.batch}, {args.apply} '
+          f'apply, stream {args.stream_dtype}: projected worst-chip '
+          f'ms/step per chip generation vs published A100 baseline')
+    print('D | A100_ms | v5e_ms | v5p_ms | v5p_sc_ms | v5p_vs_A100 | '
+          'v5p_sc_vs_A100')
+    for w in args.worlds:
+      try:
+        totals = {
+            c: analyze(args.model, w, args.batch,
+                       row_slice=args.row_slice, apply=args.apply,
+                       stream_bytes_per_elem=sbe, chip=c)['total_ms']
+            for c in ('v5e', 'v5p', 'v5p_sc')
+        }
+      except (ValueError, AssertionError) as e:
+        print(f'{w} | plan failed: {e}')
+        continue
+      base, base_n = bench.pick_baseline(args.model, w)
+      base_s = f'{base:.2f}@{base_n}' if base else '-'
+      ratios = [(f'{base / totals[c]:.2f}x' if base else '-')
+                for c in ('v5p', 'v5p_sc')]
+      print(f'{w} | {base_s} | {totals["v5e"]:.2f} | '
+            f'{totals["v5p"]:.2f} | {totals["v5p_sc"]:.2f} | '
+            f'{ratios[0]} | {ratios[1]}')
+    return 0
+
+  print(f'# {args.model}, global batch {args.batch}, chip {args.chip}, '
+        f'per-chip estimates (worst chip)')
   cols = ('D', 'mem_gib', 'lookup_rows', 'stream_rows', 'unique_bound',
           'a2a_mb', 'lookup_ms', 'compact_ms', 'scatter_ms', 'a2a_ms',
           'mlp_ms', 'total_ms')
@@ -151,9 +234,8 @@ def main(argv=None):
   for w in args.worlds:
     try:
       r = analyze(args.model, w, args.batch, row_slice=args.row_slice,
-                  apply=args.apply,
-                  stream_bytes_per_elem=(
-                      2 if args.stream_dtype == 'bfloat16' else 4))
+                  apply=args.apply, stream_bytes_per_elem=sbe,
+                  chip=args.chip)
     except (ValueError, AssertionError) as e:
       print(f'{w} | plan failed: {e}')
       continue

@@ -372,3 +372,109 @@ def test_hybrid_step_with_lr_schedule():
   state, l2 = step(state, cats, labels)
   assert np.isfinite(float(l1)) and np.isfinite(float(l2))
   assert int(state.step) == 2
+
+
+def _run_steps_with_accum_dtype(adt, n_steps=3, lr=LR, fixed_batch=False):
+  dist, params_emb, gen_inputs, kernel, labels, head_loss_fn = build()
+  opt = SparseAdagrad(learning_rate=lr, initial_accumulator_value=0.1,
+                      accum_dtype=adt)
+  step = make_hybrid_train_step(dist, head_loss_fn, optax.sgd(lr), opt,
+                                donate=False)
+  state = init_hybrid_train_state(dist, {
+      'embedding': params_emb,
+      'kernel': kernel
+  }, optax.sgd(lr), opt)
+  cats = gen_inputs() if fixed_batch else None
+  losses = []
+  for _ in range(n_steps):
+    state, loss = step(state, cats if fixed_batch else gen_inputs(),
+                       labels)
+    losses.append(float(loss))
+  return state, losses
+
+
+def test_bf16_accumulator_matches_f32_within_tolerance():
+  """accum_dtype='bfloat16' (VERDICT r4 item 5): accumulator storage
+  halves; the trained tables must track the f32-accumulator path within
+  bf16 rounding of the monotone accumulator (arithmetic stays f32 —
+  identical batches via identical build(seed) rng streams)."""
+  st32, _ = _run_steps_with_accum_dtype('float32')
+  st16, _ = _run_steps_with_accum_dtype('bfloat16')
+  acc16 = st16.opt_state[1]
+  assert all(v['acc'].dtype == jnp.bfloat16 for v in acc16.values())
+  acc32 = st32.opt_state[1]
+  for k in acc32:
+    np.testing.assert_allclose(np.asarray(acc16[k]['acc'],
+                                          dtype=np.float32),
+                               np.asarray(acc32[k]['acc']), rtol=8e-3,
+                               atol=8e-3)
+  for k in st32.params['embedding']:
+    np.testing.assert_allclose(
+        np.asarray(st16.params['embedding'][k]),
+        np.asarray(st32.params['embedding'][k]), rtol=1e-2, atol=5e-3)
+
+
+def test_bf16_accumulator_convergence_delta():
+  """Measured accuracy impact of bf16 accumulators (the documented
+  jumbo trade-off): after 50 steps on the same stream, the loss path
+  must end within 5% relative of the f32-accumulator run."""
+  _, l32 = _run_steps_with_accum_dtype('float32', n_steps=50, lr=0.05,
+                                       fixed_batch=True)
+  _, l16 = _run_steps_with_accum_dtype('bfloat16', n_steps=50, lr=0.05,
+                                       fixed_batch=True)
+  assert l32[-1] < l32[0]  # the task actually trains
+  # both runs overfit the fixed batch toward 0 — compare the AREA under
+  # the loss path, which stays sensitive to accumulator rounding even
+  # after the endpoint saturates
+  area32, area16 = sum(l32), sum(l16)
+  delta = abs(area16 - area32) / max(area32, 1e-9)
+  print(f'\nbf16-accumulator loss-path delta over 50 steps: '
+        f'{delta * 100:.3f}% (f32 area {area32:.6f} vs bf16 '
+        f'{area16:.6f}; endpoints {l32[-1]:.2e} / {l16[-1]:.2e})')
+  assert delta < 0.05
+
+
+def test_bf16_accumulator_segwalk_gate_falls_back():
+  """The segwalk/rowwise kernels are f32-accumulator only: with
+  accum_dtype='bfloat16' the dispatch and the eligibility probe must
+  BOTH report the XLA fallback (single-source gate, advisor r3)."""
+  from distributed_embeddings_tpu.parallel.sparse import _use_segwalk
+  from distributed_embeddings_tpu.utils.apply_eligibility import (
+      segwalk_serves_all_groups)
+  dist, params_emb, *_ = build()
+  opt = SparseAdagrad(use_segwalk_apply=True, accum_dtype='bfloat16')
+  table = jnp.zeros((1024, 128), jnp.float32)
+  assert not _use_segwalk(opt, table)
+  assert not segwalk_serves_all_groups(dist, 'float32',
+                                       accum_dtype='bfloat16')
+
+
+def test_bf16_accumulator_checkpoint_roundtrip():
+  """bf16 accumulators cross the global-canonical checkpoint exactly:
+  np.savez writes ml_dtypes arrays as raw void bytes (dtype lost), so
+  the canonical file stores them as f32 (exact superset) and the load
+  path casts back to the live template dtype."""
+  from distributed_embeddings_tpu.parallel import (get_optimizer_state,
+                                                   set_optimizer_state)
+  from distributed_embeddings_tpu.parallel.checkpoint import (
+      get_weights, load_train_npz, save_train_npz)
+  import tempfile, os
+  dist, params_emb, *_ = build()
+  opt = SparseAdagrad(accum_dtype='bfloat16')
+  st = opt.init(dist, params_emb)
+  st = jax.tree.map(
+      lambda x: x + (jnp.arange(x.size, dtype=jnp.float32).reshape(
+          x.shape) % 3).astype(x.dtype), st)
+  ts = get_optimizer_state(dist, st)
+  with tempfile.TemporaryDirectory() as d:
+    path = os.path.join(d, 'bf16acc.npz')
+    save_train_npz(path, get_weights(dist, params_emb), ts)
+    _, ts2, _ = load_train_npz(path)
+    assert all(np.asarray(t['acc']).dtype == np.float32 for t in ts2)
+    st2 = set_optimizer_state(dist, st, ts2)
+  assert all(v['acc'].dtype == jnp.bfloat16 for v in st2.values())
+  ts_rt = get_optimizer_state(dist, st2)
+  for a, b in zip(ts, ts_rt):
+    for k in a:
+      np.testing.assert_array_equal(np.asarray(a[k], dtype=np.float32),
+                                    np.asarray(b[k], dtype=np.float32))
