@@ -13,6 +13,15 @@ hardware that exposes it (VERDICT.md round 2; docs/perf_notes.md
 "SparseCore seam").  Do not spend further tuning effort here for
 TensorCore targets.
 
+Round-5 decision (VERDICT r4 item 8): RETAINED with exactly that status
+— additionally, the packed-storage layout helpers below (``pack_of``,
+``is_prepacked``, prepacked validation) are load-bearing for the
+segment-walk kernel and the planner's ``GroupSpec.storage_pack``
+machinery, so this module is package infrastructure independent of its
+lookup kernel's dispatch fate.  The sweep's lookup microbench step can
+still flip the dispatch if hardware ever favors it (round-4 playbook
+rule 2); absent that, the XLA gather stays the only forward path.
+
 TPU-native re-design of the reference's fused CUDA forward kernels
 ``EmbeddingLookUpVariableHot[Wide]``
 (`/root/reference/distributed_embeddings/cc/kernels/embedding_lookup_kernels.cu:175-336`,

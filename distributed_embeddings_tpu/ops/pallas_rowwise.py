@@ -1,5 +1,15 @@
 """Pallas TPU kernel: fused row-wise Adagrad update over unique rows.
 
+STATUS (round-5 decision, VERDICT r4 item 8): **DEMOTED — superseded by
+``ops/pallas_segwalk.py``** on every axis: segwalk supports bf16 tables
+(pair-fetch), consumes the raw sorted stream with no compaction
+prerequisite, has no 128x-padded uids column, and its pair-merged
+segment key removes the write-race that structurally blocks bf16 here.
+``use_pallas_apply=True`` remains a working opt-in strictly as the A/B
+reference for the sweep's microbench step; if the on-chip A/B never
+favors it, this module is scheduled for deletion once segwalk's
+hardware correctness gate passes.  New work goes to segwalk.
+
 The XLA formulation of one sparse Adagrad step costs three random-access
 passes over HBM per unique row — accumulator gather, accumulator
 scatter-set, table scatter-add — at ~100-140 ns per scatter row on v5e

@@ -57,7 +57,9 @@ def main():
                  help='compile-only topology (chips must divide it)')
   p.add_argument('--compiler_option', action='append', default=[],
                  help='k=v XLA compiler option (repeatable), e.g. '
-                 'xla_exec_time_optimization_effort=-1.0')
+                 'exec_time_optimization_effort=-1.0 (NO xla_ prefix: '
+                 'the effort knobs are ExecutionOptions, not DebugOptions '
+                 '— the prefixed names are rejected, probed round 5)')
   p.add_argument('--no_cache', action='store_true',
                  help='skip the persistent compilation cache')
   args = p.parse_args()
