@@ -186,6 +186,13 @@ def main():
                       help='Adagrad accumulator STORAGE dtype: bfloat16 '
                       'halves accumulator HBM (the jumbo-scale lever; '
                       'arithmetic stays f32)')
+  parser.add_argument('--fast_compile', action='store_true',
+                      help='compile with exec_time_optimization_effort='
+                      '-1.0 / memory_fitting_effort=-1.0: measured 2.75x '
+                      'faster XLA compile (910->331 s host-side, round 5) '
+                      'at unchanged memory/flops — for landing a step '
+                      'number inside a short tunnel window; the official '
+                      'artifact line uses default effort')
   parser.add_argument('--row_slice', type=int, default=None,
                       help='element threshold for row-sharding big tables '
                       '(multi-chip; beyond the reference)')
@@ -326,7 +333,9 @@ def main():
                                   state.params, updates)
         return TrainState(new_params, opt_state, state.step + 1), loss
 
-    return jax.jit(body, donate_argnums=(0,))
+    copts = ({'exec_time_optimization_effort': -1.0,
+              'memory_fitting_effort': -1.0} if args.fast_compile else None)
+    return jax.jit(body, donate_argnums=(0,), compiler_options=copts)
 
   step = make_step()
   pool = [((jnp.asarray(num), tuple(jnp.asarray(c) for c in cats)),
@@ -363,6 +372,10 @@ def main():
     metric += f' (baseline: {baseline_ndev}xA100 {baseline} ms)'
   if backend_note:
     metric += f' [{backend_note}]'
+  if args.fast_compile:
+    # a low-effort executable may run slower than the default-effort
+    # one: the line must say so or it reads as the official number
+    metric += ' [fast_compile: low XLA optimization effort]'
   if args.model == 'criteo':
     # DLRM-shaped model: the reference's headline metric is throughput
     # (9.16M samples/s TF32 / 10.4M AMP on 8xA100, examples/dlrm/
@@ -395,6 +408,7 @@ def main():
       # persistent .jax_cache makes repeats drop to seconds
       'warmup_s': round(warmup_s, 1),
       'packed_storage': args.packed_storage,
+      'fast_compile': args.fast_compile,
       'sha': repo_sha(),
   }
   if on_cpu:

@@ -14,11 +14,11 @@ hardware that exposes it (VERDICT.md round 2; docs/perf_notes.md
 TensorCore targets.
 
 Round-5 decision (VERDICT r4 item 8): RETAINED with exactly that status
-— additionally, the packed-storage layout helpers below (``pack_of``,
-``is_prepacked``, prepacked validation) are load-bearing for the
-segment-walk kernel and the planner's ``GroupSpec.storage_pack``
-machinery, so this module is package infrastructure independent of its
-lookup kernel's dispatch fate.  The sweep's lookup microbench step can
+— additionally, the packed-storage layout helpers below
+(``is_prepacked``, ``validate_prepacked``) are load-bearing for the
+segment-walk kernel (pallas_segwalk.py imports both) and the planner's
+``GroupSpec.storage_pack`` machinery, so this module is package
+infrastructure independent of its lookup kernel's dispatch fate.  The sweep's lookup microbench step can
 still flip the dispatch if hardware ever favors it (round-4 playbook
 rule 2); absent that, the XLA gather stays the only forward path.
 

@@ -56,10 +56,11 @@ T=900 run python examples/benchmarks/lookup_benchmark.py
 T=1200 run env DET_TESTS_REAL_TPU=1 python -m pytest tests/test_pallas_tpu.py -q -s -k "segwalk_apply_compiled or sideband"
 
 # 5. reduced-batch bench line: same full-size tables + program shape at
-# global batch 8192, no calibration — an ON-CHIP step-time number
-# (clearly comparable:false — baselines are at batch 65536) that lands
-# even if the window closes before the full artifact compiles
-T=600 run python bench.py --model tiny --batch_size 8192 --steps 10 --no-auto_capacity
+# global batch 8192, no calibration, low-effort compile (measured 2.75x
+# faster) — an ON-CHIP step-time number (clearly comparable:false —
+# baselines are at batch 65536, and low effort may cost exec time) that
+# lands even if the window closes before the full artifact compiles
+T=900 run python bench.py --model tiny --batch_size 8192 --steps 10 --no-auto_capacity --fast_compile
 
 # ---- FULL LADDER: long compiles; needs a wide window ----
 
