@@ -412,20 +412,65 @@ def main():
       'sha': repo_sha(),
   }
   if on_cpu:
-    prior = chip_evidence()
-    if prior is not None:
-      # a sweep window landed an on-chip line earlier this round; carry
-      # it (labelled, with its own sha/timestamp) so the artifact is not
-      # blind to hardware evidence the driver's timing missed
-      result['prior_chip_evidence'] = prior
+    # a sweep window may have landed an on-chip line earlier this round;
+    # carry it (labelled, with its own sha/timestamp) so the artifact is
+    # not blind to hardware evidence the driver's timing missed
+    _fold_prior_evidence(result)
   emit(result, on_tpu=not on_cpu)
+
+
+class _Watchdog(BaseException):
+  # BaseException, deliberately: the alarm is one-shot, and a broad
+  # `except Exception` anywhere in main()/JAX internals would otherwise
+  # swallow it and leave the run unbounded — the exact driver-kill/
+  # no-artifact failure this watchdog exists to prevent
+  pass
+
+
+def _arm_watchdog():
+  """A cold full-size TPU run (init + calibration + two tunnel compiles)
+  can exceed 20 minutes; if the DRIVER's timeout kills the process first
+  there is NO artifact at all.  Self-bound the wall time instead
+  (DET_BENCH_WATCHDOG_S, default 2400 s, 0 disables) so a too-slow run
+  still emits a labelled JSON line — with any prior on-chip evidence —
+  and exits 0."""
+  import signal
+  budget = float(os.environ.get('DET_BENCH_WATCHDOG_S', '2400'))
+  if budget <= 0 or not hasattr(signal, 'SIGALRM'):
+    return
+
+  def fire(signum, frame):
+    raise _Watchdog(f'wall time exceeded {budget:.0f}s '
+                    '(cold compile through the tunnel?)')
+
+  signal.signal(signal.SIGALRM, fire)
+  signal.alarm(int(budget))
+
+
+def _disarm_watchdog():
+  import signal
+  if hasattr(signal, 'SIGALRM'):
+    signal.alarm(0)
+
+
+def _fold_prior_evidence(result):
+  """Attach the freshest on-chip line (if any) to a CPU-fallback or
+  failure artifact — shared by both emit sites so the labelling/age
+  policy cannot drift."""
+  prior = chip_evidence()
+  if prior is not None:
+    result['prior_chip_evidence'] = prior
+  return result
 
 
 if __name__ == '__main__':
   try:
+    _arm_watchdog()
     main()
-  except Exception as e:
-    emit({
+    _disarm_watchdog()  # a late fire must not follow the success line
+  except (Exception, _Watchdog) as e:
+    _disarm_watchdog()
+    result = {
         'metric': 'benchmark failed',
         'value': None,
         'unit': 'ms/step',
@@ -433,5 +478,7 @@ if __name__ == '__main__':
         'error': f'{type(e).__name__}: {e}',
         'trace_tail': traceback.format_exc()[-1500:],
         'sha': repo_sha(),
-    })
+    }
+    _fold_prior_evidence(result)
+    emit(result)
     raise SystemExit(0)

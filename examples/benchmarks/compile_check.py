@@ -47,6 +47,10 @@ def main():
                  choices=['float32', 'bfloat16'],
                  help='Adagrad accumulator storage dtype: bfloat16 '
                  'halves the accumulator argument HBM (the jumbo lever)')
+  p.add_argument('--row_slice', type=int, default=None,
+                 help='element threshold for ROW-sharding big tables '
+                 '(beyond the reference; spreads a 400M-row table\'s '
+                 'rows across chips when column slicing alone cannot)')
   p.add_argument('--column_slice', default=None,
                  help="element threshold for column slicing, or "
                  "'balance' = total_elems/chips: without it a single "
@@ -113,7 +117,8 @@ def main():
   elif cst is not None:
     cst = int(cst)
   model = SyntheticModel(config, mesh=mesh, dp_input=True, param_dtype=pdt,
-                         column_slice_threshold=cst)
+                         column_slice_threshold=cst,
+                         row_slice=args.row_slice)
   dist = model.dist_embedding
   opt = SparseAdagrad(learning_rate=0.01,
                       capacity_fraction=args.capacity_fraction,
