@@ -16,6 +16,7 @@ traceback, so the driver's artifact never ends up unparseable.
 import argparse
 import json
 import os
+import sys
 import time
 import traceback
 
@@ -433,10 +434,41 @@ def _arm_watchdog():
   there is NO artifact at all.  Self-bound the wall time instead
   (DET_BENCH_WATCHDOG_S, default 2400 s, 0 disables) so a too-slow run
   still emits a labelled JSON line — with any prior on-chip evidence —
-  and exits 0."""
+  and exits 0.
+
+  Two layers: SIGALRM raises _Watchdog with a full traceback (verified
+  to interrupt this stack's XLA compile, which polls signals), and a
+  daemon-thread backstop 90 s later emits the artifact and hard-exits —
+  Python signal handlers only run when the main thread executes
+  bytecode, so a blocking C call that never polls would otherwise
+  outlive the alarm and hit the driver's kill with no artifact."""
   import signal
+  import threading
   budget = float(os.environ.get('DET_BENCH_WATCHDOG_S', '2400'))
-  if budget <= 0 or not hasattr(signal, 'SIGALRM'):
+  if budget <= 0:
+    return
+
+  def backstop():
+    result = {
+        'metric': 'benchmark failed',
+        'value': None,
+        'unit': 'ms/step',
+        'vs_baseline': None,
+        'error': f'watchdog backstop: wall time exceeded '
+                 f'{budget:.0f}s + 90s grace (main thread stuck in a '
+                 'non-interruptible call)',
+        'sha': repo_sha(),
+    }
+    _fold_prior_evidence(result)
+    emit(result)
+    sys.stdout.flush()
+    os._exit(0)
+
+  timer = threading.Timer(budget + 90, backstop)
+  timer.daemon = True
+  timer.start()
+  _WATCHDOG_STATE['timer'] = timer
+  if not hasattr(signal, 'SIGALRM'):
     return
 
   def fire(signum, frame):
@@ -444,13 +476,19 @@ def _arm_watchdog():
                     '(cold compile through the tunnel?)')
 
   signal.signal(signal.SIGALRM, fire)
-  signal.alarm(int(budget))
+  signal.alarm(max(1, int(round(budget))))
+
+
+_WATCHDOG_STATE = {}
 
 
 def _disarm_watchdog():
   import signal
   if hasattr(signal, 'SIGALRM'):
     signal.alarm(0)
+  timer = _WATCHDOG_STATE.pop('timer', None)
+  if timer is not None:
+    timer.cancel()
 
 
 def _fold_prior_evidence(result):
