@@ -72,7 +72,9 @@ T=900 run python bench.py --model tiny --batch_size 8192 --steps 10 --no-auto_ca
 # must stay parseable), so rc alone can't gate the completion marker:
 # require the official comparable line itself in this step's output.
 OFF0=$(wc -c < "$LOG" 2>/dev/null || echo 0)
-T=2700 run python bench.py --model tiny --steps 10 --auto_capacity
+# watchdog slightly inside the step timeout: bench emits its own
+# labelled artifact + prior chip evidence instead of dying silently
+T=2700 run env DET_BENCH_WATCHDOG_S=2550 python bench.py --model tiny --steps 10 --auto_capacity
 if ! tail -c +$((OFF0 + 1)) "$LOG" \
     | grep -q '"metric": "synthetic-tiny.*"comparable": true'; then
   FAIL=1
