@@ -317,10 +317,15 @@ def _segwalk_kernel(sid_smem, islast_smem, g_ref, idv_ref, lr_smem,
       else:
         adds = (tots * tots if op == 'adagrad_dedup'
                 else seg[:, pw + s * width:pw + (s + 1) * width])
-        acc_new = abuf[p, :, s, :] + adds
+        # abuf may be bf16 (accum_dtype='bfloat16' on a bf16 table):
+        # accumulate + rsqrt in f32, round once at the store — the
+        # untouched half adds zero and rewrites byte-identically
+        # (bf16(f32(bf16)) is exact), preserving the pair-write safety
+        # argument above
+        acc_new = abuf[p, :, s, :].astype(jnp.float32) + adds
         eps = lr_smem[0, 1]
         ns = ts - lr * tots * jax.lax.rsqrt(acc_new + eps)
-        abuf[p, :, s, :] = acc_new
+        abuf[p, :, s, :] = acc_new.astype(abuf.dtype)
       tbuf[p, :, s, :] = ns.astype(tbuf.dtype)
 
   # ----- update carries (AFTER the scan consumed the old values) -------
@@ -426,6 +431,19 @@ def supported(table: jax.Array) -> bool:
   return rows % (pair * pack) == 0
 
 
+def acc_dtype_ok(table_dtype, accum_dtype) -> bool:
+  """THE accumulator-dtype predicate: f32 always; bf16 only on bf16
+  tables (a bf16 accumulator needs the pair-fetch granularity the bf16
+  table establishes — Mosaic rejects single-sublane bf16 slices).
+  Single source shared by this module's validation, the dispatch gate
+  (``sparse._use_segwalk``) and both eligibility probes
+  (``utils/apply_eligibility.py``) so they can never drift."""
+  adt = jnp.dtype(accum_dtype)
+  return adt == jnp.dtype(jnp.float32) or (
+      adt == jnp.dtype(jnp.bfloat16)
+      and jnp.dtype(table_dtype) == jnp.dtype(jnp.bfloat16))
+
+
 @functools.partial(jax.jit, static_argnames=('op', 'eps', 'interpret',
                                              'logical_width', 'presorted',
                                              'stream_dtype'))
@@ -450,7 +468,10 @@ def segwalk_apply(table: jax.Array,
       (``GroupSpec.storage_pack``): the kernel's packed path runs on the
       operand itself with no reshape, so the lane-padded relayout that
       barred huge narrow groups (``packed_dispatch_ok``) cannot occur.
-    acc: Adagrad accumulator (same shape as ``table``), or None for 'sgd'.
+    acc: Adagrad accumulator (same shape as ``table``), or None for
+      'sgd'.  f32, or bf16 when the table is bf16 (rides the same
+      pair-fetch path; f32 math, one rounding at the store — the
+      ``accum_dtype='bfloat16'`` jumbo-scale configuration).
     sorted_ids: ``[n]`` int32 NATURAL row ids; sentinels (>= natural
       num_rows) mark padding.  Ascending when ``presorted`` (sentinels
       last); arbitrary order with ``presorted=False``, in which case
@@ -501,12 +522,19 @@ def segwalk_apply(table: jax.Array,
   pack = 128 // w if w < 128 else 1
   kw = w * pack
   prows = num_rows // pack
-  # bf16 fetches in PAIRS of (packed) rows — see the kernel docstring;
-  # the accumulator stays f32 (the runtime always creates it f32)
+  # bf16 fetches in PAIRS of (packed) rows — see the kernel docstring.
+  # The accumulator may be f32 (the runtime default) or, on bf16 tables
+  # ONLY, bf16 (SparseAdagrad(accum_dtype='bfloat16'), the jumbo-scale
+  # lever): a bf16 accumulator needs the same pair-fetch granularity as
+  # a bf16 table (Mosaic rejects single-sublane bf16 slices), so it can
+  # only ride the pair path the bf16 table already established — an f32
+  # table with a bf16 accumulator would mix fetch granularities and is
+  # rejected (the XLA apply serves it).
   pair = 2 if table.dtype == jnp.bfloat16 else 1
-  if pair == 2 and acc is not None and acc.dtype != jnp.float32:
-    raise ValueError(f'bf16 segwalk requires an f32 accumulator, got '
-                     f'{acc.dtype}')
+  if acc is not None and not acc_dtype_ok(table.dtype, acc.dtype):
+    raise ValueError(
+        f'segwalk accumulator must be f32 (or bf16 on a bf16 table), '
+        f'got acc {acc.dtype} with table {table.dtype}')
   tile = _tile_rows(pair * kw)
   n = sorted_ids.shape[0]
   # pad to whole _SMEM_BLOCKs (tile divides _SMEM_BLOCK), so the shared
@@ -646,7 +674,7 @@ def segwalk_apply(table: jax.Array,
       input_output_aliases={5: 0, 6: 1},
       scratch_shapes=[
           pltpu.VMEM(stage, table_k.dtype),        # tbuf (parity pair)
-          pltpu.VMEM(stage, jnp.float32),          # abuf (parity pair)
+          pltpu.VMEM(stage, acc_operand.dtype),    # abuf (parity pair)
           pltpu.VMEM((2, pair * kw), jnp.float32),  # carry (sum, sum_sq)
           pltpu.SMEM((1, 1), jnp.int32),           # carry id
           pltpu.SMEM((2, 1), jnp.int32),           # in-flight write counts

@@ -645,12 +645,12 @@ def _use_segwalk(optimizer, table) -> bool:
   """Whether the fused segment-walk kernel serves this group's apply."""
   if not getattr(optimizer, 'use_segwalk_apply', False):
     return False
-  if getattr(optimizer, 'accum_dtype', 'float32') != 'float32':
-    # the kernel's accumulator RMW bursts are f32 (bf16 TABLES still
-    # carry f32 accumulators); low-precision accumulators take the XLA
-    # path until the kernel grows a bf16-acc pair-fetch variant
-    return False
   from distributed_embeddings_tpu.ops import pallas_segwalk
+  if not pallas_segwalk.acc_dtype_ok(
+      table.dtype, getattr(optimizer, 'accum_dtype', 'float32')):
+    # bf16 accumulators ride the bf16 table's pair-fetch path ONLY;
+    # other combinations take XLA (single-source predicate)
+    return False
   if not pallas_segwalk.supported(table):
     return False
   if not packed_dispatch_ok(table.shape[0], table.shape[1]):

@@ -72,8 +72,9 @@ def eligibility_line(dist, param_dtype, fused_apply: bool,
   would actually serve, and whether it engages on this backend at all
   (empty string when neither kernel is requested).  ``accum_dtype``
   mirrors the dispatch's low-precision-accumulator gate
-  (``sparse._use_segwalk`` / ``pallas_rowwise.supported``): neither
-  kernel serves non-f32 accumulators."""
+  (``sparse._use_segwalk`` / ``pallas_rowwise.supported``): the rowwise
+  kernel is f32-only; segwalk serves bf16 accumulators only on bf16
+  tables (the pair-fetch path)."""
   parts = []
   dt = jnp.dtype(param_dtype)
   adt = jnp.dtype(accum_dtype)
@@ -87,8 +88,8 @@ def eligibility_line(dist, param_dtype, fused_apply: bool,
                  f'{_active_suffix(pallas_rowwise.FORCE_INTERPRET)}')
   if segwalk_apply:
     from distributed_embeddings_tpu.ops import pallas_segwalk
-    ok = (0 if adt != jnp.dtype(jnp.float32) else
-          sum(1 for g in groups if _segwalk_group_ok(g, dt)))
+    ok = (sum(1 for g in groups if _segwalk_group_ok(g, dt))
+          if pallas_segwalk.acc_dtype_ok(dt, adt) else 0)
     parts.append(f'segwalk_apply: {ok}/{len(groups)} groups eligible'
                  f'{_active_suffix(pallas_segwalk.FORCE_INTERPRET, pallas_segwalk.ASSUME_TPU)}')
   return '; '.join(parts)
@@ -100,11 +101,11 @@ def segwalk_serves_all_groups(dist, param_dtype,
   the active backend — in which case compaction capacities are dead
   weight (the kernel has none)."""
   from distributed_embeddings_tpu.ops import pallas_segwalk
-  if jnp.dtype(accum_dtype) != jnp.dtype(jnp.float32):
+  dt = jnp.dtype(param_dtype)
+  if not pallas_segwalk.acc_dtype_ok(dt, accum_dtype):
     return False  # mirrors sparse._use_segwalk's accumulator gate
   if not (jax.default_backend() == 'tpu'
           or pallas_segwalk.FORCE_INTERPRET
           or pallas_segwalk.ASSUME_TPU):
     return False
-  dt = jnp.dtype(param_dtype)
   return all(_segwalk_group_ok(g, dt) for g in dist.plan.groups)

@@ -47,6 +47,11 @@ def main():
                  choices=['float32', 'bfloat16'],
                  help='Adagrad accumulator storage dtype: bfloat16 '
                  'halves the accumulator argument HBM (the jumbo lever)')
+  p.add_argument('--compute_dtype', default=None,
+                 choices=['float32', 'bfloat16'],
+                 help='activation dtype (default: param_dtype, matching '
+                 'bench.py): f32 activations on bf16 tables double the '
+                 'forward combine temps at jumbo scale')
   p.add_argument('--row_slice', type=int, default=None,
                  help='element threshold for ROW-sharding big tables '
                  '(beyond the reference; spreads a 400M-row table\'s '
@@ -116,7 +121,9 @@ def main():
             // args.chips)
   elif cst is not None:
     cst = int(cst)
+  cdt = jnp.dtype(args.compute_dtype or args.param_dtype)
   model = SyntheticModel(config, mesh=mesh, dp_input=True, param_dtype=pdt,
+                         compute_dtype=cdt,
                          column_slice_threshold=cst,
                          row_slice=args.row_slice)
   dist = model.dist_embedding
@@ -191,7 +198,8 @@ def main():
   t0 = time.time()
   compiled = lowered.compile(compiler_options=copts or None)
   t_compile = time.time() - t0
-  print(f'{args.model} {args.chips}-chip v5e train step compiled in '
+  gen = args.topology.split(':')[0]
+  print(f'{args.model} {args.chips}-chip {gen} train step compiled in '
         f'{t_lower + t_compile:.0f}s (trace+lower {t_lower:.0f}s, '
         f'XLA {t_compile:.0f}s; '
         f'{"segwalk" if args.segwalk_apply else "xla"} apply)',

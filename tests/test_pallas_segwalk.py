@@ -390,10 +390,11 @@ def test_bf16_unsupported_shapes():
       jax.ShapeDtypeStruct((30, 128), jnp.bfloat16))
   assert not pallas_segwalk.supported(
       jax.ShapeDtypeStruct((31, 128), jnp.bfloat16))
-  # f32 acc required for bf16 adagrad
-  with pytest.raises(ValueError, match='f32 accumulator'):
+  # acc must be f32 — or bf16 on a bf16 table (round 5, the pair-fetch
+  # path); bf16 acc on an F32 table mixes fetch granularities: rejected
+  with pytest.raises(ValueError, match='accumulator'):
     pallas_segwalk.segwalk_apply(
-        jnp.zeros((32, 128), jnp.bfloat16),
+        jnp.zeros((32, 128), jnp.float32),
         jnp.zeros((32, 128), jnp.bfloat16),
         jnp.zeros((8,), jnp.int32), jnp.zeros((8, 128), jnp.float32),
         0.1, op='adagrad_dedup', interpret=True)
@@ -525,3 +526,74 @@ def test_bf16_stream_equals_prequantized_f32_stream():
   np.testing.assert_array_equal(np.asarray(t_b), np.asarray(t_q))
   # and the update actually moved the touched rows
   assert float(np.abs(np.asarray(t_b) - np.asarray(table)).max()) > 0.01
+
+
+# ---------------------------------------------------- bf16 accumulator
+# accum_dtype='bfloat16' (the jumbo-scale lever): a bf16 accumulator
+# rides the bf16 table's pair-fetch path — f32 accumulate + rsqrt, one
+# bf16 rounding at the store, matching the XLA apply's semantics
+# (sparse.SparseAdagrad.apply_unique) exactly.
+
+
+@pytest.mark.parametrize('op', ['adagrad_dedup', 'adagrad_sq'])
+@pytest.mark.parametrize('width', [16, 128])
+def test_bf16_accumulator_random_stream(op, width):
+  import zlib
+  rng = np.random.default_rng(zlib.crc32(f'bf16acc-{op}-{width}'.encode()))
+  rows, n = 64, 800
+  table = jnp.asarray(rng.normal(size=(rows, width)), jnp.bfloat16)
+  acc32 = rng.uniform(0.05, 0.2, size=(rows, width)).astype(np.float32)
+  acc16 = jnp.asarray(acc32, jnp.bfloat16)
+  ids = rng.integers(0, rows, n).astype(np.int32)
+  ids[rng.random(n) < 0.2] = rows
+  grads = rng.normal(size=(n, width)).astype(np.float32)
+  # oracle: f32 math from the BF16-SEEN accumulator start values, table
+  # rounding to bf16 at the end; the acc compares against a final bf16
+  # rounding of the f32 oracle accumulator
+  acc_seen = np.asarray(acc16, np.float32)
+  want_t, want_a = bf16_oracle(op, table, acc_seen.copy(), ids, grads)
+  order = np.argsort(ids, kind='stable')
+  got_t, got_a = pallas_segwalk.segwalk_apply(
+      table, acc16, jnp.asarray(ids[order], jnp.int32),
+      jnp.asarray(grads[order], jnp.float32), LR, op=op, eps=EPS,
+      interpret=True)
+  assert got_a.dtype == jnp.bfloat16
+  np.testing.assert_allclose(np.asarray(got_t, np.float32),
+                             np.asarray(want_t, np.float32),
+                             rtol=1e-2, atol=1e-2)
+  np.testing.assert_allclose(np.asarray(got_a, np.float32),
+                             np.asarray(want_a, np.float32),
+                             rtol=1e-2, atol=1e-2)
+
+
+def test_bf16_accumulator_untouched_rows_bitwise_preserved():
+  """The pair-write safety argument extended to the accumulator: the
+  untouched half of a fetched pair adds zero and must rewrite
+  byte-identically."""
+  rng = np.random.default_rng(11)
+  rows, w = 32, 128
+  table = jnp.asarray(rng.normal(size=(rows, w)), jnp.bfloat16)
+  acc = jnp.asarray(rng.uniform(0.05, 0.2, size=(rows, w)), jnp.bfloat16)
+  # touch ONLY even rows: their pair partners (odd rows) must be
+  # bit-identical afterwards
+  ids = np.repeat(np.arange(0, rows, 2, dtype=np.int32), 4)
+  grads = rng.normal(size=(ids.size, w)).astype(np.float32)
+  t2, a2 = pallas_segwalk.segwalk_apply(
+      table, acc, jnp.asarray(np.sort(ids)), jnp.asarray(grads), LR,
+      op='adagrad_dedup', eps=EPS, interpret=True)
+  before_t = np.asarray(table).view(np.uint16)
+  after_t = np.asarray(t2).view(np.uint16)
+  before_a = np.asarray(acc).view(np.uint16)
+  after_a = np.asarray(a2).view(np.uint16)
+  np.testing.assert_array_equal(after_t[1::2], before_t[1::2])
+  np.testing.assert_array_equal(after_a[1::2], before_a[1::2])
+  assert not np.array_equal(after_t[0::2], before_t[0::2])
+
+
+def test_bf16_accumulator_on_f32_table_rejected():
+  t = jnp.zeros((32, 128), jnp.float32)
+  a = jnp.zeros((32, 128), jnp.bfloat16)
+  with pytest.raises(ValueError, match='accumulator'):
+    pallas_segwalk.segwalk_apply(t, a, jnp.zeros((8,), jnp.int32),
+                                 jnp.zeros((8, 128), jnp.float32), LR,
+                                 op='adagrad_dedup', interpret=True)

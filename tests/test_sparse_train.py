@@ -434,19 +434,39 @@ def test_bf16_accumulator_convergence_delta():
   assert delta < 0.05
 
 
-def test_bf16_accumulator_segwalk_gate_falls_back():
-  """The segwalk/rowwise kernels are f32-accumulator only: with
-  accum_dtype='bfloat16' the dispatch and the eligibility probe must
-  BOTH report the XLA fallback (single-source gate, advisor r3)."""
+def test_bf16_accumulator_segwalk_gate():
+  """bf16 accumulators ride segwalk ONLY on bf16 tables (pair-fetch);
+  on f32 tables the dispatch and the eligibility probe must BOTH
+  report the XLA fallback (single-source gate, advisor r3)."""
+  from distributed_embeddings_tpu.ops import pallas_segwalk
   from distributed_embeddings_tpu.parallel.sparse import _use_segwalk
   from distributed_embeddings_tpu.utils.apply_eligibility import (
       segwalk_serves_all_groups)
   dist, params_emb, *_ = build()
   opt = SparseAdagrad(use_segwalk_apply=True, accum_dtype='bfloat16')
-  table = jnp.zeros((1024, 128), jnp.float32)
-  assert not _use_segwalk(opt, table)
+  assert not _use_segwalk(opt, jnp.zeros((1024, 128), jnp.float32))
   assert not segwalk_serves_all_groups(dist, 'float32',
                                        accum_dtype='bfloat16')
+  # positive case: bf16 table + bf16 accumulator engages the kernel
+  # (backend-gated; FORCE_INTERPRET stands in for the chip here)
+  pallas_segwalk.FORCE_INTERPRET = True
+  try:
+    assert _use_segwalk(opt, jnp.zeros((1024, 128), jnp.bfloat16))
+    # serves-all needs a plan whose row granularity satisfies the bf16
+    # pair divisibility — the planner grants that when params ARE bf16.
+    # Large-ish unsliced tables: auto column slicing would split widths
+    # below the kernel's 8-lane minimum at this world size.
+    bdist = DistributedEmbedding(
+        [TableConfig(256 + 32 * i, 16, 'sum') for i in range(WORLD)],
+        mesh=create_mesh(jax.devices()[:WORLD]),
+        column_slice_threshold=1 << 30,
+        param_dtype=jnp.bfloat16)
+    assert segwalk_serves_all_groups(bdist, 'bfloat16',
+                                     accum_dtype='bfloat16')
+    assert not segwalk_serves_all_groups(bdist, 'bfloat16',
+                                         accum_dtype='float16')
+  finally:
+    pallas_segwalk.FORCE_INTERPRET = False
 
 
 def test_bf16_accumulator_checkpoint_roundtrip():

@@ -223,3 +223,20 @@ def test_segwalk_bf16_stream_compiles_for_v5e(v5e, op, w):
   _compile_single(v5e, fn, ((rows, w), jnp.float32),
                   ((rows, w), jnp.float32), ((n,), jnp.int32),
                   ((n, w), jnp.float32))
+
+
+@pytest.mark.parametrize('op', ['adagrad_dedup', 'adagrad_sq'])
+@pytest.mark.parametrize('w', [16, 128])
+def test_segwalk_bf16_accumulator_compiles_for_v5e(v5e, op, w):
+  """accum_dtype='bfloat16' on bf16 tables (the jumbo configuration):
+  the bf16 accumulator rides the pair-fetch path; abuf staging, the
+  f32 up-cast and the rounded store must all lower for v5e."""
+  rows, n = 1024, 2048
+
+  def fn(table, acc, sid, sg):
+    return pallas_segwalk.segwalk_apply(table, acc, sid, sg, 0.01,
+                                        op=op, eps=1e-7)
+
+  _compile_single(v5e, fn, ((rows, w), jnp.bfloat16),
+                  ((rows, w), jnp.bfloat16), ((n,), jnp.int32),
+                  ((n, w), jnp.float32))
