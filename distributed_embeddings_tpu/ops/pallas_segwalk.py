@@ -458,7 +458,8 @@ def segwalk_apply(table: jax.Array,
                   interpret: bool = False,
                   logical_width: Optional[int] = None,
                   presorted: bool = True,
-                  stream_dtype=jnp.float32):
+                  stream_dtype=jnp.float32,
+                  g_index: Optional[jax.Array] = None):
   """Apply one optimizer step from a per-occurrence update stream.
 
   Args:
@@ -493,6 +494,15 @@ def segwalk_apply(table: jax.Array,
       quantisation the optimizer sums absorb (opt-in:
       ``SparseSGD/SparseAdagrad(stream_dtype='bfloat16')``).  Exact
       for gradients already representable in bf16.
+    g_index: optional ``[n]`` int32 mapping stream position ->
+      row of a COMPACT ``sorted_g`` (``[m, w]``, one row per
+      (sample, bag) instead of per occurrence).  Multi-hot bags
+      broadcast one cotangent row to every occurrence; with
+      ``g_index`` that broadcast never materialises — the kernel
+      operand gathers straight from the compact rows, cutting the
+      dominant ``[n, 128]`` stream temp from two copies to one (plus a
+      small ``[m, 128]``).  Requires ``presorted=False`` (the sort
+      composes with the indirection as a cheap 1-D index gather).
 
   Returns:
     ``new_table`` ('sgd') or ``(new_table, new_acc)`` — in the same
@@ -535,6 +545,15 @@ def segwalk_apply(table: jax.Array,
     raise ValueError(
         f'segwalk accumulator must be f32 (or bf16 on a bf16 table), '
         f'got acc {acc.dtype} with table {table.dtype}')
+  if g_index is not None:
+    if presorted:
+      raise ValueError('g_index requires presorted=False (the sort '
+                       'composes with the indirection)')
+    if g_index.shape[0] != sorted_ids.shape[0]:
+      # jnp.take would silently CLIP a mismatched index to the last
+      # compact row — wrong gradients on real ids, not an error
+      raise ValueError(f'g_index length {g_index.shape[0]} != stream '
+                       f'length {sorted_ids.shape[0]}')
   tile = _tile_rows(pair * kw)
   n = sorted_ids.shape[0]
   # pad to whole _SMEM_BLOCKs (tile divides _SMEM_BLOCK), so the shared
@@ -543,9 +562,17 @@ def segwalk_apply(table: jax.Array,
   if n_pad != n:
     pad = n_pad - n
     sorted_ids = jnp.pad(sorted_ids, (0, pad), constant_values=num_rows)
-    sorted_g = jnp.pad(sorted_g, ((0, pad), (0, 0)))
+    if g_index is None:
+      sorted_g = jnp.pad(sorted_g, ((0, pad), (0, 0)))
+    else:
+      # padded positions carry the sentinel id: their payload rows are
+      # summed only into the sentinel segment, which the walks skip —
+      # any in-range index is safe
+      g_index = jnp.pad(g_index, (0, pad))
   sorted_ids = sorted_ids.astype(jnp.int32)
   sorted_g = sorted_g.astype(jnp.float32)
+  if g_index is not None:
+    g_index = g_index.astype(jnp.int32)
   # sort HERE (presorted=False) so the one big materialisation is the
   # dense gather of the combined block below (sentinels = num_rows
   # sort to the end); ids themselves gather 1-D, untiled, cheap
@@ -578,35 +605,55 @@ def segwalk_apply(table: jax.Array,
     raise ValueError(f'stream_dtype must be float32 or bfloat16, '
                      f'got {sdt}')
   sid1d = sorted_ids if order is None else jnp.take(sorted_ids, order)
+  # with g_index the payload gathers ONCE, straight from the compact
+  # per-bag rows into the (sorted) kernel operand: the 1-D index
+  # composition take(g_index, order) is cheap, and the broadcast-to-
+  # occurrences never materialises
+  gidx_sorted = (None if g_index is None else
+                 (g_index if order is None else jnp.take(g_index, order)))
   sideband = w < 128
   if sideband:
     # lane-iota select, not concat of a [n, 1] column: a unit-width f32
     # column materialises T(8,128)-padded at 128x (a 2 GiB temp at
     # synthetic scale), while this form is elementwise over the dense
     # [n, 128] block and fuses into its one materialisation
+    if gidx_sorted is not None:
+      # gather the small padded compact rows into SORTED stream order,
+      # then lane-select the (already sorted) ids in: one [n, 128]
+      # materialisation total
+      gsmall = jnp.pad(sorted_g.astype(sdt), ((0, 0), (0, 128 - w)))
+      gpad = jnp.take(gsmall, gidx_sorted, axis=0)
+      ids_for_lanes = sid1d
+    else:
+      gpad = jnp.pad(sorted_g.astype(sdt), ((0, 0), (0, 128 - w)))
+      ids_for_lanes = sorted_ids
     lane = jax.lax.broadcasted_iota(jnp.int32, (n_pad, 128), 1)
-    gpad = jnp.pad(sorted_g.astype(sdt), ((0, 0), (0, 128 - w)))
     if sdt == jnp.bfloat16:
       # 32-bit ids split over two raw-bits bf16 lanes: [n, 2] with
       # element 0 the low half (little-endian bitcast order — the
       # kernel reassembles lo | hi<<16, round-tripped bit-exact in
       # tests)
-      ids_bf = jax.lax.bitcast_convert_type(sorted_ids, jnp.bfloat16)
+      ids_bf = jax.lax.bitcast_convert_type(ids_for_lanes, jnp.bfloat16)
       comb = jnp.where(
           lane == w, ids_bf[:, 0:1],
           jnp.where(lane == w + 1, ids_bf[:, 1:2], gpad))
     else:
       comb = jnp.where(
           lane == w,
-          jax.lax.bitcast_convert_type(sorted_ids, jnp.float32)[:, None],
+          jax.lax.bitcast_convert_type(ids_for_lanes,
+                                       jnp.float32)[:, None],
           gpad)
-    g_operand = comb if order is None else jnp.take(comb, order, axis=0)
+    g_operand = (comb if order is None or gidx_sorted is not None
+                 else jnp.take(comb, order, axis=0))
     idv_operand = jnp.zeros((1, 1), jnp.int32)  # statically never read
   else:
     # convert BEFORE the gather so its output buffer is already
     # sdt-sized (half the bytes for a bf16 stream)
     gs = sorted_g.astype(sdt)
-    g_operand = gs if order is None else jnp.take(gs, order, axis=0)
+    if gidx_sorted is not None:
+      g_operand = jnp.take(gs, gidx_sorted, axis=0)
+    else:
+      g_operand = gs if order is None else jnp.take(gs, order, axis=0)
     idv_operand = sid1d[:, None]
   # fetch-unit ids for the global segment-last flags (the one lookahead
   # the kernel cannot do): adjacent uids sharing a packed row (or bf16

@@ -661,12 +661,15 @@ def _use_segwalk(optimizer, table) -> bool:
 
 
 def _segwalk_apply(optimizer, table, state, flat_ids, flat_g, lr,
-                   storage_pack: int = 1):
+                   storage_pack: int = 1, g_index=None):
   """Sort the raw stream and hand it to the fused segment-walk kernel
   (ops/pallas_segwalk.py) — no compaction, no capacity, no correction
   wave: every segment is applied exactly once.  ``storage_pack > 1``:
   the table arrives (and returns) in the physical packed layout; the
-  kernel runs its packed path on the operand itself."""
+  kernel runs its packed path on the operand itself.  ``g_index``:
+  ``flat_g`` holds COMPACT per-(sample, bag) rows and ``g_index`` maps
+  each stream position to its row — the multi-hot broadcast never
+  materialises (pallas_segwalk.segwalk_apply docstring)."""
   from distributed_embeddings_tpu.ops import pallas_segwalk
   interp = pallas_segwalk.FORCE_INTERPRET
   lw = flat_g.shape[1] if storage_pack > 1 else None
@@ -680,13 +683,14 @@ def _segwalk_apply(optimizer, table, state, flat_ids, flat_g, lr,
   if isinstance(optimizer, SparseSGD):
     t2 = pallas_segwalk.segwalk_apply(
         table, None, ids, g, lr, op='sgd', interpret=interp,
-        logical_width=lw, presorted=False, stream_dtype=sdt)
+        logical_width=lw, presorted=False, stream_dtype=sdt,
+        g_index=g_index)
     return t2, state
   op = 'adagrad_dedup' if optimizer.dedup else 'adagrad_sq'
   t2, a2 = pallas_segwalk.segwalk_apply(
       table, state['acc'], ids, g, lr, op=op, eps=optimizer.epsilon,
       interpret=interp, logical_width=lw, presorted=False,
-      stream_dtype=sdt)
+      stream_dtype=sdt, g_index=g_index)
   return t2, {'acc': a2}
 
 
@@ -706,9 +710,10 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
     new_state = dict(opt_state)
     fence = lr  # serialisation token threaded through the group applies
     for gi, group in enumerate(dist.plan.groups):
-      ids_list, grad_list = [], []
+      ids_list, grad_list, gidx_list = [], [], []
       rows_cap = group.rows_cap
       w = group.width
+      row_off = 0
       for si, sub in enumerate(subs):
         if sub.gi != gi:
           continue
@@ -720,15 +725,34 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
         # mean_row_sliced: the cotangent arrives pre-divided by the TRUE
         # per-sample count (make_hybrid_train_step), and the shard-local
         # count here would be the window count - no division
-        pos_g = jnp.broadcast_to(gg[:, :, None, :], ids.shape + (w,))
+        # Multi-hot bags broadcast ONE cotangent row to every
+        # occurrence: keep the compact [n_cap*GB, w] rows plus an [n]
+        # position->row index instead of materialising the h-fold
+        # broadcast (the 12.6 GiB-class stream temps of the jumbo
+        # memory audit); the segwalk path consumes the indirection
+        # natively, the XLA paths gather it back below
+        n_cap, gb, h = ids.shape
         ids_list.append(ids.reshape(-1))
-        grad_list.append(pos_g.reshape(-1, w))
+        grad_list.append(gg.reshape(-1, w))
+        gidx_list.append(
+            row_off + jnp.repeat(jnp.arange(n_cap * gb, dtype=jnp.int32),
+                                 h))
+        row_off += n_cap * gb
       if not ids_list:
         continue
       flat_ids = jnp.concatenate(ids_list) if len(ids_list) > 1 \
           else ids_list[0]
-      flat_g = jnp.concatenate(grad_list) if len(grad_list) > 1 \
+      g_rows = jnp.concatenate(grad_list) if len(grad_list) > 1 \
           else grad_list[0]
+      if row_off == flat_ids.shape[0]:
+        # every slot is hotness-1: the position->row map is the
+        # identity, so the compact rows ARE the stream — skip the
+        # indirection (it would only add a pointless [m, 128] pad
+        # materialisation, measured +0.18 GiB on tiny)
+        g_idx = None
+      else:
+        g_idx = jnp.concatenate(gidx_list) if len(gidx_list) > 1 \
+            else gidx_list[0]
       key = f'group_{gi}'
       # serialise the per-group applies: without a data dependency XLA may
       # schedule every group's sort/gather/scatter pipeline concurrently,
@@ -747,7 +771,12 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
       if caps is not None and gi < len(caps):
         cap_rows = caps[gi]
       flat_sq = None
+      flat_g = None  # materialised lazily: only the XLA paths need the
+      #                per-occurrence stream; segwalk consumes (g_rows,
+      #                g_idx) without ever broadcasting the bags
       if dist.num_slices > 1:
+        flat_g = (g_rows if g_idx is None
+                  else jnp.take(g_rows, g_idx, axis=0))
         # Cross-slice update exchange — the DP-gradient step for the
         # slice-REPLICATED table shards (each slice computed updates
         # from its own sub-batch; every replica must apply them all,
@@ -784,11 +813,22 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
       if flat_sq is None and _use_segwalk(optimizer, params[key][0]):
         # fused segment-walk path (flat_sq present means the stream
         # carries pre-accumulated squares the kernel cannot consume —
-        # multi-slice per-occurrence Adagrad falls back to XLA)
-        table, state2 = _segwalk_apply(optimizer, params[key][0],
-                                       state_g, flat_ids, flat_g, lr,
-                                       storage_pack=spack)
+        # multi-slice per-occurrence Adagrad falls back to XLA).
+        # Single-slice: hand over the compact rows + index — the
+        # kernel's one [n, 128] operand gathers straight from them
+        if flat_g is None:
+          table, state2 = _segwalk_apply(optimizer, params[key][0],
+                                         state_g, flat_ids, g_rows, lr,
+                                         storage_pack=spack,
+                                         g_index=g_idx)
+        else:  # multi-slice: the DCN exchange already compacted
+          table, state2 = _segwalk_apply(optimizer, params[key][0],
+                                         state_g, flat_ids, flat_g, lr,
+                                         storage_pack=spack)
       else:
+        if flat_g is None:
+          flat_g = (g_rows if g_idx is None
+                    else jnp.take(g_rows, g_idx, axis=0))
         table, state2 = _dedup_and_apply(optimizer, params[key][0],
                                          state_g, flat_ids, flat_g, lr,
                                          rows_cap, cap_rows=cap_rows,

@@ -51,8 +51,15 @@ MLP_MS = {'tiny': 2.0, 'small': 4.0}  # measured fwd+bwd head cost, tiny
 # segwalk-apply pricing (the round-3/4 kernel; docs/perf_notes.md):
 SORT_NS = 5.0               # argsort of the raw id stream
 HBM_BYTES_PER_S = 819e9     # v5e HBM bandwidth (stream passes)
-STREAM_PASSES = 4           # comb write + sorted-gather read/write +
-                            # kernel sequential read
+# segwalk stream passes, per group (round 5, g_index): groups with
+# multi-hot slots gather the comb straight from the compact per-bag
+# rows — write + kernel read of the one live [n, 128] copy + slack for
+# the padded compact-row materialisation = 3 passes (measured: one
+# fewer full copy at jumbo, 25.9 -> 19.1 GiB temps); pure hotness-1
+# groups take the identity shortcut and keep the round-4 pipeline
+# (comb write + sorted-gather read/write + kernel read = 4)
+STREAM_PASSES_MULTIHOT = 3
+STREAM_PASSES_H1 = 4
 DMA_ISSUE_NS = 47.0         # measured scalar-core DMA issue floor
 DMA_PER_UNIQUE = 4          # table r/w + acc r/w per unique packed row
 
@@ -140,7 +147,9 @@ def analyze(name: str, world: int, batch: int, row_slice=None,
           per_dev[dev]['out_bytes'] += batch * g.width * 4
       per_dev[dev]['groups'].append(
           dict(stream=gstream, rows=g.rows[dev], pack=pack,
-               width=g.width))
+               width=g.width,
+               multihot=any(hot_of[r.input_id] > 1
+                            for r in g.requests[dev])))
   off_chip = (D - 1) / D if D > 1 else 0.0
   worst = max(per_dev, key=lambda d: d['lookup'] + d['stream'])
   unique_bound = min(worst['stream'], worst['rows'])
@@ -149,8 +158,11 @@ def analyze(name: str, world: int, batch: int, row_slice=None,
     # sort + STREAM_PASSES sequential passes over the dense [*, 128]
     # stream + the kernel's random DMAs, one set per unique PACKED row
     compact_ms = worst['stream'] * hw['sort_ns'] * 1e-6
-    stream_bytes = worst['stream'] * 128 * stream_bytes_per_elem
-    compact_ms += (stream_bytes * STREAM_PASSES / hw['hbm_Bps']) * 1e3
+    stream_pass_bytes = sum(
+        gr['stream'] * 128 * stream_bytes_per_elem *
+        (STREAM_PASSES_MULTIHOT if gr['multihot'] else STREAM_PASSES_H1)
+        for gr in worst['groups'])
+    compact_ms += (stream_pass_bytes / hw['hbm_Bps']) * 1e3
     uniq_packed = sum(
         min(gr['stream'], -(-gr['rows'] // gr['pack']))
         for gr in worst['groups'])

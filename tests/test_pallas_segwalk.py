@@ -597,3 +597,54 @@ def test_bf16_accumulator_on_f32_table_rejected():
     pallas_segwalk.segwalk_apply(t, a, jnp.zeros((8,), jnp.int32),
                                  jnp.zeros((8, 128), jnp.float32), LR,
                                  op='adagrad_dedup', interpret=True)
+
+
+# ------------------------------------------------------ g_index stream
+# Multi-hot bags broadcast one cotangent row per occurrence; g_index
+# hands the kernel the compact per-bag rows + a position->row map so
+# the broadcast never materialises (round 5: the 12.6 GiB-class jumbo
+# stream temps).  Semantics must be EXACTLY the materialised stream's.
+
+
+@pytest.mark.parametrize('op', ['sgd', 'adagrad_dedup', 'adagrad_sq'])
+@pytest.mark.parametrize('width,dtype', [(16, np.float32), (128, np.float32),
+                                         (16, 'bf16'), (128, 'bf16')])
+def test_g_index_matches_materialized_stream(op, width, dtype):
+  import zlib
+  rng = np.random.default_rng(zlib.crc32(f'gidx-{op}-{width}-{dtype}'.encode()))
+  rows, m, h = 64, 200, 5   # m bags of h occurrences: n = 1000
+  bf16 = dtype == 'bf16'
+  table32 = rng.normal(size=(rows, width)).astype(np.float32)
+  table = jnp.asarray(table32, jnp.bfloat16 if bf16 else jnp.float32)
+  acc = None if op == 'sgd' else jnp.asarray(
+      rng.uniform(0.05, 0.2, size=(rows, width)).astype(np.float32))
+  ids = rng.integers(0, rows, m * h).astype(np.int32)
+  ids[rng.random(m * h) < 0.15] = rows  # sentinels
+  g_rows = rng.normal(size=(m, width)).astype(np.float32)
+  g_idx = np.repeat(np.arange(m, dtype=np.int32), h)
+  flat_g = g_rows[g_idx]
+
+  def run(**kw):
+    out = pallas_segwalk.segwalk_apply(
+        table, acc, jnp.asarray(ids), lr=LR, op=op, eps=EPS,
+        interpret=True, presorted=False, **kw)
+    return out if op == 'sgd' else out[0], (None if op == 'sgd'
+                                            else out[1])
+
+  t_mat, a_mat = run(sorted_g=jnp.asarray(flat_g))
+  t_idx, a_idx = run(sorted_g=jnp.asarray(g_rows),
+                     g_index=jnp.asarray(g_idx))
+  np.testing.assert_array_equal(np.asarray(t_idx, np.float32),
+                                np.asarray(t_mat, np.float32))
+  if a_mat is not None:
+    np.testing.assert_array_equal(np.asarray(a_idx, np.float32),
+                                  np.asarray(a_mat, np.float32))
+
+
+def test_g_index_requires_unsorted_entry():
+  t = jnp.zeros((32, 128), jnp.float32)
+  with pytest.raises(ValueError, match='presorted'):
+    pallas_segwalk.segwalk_apply(
+        t, None, jnp.zeros((8,), jnp.int32), jnp.zeros((4, 128)),
+        0.1, op='sgd', interpret=True, presorted=True,
+        g_index=jnp.zeros((8,), jnp.int32))
