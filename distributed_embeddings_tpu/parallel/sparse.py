@@ -52,7 +52,8 @@ def compact_segments(ids: jax.Array,
                      cap: int,
                      sentinel: int,
                      with_sq: bool = False,
-                     order: Optional[jax.Array] = None):
+                     order: Optional[jax.Array] = None,
+                     g_index: Optional[jax.Array] = None):
   """Sort-dedup and COMPACT segment sums into static capacity ``cap``.
 
   The key fact motivating this (measured on v5e, docs/perf_notes.md):
@@ -82,6 +83,11 @@ def compact_segments(ids: jax.Array,
       per-occurrence Adagrad accumulator semantics).
     order: optional precomputed ``argsort(ids)`` (lets callers share the
       sort with an overflow pre-check).
+    g_index: optional ``[n]`` int32 position->row map into COMPACT
+      ``grads`` (``[m, w]``, one row per (sample, bag)): multi-hot
+      broadcasts never materialise — the sorted payload gathers
+      straight from the compact rows (same contract as
+      ``pallas_segwalk.segwalk_apply``).
 
   Returns:
     ``(uids[c], sum_g[c, w], sum_sq[c, w] | None, num_unique)`` with
@@ -90,10 +96,14 @@ def compact_segments(ids: jax.Array,
     the sentinel segment).
   """
   n = ids.shape[0]
+  if g_index is not None and g_index.shape[0] != n:
+    raise ValueError(f'g_index length {g_index.shape[0]} != stream '
+                     f'length {n}')  # jnp.take would silently clip
   if order is None:
     order = jnp.argsort(ids)
   sid = ids[order]
-  sg = grads[order].astype(jnp.float32)
+  sg = (grads[order] if g_index is None else
+        grads[jnp.take(g_index, order)]).astype(jnp.float32)
   is_first, is_last, first_pos, _ = _sorted_segments(sid)
   rank = jnp.cumsum(is_first.astype(jnp.int32)) - 1
   num_unique = rank[-1] + 1
@@ -775,8 +785,6 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
       #                per-occurrence stream; segwalk consumes (g_rows,
       #                g_idx) without ever broadcasting the bags
       if dist.num_slices > 1:
-        flat_g = (g_rows if g_idx is None
-                  else jnp.take(g_rows, g_idx, axis=0))
         # Cross-slice update exchange — the DP-gradient step for the
         # slice-REPLICATED table shards (each slice computed updates
         # from its own sub-batch; every replica must apply them all,
@@ -794,7 +802,8 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
         needs_sq = bool(getattr(optimizer, 'needs_sq', True))
         pcap = _guaranteed_cap(flat_ids.shape[0], rows_cap)
         uids_s, sum_g_s, sum_sq_s, _ = compact_segments(
-            flat_ids, flat_g, pcap, rows_cap, with_sq=needs_sq)
+            flat_ids, g_rows, pcap, rows_cap, with_sq=needs_sq,
+            g_index=g_idx)
         # ONE DCN collective per group: ids ride as a bitcast f32
         # column alongside the grad (and square) payload
         packed = [

@@ -155,8 +155,9 @@ def analyze(name: str, world: int, batch: int, row_slice=None,
   unique_bound = min(worst['stream'], worst['rows'])
   lookup_ms = worst['lookup'] * hw['gather_ns'] * 1e-6
   if apply == 'segwalk':
-    # sort + STREAM_PASSES sequential passes over the dense [*, 128]
-    # stream + the kernel's random DMAs, one set per unique PACKED row
+    # sort + per-group sequential stream passes (3 with the g_index
+    # indirection, 4 on the hotness-1 shortcut) over the dense
+    # [*, 128] stream + the kernel's random DMAs per unique PACKED row
     compact_ms = worst['stream'] * hw['sort_ns'] * 1e-6
     stream_pass_bytes = sum(
         gr['stream'] * 128 * stream_bytes_per_elem *
