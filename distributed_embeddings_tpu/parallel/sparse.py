@@ -723,10 +723,26 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
       ids_list, grad_list, gidx_list = [], [], []
       rows_cap = group.rows_cap
       w = group.width
+      slots = [(si, sub) for si, sub in enumerate(subs) if sub.gi == gi]
+      if not slots:
+        continue
+      # Multi-hot bags broadcast ONE cotangent row to every occurrence.
+      # When duplication is real (n >= 2m), keep the compact
+      # [n_cap*GB, w] rows plus an [n] position->row index instead of
+      # materialising the h-fold broadcast (the 12.6 GiB-class stream
+      # temps of the jumbo memory audit); the segwalk path consumes the
+      # indirection natively, the XLA paths gather it back below.
+      # Below 2x duplication the indirection LOSES: the compact rows
+      # are a materialised array (the lazy broadcast fuses into its
+      # consumer) and w<128 rows store T(8,128) lane-padded — at m ~ n
+      # that re-buys the round-4 padding blowup (+3.3 GiB measured on
+      # medium@32) — so those groups keep the fused broadcast.
+      n_total = sum(residuals[si][0].size for si, _ in slots)
+      m_total = sum(residuals[si][0].shape[0] * residuals[si][0].shape[1]
+                    for si, _ in slots)
+      use_idx = n_total >= 2 * m_total
       row_off = 0
-      for si, sub in enumerate(subs):
-        if sub.gi != gi:
-          continue
+      for si, sub in slots:
         ids = residuals[si][0]            # [n_cap, GB, h]
         gg = gs[si][0].astype(jnp.float32)  # [n_cap, GB, w]
         if group.combiner == 'mean' and not sub.mean_row_sliced:
@@ -735,32 +751,23 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
         # mean_row_sliced: the cotangent arrives pre-divided by the TRUE
         # per-sample count (make_hybrid_train_step), and the shard-local
         # count here would be the window count - no division
-        # Multi-hot bags broadcast ONE cotangent row to every
-        # occurrence: keep the compact [n_cap*GB, w] rows plus an [n]
-        # position->row index instead of materialising the h-fold
-        # broadcast (the 12.6 GiB-class stream temps of the jumbo
-        # memory audit); the segwalk path consumes the indirection
-        # natively, the XLA paths gather it back below
         n_cap, gb, h = ids.shape
         ids_list.append(ids.reshape(-1))
-        grad_list.append(gg.reshape(-1, w))
-        gidx_list.append(
-            row_off + jnp.repeat(jnp.arange(n_cap * gb, dtype=jnp.int32),
-                                 h))
-        row_off += n_cap * gb
-      if not ids_list:
-        continue
+        if use_idx:
+          grad_list.append(gg.reshape(-1, w))
+          gidx_list.append(
+              row_off + jnp.repeat(
+                  jnp.arange(n_cap * gb, dtype=jnp.int32), h))
+          row_off += n_cap * gb
+        else:
+          pos_g = jnp.broadcast_to(gg[:, :, None, :], ids.shape + (w,))
+          grad_list.append(pos_g.reshape(-1, w))
       flat_ids = jnp.concatenate(ids_list) if len(ids_list) > 1 \
           else ids_list[0]
       g_rows = jnp.concatenate(grad_list) if len(grad_list) > 1 \
           else grad_list[0]
-      if row_off == flat_ids.shape[0]:
-        # every slot is hotness-1: the position->row map is the
-        # identity, so the compact rows ARE the stream — skip the
-        # indirection (it would only add a pointless [m, 128] pad
-        # materialisation, measured +0.18 GiB on tiny)
-        g_idx = None
-      else:
+      g_idx = None
+      if use_idx:
         g_idx = jnp.concatenate(gidx_list) if len(gidx_list) > 1 \
             else gidx_list[0]
       key = f'group_{gi}'

@@ -58,10 +58,13 @@ def main():
                  'rows across chips when column slicing alone cannot)')
   p.add_argument('--column_slice', default=None,
                  help="element threshold for column slicing, or "
-                 "'balance' = total_elems/chips: without it a single "
-                 "100M-row table lands whole on one chip and capacity "
-                 "padding bloats every other chip to match (medium+ "
-                 "models at multi-chip)")
+                 "'balance' = planner sweep picking the threshold with "
+                 "the least per-chip capacity padding (total/chips "
+                 "alone is too coarse: it left medium@32 at 16.3 GiB "
+                 "of args vs 10.0 at total/256, round 5).  Without "
+                 "any threshold a single 100M-row table lands whole "
+                 "on one chip and capacity padding bloats every other "
+                 "chip to match (medium+ models at multi-chip)")
   p.add_argument('--topology', default='v5e:2x2',
                  help='compile-only topology (chips must divide it)')
   p.add_argument('--compiler_option', action='append', default=[],
@@ -116,9 +119,40 @@ def main():
   pdt = jnp.dtype(args.param_dtype)
   cst = args.column_slice
   if cst == 'balance':
-    tconfigs, _, _ = expand_tables(config)
-    cst = -(-sum(c.input_dim * c.output_dim for c in tconfigs)
-            // args.chips)
+    # pure-Python planner sweep (seconds): pick the threshold with the
+    # least per-chip padded memory — total/chips alone under-slices
+    # (integer table-count imbalance keeps groups ~50% filled)
+    from distributed_embeddings_tpu.parallel.planner import ShardingPlan
+    tconfigs, titm, _ = expand_tables(config)
+    total = sum(c.input_dim * c.output_dim for c in tconfigs)
+    best = None
+    for div in (args.chips, 2 * args.chips, 4 * args.chips,
+                8 * args.chips, 16 * args.chips, 32 * args.chips):
+      cand = -(-total // div)
+      try:
+        # the SAME strategy SyntheticModel builds the compiled model
+        # with — a 'basic'-plan sweep would minimise padding for a
+        # different placement than the one whose memory is reported
+        pe = ShardingPlan(tconfigs, world_size=args.chips,
+                          input_table_map=titm,
+                          strategy='memory_balanced',
+                          column_slice_threshold=cand,
+                          row_slice_threshold=args.row_slice
+                          ).padded_memory_elements()
+      except ValueError:
+        continue
+      if best is None or pe < best[0]:
+        best = (pe, cand)
+    if best is None:
+      raise SystemExit('balance sweep: every candidate threshold '
+                       f'produced an invalid plan for {args.model} at '
+                       f'{args.chips} chips — pass an explicit '
+                       '--column_slice')
+    cst = best[1]
+    bpe = jnp.dtype(args.param_dtype).itemsize
+    print(f'balance sweep: column_slice_threshold={cst} '
+          f'({best[0] * bpe / 2**30:.2f} GiB/chip padded '
+          f'{args.param_dtype})', flush=True)
   elif cst is not None:
     cst = int(cst)
   cdt = jnp.dtype(args.compute_dtype or args.param_dtype)

@@ -145,11 +145,14 @@ def analyze(name: str, world: int, batch: int, row_slice=None,
         # slot shared by all shards — charge it once, on the first shard
         if not row_sliced or r.row_start == 0:
           per_dev[dev]['out_bytes'] += batch * g.width * 4
+      # mirrors sparse.py's use_idx rule: the indirection engages only
+      # at >=2x duplication (n >= 2m); below that the fused broadcast
+      # (4-pass pipeline) is kept
+      nreq = len(g.requests[dev])
       per_dev[dev]['groups'].append(
           dict(stream=gstream, rows=g.rows[dev], pack=pack,
                width=g.width,
-               multihot=any(hot_of[r.input_id] > 1
-                            for r in g.requests[dev])))
+               multihot=nreq > 0 and gstream >= 2 * batch * nreq))
   off_chip = (D - 1) / D if D > 1 else 0.0
   worst = max(per_dev, key=lambda d: d['lookup'] + d['stream'])
   unique_bound = min(worst['stream'], worst['rows'])
