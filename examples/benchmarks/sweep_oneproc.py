@@ -123,13 +123,23 @@ def main():
       # the rowwise kernel is f32-only: a bf16 'fused' phase would
       # spend ~5 min of a tunnel window measuring its XLA fallback
       variants.append(('fused', {'use_pallas_apply': True}))
+    else:
+      # the jumbo-scale configuration: bf16 tables + bf16 accumulators
+      # + bf16 stream through the segwalk pair-fetch path (bf16 acc on
+      # f32 tables would measure the XLA fallback — bf16 models only)
+      variants.append(('segwalk-bf16acc', {'use_segwalk_apply': True,
+                                           'stream_dtype': 'bfloat16',
+                                           'accum_dtype': 'bfloat16'}))
     baseline, baseline_ndev = bench.pick_baseline(model_name, len(devices))
     for vname, flags in variants:
       label = f'{model_name}-{param_dtype}-{vname}'
       signal.alarm(args.phase_budget_s)
       try:
         need_cap = not (flags.get('use_segwalk_apply')
-                        and segwalk_serves_all_groups(dist, param_dtype))
+                        and segwalk_serves_all_groups(
+                            dist, param_dtype,
+                            accum_dtype=flags.get('accum_dtype',
+                                                  'float32')))
         emb_opt = SparseAdagrad(learning_rate=0.01,
                                 capacity_rows=(capacity_rows
                                                if need_cap else None),
@@ -156,7 +166,9 @@ def main():
         signal.alarm(0)
         note = eligibility_line(dist, param_dtype,
                                 flags.get('use_pallas_apply', False),
-                                flags.get('use_segwalk_apply', False))
+                                flags.get('use_segwalk_apply', False),
+                                accum_dtype=flags.get('accum_dtype',
+                                                      'float32'))
         emit({'phase': label, 'value': round(step_ms, 3), 'unit': 'ms/step',
               'warmup_s': round(warmup_s, 1), 'comparable': not on_cpu,
               'vs_baseline': (round(baseline / step_ms, 4)
