@@ -472,7 +472,7 @@ def _capacity(optimizer, n: int, rows_cap: int,
 
 def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
                      rows_cap: int, cap_rows: Optional[int] = None,
-                     flat_sq=None, storage_pack: int = 1):
+                     flat_sq=None, storage_pack: int = 1, g_index=None):
   """Compact duplicate update rows, then run the optimizer on the unique
   rows only.
 
@@ -488,6 +488,13 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
   slice; squares of per-slice SUMS would be wrong, so the squares travel
   as their own additive channel).  When absent, squares are computed
   from the raw stream as usual.
+
+  ``g_index``: optional ``[n]`` position->row map into COMPACT
+  ``flat_g`` (``[m, w]``; the ``compact_segments`` contract) — the
+  multi-hot broadcast never materialises, in the main wave or the
+  overflow correction's ``cond`` branch (whose temps count toward peak
+  HBM even untaken).  Mutually exclusive with ``flat_sq`` (that path's
+  stream is already per-occurrence-compacted by the DCN exchange).
 
   Scatter cost is linear in the STATIC update-row count (~110-140 ns/row
   on v5e whether or not rows are dropped — docs/perf_notes.md), so the
@@ -516,6 +523,9 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
   materialised a full accumulator copy for the branches — +4.5 GB of
   temps at synthetic-tiny scale, measured via memory_analysis.)
   """
+  if g_index is not None and flat_sq is not None:
+    raise ValueError('g_index and flat_sq are mutually exclusive (the '
+                     'pre-summed-squares stream is already compact)')
   n = flat_ids.shape[0]
   sentinel = rows_cap
   cap_safe = _guaranteed_cap(n, rows_cap)
@@ -535,7 +545,8 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
     sn = {k: (v.reshape(rows_cap, w) if v.shape == packed_shape else v)
           for k, v in state.items()}
     t2, s2 = _dedup_and_apply(optimizer, tn, sn, flat_ids, flat_g, lr,
-                              rows_cap, cap_rows=cap_rows, flat_sq=flat_sq)
+                              rows_cap, cap_rows=cap_rows, flat_sq=flat_sq,
+                              g_index=g_index)
     return t2.reshape(packed_shape), {
         k: (v.reshape(packed_shape) if v.shape == (rows_cap, w) else v)
         for k, v in s2.items()
@@ -564,7 +575,8 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
     sum_g, sum_sq = tot[:, :w], tot[:, w:]
   else:
     uids, sum_g, sum_sq, num_unique = compact_segments(
-        flat_ids, flat_g, cap, sentinel, with_sq=with_sq, order=order)
+        flat_ids, flat_g, cap, sentinel, with_sq=with_sq, order=order,
+        g_index=g_index)
   if storage_packed:
     # updates lane-pack against the physically packed operand directly
     pids, g_p, sq_p = _lane_pack(uids, sum_g, sum_sq, pack, rows_cap)
@@ -590,7 +602,8 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
     # rather than O(n) when the fused table is smaller than the stream
     t3, s3 = args
     sid = flat_ids[order]
-    sg = flat_g[order].astype(jnp.float32)
+    sg = (flat_g[order] if g_index is None else
+          flat_g[jnp.take(g_index, order)]).astype(jnp.float32)
     is_first, is_last, _, seg_total = _sorted_segments(sid)
     rank = jnp.cumsum(is_first.astype(jnp.int32)) - 1
     keep = is_last & (rank >= cap)
@@ -842,14 +855,19 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
                                          state_g, flat_ids, flat_g, lr,
                                          storage_pack=spack)
       else:
-        if flat_g is None:
-          flat_g = (g_rows if g_idx is None
-                    else jnp.take(g_rows, g_idx, axis=0))
-        table, state2 = _dedup_and_apply(optimizer, params[key][0],
-                                         state_g, flat_ids, flat_g, lr,
-                                         rows_cap, cap_rows=cap_rows,
-                                         flat_sq=flat_sq,
-                                         storage_pack=spack)
+        if flat_g is None:  # single-slice: the compact rows + index go
+          #                   straight through (g_idx None = h1 stream)
+          table, state2 = _dedup_and_apply(optimizer, params[key][0],
+                                           state_g, flat_ids, g_rows, lr,
+                                           rows_cap, cap_rows=cap_rows,
+                                           storage_pack=spack,
+                                           g_index=g_idx)
+        else:  # multi-slice: the DCN exchange already compacted
+          table, state2 = _dedup_and_apply(optimizer, params[key][0],
+                                           state_g, flat_ids, flat_g, lr,
+                                           rows_cap, cap_rows=cap_rows,
+                                           flat_sq=flat_sq,
+                                           storage_pack=spack)
       new_params[key] = table[None]
       new_state[key] = {k: v[None] for k, v in state2.items()}
       fence = table[0, 0]
