@@ -14,6 +14,7 @@ traceback, so the driver's artifact never ends up unparseable.
 """
 
 import argparse
+import calendar
 import json
 import os
 import sys
@@ -112,6 +113,31 @@ def repo_sha():
 CHIP_LINES = '/tmp/tpu_bench_lines.jsonl'
 
 
+def split_windows(steps: int, windows: int):
+  """Partition ``steps`` into ``windows`` contiguous measurement windows
+  (the first windows absorb the remainder), at least one step each.
+
+  The official number is the MIN over window means: a loaded driver
+  host (the bench shares it with sweeps and compiles) inflates wall
+  time in bursts, and a single long window averages the burst in —
+  printing a phantom regression (VERDICT.md round 5, weak #1).  The
+  min of several windows is the standard noise-robust estimator; the
+  per-window list and the host loadavg are journaled alongside so a
+  suspicious artifact line carries its own evidence."""
+  windows = max(1, min(int(windows), int(steps)))
+  base, rem = divmod(int(steps), windows)
+  return [base + (1 if i < rem else 0) for i in range(windows)]
+
+
+def host_load():
+  """1/5/15-minute load averages of the bench host, for the artifact;
+  None where the platform has no getloadavg."""
+  try:
+    return [round(x, 2) for x in os.getloadavg()]
+  except (AttributeError, OSError):
+    return None
+
+
 def chip_evidence(max_age_h: float = 14.0):
   """Most recent ON-CHIP bench line recorded by a sweep window this round
   (appended by emit() whenever a TPU measurement lands).  Folded into the
@@ -128,8 +154,13 @@ def chip_evidence(max_age_h: float = 14.0):
   now = time.time()
   for line in reversed(lines):
     try:
-      rec = time.mktime(time.strptime(line.get('recorded_at', ''),
-                                      '%Y-%m-%dT%H:%M:%SZ')) - time.timezone
+      # recorded_at is UTC: timegm is its exact inverse.  The previous
+      # mktime(...) - time.timezone dance mis-converts in DST locales
+      # (mktime interprets the struct as LOCAL time including DST while
+      # time.timezone is the non-DST offset), shifting the freshness
+      # cutoff by an hour (ADVICE.md round 5, low #1).
+      rec = calendar.timegm(time.strptime(line.get('recorded_at', ''),
+                                          '%Y-%m-%dT%H:%M:%SZ'))
     except (ValueError, TypeError):
       continue
     if now - rec <= max_age_h * 3600:
@@ -178,6 +209,12 @@ def main():
                       help='opt into the fused segment-walk apply '
                       '(ops/pallas_segwalk.py): sorted raw stream in, '
                       'no compaction pipeline')
+  parser.add_argument('--sparsecore_apply', action='store_true',
+                      help='opt into the SparseCore grad+optimizer '
+                      'apply (parallel/sparsecore.py): the update '
+                      'stream executes through the static-CSR buffers '
+                      '— real custom call on SC hardware, executable '
+                      'emulation elsewhere (docs/design.md §8)')
   parser.add_argument('--stream_dtype', default='float32',
                       choices=['float32', 'bfloat16'],
                       help='segwalk update-stream payload dtype '
@@ -208,6 +245,19 @@ def main():
                       'blowup), off for the CPU fallback (no lane padding '
                       'to avoid; the mask+fold lane-select alone cost '
                       '~2.5x on the r04 CPU artifact line)')
+  parser.add_argument('--lookup_impl', default='auto',
+                      choices=['auto', 'xla', 'pallas', 'sparsecore'],
+                      help='embedding lookup dispatch; sparsecore runs '
+                      'the docs/design.md §8 path (mod-sharded plan + '
+                      'static CSR), through the executable emulation on '
+                      'TensorCore/CPU backends — the artifact line is '
+                      'labelled with the resolved backend so an '
+                      'emulation number can never read as SC hardware')
+  parser.add_argument('--measure_windows', type=int, default=3,
+                      help='min-of-k measurement: split --steps into k '
+                      'windows and report the fastest window, immunising '
+                      'the official number against driver-host load '
+                      'bursts (per-window times + loadavg are journaled)')
   parser.add_argument('--auto_capacity',
                       action=argparse.BooleanOptionalAction, default=True,
                       help='calibrate per-group compaction capacities from '
@@ -245,6 +295,7 @@ def main():
       })
       return
   import jax.numpy as jnp
+  import numpy as np
   import optax
   from distributed_embeddings_tpu.models.synthetic import (SYNTHETIC_MODELS,
                                                            InputGenerator,
@@ -265,11 +316,21 @@ def main():
                          row_slice=args.row_slice,
                          param_dtype=jnp.dtype(args.param_dtype),
                          compute_dtype=compute_dtype,
-                         packed_storage=args.packed_storage)
+                         packed_storage=args.packed_storage,
+                         lookup_impl=args.lookup_impl)
+  if args.lookup_impl == 'sparsecore' or args.sparsecore_apply:
+    # Resolve the SC backend BEFORE any compile or measurement work: on
+    # a TPU without jax-tpu-embedding this raises the §8 contract error
+    # immediately (a labelled failure artifact), instead of burning the
+    # full warmup+measure run and crashing at metric-build time — and
+    # instead of a bf16/wide config silently measuring the XLA fallback
+    # under a sparsecore label (every group can decline the SC gate).
+    sc_backend = model.dist_embedding._resolve_sc_backend()
   params = model.init(0)
 
   gen = InputGenerator(config, args.batch_size, alpha=args.alpha,
                        num_batches=2, seed=0)
+  (_, cats0), _ = gen.pool[0]  # shared by calibration + CSR measurement
 
   def loss_fn(p, batch):
     (numerical, cats), labels = batch
@@ -296,15 +357,38 @@ def main():
                                               accum_dtype=args.accum_dtype)
     if not segwalk_all:
       from distributed_embeddings_tpu.parallel import calibrate_capacity_rows
-      (_, cats0), _ = gen.pool[0]
       capacity_rows = calibrate_capacity_rows(
           model.dist_embedding, [jnp.asarray(c) for c in cats0],
           params=params['embedding'])
+  # Host-side static-CSR preprocessing cost (docs/design.md §8): the
+  # per-batch NumPy transform the real SparseCore feed pays on this
+  # host, measured so the v5p projection's "including preprocessing"
+  # term is a number, not an assumption.  Caps are CALIBRATED (with
+  # margin) from batch 0 and the timed padded build runs on batch 1,
+  # so the journaled csr_dropped is a genuine cross-batch check of the
+  # calibration, not 0 by construction.  Runs BEFORE the train loop —
+  # the first donating step invalidates `params`, which the calibration
+  # forward reads.  Never fatal to the artifact.
+  csr_stats = None
+  if args.trainer == 'sparse':
+    try:
+      from distributed_embeddings_tpu.parallel import sparsecore
+      sc_caps = sparsecore.calibrate_max_ids_per_partition(
+          model.dist_embedding, [jnp.asarray(c) for c in cats0],
+          params=params['embedding'])
+      (_, cats1), _ = gen.pool[1 % len(gen.pool)]
+      csr_stats = sparsecore.measure_preprocess_ms(
+          model.dist_embedding, [np.asarray(c) for c in cats1],
+          max_ids_per_partition=sc_caps)
+    except Exception as e:
+      csr_stats = {'csr_preprocess_error': f'{type(e).__name__}: {e}'}
+
   emb_opt = SparseAdagrad(learning_rate=0.01,
                           capacity_fraction=args.capacity_fraction,
                           capacity_rows=capacity_rows,
                           use_pallas_apply=args.fused_apply,
                           use_segwalk_apply=args.segwalk_apply,
+                          use_sparsecore_apply=args.sparsecore_apply,
                           stream_dtype=args.stream_dtype,
                           accum_dtype=args.accum_dtype)
   if args.trainer == 'sparse':
@@ -353,13 +437,21 @@ def main():
   float(loss)  # force full sync (block_until_ready is unreliable here)
   warmup_s = time.perf_counter() - warm_start
 
-  start = time.perf_counter()
-  for i in range(args.steps):
-    state, loss = step(state, pool[i % len(pool)])
-  float(loss)
-  elapsed = time.perf_counter() - start
+  # Min-of-k windows (split_windows): the fastest window is the
+  # official number; the full list + host load ride the artifact so a
+  # loaded driver host cannot print a phantom regression unnoticed.
+  window_ms = []
+  i = 0
+  for wsteps in split_windows(args.steps, args.measure_windows):
+    t0 = time.perf_counter()
+    for _ in range(wsteps):
+      state, loss = step(state, pool[i % len(pool)])
+      i += 1
+    float(loss)
+    window_ms.append((time.perf_counter() - t0) / wsteps * 1000)
 
-  step_ms = elapsed / args.steps * 1000
+  step_ms = min(window_ms)
+
   n_dev = len(devices)
   backend = devices[0].platform
   # the baselines are AT global batch 65536: a reduced-batch chip run
@@ -385,15 +477,26 @@ def main():
     # a shape proxy, not the Criteo-1TB vocabularies.
     metric += (f' [throughput {args.batch_size / (step_ms / 1000) / 1e6:.3f}'
                f'M samples/s; reference DLRM 8xA100 TF32: 9.158M]')
-  if (args.fused_apply or args.segwalk_apply) and args.trainer == 'sparse':
+  if (args.fused_apply or args.segwalk_apply
+      or args.sparsecore_apply) and args.trainer == 'sparse':
     # without this note an A/B run can silently measure the XLA
     # fallback and read as "kernel is no faster"
     from distributed_embeddings_tpu.utils.apply_eligibility import (
         eligibility_line)
-    metric += ' [' + eligibility_line(model.dist_embedding,
-                                      args.param_dtype, args.fused_apply,
-                                      args.segwalk_apply,
-                                      accum_dtype=args.accum_dtype) + ']'
+    metric += ' [' + eligibility_line(
+        model.dist_embedding, args.param_dtype, args.fused_apply,
+        args.segwalk_apply, accum_dtype=args.accum_dtype,
+        sparsecore_apply=args.sparsecore_apply) + ']'
+  if args.lookup_impl == 'sparsecore':
+    # the resolved backend AND the engaged-group count must be on the
+    # line: an emulation number must never read as SC hardware, and a
+    # run whose groups all declined the SC gate (bf16, very wide) must
+    # never read as a sparsecore measurement at all
+    from distributed_embeddings_tpu.parallel import sparsecore as sc_lib
+    plan = model.dist_embedding.plan
+    engaged = len(sc_lib.engaged_groups(plan, args.param_dtype))
+    metric += (f' [sparsecore backend: {sc_backend}; '
+               f'{engaged}/{len(plan.groups)} groups on the SC path]')
   result = {
       'metric': metric,
       'value': round(step_ms, 3),
@@ -408,16 +511,27 @@ def main():
       # the two-compile warmup burned (VERDICT r2 weak 6); the
       # persistent .jax_cache makes repeats drop to seconds
       'warmup_s': round(warmup_s, 1),
+      # driver-host load hardening (VERDICT r5 weak #1): every window's
+      # mean plus the host load averages, so the min-of-k headline
+      # number carries its own noise evidence
+      'window_ms': [round(w, 3) for w in window_ms],
+      'loadavg': host_load(),
       'packed_storage': args.packed_storage,
       'fast_compile': args.fast_compile,
+      'lookup_impl': args.lookup_impl,
       'sha': repo_sha(),
   }
+  if csr_stats:
+    result.update(csr_stats)
   if on_cpu:
     # a sweep window may have landed an on-chip line earlier this round;
     # carry it (labelled, with its own sha/timestamp) so the artifact is
     # not blind to hardware evidence the driver's timing missed
     _fold_prior_evidence(result)
-  emit(result, on_tpu=not on_cpu)
+  # journal as chip evidence ONLY for an actual TPU backend: `not
+  # on_cpu` would let a GPU fallback masquerade as prior on-chip TPU
+  # evidence (ADVICE.md round 5, low #2)
+  emit(result, on_tpu=devices[0].platform == 'tpu')
 
 
 class _Watchdog(BaseException):

@@ -1,0 +1,49 @@
+"""JAX version compatibility shims.
+
+The runtime targets the current `jax.shard_map` / Pallas surfaces; older
+jax releases (0.4.x) carry the same functionality under different names.
+Importing this module (the package ``__init__`` does it first) installs
+the aliases once, so every call site — runtime and tests — uses one
+spelling:
+
+- ``jax.shard_map``: moved out of ``jax.experimental.shard_map`` after
+  0.4.x; the old entry point also spells the replication check
+  ``check_rep`` where the new one says ``check_vma``.
+- ``pallas.tpu.CompilerParams``: named ``TPUCompilerParams`` in 0.4.x.
+
+Each shim applies only when the modern name is absent, so running under
+a current jax is a no-op.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def _install_shard_map():
+  if hasattr(jax, 'shard_map'):
+    return
+  from jax.experimental.shard_map import shard_map as _legacy
+
+  @functools.wraps(_legacy)
+  def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+    return _legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_vma, **kw)
+
+  jax.shard_map = shard_map
+
+
+def _install_pallas_compiler_params():
+  try:
+    from jax.experimental.pallas import tpu as pltpu
+  except ImportError:  # pallas absent: the kernels gate on import anyway
+    return
+  if not hasattr(pltpu, 'CompilerParams'):
+    if hasattr(pltpu, 'TPUCompilerParams'):
+      pltpu.CompilerParams = pltpu.TPUCompilerParams
+
+
+_install_shard_map()
+_install_pallas_compiler_params()

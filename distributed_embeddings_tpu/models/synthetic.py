@@ -238,6 +238,8 @@ class SyntheticModel:
     dp_input: data-parallel input (reference benchmark default is False).
     param_dtype / compute_dtype: storage and activation dtypes.
     packed_storage: forwarded to the planner (lane-packed narrow groups).
+    lookup_impl: forwarded to ``DistributedEmbedding`` ('sparsecore'
+      engages the mod-sharded static-CSR path of docs/design.md §8).
   """
   config: ModelConfig
   mesh: Optional[Mesh] = None
@@ -248,6 +250,7 @@ class SyntheticModel:
   param_dtype: Any = jnp.float32
   compute_dtype: Any = jnp.float32
   packed_storage: bool = True
+  lookup_impl: str = 'auto'
 
   def __post_init__(self):
     tables, input_table_map, hotness = expand_tables(self.config)
@@ -263,7 +266,8 @@ class SyntheticModel:
         mesh=self.mesh,
         param_dtype=self.param_dtype,
         compute_dtype=self.compute_dtype,
-        packed_storage=self.packed_storage)
+        packed_storage=self.packed_storage,
+        lookup_impl=self.lookup_impl)
     total_width = sum(
         tables[t].output_dim for t in input_table_map)
     if self.config.interact_stride is not None:

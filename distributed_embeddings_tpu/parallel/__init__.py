@@ -9,6 +9,7 @@ from distributed_embeddings_tpu.parallel.planner import (
     slice_table_column,
     auto_column_slice_threshold,
     apply_strategy,
+    mod_slice_rows,
 )
 from distributed_embeddings_tpu.parallel.dist_embedding import DistributedEmbedding
 from distributed_embeddings_tpu.parallel.checkpoint import (
@@ -41,4 +42,12 @@ from distributed_embeddings_tpu.parallel.sparse import (
     make_hybrid_train_step,
     init_hybrid_train_state,
     sparse_apply_updates,
+)
+from distributed_embeddings_tpu.parallel.sparsecore import (
+    StaticCsr,
+    build_csr_host,
+    csr_from_routed,
+    calibrate_max_ids_per_partition,
+    measure_preprocess_ms,
+    preprocess_batch_host,
 )

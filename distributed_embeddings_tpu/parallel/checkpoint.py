@@ -144,9 +144,11 @@ def set_weights(dist: DistributedEmbedding,
       dev = index[0].start if index[0].start is not None else 0
       chunks = []
       for lt in g.member_tables[dev]:
+        # row_stride > 1: a mod-sharded window (residue class) — numpy's
+        # strided slice extracts exactly the shard's resident rows
         chunks.append(
             np.asarray(
-                loaded[lt.table_id][lt.row_start:lt.row_end,
+                loaded[lt.table_id][lt.row_start:lt.row_end:lt.row_stride,
                                     lt.col_start:lt.col_end],
                 dtype=dist.param_dtype))
       pad_rows = g.rows_cap - g.rows[dev]
@@ -190,24 +192,25 @@ def get_weights(dist: DistributedEmbedding,
   result = []
   for tid, shards in enumerate(plan.shard_layout()):
     cfg = plan.table_configs[tid]
-    if len(shards) == 1:
-      dev, group_key, row_offset, _, _, _, _ = shards[0]
+    if len(shards) == 1 and shards[0][7] == 1:
+      dev, group_key, row_offset = shards[0][:3]
       gi = group_index[group_key]
       result.append(
           host_shards[gi][dev][row_offset:row_offset + cfg.input_dim, :])
       continue
     # paste row x column windows into the global [rows, width] canvas
-    # (covers column slicing, row slicing, and plain tables uniformly);
-    # zeros, not empty: the planner asserts the windows tile the table,
-    # but a future layout gap must read as zeros, never as uninitialised
-    # memory (ADVICE.md round 2)
+    # (covers column slicing, contiguous AND mod row slicing, and plain
+    # tables uniformly); zeros, not empty: the planner asserts the
+    # windows tile the table, but a future layout gap must read as
+    # zeros, never as uninitialised memory (ADVICE.md round 2)
     out = np.zeros((cfg.input_dim, cfg.output_dim),
                    host_shards[group_index[shards[0][1]]][0].dtype)
     for dev, group_key, row_offset, col_start, col_end, row_start, \
-        row_end in shards:
+        row_end, row_stride in shards:
       gi = group_index[group_key]
-      out[row_start:row_end, col_start:col_end] = (
-          host_shards[gi][dev][row_offset:row_offset + (row_end - row_start)])
+      span = -(-(row_end - row_start) // row_stride)
+      out[row_start:row_end:row_stride, col_start:col_end] = (
+          host_shards[gi][dev][row_offset:row_offset + span])
     result.append(out)
   return result
 
@@ -259,11 +262,11 @@ def get_optimizer_state(dist: DistributedEmbedding,
     for k in leaf_names:
       canvas = None
       for dev, group_key, row_offset, col_start, col_end, row_start, \
-          row_end in shards:
+          row_end, row_stride in shards:
         gi = group_index[group_key]
         if (gi, k) not in host:
           continue
-        span = row_end - row_start
+        span = -(-(row_end - row_start) // row_stride)
         piece = host[(gi, k)][dev][row_offset:row_offset + span]
         if canvas is None:
           shape = ((cfg.input_dim,) if piece.ndim == 1
@@ -272,9 +275,9 @@ def get_optimizer_state(dist: DistributedEmbedding,
         if piece.ndim == 1:
           # per-row leaf: identical across column slices of a row window,
           # so column shards just overwrite with the same values
-          canvas[row_start:row_end] = piece
+          canvas[row_start:row_end:row_stride] = piece
         else:
-          canvas[row_start:row_end, col_start:col_end] = piece
+          canvas[row_start:row_end:row_stride, col_start:col_end] = piece
       if canvas is not None:
         entry[k] = canvas
     result.append(entry)
@@ -312,11 +315,13 @@ def set_optimizer_state(dist: DistributedEmbedding,
           if tmpl.ndim == 3:
             chunks.append(
                 np.asarray(
-                    st[lt.row_start:lt.row_end, lt.col_start:lt.col_end],
+                    st[lt.row_start:lt.row_end:lt.row_stride,
+                       lt.col_start:lt.col_end],
                     dtype=dtype))
           else:
-            chunks.append(np.asarray(st[lt.row_start:lt.row_end],
-                                     dtype=dtype))
+            chunks.append(
+                np.asarray(st[lt.row_start:lt.row_end:lt.row_stride],
+                           dtype=dtype))
         pad_rows = g.rows_cap - g.rows[dev]
         if pad_rows or not chunks:
           pad_shape = ((pad_rows, g.width) if tmpl.ndim == 3
@@ -342,11 +347,16 @@ def set_optimizer_state(dist: DistributedEmbedding,
 def _portable(a) -> np.ndarray:
   """Canonical on-disk dtype: ``np.savez`` writes ml_dtypes arrays
   (bfloat16 tables / accumulators) as raw void bytes that load back as
-  ``V2`` and lose their dtype — up-cast them to f32 (exact: f32 is a
-  superset of bf16) so the file stays portable; ``set_weights`` /
-  ``set_optimizer_state`` cast back to the live template dtype on load."""
+  ``V2`` and lose their dtype — up-cast exactly those (kind ``'V'``
+  with no struct fields: the ml_dtypes registration) to f32 (exact: f32
+  is a superset of bf16) so the file stays portable; ``set_weights`` /
+  ``set_optimizer_state`` cast back to the live template dtype on load.
+  Every other kind passes through unchanged: numpy serialises complex,
+  string/bytes, object-free structured and bool arrays natively, and
+  the old blanket up-cast silently truncated complex extras and garbled
+  non-numeric ones (ADVICE.md round 5, low #3)."""
   a = np.asarray(a)
-  if a.dtype.kind not in 'fiub':
+  if a.dtype.kind == 'V' and a.dtype.names is None:
     return a.astype(np.float32)
   return a
 

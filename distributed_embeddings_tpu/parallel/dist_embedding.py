@@ -95,6 +95,24 @@ class DistributedEmbedding:
     param_dtype: table storage dtype (bfloat16 halves HBM; accumulation is
       always fp32).
     compute_dtype: dtype of returned activations (default ``param_dtype``).
+    lookup_impl: 'auto' (measured XLA path) | 'xla' | 'pallas' |
+      'sparsecore'.  'sparsecore' engages the docs/design.md §8 path:
+      mod-sharded windows, static-CSR preprocessing, and per-group
+      dispatch to the SC backend (see ``sparsecore_backend``), with
+      combiner=None / very-wide / non-f32 groups falling back to the
+      TensorCore paths.
+    mod_sharding: row-sliced tables shard as ``id % m`` residue classes
+      instead of contiguous windows (``ShardingPlan(mod_sharding=True)``).
+      Default: True exactly when ``lookup_impl='sparsecore'``.
+    num_sc: SparseCores per chip for the CSR partition transform
+      (v5p: 4, v6e: 2).
+    sparsecore_backend: 'auto' | 'emulate' | 'custom_call'.  'auto'
+      takes the real jax-tpu-embedding custom call on SC hardware, the
+      executable emulation on CPU/TensorCore backends, and RAISES the
+      contract error on a TPU without the library (a sparsecore
+      measurement is never silently something else);
+      'custom_call' demands the real binding; 'emulate' forces the
+      emulation anywhere.
   """
 
   def __init__(self,
@@ -109,7 +127,10 @@ class DistributedEmbedding:
                param_dtype: Any = jnp.float32,
                compute_dtype: Any = None,
                lookup_impl: str = 'auto',
-               packed_storage: bool = True):
+               packed_storage: bool = True,
+               mod_sharding: Optional[bool] = None,
+               num_sc: int = 4,
+               sparsecore_backend: str = 'auto'):
     if row_slice is not None and (isinstance(row_slice, bool)
                                   or not isinstance(row_slice,
                                                     (int, np.integer))):
@@ -119,7 +140,19 @@ class DistributedEmbedding:
     row_slice = None if row_slice is None else int(row_slice)
     if lookup_impl not in ('auto', 'xla', 'pallas', 'sparsecore'):
       raise ValueError(f'Unknown lookup_impl {lookup_impl!r}')
+    if sparsecore_backend not in ('auto', 'emulate', 'custom_call'):
+      raise ValueError(
+          f'Unknown sparsecore_backend {sparsecore_backend!r}')
     self.lookup_impl = lookup_impl
+    # SparseCore wants id%-sharded tables (docs/design.md §8); any other
+    # lookup keeps the contiguous windows the TensorCore kernels expect
+    if mod_sharding is None:
+      mod_sharding = lookup_impl == 'sparsecore'
+    self.sparsecore_backend = sparsecore_backend
+    # resolved lazily at first lookup: 'auto' needs the active platform,
+    # and resolution on a TPU without jax-tpu-embedding must raise at
+    # the same point the old stub did (the lookup), not at construction
+    self._sc_backend_resolved: Optional[str] = None
     self.mesh = mesh if mesh is not None else mesh_lib.create_mesh(
         axis_name=axis_name)
     self.axis_name = axis_name
@@ -151,8 +184,24 @@ class DistributedEmbedding:
                              input_table_map=input_table_map,
                              column_slice_threshold=column_slice_threshold,
                              row_slice_threshold=row_slice,
-                             packed_storage=packed_storage)
+                             packed_storage=packed_storage,
+                             mod_sharding=mod_sharding,
+                             num_sc=num_sc)
     self.num_inputs = len(self.plan.input_table_map)
+    if lookup_impl == 'sparsecore':
+      # per-group fallback is by design, but ZERO engaged groups means
+      # the whole layer would silently run plain TensorCore XLA under a
+      # sparsecore label — the exact masquerade this path's backend
+      # discipline forbids.  Fail at construction, actionably.
+      from distributed_embeddings_tpu.parallel import sparsecore
+      if not sparsecore.engaged_groups(self.plan, self.param_dtype):
+        raise ValueError(
+            "lookup_impl='sparsecore': no fusion group passes the "
+            "SparseCore gate (f32 tables, sum/mean combiner, width <= "
+            f"{sparsecore.SC_WIDTH_LIMIT}, natural storage) — every "
+            "lookup would silently take the TensorCore path. Use "
+            "lookup_impl='auto' for this model, or adjust "
+            "param_dtype/combiners to SC-servable settings.")
     # compiled-function cache, keyed by shape signature; lives on the
     # instance so dropping the layer frees its traced executables
     self._fn_cache: Dict[Any, Any] = {}
@@ -171,23 +220,14 @@ class DistributedEmbedding:
     mirroring the reference's own native-op vs tf.nn dispatch
     (embedding_lookup_ops.py:67-102), with the dispatch decided by
     measurement instead of availability.
+
+    'sparsecore' routes SC-servable groups through the static-CSR path
+    (parallel/sparsecore.py; docs/design.md §8) — real custom call or
+    executable emulation per ``sparsecore_backend`` — and the rest
+    through the TensorCore paths, per-group like every other seam.
     """
     from distributed_embeddings_tpu.ops import pallas_lookup
     impl = self.lookup_impl
-    if impl == 'sparsecore':
-      # Staged seam, hardware-gated: the concrete contract (mod-sharded
-      # tables behind a ShardingPlan variant, routed ids -> static CSR
-      # buffers for jax-tpu-embedding's tpu_sparse_dense_matmul custom
-      # calls, fused SC grad+optimizer RMW dispatched like
-      # use_segwalk_apply) is specified in docs/design.md §8.  This
-      # environment has neither SparseCore hardware (v5e) nor the
-      # library, so requesting it is an explicit error, never a silent
-      # TensorCore fallback.
-      raise NotImplementedError(
-          "lookup_impl='sparsecore' is a staged seam: see docs/design.md "
-          "§8 for the integration contract (requires SparseCore hardware "
-          "(v5p/v6e) and the jax-tpu-embedding custom-call surface). Use "
-          "'auto' on TensorCore-only targets.")
     hotness = routed.shape[2]
     # packed-storage groups (GroupSpec.storage_pack): table arrives as
     # the physical [rows_cap/pack, 128] view; probe support at the
@@ -195,6 +235,30 @@ class DistributedEmbedding:
     w = table.shape[1] // pack
     nat = (jax.ShapeDtypeStruct((table.shape[0] * pack, w), table.dtype)
            if pack > 1 else table)
+    if impl == 'sparsecore':
+      # The host/SPMD side of docs/design.md §8, implemented: mod-
+      # sharded plan windows route here, the routed ids turn into
+      # partition-sorted static-CSR buffers, and the buffers execute
+      # either through the real jax-tpu-embedding custom call (SC
+      # hardware; resolve_backend raises the contract error when the
+      # library is absent — never a silent substitute) or through the
+      # executable TensorCore emulation (CPU/TensorCore backends, the
+      # functional testbed).  Per-group gate like every other kernel
+      # seam: combiner=None pass-through, very-wide rows, non-f32 and
+      # lane-packed groups keep the TensorCore paths.
+      from distributed_embeddings_tpu.parallel import sparsecore
+      if pack == 1 and sparsecore.group_supported(nat, combiner, hotness):
+        backend = self._resolve_sc_backend()
+        if backend == 'custom_call':
+          csr = sparsecore.csr_from_routed(routed, table.shape[0],
+                                           self.plan.num_sc, combiner)
+          return sparsecore.custom_call_lookup(table, csr, combiner,
+                                               self.compute_dtype,
+                                               self.plan.num_sc)
+        return sparsecore.emulated_lookup(table, routed, combiner,
+                                          self.compute_dtype,
+                                          self.plan.num_sc)
+      impl = 'xla'
     ok = pallas_lookup.supported(nat, combiner, hotness)
     if impl == 'auto':
       impl = 'xla'
@@ -211,6 +275,15 @@ class DistributedEmbedding:
                                   self.compute_dtype)
     return _fused_lookup(table, routed, combiner, self.compute_dtype)
 
+  def _resolve_sc_backend(self) -> str:
+    """Resolve (once) the requested SparseCore backend against the
+    active platform; raises the §8 contract error when the real binding
+    is required but jax-tpu-embedding is absent (sparsecore.resolve_backend)."""
+    if self._sc_backend_resolved is None:
+      from distributed_embeddings_tpu.parallel import sparsecore
+      self._sc_backend_resolved = sparsecore.resolve_backend(
+          self.sparsecore_backend)
+    return self._sc_backend_resolved
 
   # ------------------------------------------------------------------ init
 
@@ -482,7 +555,10 @@ class DistributedEmbedding:
     """
     def is_row_sliced(r):
       cfg = self.table_configs[r.table_id]
-      return (r.row_start, r.row_end) != (0, cfg.input_dim)
+      # mod windows (stride > 1) are row shards even for residue 0,
+      # whose (row_start, row_end) looks like the full table
+      return (r.row_stride > 1
+              or (r.row_start, r.row_end) != (0, cfg.input_dim))
 
     subs = []
     for gi, g in enumerate(self.plan.groups):
@@ -503,12 +579,14 @@ class DistributedEmbedding:
         vocab = np.ones((self.world_size, n_cap), np.int32)
         row_lo = np.zeros((self.world_size, n_cap), np.int32)
         row_hi = np.ones((self.world_size, n_cap), np.int32)
+        row_st = np.ones((self.world_size, n_cap), np.int32)
         for dev, rs in enumerate(per_dev):
           for s, r in enumerate(rs):
             offs[dev, s] = r.row_offset
             vocab[dev, s] = self.table_configs[r.table_id].input_dim
             row_lo[dev, s] = r.row_start
             row_hi[dev, s] = r.row_end
+            row_st[dev, s] = r.row_stride
         # ---- output-side routing ----------------------------------------
         # Row-shard slots leave mp space through ONE psum_scatter per
         # input — summing the K shard partials on the way — instead of
@@ -542,6 +620,7 @@ class DistributedEmbedding:
         subs.append(_SubGroup(gi=gi, group=g, hotness=h, n_cap=n_cap,
                               requests=per_dev, offsets=offs, vocab=vocab,
                               row_lo=row_lo, row_hi=row_hi,
+                              row_stride=row_st,
                               mean_row_sliced=rsliced,
                               merge_inputs=tuple(merge_inputs),
                               merge_slot=merge_slot, out_sel=out_sel,
@@ -682,7 +761,9 @@ class DistributedEmbedding:
         routed = _route_ids(ids, jnp.asarray(sub.offsets)[me],
                             jnp.asarray(sub.vocab)[me], rows_cap,
                             jnp.asarray(sub.row_lo)[me],
-                            jnp.asarray(sub.row_hi)[me])
+                            jnp.asarray(sub.row_hi)[me],
+                            (jnp.asarray(sub.row_stride)[me]
+                             if sub.has_mod_windows else None))
         out = self._lookup(params[f'group_{sub.gi}'][0], routed,
                            sub.lookup_combiner,
                            pack=self.plan.groups[sub.gi].storage_pack)
@@ -774,7 +855,9 @@ class DistributedEmbedding:
         routed = _route_ids(ids, jnp.asarray(sub.offsets)[me],
                             jnp.asarray(sub.vocab)[me], rows_cap,
                             jnp.asarray(sub.row_lo)[me],
-                            jnp.asarray(sub.row_hi)[me])
+                            jnp.asarray(sub.row_hi)[me],
+                            (jnp.asarray(sub.row_stride)[me]
+                             if sub.has_mod_windows else None))
         out = self._lookup(params[f'group_{sub.gi}'][0], routed,
                            sub.lookup_combiner,
                            pack=self.plan.groups[sub.gi].storage_pack)
@@ -964,6 +1047,9 @@ class _SubGroup:
   vocab: np.ndarray    # [D, n_cap] per-slot FULL vocabulary sizes
   row_lo: np.ndarray   # [D, n_cap] per-slot resident row window start
   row_hi: np.ndarray   # [D, n_cap] per-slot resident row window end
+  # [D, n_cap] per-slot row window stride (mod windows > 1); None only
+  # in hand-built test fixtures predating mod sharding
+  row_stride: Optional[np.ndarray] = None
   # row shards of a mean table: lookup runs with 'sum' and the runtime
   # divides by the true per-sample id count at assembly / in the sparse
   # cotangent (see _subgroups)
@@ -979,6 +1065,13 @@ class _SubGroup:
   @property
   def lookup_combiner(self):
     return 'sum' if self.mean_row_sliced else self.group.combiner
+
+  @property
+  def has_mod_windows(self) -> bool:
+    """Any slot serving a mod (strided) row window — the routing then
+    needs the per-slot stride arrays (``_route_ids``)."""
+    return (self.row_stride is not None
+            and bool((self.row_stride > 1).any()))
 
 
 def _gather_slots(n_dev: int, n_slots: int, key_of, value_of) -> jax.Array:
@@ -1017,7 +1110,8 @@ def _valid_count(ids: jax.Array) -> jax.Array:
 def _route_ids(ids: jax.Array, offsets: jax.Array, vocab: jax.Array,
                rows_cap: int,
                row_lo: Optional[jax.Array] = None,
-               row_hi: Optional[jax.Array] = None) -> jax.Array:
+               row_hi: Optional[jax.Array] = None,
+               row_stride: Optional[jax.Array] = None) -> jax.Array:
   """Map raw slot ids into fused-table row space.
 
   ``ids``: [n_cap, GB, h] with -1 sentinel padding; ``offsets``/``vocab``:
@@ -1033,6 +1127,12 @@ def _route_ids(ids: jax.Array, offsets: jax.Array, vocab: jax.Array,
   out-of-vocab id lands on the last row and is served by exactly the tail
   shard — identical clip semantics to the unsliced table.  Full tables pass
   ``row_lo=0, row_hi=vocab`` (or None), making the window check a no-op.
+
+  ``row_stride`` (mod-sharded plans, docs/design.md §8): the slot serves
+  the residue class ``range(row_lo, row_hi, stride)`` — ids congruent to
+  ``row_lo`` modulo ``stride`` — stored densely at local row
+  ``(id - row_lo) // stride``.  ``None`` (all slots stride 1) keeps the
+  contiguous-window arithmetic with no extra per-id ops.
   """
   mask = ids >= 0
   clipped = jnp.clip(ids, 0, vocab[:, None, None] - 1)
@@ -1040,6 +1140,10 @@ def _route_ids(ids: jax.Array, offsets: jax.Array, vocab: jax.Array,
     lo = row_lo[:, None, None]
     mask = mask & (clipped >= lo) & (clipped < row_hi[:, None, None])
     clipped = clipped - lo
+    if row_stride is not None:
+      st = row_stride[:, None, None]
+      mask = mask & (clipped % st == 0)
+      clipped = clipped // st
   return jnp.where(mask, clipped + offsets[:, None, None], rows_cap)
 
 

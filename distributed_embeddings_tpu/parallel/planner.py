@@ -84,22 +84,30 @@ class TableConfig:
 @dataclasses.dataclass
 class LocalTable:
   """One (possibly column- or row-sliced, possibly slice-merged) table shard
-  placed on a device: rows ``[row_start, row_end)`` x columns
-  ``[col_start, col_end)`` of global table ``table_id``.  ``input_dim`` is
-  the SHARD's resident row count (= ``row_end - row_start``), so fused-group
+  placed on a device: rows ``range(row_start, row_end, row_stride)`` x
+  columns ``[col_start, col_end)`` of global table ``table_id``.
+  ``input_dim`` is the SHARD's resident row count, so fused-group
   row-offset arithmetic is shard-local.  A table is sliced along at most one
-  axis: column shards span all rows, row shards span all columns."""
+  axis: column shards span all rows, row shards span all columns.
+
+  ``row_stride == 1`` (contiguous windows, the TensorCore layout) makes
+  the window the familiar ``[row_start, row_end)``.  ``row_stride > 1``
+  is a MOD window (SparseCore layout, ``ShardingPlan(mod_sharding=True)``):
+  the shard serves ids congruent to ``row_start`` modulo ``row_stride``,
+  stored densely at local row ``(id - row_start) // row_stride``."""
   table_id: int
   input_dim: int
   col_start: int
   col_end: int
   row_start: int = 0
   row_end: int = -1  # set to row_start + input_dim in __post_init__
+  row_stride: int = 1
 
   def __post_init__(self):
     if self.row_end < 0:
-      self.row_end = self.row_start + self.input_dim
-    assert self.row_end - self.row_start == self.input_dim
+      self.row_end = self.row_start + self.input_dim * self.row_stride
+    assert -(-(self.row_end - self.row_start) // self.row_stride) \
+        == self.input_dim
 
   @property
   def width(self) -> int:
@@ -114,8 +122,10 @@ class Request:
   ids, adds ``row_offset`` (position of its table inside the fused group
   parameter) and produces ``width`` output columns ``[col_start, col_end)`` of
   the input's logical output.  For a ROW-sliced table the request serves only
-  ids in ``[row_start, row_end)`` (others drop to the sentinel and contribute
-  zero); requests sharing an input and column range are summed at assembly.
+  ids in ``range(row_start, row_end, row_stride)`` (others drop to the
+  sentinel and contribute zero); requests sharing an input and column range
+  are summed at assembly.  ``row_stride > 1`` marks a MOD window (SparseCore
+  sharding; see ``LocalTable``).
   """
   input_id: int
   table_id: int
@@ -127,6 +137,7 @@ class Request:
   col_end: int
   row_start: int = 0
   row_end: int = -1  # always set explicitly from the shard's LocalTable
+  row_stride: int = 1
 
   @property
   def width(self) -> int:
@@ -184,6 +195,16 @@ class GroupSpec:
   def param_width(self) -> int:
     """Physical parameter width (128 lanes for packed storage)."""
     return self.width * self.storage_pack
+
+  @property
+  def sc_padded_width(self) -> int:
+    """SC activation width contract for the hardware binding: SC lane
+    granularity is 8 (f32), not the TensorCore 128, so narrow tables pad
+    to the next multiple of 8 instead of paying the 128-lane pack tax
+    (docs/design.md §8).  Plan metadata only today — storage and the
+    emulation stay natural width; ``custom_call_lookup`` consumes this
+    when sizing the real activation buffers at binding time."""
+    return _round_up(self.width, 8)
 
 
 def _round_up(x: int, m: int) -> int:
@@ -246,6 +267,24 @@ def slice_table_row(config: TableConfig, row_slice_threshold,
   num_shards = min(num_shards, world_size, config.input_dim)
   rows_per, remainder = divmod(config.input_dim, num_shards)
   return [rows_per + (1 if i < remainder else 0) for i in range(num_shards)]
+
+
+def mod_slice_rows(config: TableConfig, row_slice_threshold,
+                   world_size: int) -> List[int]:
+  """Resident row counts of the MOD-sharded variant of ``slice_table_row``.
+
+  Same power-of-2 shard-count sizing rule, but shard ``k`` of ``m``
+  serves ids congruent to ``k`` mod ``m`` (the SparseCore table layout,
+  docs/design.md §8) instead of a contiguous window, so its count is
+  ``ceil((input_dim - k) / m)``.  Residue 0 takes the remainder rows —
+  count lists coincide with the contiguous variant's (remainder spread
+  over the first shards), only the id->shard map differs.
+  """
+  contiguous = slice_table_row(config, row_slice_threshold, world_size)
+  m = len(contiguous)
+  if m == 1:
+    return contiguous
+  return [-(-(config.input_dim - k) // m) for k in range(m)]
 
 
 def auto_column_slice_threshold(table_sizes: Sequence[int],
@@ -338,6 +377,19 @@ class ShardingPlan:
       (see ``GroupSpec.storage_pack``).  Default on; the escape hatch
       exists for A/B tests and for optimizers without lane-packed apply
       support on huge narrow groups (``SparseAdam``).
+    mod_sharding: emit MOD row windows (shard ``k`` of ``m`` serves ids
+      ``id % m == k``, stored at local row ``id // m``) instead of
+      contiguous ones for row-sliced tables — the SparseCore table
+      layout (docs/design.md §8).  Composed with the per-device SC tile
+      split (``num_sc``) this realises the ``id % (num_chips * num_sc)``
+      partitioning as a mixed-radix decomposition: device = id % D,
+      SC tile = (id // D) % num_sc.  Mod plans pad ``rows_cap`` to
+      multiples of 8 only (SC lane granularity) and always store
+      NATURAL width (``storage_pack == 1``): the lane-pack tax is a
+      TensorCore remedy the SC path never needs.
+    num_sc: emulated/physical SparseCores per chip (v5p: 4, v6e: 2);
+      metadata consumed by the CSR partition transform
+      (parallel/sparsecore.py), not by placement.
   """
 
   def __init__(self,
@@ -347,7 +399,9 @@ class ShardingPlan:
                input_table_map: Optional[Sequence[int]] = None,
                column_slice_threshold: Optional[int] = None,
                row_slice_threshold: Optional[int] = None,
-               packed_storage: bool = True):
+               packed_storage: bool = True,
+               mod_sharding: bool = False,
+               num_sc: int = 4):
     if strategy not in ('basic', 'memory_balanced', 'memory_optimized'):
       raise ValueError(f'Unsupported shard strategy {strategy}')
     # Single-process case may skip collectives; mirror the reference's
@@ -368,13 +422,21 @@ class ShardingPlan:
         raise ValueError(f'{name} must be positive, got {thr}')
     self.column_slice_threshold = column_slice_threshold
     self.row_slice_threshold = row_slice_threshold
-    self.packed_storage = bool(packed_storage)
+    self.mod_sharding = bool(mod_sharding)
+    if num_sc <= 0:
+      raise ValueError(f'num_sc must be positive, got {num_sc}')
+    self.num_sc = int(num_sc)
+    # mod plans never lane-pack: SC padding granularity is 8, and the
+    # natural layout is what both the emulation backend and the hardware
+    # binding consume
+    self.packed_storage = bool(packed_storage) and not self.mod_sharding
 
     # --- 1a. row slicing (beyond the reference; see slice_table_row) -----
     # A qualifying table is sliced along rows only (its shards span every
     # column); all other tables go through column slicing below.
     self.row_slice_rows: List[List[int]] = [
-        slice_table_row(c, row_slice_threshold, world_size)
+        (mod_slice_rows if self.mod_sharding else slice_table_row)(
+            c, row_slice_threshold, world_size)
         for c in self.table_configs
     ]
     self.row_sliced: List[bool] = [
@@ -447,6 +509,26 @@ class ShardingPlan:
       for pos in placed[dev]:
         tid = flat_ids[pos]
         if self.row_sliced[tid]:
+          if self.mod_sharding:
+            # claim the next residue class: shard k of m serves ids
+            # id % m == k.  Two residues are never one strided window,
+            # so mod shards do not merge — a device claiming several
+            # residues holds them as separate LocalTables (their partial
+            # outputs sum at assembly like any row shards).
+            k = next_slice_of_table[tid]
+            rows = self.row_slice_rows[tid][k]
+            next_slice_of_table[tid] += 1
+            m = len(self.row_slice_rows[tid])
+            lt = LocalTable(table_id=tid,
+                            input_dim=rows,
+                            col_start=0,
+                            col_end=self.table_configs[tid].output_dim,
+                            row_start=k,
+                            row_end=self.table_configs[tid].input_dim,
+                            row_stride=m)
+            self.local_tables[dev].append(lt)
+            self.table_shards[tid].append((dev, lt))
+            continue
           # claim the next row window; same-device contiguous windows merge
           rows = self.row_slice_rows[tid][next_slice_of_table[tid]]
           next_slice_of_table[tid] += 1
@@ -537,22 +619,31 @@ class ShardingPlan:
                         col_start=lt.col_start,
                         col_end=lt.col_end,
                         row_start=lt.row_start,
-                        row_end=lt.row_end))
+                        row_end=lt.row_end,
+                        row_stride=lt.row_stride))
           row_offset += lt.input_dim
         rows.append(row_offset)
         reqs.append(dev_reqs)
-      # sub-128 widths (8..64) need rows_cap divisible by the Pallas pack
-      # factor 128//width — DOUBLED for the bf16 pair fetch, so bf16
-      # tables qualify too (ops/pallas_lookup.py:supported); widths < 8
-      # always take the XLA fallback, so only sublane alignment applies
-      gran = max(8, 2 * (128 // width)) if (width >= 8
-                                            and 128 % width == 0) else 8
+      if self.mod_sharding:
+        # SparseCore padding: rows align to the sublane granularity 8
+        # only, and storage stays natural width — SC's lane granularity
+        # is 8 (GroupSpec.sc_padded_width), so narrow tables never pay
+        # the 128-lane pack tax here (docs/design.md §8)
+        gran = 8
+      else:
+        # sub-128 widths (8..64) need rows_cap divisible by the Pallas
+        # pack factor 128//width — DOUBLED for the bf16 pair fetch, so
+        # bf16 tables qualify too (ops/pallas_lookup.py:supported);
+        # widths < 8 always take the XLA fallback, so only sublane
+        # alignment applies
+        gran = max(8, 2 * (128 // width)) if (width >= 8
+                                              and 128 % width == 0) else 8
       rows_cap = max(gran, _round_up(max(rows), gran))
       # packed storage qualifies exactly where the kernels' lane packing
       # does: width 8..64 dividing 128 (gran guarantees rows_cap
       # divisibility by 2*pack); widths < 8 or non-divisors stay natural
       pack = 1
-      if packed_storage and 8 <= width < 128 and 128 % width == 0:
+      if self.packed_storage and 8 <= width < 128 and 128 % width == 0:
         pack = 128 // width
         assert rows_cap % pack == 0, (rows_cap, width)
       spec = GroupSpec(key=key,
@@ -573,7 +664,8 @@ class ShardingPlan:
     # Output slices of each input arrive in device order.  Distinct column
     # ranges must tile [0, output_dim) exactly; requests SHARING a column
     # range are row shards whose outputs sum at assembly, and their row
-    # windows must partition [0, input_dim) exactly.
+    # windows must partition [0, input_dim) exactly — contiguously
+    # (stride 1) or as a complete residue system (mod windows).
     for inp, rs in enumerate(self.input_requests):
       rs.sort(key=lambda r: (r.col_start, r.row_start))
       cfg = self.table_configs[self.input_table_map[inp]]
@@ -581,15 +673,28 @@ class ShardingPlan:
       i = 0
       while i < len(rs):
         j = i
-        expect_row = 0
-        while j < len(rs) and rs[j].col_start == rs[i].col_start:
-          if (rs[j].col_end != rs[i].col_end
-              or rs[j].row_start != expect_row):
+        while (j < len(rs) and rs[j].col_start == rs[i].col_start):
+          if rs[j].col_end != rs[i].col_end:
             raise AssertionError(f'input {inp}: non-tiling row shards')
-          expect_row = rs[j].row_end
           j += 1
-        if expect_row != cfg.input_dim:
-          raise AssertionError(f'input {inp}: row shards do not cover table')
+        group = rs[i:j]
+        if any(r.row_stride > 1 for r in group):
+          # mod windows: shards of one table share the stride m and
+          # their residues must be exactly {0, .., m-1}
+          m = group[0].row_stride
+          if (any(r.row_stride != m or r.row_end != cfg.input_dim
+                  for r in group)
+              or sorted(r.row_start for r in group) != list(range(m))):
+            raise AssertionError(f'input {inp}: incomplete mod residues')
+        else:
+          expect_row = 0
+          for r in group:
+            if r.row_start != expect_row:
+              raise AssertionError(f'input {inp}: non-tiling row shards')
+            expect_row = r.row_end
+          if expect_row != cfg.input_dim:
+            raise AssertionError(
+                f'input {inp}: row shards do not cover table')
         if rs[i].col_start != expect_col:
           raise AssertionError(f'input {inp}: non-tiling column slices')
         expect_col = rs[i].col_end
@@ -652,11 +757,15 @@ class ShardingPlan:
   def shard_layout(self):
     """Per-table physical layout: list (over tables) of shard records
     ``(device, group_key, fused_row_offset, col_start, col_end, row_start,
-    row_end)`` in (column, row) range order.  This is the
+    row_end, row_stride)`` in (column, row) range order.  This is the
     global-canonical-layout contract the checkpoint reshard path relies on
     (reference dist_model_parallel.py:452-645): shards of a table hold
-    contiguous, device-ordered column ranges (and, for row-sliced tables,
-    row ranges) of the full ``[rows, width]`` weight.
+    device-ordered column ranges and row windows of the full
+    ``[rows, width]`` weight — contiguous ``[row_start, row_end)`` ranges
+    when ``row_stride == 1``, strided residue classes
+    ``range(row_start, row_end, row_stride)`` for mod-sharded tables.
+    Checkpoints stay GLOBAL canonical arrays either way, so a file saved
+    under one sharding mode restores under the other.
     """
     layout = [[] for _ in self.table_configs]
     for g in self.groups:
@@ -665,7 +774,7 @@ class ShardingPlan:
         for lt in g.member_tables[dev]:
           layout[lt.table_id].append(
               (dev, g.key, row_offset, lt.col_start, lt.col_end,
-               lt.row_start, lt.row_end))
+               lt.row_start, lt.row_end, lt.row_stride))
           row_offset += lt.input_dim
     for shards in layout:
       shards.sort(key=lambda s: (s[3], s[5]))
@@ -687,7 +796,8 @@ class ShardingPlan:
     """Human-readable plan summary."""
     lines = [
         f'ShardingPlan: {len(self.table_configs)} tables '
-        f'({sum(self.row_sliced)} row-sliced), '
+        f'({sum(self.row_sliced)} row-sliced'
+        f'{", mod windows" if self.mod_sharding else ""}), '
         f'{len(self.input_table_map)} inputs, world_size={self.world_size}, '
         f'strategy={self.strategy}'
     ]

@@ -210,9 +210,20 @@ class SparseSGD:
   # pair are the binding temps at pod scale — docs/perf_notes.md);
   # gradients round to bf16 once before the f32 segment summation
   stream_dtype: str = 'float32'
+  # opt-in SparseCore grad+optimizer apply (parallel/sparsecore.py,
+  # docs/design.md §8): the update stream executes through the
+  # partition-sorted static-CSR buffers — the real
+  # tpu_sparse_dense_matmul_grad_with_sgd custom call on SC hardware,
+  # the executable XLA emulation elsewhere.  Dispatched per group
+  # exactly like use_segwalk_apply (natural-storage f32 groups up to
+  # SC_WIDTH_LIMIT; others keep the XLA/segwalk paths); takes
+  # precedence over use_segwalk_apply where both engage.
+  use_sparsecore_apply: bool = False
 
   needs_sq = False
   supports_lane_packing = True
+  # capability tag for the SC grad custom calls (sparsecore.apply_supported)
+  sc_apply_kind = 'sgd'
 
   def init(self, dist: DistributedEmbedding, params) -> Dict:
     return {f'group_{gi}': {} for gi in range(len(dist.plan.groups))}
@@ -276,8 +287,15 @@ class SparseAdagrad:
   stream_dtype: str = 'float32'
   # accumulator STORAGE dtype ('float32' | 'bfloat16'); see class docstring
   accum_dtype: str = 'float32'
+  # opt-in SparseCore grad+optimizer apply (see SparseSGD): emulates /
+  # binds tpu_sparse_dense_matmul_grad_with_adagrad per group; both
+  # dedup (reference) and per-occurrence-squares semantics ride the
+  # same CSR buffers (the squares are a second segment-sum payload)
+  use_sparsecore_apply: bool = False
 
   supports_lane_packing = True
+  # capability tag for the SC grad custom calls (sparsecore.apply_supported)
+  sc_apply_kind = 'adagrad'
 
   @property
   def needs_sq(self):
@@ -664,6 +682,41 @@ def packed_view_ok(rows_cap: int, width: int) -> bool:
           and packed_dispatch_ok(rows_cap, width))
 
 
+def _use_sparsecore(optimizer, dist, table, storage_pack: int) -> bool:
+  """Whether the SparseCore grad+optimizer path serves this group's
+  apply — dispatched exactly like ``use_segwalk_apply``: the opt-in
+  flag plus the per-group support gate (natural-storage f32 groups up
+  to ``SC_WIDTH_LIMIT``; SGD/Adagrad RMW).  Resolving the layer's
+  backend may raise the docs/design.md §8 contract error: an explicit
+  ``use_sparsecore_apply=True`` on a TPU without jax-tpu-embedding is
+  an error, never a silent XLA substitute."""
+  if not getattr(optimizer, 'use_sparsecore_apply', False):
+    return False
+  from distributed_embeddings_tpu.parallel import sparsecore
+  if not sparsecore.apply_supported(optimizer, table, storage_pack):
+    return False
+  dist._resolve_sc_backend()
+  return True
+
+
+def _sc_apply(optimizer, dist, table, state, flat_ids, flat_g, lr,
+              g_index=None):
+  """Route one group's apply through the SparseCore path: the real
+  fused grad custom call when the layer resolved to it, else the
+  executable emulation (``sparsecore.sc_grad_apply``)."""
+  from distributed_embeddings_tpu.parallel import sparsecore
+  num_sc = getattr(dist.plan, 'num_sc', 4)
+  if dist._resolve_sc_backend() == 'custom_call':
+    n = flat_ids.shape[0]
+    csr = sparsecore.csr_from_routed(flat_ids.reshape(1, n, 1),
+                                     table.shape[0], num_sc, 'sum')
+    return sparsecore.custom_call_grad_apply(optimizer, table, state, csr,
+                                             flat_g, lr, num_sc,
+                                             g_index=g_index)
+  return sparsecore.sc_grad_apply(optimizer, table, state, flat_ids,
+                                  flat_g, lr, num_sc, g_index=g_index)
+
+
 def _use_segwalk(optimizer, table) -> bool:
   """Whether the fused segment-walk kernel serves this group's apply."""
   if not getattr(optimizer, 'use_segwalk_apply', False):
@@ -839,7 +892,21 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
         if needs_sq:
           flat_sq = gathered[:, 1 + w:]
       spack = getattr(group, 'storage_pack', 1)
-      if flat_sq is None and _use_segwalk(optimizer, params[key][0]):
+      if flat_sq is None and _use_sparsecore(optimizer, dist,
+                                             params[key][0], spack):
+        # SparseCore grad+optimizer path (docs/design.md §8): the
+        # stream executes through the partition-sorted CSR buffers.
+        # flat_sq present (multi-slice per-occurrence Adagrad) means
+        # pre-accumulated squares the CSR grad op cannot consume —
+        # that case keeps the XLA path, like segwalk.
+        if flat_g is None:  # single-slice: compact rows + index
+          table, state2 = _sc_apply(optimizer, dist, params[key][0],
+                                    state_g, flat_ids, g_rows, lr,
+                                    g_index=g_idx)
+        else:  # multi-slice: the DCN exchange already compacted
+          table, state2 = _sc_apply(optimizer, dist, params[key][0],
+                                    state_g, flat_ids, flat_g, lr)
+      elif flat_sq is None and _use_segwalk(optimizer, params[key][0]):
         # fused segment-walk path (flat_sq present means the stream
         # carries pre-accumulated squares the kernel cannot consume —
         # multi-slice per-occurrence Adagrad falls back to XLA).
@@ -1034,7 +1101,12 @@ def _calibration_mirror(dist: DistributedEmbedding, cpus):
       axis_name=dist.axis_name,
       param_dtype=dist.param_dtype,
       compute_dtype=dist.compute_dtype,
-      packed_storage=dist.plan.packed_storage)
+      packed_storage=dist.plan.packed_storage,
+      # mod-sharded (SparseCore) plans route ids through residue
+      # windows; the mirror must reproduce them or every calibrated
+      # capacity would describe the wrong id->device map
+      mod_sharding=dist.plan.mod_sharding,
+      num_sc=dist.plan.num_sc)
   # the mirror's params must match ITS plan's physical layout (packed
   # [param_rows, param_width] for storage-packed groups)
   zeros = {

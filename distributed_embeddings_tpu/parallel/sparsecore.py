@@ -1,0 +1,622 @@
+"""SparseCore lookup path: static-CSR preprocessing + executable emulation.
+
+This is the host/SPMD side of the SparseCore offload designed in
+docs/design.md §8, implemented end to end so every stage runs and is
+testable on the faked 8-device CPU mesh today; only the final custom-call
+binding (``custom_call_lookup`` / ``custom_call_grad_apply``) stays
+hardware-gated behind the ONE adapter seam at the bottom of this file.
+
+The SparseCore contract (TPU v4 paper, arXiv:2304.01433 §3; the
+jax-tpu-embedding surface): tables are MOD-sharded over
+``num_chips * num_sc`` partitions (``ShardingPlan(mod_sharding=True)``
+emits the device-level windows; this module handles the per-device SC
+tile split), and lookups arrive as statically-shaped partition-sorted CSR
+buffers built host-side:
+
+- ``row_pointers``: per-partition end offsets into the id buffers,
+- ``embedding_ids``: partition-LOCAL row ids (``local_row // num_sc``),
+- ``sample_ids``: which output row each id contributes to,
+- ``gains``: per-id multiplier (1 for 'sum'; 1/count carries 'mean'),
+
+padded to a calibrated ``max_ids_per_partition`` (8-aligned, SC's f32
+lane granularity).  Two builders produce the SAME logical content:
+
+- ``build_csr_host``: pure NumPy, the real per-batch host preprocessing
+  whose ms/batch cost the bench measures and journals (the
+  "including preprocessing" term of the v5p projection,
+  docs/perf_notes.md);
+- ``csr_from_routed``: the traced XLA twin the EMULATION backend uses
+  inside the jitted train step (flat exact-capacity variant: padding is
+  a hardware buffer-sizing concern, not a semantics one).
+
+The emulation backend then executes the buffers with TensorCore XLA ops:
+
+- ``emulated_lookup``: gather at the CSR's reconstituted fused rows,
+  scatter back to the dense (sample, hot) grid, and run the SHARED
+  combine tail (``dist_embedding._combine_rows``) — identical masking
+  and summation order to the TensorCore path, hence bit-identical f32
+  outputs (the equivalence fuzz asserts exact equality);
+- ``sc_grad_apply``: the grad+optimizer custom calls
+  (``tpu_sparse_dense_matmul_grad_with_{sgd,adagrad}``) emulated as an
+  XLA segment-sum + row-wise RMW over the same buffers, expressed
+  through the audited ``compact_segments`` + ``apply_unique`` pair.
+  The hardware walks partitions in parallel; the emulation fixes the
+  walk order to the update-stream order (the ``inverse_order`` bridge)
+  so results are reproducible and bit-comparable with the TensorCore
+  sparse path.
+
+Requesting the real binding without the library always raises the
+contract error below — never a silent fallback to TensorCore or to the
+emulation on a TPU backend, where a "SparseCore" measurement must never
+secretly be something else.
+"""
+
+from __future__ import annotations
+
+import time
+
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Groups the SparseCore path declines, staying on the TensorCore paths
+# (docs/design.md §8 #4): combiner=None pass-through (SC is a reducing
+# engine) and very wide rows (SC tile SRAM holds rows up to a few
+# hundred lanes; 256 is the conservative published bound).
+SC_WIDTH_LIMIT = 256
+
+_CONTRACT_MSG = (
+    "lookup_impl='sparsecore' custom-call backend requires SparseCore "
+    "hardware (v5p/v6e) and the jax-tpu-embedding custom-call surface "
+    "(tpu_sparse_dense_matmul / tpu_sparse_dense_matmul_grad_with_*), "
+    "which are not present. The host/SPMD side — mod-sharded planner, "
+    "static-CSR preprocessing, executable emulation backend — runs "
+    "everywhere: pass sparsecore_backend='emulate' for functional work "
+    "on TensorCore/CPU backends, or install jax-tpu-embedding on SC "
+    "hardware for the real binding. See docs/design.md §8.")
+
+
+class StaticCsr(NamedTuple):
+  """Statically-shaped partition-sorted CSR buffers for one (device,
+  group, hotness-class) lookup.  All fields are arrays (the tuple is a
+  pytree, so it flows through jit/shard_map); ``num_sc`` travels as a
+  Python-level argument to the consumers.
+
+  ``hot_ids`` and ``positions`` are EMULATION-ONLY auxiliaries (the
+  hardware ABI carries only the first four buffers): ``hot_ids`` lets
+  the emulated forward scatter entries back onto the dense
+  (sample, hot) grid for the bit-exact shared combine tail;
+  ``positions`` is each entry's origin in the flattened routed stream,
+  the determinism bridge the emulated grad apply uses to fix its walk
+  order.
+  """
+  row_pointers: jax.Array   # [num_sc] end offsets per partition
+  embedding_ids: jax.Array  # [N] partition-local row ids (row // num_sc)
+  sample_ids: jax.Array     # [N] output row; == num_samples marks padding
+  gains: jax.Array          # [N] f32 multiplier (0 at padding)
+  partition_ids: jax.Array  # [N] partition of each entry (num_sc = pad)
+  hot_ids: jax.Array        # [N] hot-axis position (emulation aux)
+  positions: jax.Array      # [N] origin position in the flat stream
+
+
+def group_supported(table_aval, combiner: Optional[str],
+                    hotness: int) -> bool:
+  """Per-group SparseCore eligibility — the measurement-style gate the
+  ``_lookup`` dispatch applies, mirroring ``pallas_lookup.supported``.
+  Unsupported groups keep the TensorCore paths (by design, not as a
+  silent substitute for the whole layer)."""
+  del hotness  # any hotness routes through the CSR transform
+  if combiner not in ('sum', 'mean'):
+    return False  # pass-through (combiner=None) stays on TensorCore
+  if table_aval.shape[1] > SC_WIDTH_LIMIT:
+    return False  # very-wide rows stay on TensorCore
+  # SC accumulates f32; bf16 tables would need the pair-fetch layout the
+  # hardware does not expose through this surface
+  return jnp.dtype(table_aval.dtype) == jnp.float32
+
+
+def engaged_groups(plan, param_dtype) -> List[int]:
+  """Indices of the plan's fusion groups the SC lookup path serves at
+  ``param_dtype`` — the ONE definition of "engaged" shared by the
+  layer's zero-engagement guard (``DistributedEmbedding.__init__``) and
+  the bench artifact label, so the two can never disagree about which
+  groups actually take the SC path."""
+  dt = jnp.dtype(param_dtype)
+  return [
+      gi for gi, g in enumerate(plan.groups)
+      if g.storage_pack == 1 and group_supported(
+          jax.ShapeDtypeStruct((g.rows_cap, g.width), dt), g.combiner, 1)
+  ]
+
+
+def apply_supported(optimizer, table_aval, storage_pack: int = 1) -> bool:
+  """Whether ``sc_grad_apply`` serves this (optimizer, group): natural
+  (unpacked) storage, f32, SC-servable width, and an optimizer whose
+  RMW the SC grad custom calls implement — declared by the capability
+  attribute ``sc_apply_kind`` ('sgd' / 'adagrad') on the optimizer, so
+  subclasses and renames keep working and the eligibility probe shares
+  the same contract."""
+  if storage_pack > 1:
+    return False  # SC plans store natural; packed groups are TensorCore
+  if table_aval.shape[1] > SC_WIDTH_LIMIT:
+    return False
+  if jnp.dtype(table_aval.dtype) != jnp.float32:
+    return False
+  return getattr(optimizer, 'sc_apply_kind', None) in ('sgd', 'adagrad')
+
+
+# --------------------------------------------------------------------------
+# backend resolution
+# --------------------------------------------------------------------------
+
+
+def custom_call_available() -> bool:
+  """Whether the jax-tpu-embedding custom-call surface is importable."""
+  try:
+    import jax_tpu_embedding  # noqa: F401
+  except ImportError:
+    return False
+  return True
+
+
+def resolve_backend(requested: str, platform: Optional[str] = None) -> str:
+  """Resolve 'auto' | 'emulate' | 'custom_call' to a concrete backend.
+
+  'auto' picks the real binding when the library is importable on a TPU
+  backend; on non-TPU backends it picks the executable emulation (the
+  functional testbed this module exists for).  On a TPU backend WITHOUT
+  the library it raises: a TPU measurement labelled sparsecore must
+  never silently be the emulation (same discipline as the stub this
+  module replaces — never a silent fallback).
+  """
+  if requested not in ('auto', 'emulate', 'custom_call'):
+    raise ValueError(f'Unknown sparsecore backend {requested!r}')
+  if requested == 'emulate':
+    return 'emulate'
+  if requested == 'custom_call':
+    if not custom_call_available():
+      raise NotImplementedError(_CONTRACT_MSG)
+    return 'custom_call'
+  platform = platform if platform is not None else jax.default_backend()
+  if platform == 'tpu':
+    if custom_call_available():
+      return 'custom_call'
+    raise NotImplementedError(_CONTRACT_MSG)
+  return 'emulate'
+
+
+# --------------------------------------------------------------------------
+# COO -> partition-sorted static CSR: traced (XLA) builder
+# --------------------------------------------------------------------------
+
+
+def csr_from_routed(routed: jax.Array, rows_cap: int, num_sc: int,
+                    combiner: Optional[str] = 'sum') -> StaticCsr:
+  """Traced COO -> partition-sorted static-CSR transform.
+
+  ``routed``: ``[n_cap, GB, h]`` fused local-row ids from ``_route_ids``
+  (values ``>= rows_cap`` mark padding).  Each valid position becomes a
+  COO entry ``(sample = slot*GB + b, id, gain)``; entries sort stably by
+  SC partition ``id % num_sc`` (padding to the back), local ids divide
+  by ``num_sc``.  This is the flat exact-capacity variant (buffer length
+  = the static stream length): per-partition padding to
+  ``max_ids_per_partition`` is how the HARDWARE buffers are sized
+  (``build_csr_host``), not a semantics difference — the logical
+  content, section by section, is identical and the tests assert it.
+  """
+  n_cap, gb, h = routed.shape
+  samples = n_cap * gb
+  flat = routed.reshape(-1).astype(jnp.int32)
+  valid = flat < rows_cap
+  part = jnp.where(valid, flat % num_sc, num_sc).astype(jnp.int32)
+  order = jnp.argsort(part, stable=True).astype(jnp.int32)
+  part_sorted = part[order]
+  rows_sorted = flat[order]
+  sample = order // h
+  hot = order % h
+  valid_sorted = valid[order]
+  if combiner == 'mean':
+    counts = jnp.sum(valid.reshape(samples, h), axis=1)
+    gain_per_sample = 1.0 / jnp.maximum(counts, 1).astype(jnp.float32)
+    gains = jnp.where(valid_sorted, gain_per_sample[sample], 0.0)
+  else:
+    gains = jnp.where(valid_sorted, 1.0, 0.0)
+  return StaticCsr(
+      row_pointers=jnp.searchsorted(
+          part_sorted, jnp.arange(num_sc, dtype=jnp.int32),
+          side='right').astype(jnp.int32),
+      embedding_ids=jnp.where(valid_sorted, rows_sorted // num_sc,
+                              rows_cap).astype(jnp.int32),
+      sample_ids=jnp.where(valid_sorted, sample, samples).astype(jnp.int32),
+      gains=gains,
+      partition_ids=part_sorted,
+      hot_ids=hot.astype(jnp.int32),
+      positions=order,
+  )
+
+
+# --------------------------------------------------------------------------
+# COO -> partition-sorted static CSR: NumPy host builder (the real feed)
+# --------------------------------------------------------------------------
+
+
+class HostCsr(NamedTuple):
+  """Padded per-partition CSR buffers, the hardware feed layout: section
+  ``p`` occupies ``[p*cap, p*cap + count_p)`` of each buffer (``cap`` =
+  8-aligned ``max_ids_per_partition``), ``row_pointers[p]`` is the
+  section's end offset, padding slots hold sentinel ids / one-past
+  sample ids / zero gains.  ``dropped`` counts entries past a
+  partition's capacity (0 under a correctly calibrated cap; the bench
+  journals it so an undersized cap is visible, never silent)."""
+  row_pointers: np.ndarray   # [num_sc]
+  embedding_ids: np.ndarray  # [num_sc * cap]
+  sample_ids: np.ndarray     # [num_sc * cap]
+  gains: np.ndarray          # [num_sc * cap]
+  max_ids_per_partition: int
+  dropped: int
+
+
+def _round_up8(x: int) -> int:
+  return -(-int(x) // 8) * 8
+
+
+def build_csr_host(routed: np.ndarray, rows_cap: int, num_sc: int,
+                   combiner: Optional[str] = 'sum',
+                   max_ids_per_partition: Optional[int] = None) -> HostCsr:
+  """NumPy twin of ``csr_from_routed`` producing the PADDED hardware
+  layout.  Vectorised throughout — this is the per-batch host cost the
+  bench measures (``measure_preprocess_ms``), so it must be the fast
+  path, not a reference loop.
+
+  ``max_ids_per_partition``: per-partition static capacity (8-aligned
+  internally); ``None`` sizes to the batch's worst partition (never
+  drops).  Calibrate with ``calibrate_max_ids_per_partition``.
+  """
+  n_cap, gb, h = routed.shape
+  samples = n_cap * gb
+  flat = np.ascontiguousarray(routed, dtype=np.int32).reshape(-1)
+  valid = flat < rows_cap
+  part = np.where(valid, flat % num_sc, num_sc).astype(np.int32)
+  order = np.argsort(part, kind='stable').astype(np.int32)
+  part_sorted = part[order]
+  ends = np.searchsorted(part_sorted, np.arange(num_sc), side='right')
+  starts = np.concatenate([[0], ends[:-1]])
+  counts = ends - starts
+  cap = _round_up8(max_ids_per_partition if max_ids_per_partition
+                   is not None else max(int(counts.max(initial=0)), 1))
+  kept = np.minimum(counts, cap)
+  dropped = int((counts - kept).sum())
+  # rank of each valid sorted entry within its partition; keep the
+  # first `cap` of every partition (the rest are the `dropped` count)
+  nvalid = int(counts.sum())
+  rank = np.arange(nvalid) - np.repeat(starts, counts)
+  keep = rank < cap
+  src = order[:nvalid][keep]
+  dst = part_sorted[:nvalid][keep].astype(np.int64) * cap + rank[keep]
+  eids = np.full(num_sc * cap, rows_cap, np.int32)
+  sids = np.full(num_sc * cap, samples, np.int32)
+  gains = np.zeros(num_sc * cap, np.float32)
+  eids[dst] = flat[src] // num_sc
+  sids[dst] = src // h
+  if combiner == 'mean':
+    cnt = np.maximum(valid.reshape(samples, h).sum(axis=1), 1)
+    gains[dst] = 1.0 / cnt[src // h].astype(np.float32)
+  else:
+    gains[dst] = 1.0
+  return HostCsr(
+      row_pointers=(np.arange(num_sc) * cap + kept).astype(np.int32),
+      embedding_ids=eids, sample_ids=sids, gains=gains,
+      max_ids_per_partition=cap, dropped=dropped)
+
+
+# --------------------------------------------------------------------------
+# executable emulation backend
+# --------------------------------------------------------------------------
+
+
+def emulated_lookup(table: jax.Array, routed: jax.Array,
+                    combiner: Optional[str], compute_dtype,
+                    num_sc: int) -> jax.Array:
+  """Executable TensorCore emulation of ``tpu_sparse_dense_matmul``.
+
+  ``table``: ``[rows_cap, w]`` natural fused shard; ``routed``:
+  ``[n_cap, GB, h]`` (``_route_ids`` output).  Pipeline: the traced CSR
+  transform, then ONE gather at the partition-reconstituted fused rows
+  (``eid * num_sc + partition`` — the emulation keeps the natural row
+  layout and reconstitutes; hardware stores partition-major), ONE
+  scatter back onto the dense (sample, hot) grid (indices unique by
+  construction), and the combine tail SHARED with the TensorCore path
+  (``_combine_rows``) — identical masking and h-axis summation order,
+  so the output is bit-identical f32 to ``_fused_lookup``.  ``gains``
+  are built per the hardware contract (mean rides them there) but the
+  emulated combine divides after the sum exactly like the TensorCore
+  path, keeping the bit-exactness the equivalence fuzz asserts.
+  """
+  from distributed_embeddings_tpu.parallel.dist_embedding import _combine_rows
+  rows_cap, w = table.shape
+  n_cap, gb, h = routed.shape
+  samples = n_cap * gb
+  csr = csr_from_routed(routed, rows_cap, num_sc, combiner)
+  fused = jnp.where(csr.sample_ids < samples,
+                    csr.embedding_ids * num_sc + csr.partition_ids, rows_cap)
+  rows = jnp.take(table, jnp.minimum(fused, rows_cap - 1), axis=0)  # [N, w]
+  # padding entries scatter out of bounds (dropped) at DISTINCT indices
+  # (samples*h + entry position): several padding entries sharing one
+  # index would break the unique_indices promise, which XLA documents
+  # as undefined even for dropped slots (see sparse._distinct_oob)
+  n_entries = csr.sample_ids.shape[0]
+  idx = jnp.where(csr.sample_ids < samples,
+                  csr.sample_ids * h + csr.hot_ids,
+                  samples * h + jnp.arange(n_entries, dtype=jnp.int32))
+  dense = jnp.zeros((samples * h, w), table.dtype).at[idx].set(
+      rows, mode='drop', unique_indices=True)
+  mask = jnp.zeros((samples * h,), bool).at[idx].set(
+      True, mode='drop', unique_indices=True)
+  return _combine_rows(dense.reshape(n_cap, gb, h, w),
+                       mask.reshape(n_cap, gb, h), combiner, table.dtype,
+                       compute_dtype)
+
+
+def sc_grad_apply(optimizer, table: jax.Array, state: Dict[str, jax.Array],
+                  flat_ids: jax.Array, grads: jax.Array, lr,
+                  num_sc: int, g_index: Optional[jax.Array] = None):
+  """Executable emulation of the SC grad+optimizer custom calls
+  (``tpu_sparse_dense_matmul_grad_with_{sgd,adagrad}``): rebuild the
+  update stream's partition-sorted CSR buffers (the same transform that
+  feeds the forward), then execute their semantics in XLA — segment-sum
+  of the per-occurrence gradient rows followed by the row-wise RMW,
+  expressed through the audited ``compact_segments`` +
+  ``optimizer.apply_unique`` pair from parallel/sparse.py.
+
+  The hardware walks its partitions in parallel with unspecified
+  interleave; the emulation reads the buffers back through the CSR's
+  ``positions`` bridge so the segment summation consumes entries in
+  update-stream order — making the result bit-identical (f32) to the
+  TensorCore sparse path at guaranteed capacity, which the equivalence
+  fuzz exploits.
+
+  Args mirror ``sparse._dedup_and_apply``'s stream contract: ``grads``
+  is either per-occurrence ``[n, w]`` rows or compact per-(sample, bag)
+  rows with ``g_index`` mapping positions to rows.
+  """
+  from distributed_embeddings_tpu.parallel.sparse import (_guaranteed_cap,
+                                                          compact_segments)
+  rows_cap = table.shape[0]
+  n = flat_ids.shape[0]
+  sentinel = rows_cap
+  # the CSR buffers for this stream (sample grid = stream positions)
+  csr = csr_from_routed(flat_ids.reshape(1, n, 1), rows_cap, num_sc,
+                        combiner='sum')
+  # read the stream BACK OUT of the buffers in original order: inverse
+  # of the partition sort (the determinism bridge; proves the buffers
+  # carry the full stream)
+  inv = jnp.zeros((n,), jnp.int32).at[csr.positions].set(
+      jnp.arange(n, dtype=jnp.int32), unique_indices=True)
+  stream_ids = jnp.where(
+      csr.sample_ids < n,
+      csr.embedding_ids * num_sc + csr.partition_ids, sentinel)[inv]
+  with_sq = bool(getattr(optimizer, 'needs_sq', False))
+  cap = _guaranteed_cap(n, rows_cap)
+  # g_index passes straight through: compact_segments gathers the
+  # payload from the COMPACT per-(sample, bag) rows in sorted order, so
+  # the h-fold multi-hot broadcast never materialises here either (the
+  # same indirection contract as the segwalk/XLA dispatch)
+  uids, sum_g, sum_sq, _ = compact_segments(stream_ids, grads, cap,
+                                            sentinel, with_sq=with_sq,
+                                            g_index=g_index)
+  return optimizer.apply_unique(table, state, uids, sum_g, sum_sq, lr)
+
+
+# --------------------------------------------------------------------------
+# capacity calibration + host preprocessing measurement
+# --------------------------------------------------------------------------
+
+
+def calibrate_max_ids_per_partition(dist, cats, margin: float = 1.3,
+                                    params=None,
+                                    prefer_cpu: bool = True
+                                    ) -> Tuple[int, ...]:
+  """Measure per-group worst (device, SC partition) id counts on a
+  sample batch and return calibrated ``max_ids_per_partition`` per
+  fusion group — the capacity statics of the HOST CSR buffers, derived
+  by the same machinery as the compaction capacities
+  (``sparse.calibrate_capacity_rows``: CPU plan mirror, one
+  representative batch, multiplicative margin, 8-aligned)."""
+  from distributed_embeddings_tpu.parallel.sparse import _calibration_mirror
+  if (prefer_cpu
+      and dist.mesh.devices.ravel()[0].platform != 'cpu'):
+    try:
+      cpus = jax.devices('cpu')
+    except RuntimeError:
+      cpus = []
+    if len(cpus) >= dist.world_size:
+      mirror, zeros = _calibration_mirror(dist, cpus)
+      host_cats = [np.asarray(x) for x in cats]
+      return calibrate_max_ids_per_partition(mirror, host_cats,
+                                             margin=margin, params=zeros,
+                                             prefer_cpu=False)
+  if params is None:
+    params = dist.init(0)
+  _, residuals, (_, hotness) = dist.forward_with_residuals(params, cats)
+  subs = dist._subgroups(hotness)
+  num_sc = getattr(dist.plan, 'num_sc', 4)
+  per_group: Dict[int, List[np.ndarray]] = {}
+  for si, sub in enumerate(subs):
+    ids = np.asarray(residuals[si])  # [D, n_cap, GB, h]
+    per_group.setdefault(sub.gi, []).append(ids.reshape(ids.shape[0], -1))
+  caps = []
+  for gi, group in enumerate(dist.plan.groups):
+    streams = per_group.get(gi)
+    if not streams:
+      caps.append(8)
+      continue
+    per_dev = np.concatenate(streams, axis=1)
+    worst = 0
+    for row in per_dev:
+      v = row[row < group.rows_cap]
+      if v.size:
+        worst = max(worst, int(np.bincount(v % num_sc,
+                                           minlength=num_sc).max()))
+    caps.append(_round_up8(max(8, int(worst * margin))))
+  return tuple(caps)
+
+
+def _route_ids_np(ids: np.ndarray, offs, vocab, rows_cap: int,
+                  lo, hi, stride) -> np.ndarray:
+  """NumPy twin of ``dist_embedding._route_ids`` (incl. mod windows),
+  used by the host preprocessing path where the routing must happen on
+  the CPU before the device program runs."""
+  mask = ids >= 0
+  clipped = np.clip(ids, 0, vocab[:, None, None] - 1)
+  lo = lo[:, None, None]
+  stride = stride[:, None, None]
+  mask = (mask & (clipped >= lo) & (clipped < hi[:, None, None])
+          & ((clipped - lo) % stride == 0))
+  local = (clipped - lo) // stride
+  return np.where(mask, local + offs[:, None, None], rows_cap).astype(
+      np.int32)
+
+
+def preprocess_batch_host(dist, cats,
+                          max_ids_per_partition: Optional[Tuple[int, ...]]
+                          = None) -> Dict[Tuple[int, int], List[HostCsr]]:
+  """Per-batch HOST preprocessing for the real SC feed: route every
+  subgroup's raw ids into each device's fused local-row space (the
+  NumPy twin of ``_route_ids``) and build the padded partition-sorted
+  CSR buffers per (subgroup, device).
+
+  Returns ``{(group_index, hotness): [HostCsr per device]}``.  This is
+  the function ``bench.py`` times (``measure_preprocess_ms``) to ground
+  the v5p projection's "including preprocessing" term in a number.
+  """
+  cats = [np.asarray(c) for c in cats]
+  hotness = tuple(1 if c.ndim == 1 else c.shape[1] for c in cats)
+  subs = dist._subgroups(hotness)
+  num_sc = getattr(dist.plan, 'num_sc', 4)
+  out: Dict[Tuple[int, int], List[HostCsr]] = {}
+  for sub in subs:
+    g = dist.plan.groups[sub.gi]
+    # the SAME [D, n_cap] stride table the traced routing selects from
+    # (_SubGroup.row_stride) — re-deriving it here could silently drift
+    # from the real routed ids
+    stride = (sub.row_stride if sub.row_stride is not None else
+              np.ones((dist.world_size, sub.n_cap), np.int32))
+    cap = None
+    if max_ids_per_partition is not None:
+      cap = max_ids_per_partition[sub.gi]
+    per_dev = []
+    for dev in range(dist.world_size):
+      slot_ids = []
+      for s in range(sub.n_cap):
+        if s < len(sub.requests[dev]):
+          x = cats[sub.requests[dev][s].input_id]
+          x = x[:, None] if x.ndim == 1 else x
+        else:
+          x = np.full((cats[0].shape[0], sub.hotness), -1, np.int32)
+        slot_ids.append(x.astype(np.int32))
+      ids = np.stack(slot_ids)  # [n_cap, GB, h]
+      routed = _route_ids_np(ids, sub.offsets[dev], sub.vocab[dev],
+                             g.rows_cap, sub.row_lo[dev], sub.row_hi[dev],
+                             stride[dev])
+      per_dev.append(
+          build_csr_host(routed, g.rows_cap, num_sc,
+                         combiner=sub.lookup_combiner,
+                         max_ids_per_partition=cap))
+    out[(sub.gi, sub.hotness)] = per_dev
+  return out
+
+
+def measure_preprocess_ms(dist, cats, repeats: int = 3,
+                          max_ids_per_partition: Optional[Tuple[int, ...]]
+                          = None) -> Dict[str, Any]:
+  """Time ``preprocess_batch_host`` on this host: min-of-k wall time per
+  batch plus the total id volume, for the bench artifact and
+  docs/perf_notes.md.
+
+  The timed builds always run with STATIC per-group capacities — the
+  caller's calibrated ``max_ids_per_partition`` when given, else caps
+  derived from one untimed sizing pass (per-group max over devices and
+  hotness classes) — so the measurement covers the padded layout the
+  real feed pays, and the journaled ``csr_dropped`` is a live check of
+  the caps against this batch rather than 0 by construction."""
+  caps = max_ids_per_partition
+  if caps is None:
+    sizing = preprocess_batch_host(dist, cats)
+    by_group: Dict[int, int] = {}
+    for (gi, _), lst in sizing.items():
+      by_group[gi] = max(by_group.get(gi, 8),
+                         max(c.max_ids_per_partition for c in lst))
+    caps = tuple(by_group.get(gi, 8)
+                 for gi in range(len(dist.plan.groups)))
+  times = []
+  dropped = 0
+  for _ in range(max(1, repeats)):
+    t0 = time.perf_counter()
+    csrs = preprocess_batch_host(dist, cats, max_ids_per_partition=caps)
+    times.append((time.perf_counter() - t0) * 1000.0)
+    dropped = sum(c.dropped for lst in csrs.values() for c in lst)
+  n_ids = int(sum(np.asarray(c).size for c in cats))
+  return {
+      'csr_preprocess_ms': round(min(times), 3),
+      'csr_preprocess_ids': n_ids,
+      'csr_preprocess_ns_per_id': round(min(times) * 1e6 / max(n_ids, 1), 2),
+      'csr_dropped': dropped,
+  }
+
+
+# --------------------------------------------------------------------------
+# THE hardware-gated adapter seam (the one remaining binding)
+# --------------------------------------------------------------------------
+
+
+def _require_custom_call():
+  """Import gate shared by both adapter functions: one place, one
+  contract message."""
+  try:
+    import jax_tpu_embedding
+  except ImportError:
+    raise NotImplementedError(_CONTRACT_MSG) from None
+  return jax_tpu_embedding
+
+
+def custom_call_lookup(table: jax.Array, csr: StaticCsr,
+                       combiner: Optional[str], compute_dtype,
+                       num_sc: int) -> jax.Array:
+  """THE adapter between this module's CSR buffers and
+  ``jax-tpu-embedding``'s ``tpu_sparse_dense_matmul`` custom call — the
+  single remaining hardware-gated seam of docs/design.md §8.  Everything
+  upstream (planner mod windows, routing, CSR transform) and downstream
+  (assembly, sparse apply) is the code exercised by the emulation
+  backend; this function only swaps the executable emulation for the
+  real custom call on SC hardware, where it is validated.  Without the
+  library it raises the contract error (never a silent fallback)."""
+  lib = _require_custom_call()
+  raise NotImplementedError(
+      'jax-tpu-embedding is importable but this binding has not been '
+      'validated on SparseCore hardware in this environment; wire '
+      f'{lib.__name__}.tpu_sparse_dense_matmul to the StaticCsr buffers '
+      'here (row_pointers/embedding_ids/sample_ids/gains map 1:1) and '
+      'validate against the emulation backend, which is the executable '
+      'specification of the expected numerics.')
+
+
+def custom_call_grad_apply(optimizer, table, state, csr: StaticCsr, grads,
+                           lr, num_sc: int,
+                           g_index: Optional[jax.Array] = None):
+  """Hardware-gated twin of ``sc_grad_apply`` for the fused
+  ``tpu_sparse_dense_matmul_grad_with_{sgd,adagrad}`` custom calls; same
+  single-seam discipline as ``custom_call_lookup``.
+
+  ``grads``/``g_index`` follow the stream contract of ``sc_grad_apply``:
+  with ``g_index`` the rows are COMPACT per-(sample, bag) — the binding
+  must expand through the index (or hand the pair to hardware that
+  consumes it) before/while walking the CSR's n entries, exactly as the
+  emulation's ``compact_segments(..., g_index=...)`` does."""
+  lib = _require_custom_call()
+  raise NotImplementedError(
+      'jax-tpu-embedding is importable but this binding has not been '
+      'validated on SparseCore hardware in this environment; wire '
+      f'{lib.__name__}.tpu_sparse_dense_matmul_grad_with_* here and '
+      'validate against sc_grad_apply, the executable specification.')

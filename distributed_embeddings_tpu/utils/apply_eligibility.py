@@ -67,7 +67,8 @@ def _group_table_aval(g, dt):
 
 def eligibility_line(dist, param_dtype, fused_apply: bool,
                      segwalk_apply: bool,
-                     accum_dtype: str = 'float32') -> str:
+                     accum_dtype: str = 'float32',
+                     sparsecore_apply: bool = False) -> str:
   """One line saying which fusion groups each requested fused kernel
   would actually serve, and whether it engages on this backend at all
   (empty string when neither kernel is requested).  ``accum_dtype``
@@ -92,6 +93,27 @@ def eligibility_line(dist, param_dtype, fused_apply: bool,
           if pallas_segwalk.acc_dtype_ok(dt, adt) else 0)
     parts.append(f'segwalk_apply: {ok}/{len(groups)} groups eligible'
                  f'{_active_suffix(pallas_segwalk.FORCE_INTERPRET, pallas_segwalk.ASSUME_TPU)}')
+  if sparsecore_apply:
+    # dispatch mirror of sparse._use_sparsecore: a minimal probe
+    # carrying the capability tag; the shape/dtype/storage gates are real
+    from types import SimpleNamespace
+    from distributed_embeddings_tpu.parallel import sparsecore
+
+    probe = SimpleNamespace(sc_apply_kind='sgd')
+    ok = sum(1 for g in groups if sparsecore.apply_supported(
+        probe, jax.ShapeDtypeStruct((g.rows_cap, g.width), dt),
+        getattr(g, 'storage_pack', 1)))
+    try:
+      # resolve the LAYER's configured backend — the one the dispatch
+      # actually runs — not a hardcoded 'auto'
+      requested = getattr(dist, 'sparsecore_backend', 'auto')
+      backend = sparsecore.resolve_backend(requested) if ok else 'n/a'
+    except NotImplementedError:
+      # a TPU without jax-tpu-embedding: the report must still print
+      # (the dispatch itself raises at apply time)
+      backend = 'unavailable (jax-tpu-embedding absent)'
+    parts.append(f'sparsecore_apply: {ok}/{len(groups)} groups eligible '
+                 f'(backend: {backend})')
   return '; '.join(parts)
 
 

@@ -96,3 +96,40 @@ def test_watchdog_disabled_by_zero(bench, monkeypatch):
   bench._arm_watchdog()
   assert signal.getitimer(signal.ITIMER_REAL)[0] == 0
   assert 'timer' not in bench._WATCHDOG_STATE
+
+
+def test_chip_evidence_utc_parse_is_dst_immune(bench, monkeypatch):
+  """recorded_at is UTC; the parse must be timegm (its exact inverse).
+  The old mktime(...) - time.timezone conversion shifted the epoch by
+  an hour whenever the LOCAL zone was in DST, silently staling lines
+  near the 14h cutoff (ADVICE.md round 5, low #1).  Pin a DST locale
+  and a line 13.5h old: it must stay fresh."""
+  monkeypatch.setenv('TZ', 'America/New_York')
+  time.tzset()
+  try:
+    with open(bench.CHIP_LINES, 'w') as f:
+      f.write(json.dumps({'value': 5,
+                          'recorded_at': _stamp(-13.5 * 3600)}) + '\n')
+    ev = bench.chip_evidence()
+    assert ev is not None and ev['value'] == 5
+    # and a genuinely stale line still filters
+    with open(bench.CHIP_LINES, 'w') as f:
+      f.write(json.dumps({'value': 6,
+                          'recorded_at': _stamp(-14.5 * 3600)}) + '\n')
+    assert bench.chip_evidence() is None
+  finally:
+    monkeypatch.delenv('TZ')
+    time.tzset()
+
+
+def test_split_windows(bench):
+  assert bench.split_windows(20, 3) == [7, 7, 6]
+  assert bench.split_windows(2, 5) == [1, 1]   # never more windows than steps
+  assert bench.split_windows(5, 1) == [5]
+  assert sum(bench.split_windows(17, 4)) == 17
+
+
+def test_host_load_shape(bench):
+  load = bench.host_load()
+  assert load is None or (len(load) == 3
+                          and all(isinstance(x, float) for x in load))

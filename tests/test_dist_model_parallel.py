@@ -674,15 +674,46 @@ class TestInit:
 
 class TestSparseCoreSeam:
 
-  def test_sparsecore_stub_raises_with_contract(self):
-    """lookup_impl='sparsecore' is a staged, hardware-gated seam
-    (docs/design.md §8): constructing the layer works (so configs can be
-    written portably), but any lookup raises — never a silent
-    TensorCore fallback."""
+  def test_emulation_backend_runs_on_cpu_mesh(self):
+    """lookup_impl='sparsecore' is implemented host/SPMD-side
+    (docs/design.md §8): on a non-TPU backend the 'auto' backend
+    resolves to the executable emulation and the lookup RUNS, matching
+    the TensorCore path bit-exactly (the deep fuzz lives in
+    tests/test_sparsecore.py)."""
     mesh = create_mesh(jax.devices()[:4])
     dist = DistributedEmbedding([TableConfig(64, 16, 'sum')] * 4,
                                 mesh=mesh, lookup_impl='sparsecore')
+    assert dist.plan.mod_sharding
     params = dist.init(0)
     ids = [np.zeros((8, 2), np.int32)] * 4
-    with pytest.raises(NotImplementedError, match='sparsecore'):
+    outs = dist.apply(params, ids)
+    assert dist._resolve_sc_backend() == 'emulate'
+    ref = DistributedEmbedding([TableConfig(64, 16, 'sum')] * 4,
+                               mesh=mesh, lookup_impl='auto',
+                               mod_sharding=True)
+    ref_outs = ref.apply(params, ids)
+    for a, b in zip(outs, ref_outs):
+      np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+  def test_custom_call_backend_raises_contract_error(self):
+    """The real custom-call binding stays hardware-gated: requesting it
+    without jax-tpu-embedding raises the §8 contract error at the first
+    lookup — never a silent fallback to TensorCore or the emulation."""
+    mesh = create_mesh(jax.devices()[:4])
+    dist = DistributedEmbedding([TableConfig(64, 16, 'sum')] * 4,
+                                mesh=mesh, lookup_impl='sparsecore',
+                                sparsecore_backend='custom_call')
+    params = dist.init(0)
+    ids = [np.zeros((8, 2), np.int32)] * 4
+    with pytest.raises(NotImplementedError, match='jax-tpu-embedding'):
       dist.apply(params, ids)
+
+  def test_auto_backend_raises_on_tpu_without_library(self):
+    """'auto' on a TPU platform without the library must raise, not
+    silently run the emulation: a TPU measurement labelled sparsecore
+    is never secretly something else."""
+    from distributed_embeddings_tpu.parallel import sparsecore
+    with pytest.raises(NotImplementedError, match='jax-tpu-embedding'):
+      sparsecore.resolve_backend('auto', platform='tpu')
+    assert sparsecore.resolve_backend('auto', platform='cpu') == 'emulate'
+    assert sparsecore.resolve_backend('emulate', platform='tpu') == 'emulate'

@@ -68,9 +68,10 @@ class TestPlanner:
         world_size=4, strategy='basic', row_slice_threshold=300)
     assert plan.row_sliced == [True, False]
     shards = plan.shard_layout()[0]
-    windows = sorted((rs, re) for _, _, _, _, _, rs, re in shards)
+    windows = sorted((rs, re) for _, _, _, _, _, rs, re, _ in shards)
     assert windows == [(0, 25), (25, 50), (50, 75), (75, 100)]
-    assert all(cs == 0 and ce == 8 for _, _, _, cs, ce, _, _ in shards)
+    assert all(cs == 0 and ce == 8 for _, _, _, cs, ce, _, _, _ in shards)
+    assert all(stride == 1 for *_, stride in shards)
     # row-sliced tables produce no column-slice output ranges
     assert plan.sliced_out_ranges == []
 

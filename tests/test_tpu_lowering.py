@@ -11,10 +11,18 @@ hardware-gated in tests/test_pallas_tpu.py.
 
 Covers every kernel configuration AND the full 4-chip hybrid train
 step (flat and two-axis meshes) compiled for v5e 2x2.
+
+Marked ``slow``: the abstract-topology compile stack costs ~10 minutes
+of host XLA time on this image's 2-core CI host (and most cases still
+need a newer jax/libtpu than the image carries), which does not fit
+the tier-1 time budget — run with ``pytest -m slow`` where the stack
+is available.
 """
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow
 
 import jax
 import jax.numpy as jnp
