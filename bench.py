@@ -202,9 +202,6 @@ def main():
                       help='sparse = O(nnz) row-wise embedding updates '
                       '(parallel/sparse.py, matching the reference '
                       'IndexedSlices path); dense = autodiff + optax')
-  parser.add_argument('--fused_apply', action='store_true',
-                      help='opt into the fused Pallas row-wise Adagrad '
-                      'apply (ops/pallas_rowwise.py)')
   parser.add_argument('--segwalk_apply', action='store_true',
                       help='opt into the fused segment-walk apply '
                       '(ops/pallas_segwalk.py): sorted raw stream in, '
@@ -361,15 +358,19 @@ def main():
           model.dist_embedding, [jnp.asarray(c) for c in cats0],
           params=params['embedding'])
   # Host-side static-CSR preprocessing cost (docs/design.md §8): the
-  # per-batch NumPy transform the real SparseCore feed pays on this
-  # host, measured so the v5p projection's "including preprocessing"
-  # term is a number, not an assumption.  Caps are CALIBRATED (with
-  # margin) from batch 0 and the timed padded build runs on batch 1,
-  # so the journaled csr_dropped is a genuine cross-batch check of the
-  # calibration, not 0 by construction.  Runs BEFORE the train loop —
-  # the first donating step invalidates `params`, which the calibration
-  # forward reads.  Never fatal to the artifact.
+  # per-batch transform the real SparseCore feed pays on this host —
+  # the native C++ builder fanned out over the worker pool when the
+  # toolchain exists, with the NumPy oracle's number (and a live
+  # bit-exact parity check against it) journaled alongside — so the
+  # v5p projection's "including preprocessing" term is a number, not
+  # an assumption.  Caps are CALIBRATED (with margin) from batch 0 and
+  # the timed padded build runs on batch 1, so the journaled
+  # csr_dropped is a genuine cross-batch check of the calibration, not
+  # 0 by construction.  Runs BEFORE the train loop — the first
+  # donating step invalidates `params`, which the calibration forward
+  # reads.  Never fatal to the artifact.
   csr_stats = None
+  sc_caps = None
   if args.trainer == 'sparse':
     try:
       from distributed_embeddings_tpu.parallel import sparsecore
@@ -379,14 +380,13 @@ def main():
       (_, cats1), _ = gen.pool[1 % len(gen.pool)]
       csr_stats = sparsecore.measure_preprocess_ms(
           model.dist_embedding, [np.asarray(c) for c in cats1],
-          max_ids_per_partition=sc_caps)
+          repeats=5, max_ids_per_partition=sc_caps)
     except Exception as e:
       csr_stats = {'csr_preprocess_error': f'{type(e).__name__}: {e}'}
 
   emb_opt = SparseAdagrad(learning_rate=0.01,
                           capacity_fraction=args.capacity_fraction,
                           capacity_rows=capacity_rows,
-                          use_pallas_apply=args.fused_apply,
                           use_segwalk_apply=args.segwalk_apply,
                           use_sparsecore_apply=args.sparsecore_apply,
                           stream_dtype=args.stream_dtype,
@@ -452,6 +452,40 @@ def main():
 
   step_ms = min(window_ms)
 
+  # Pipelined host-feed phase (docs/design.md §8 "host feed pipeline"):
+  # run the same step through a CsrFeed that builds batch N+1's padded
+  # static-CSR buffers on worker threads while the device executes
+  # batch N, and journal how much of the host build time the device
+  # step hid.  The overlap metric is DIRECT (the feed's blocked-ms
+  # accounting, not a subtraction of two noisy walls); batch 0's build
+  # has no prior step to hide behind, so the feed's stats reset after
+  # it and the journaled overlap is steady-state.  Never fatal.
+  if args.trainer == 'sparse' and sc_caps is not None and csr_stats:
+    try:
+      from distributed_embeddings_tpu.parallel import run_pipelined
+      from distributed_embeddings_tpu.parallel.csr_feed import CsrFeed
+      k = max(args.steps, 8)
+      src = ((j, gen.pool[j % len(gen.pool)]) for j in range(k))
+      feed = CsrFeed(model.dist_embedding, src,
+                     cats_fn=lambda it: [np.asarray(c)
+                                         for c in it[1][0][1]],
+                     max_ids_per_partition=sc_caps)
+      # run_pipelined owns the consume/sync/steady-state-reset protocol
+      # (ONE copy of the overlap accounting); the adapters map its
+      # (cats, batch) contract onto the bench's prebuilt device pool
+      state, _, fstats = run_pipelined(
+          lambda st, _cats, j: step(st, pool[j % len(pool)]),
+          state, feed, lambda fed: (None, fed.item[0]))
+      csr_stats.update({
+          'csr_feed_batches': fstats['batches'],
+          'csr_feed_build_ms': fstats['build_ms'],
+          'csr_feed_blocked_ms': fstats['blocked_ms'],
+          'csr_feed_overlap_pct': fstats['overlap_pct'],
+          'csr_feed_builder': fstats['builder'],
+      })
+    except Exception as e:
+      csr_stats['csr_feed_error'] = f'{type(e).__name__}: {e}'
+
   n_dev = len(devices)
   backend = devices[0].platform
   # the baselines are AT global batch 65536: a reduced-batch chip run
@@ -477,14 +511,14 @@ def main():
     # a shape proxy, not the Criteo-1TB vocabularies.
     metric += (f' [throughput {args.batch_size / (step_ms / 1000) / 1e6:.3f}'
                f'M samples/s; reference DLRM 8xA100 TF32: 9.158M]')
-  if (args.fused_apply or args.segwalk_apply
-      or args.sparsecore_apply) and args.trainer == 'sparse':
+  if (args.segwalk_apply or args.sparsecore_apply) \
+      and args.trainer == 'sparse':
     # without this note an A/B run can silently measure the XLA
     # fallback and read as "kernel is no faster"
     from distributed_embeddings_tpu.utils.apply_eligibility import (
         eligibility_line)
     metric += ' [' + eligibility_line(
-        model.dist_embedding, args.param_dtype, args.fused_apply,
+        model.dist_embedding, args.param_dtype,
         args.segwalk_apply, accum_dtype=args.accum_dtype,
         sparsecore_apply=args.sparsecore_apply) + ']'
   if args.lookup_impl == 'sparsecore':

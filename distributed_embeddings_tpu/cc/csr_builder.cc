@@ -1,0 +1,155 @@
+// Native static-CSR builder for the SparseCore host feed.
+//
+// C++ twin of the NumPy host builder in `parallel/sparsecore.py`
+// (`_route_ids_np` + `build_csr_host`): raw-id routing into the fused
+// local-row space, partition-stable ordering, the padded per-partition
+// section scatter, and capacity/overflow accounting.  The NumPy builder
+// stays the bit-exact oracle (tests/test_csr_native.py fuzzes parity);
+// this one is the production feed path — the measured ~260 ns/id NumPy
+// cost is ~9x the v5e on-chip gather floor (docs/perf_notes.md), so the
+// per-batch transform must drop to counting-sort speed and parallelise
+// over (group, device) pairs to keep a chip fed.
+//
+// Same plain-C ABI + ctypes pattern as fastloader.cc (no Python.h); the
+// Python side (`parallel/csr_native.py`) handles capacity sizing and
+// buffer allocation.  Each call is single-threaded and GIL-free during
+// the call, so Python-level worker threads over (group, device) pairs
+// get real parallelism.
+//
+// Bit-exactness notes (each mirrors a NumPy expression exactly):
+// - NumPy's stable argsort over partition keys followed by a rank-capped
+//   section scatter == a counting scatter in flat order (stable by
+//   construction): entries within a partition keep stream order.
+// - 'mean' gains are 1.0f / (float)count with count clamped to >= 1 —
+//   a single f32 IEEE division, identical to
+//   `1.0 / cnt.astype(np.float32)`.
+// - Routing computes `(clipped - lo) / stride` only when
+//   `clipped >= lo`, so C++ truncating division == NumPy floor division.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+// NumPy's % / // are FLOOR mod/div; C++'s truncate.  The builder must
+// match the oracle on EVERY int32 input — including negative routed
+// ids, which `flat < rows_cap` classifies as in-range exactly like
+// `build_csr_host` does (a truncating % there indexed buffers with a
+// negative partition: heap corruption, caught by review).
+inline int32_t FloorMod(int32_t x, int32_t m) {
+  int32_t r = x % m;
+  return r < 0 ? r + m : r;
+}
+
+inline int32_t FloorDiv(int32_t x, int32_t m) {
+  return (x - FloorMod(x, m)) / m;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Route raw ids into one device's fused local-row space — the twin of
+// `sparsecore._route_ids_np` (including mod-sharding residue windows).
+// ids: [n_cap * gbh] raw ids, slot-major (slot = i / gbh); offs / vocab /
+// lo / hi / stride: [n_cap] per-slot routing constants.  Invalid or
+// out-of-window ids route to the sentinel `rows_cap`.
+void det_csr_route(const int32_t* ids, int64_t n_cap, int64_t gbh,
+                   const int32_t* offs, const int32_t* vocab,
+                   const int32_t* lo, const int32_t* hi,
+                   const int32_t* stride, int32_t rows_cap,
+                   int32_t* routed_out) {
+  for (int64_t s = 0; s < n_cap; ++s) {
+    const int32_t vmax = vocab[s] - 1;
+    const int32_t slo = lo[s], shi = hi[s], sstr = stride[s];
+    const int32_t soff = offs[s];
+    const int32_t* src = ids + s * gbh;
+    int32_t* dst = routed_out + s * gbh;
+    for (int64_t i = 0; i < gbh; ++i) {
+      const int32_t id = src[i];
+      int32_t c = id < 0 ? 0 : (id > vmax ? vmax : id);
+      const bool ok =
+          id >= 0 && c >= slo && c < shi && (c - slo) % sstr == 0;
+      dst[i] = ok ? (c - slo) / sstr + soff : rows_cap;
+    }
+  }
+}
+
+// Per-partition valid-id counts of a routed stream (the capacity-sizing
+// pass for max_ids_per_partition=None).  Returns the total valid count.
+int64_t det_csr_counts(const int32_t* routed, int64_t n, int32_t rows_cap,
+                       int32_t num_sc, int32_t* counts_out) {
+  std::memset(counts_out, 0, sizeof(int32_t) * num_sc);
+  int64_t valid = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int32_t r = routed[i];
+    if (r < rows_cap) {
+      ++counts_out[FloorMod(r, num_sc)];
+      ++valid;
+    }
+  }
+  return valid;
+}
+
+// Padded partition-sorted static-CSR build — the twin of
+// `build_csr_host`'s section scatter.  routed: [n_cap * gb * h] fused
+// local-row ids (>= rows_cap marks padding); cap: per-partition static
+// capacity (the caller 8-aligns it); combiner_mean selects 1/count
+// gains.  Output buffers: row_pointers [num_sc], embedding_ids /
+// sample_ids [num_sc * cap] int32, gains [num_sc * cap] f32.  Returns
+// the dropped-entry count (> 0 iff some partition exceeded cap), or -1
+// on invalid arguments.
+int64_t det_csr_build(const int32_t* routed, int64_t n_cap, int64_t gb,
+                      int64_t h, int32_t rows_cap, int32_t num_sc,
+                      int combiner_mean, int32_t cap,
+                      int32_t* row_pointers, int32_t* embedding_ids,
+                      int32_t* sample_ids, float* gains) {
+  if (num_sc <= 0 || cap <= 0 || h <= 0) return -1;
+  const int64_t n = n_cap * gb * h;
+  const int64_t samples = n_cap * gb;
+  const int64_t out_n = (int64_t)num_sc * cap;
+
+  // padding prefill: sentinel ids, one-past sample ids, zero gains
+  for (int64_t i = 0; i < out_n; ++i) {
+    embedding_ids[i] = rows_cap;
+    sample_ids[i] = (int32_t)samples;
+    gains[i] = 0.0f;
+  }
+
+  // per-sample valid counts ride the 'mean' gains (clamped to >= 1,
+  // exactly like np.maximum(valid.sum(axis=1), 1))
+  std::vector<int32_t> cnt;
+  if (combiner_mean) {
+    cnt.assign(samples, 0);
+    for (int64_t i = 0; i < n; ++i)
+      if (routed[i] < rows_cap) ++cnt[i / h];
+    for (int64_t s = 0; s < samples; ++s)
+      if (cnt[s] < 1) cnt[s] = 1;
+  }
+
+  // counting scatter in flat order == stable partition sort + rank cap
+  std::vector<int32_t> rank(num_sc, 0);
+  int64_t dropped = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int32_t r = routed[i];
+    if (r >= rows_cap) continue;
+    const int32_t p = FloorMod(r, num_sc);
+    const int32_t k = rank[p]++;
+    if (k >= cap) {
+      ++dropped;
+      continue;
+    }
+    const int64_t dst = (int64_t)p * cap + k;
+    embedding_ids[dst] = FloorDiv(r, num_sc);
+    sample_ids[dst] = (int32_t)(i / h);
+    gains[dst] = combiner_mean ? 1.0f / (float)cnt[i / h] : 1.0f;
+  }
+  for (int32_t p = 0; p < num_sc; ++p) {
+    const int32_t kept = rank[p] < cap ? rank[p] : cap;
+    row_pointers[p] = p * cap + kept;
+  }
+  return dropped;
+}
+
+}  // extern "C"

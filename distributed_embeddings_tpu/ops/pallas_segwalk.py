@@ -46,13 +46,14 @@ Hazard discipline: reads are issued first and land while the vector
 core runs the segmented scan (latency hidden behind compute); writes
 are issued at tile end and stay in flight through the NEXT tile's
 reads/compute, draining only when their parity's staging buffers are
-about to be reused two steps later (`ops/pallas_rowwise.py`'s parity
-protocol, with the per-tile in-flight count carried in SMEM because
-the valid-row count here is data-dependent).  This is safe because
-each unique row is touched at exactly one grid step (its segment-last
-position in the sorted stream), so in-flight writes can never alias a
-later step's reads.  Like the rowwise kernel this one is OPT-IN
-(``use_segwalk_apply=True``) until measured on chip.
+about to be reused two steps later (the parity protocol inherited
+from the retired round-2 rowwise kernel, with the per-tile in-flight
+count carried in SMEM because the valid-row count here is
+data-dependent).  This is safe because each unique row is touched at
+exactly one grid step (its segment-last position in the sorted
+stream), so in-flight writes can never alias a later step's reads.
+The kernel is OPT-IN (``use_segwalk_apply=True``) until measured on
+chip.
 """
 
 from __future__ import annotations
@@ -65,8 +66,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Test hook, as in ops/pallas_rowwise.py: engage the kernel in
-# interpreter mode on any backend so CI exercises the real producers.
+# Test hook: engage the kernel in interpreter mode on any backend so
+# CI exercises the real producers.
 FORCE_INTERPRET = False
 # AOT hook: compile-only flows (jax.experimental.topologies) trace on a
 # CPU default backend while targeting TPU, so the runtime's
@@ -159,8 +160,8 @@ def _segwalk_kernel(sid_smem, islast_smem, g_ref, idv_ref, lr_smem,
   scan/carry machinery runs unchanged at that superrow width; the
   optimizer update runs per half on f32-converted staging values and
   rounds to bf16 once at write.  The write-back of a whole fetched
-  pair is SAFE here — unlike the rowwise kernel
-  (ops/pallas_rowwise.py header) — because the segment key IS the
+  pair is SAFE here — unlike a per-unique-row RMW kernel (the retired
+  rowwise kernel's hazard) — because the segment key IS the
   pair: both rows of a pair merge into one segment applied at exactly
   one grid position, so no other step can race the untouched half
   (which is rewritten byte-identically: zero gradient lanes give a

@@ -41,13 +41,16 @@ from distributed_embeddings_tpu.parallel.sparse import (
     dedup_rows,
     make_hybrid_train_step,
     init_hybrid_train_state,
+    run_pipelined,
     sparse_apply_updates,
 )
 from distributed_embeddings_tpu.parallel.sparsecore import (
     StaticCsr,
+    build_csr,
     build_csr_host,
     csr_from_routed,
     calibrate_max_ids_per_partition,
     measure_preprocess_ms,
     preprocess_batch_host,
 )
+from distributed_embeddings_tpu.parallel.csr_feed import CsrFeed, FedBatch

@@ -1,0 +1,208 @@
+"""Double-buffered host->device input pipeline for the SparseCore feed.
+
+``docs/perf_notes.md`` ("Static-CSR host preprocessing cost") measured
+the per-batch host transform at ~260 ns/id single-threaded NumPy — ~9x
+the v5e on-chip gather floor — and named the production fix: pipeline
+the build (batch N+1's buffers are built while the device executes
+batch N) and parallelise it over (group, device) pairs.  This module is
+that pipeline:
+
+- a single ordered PRODUCER thread walks the caller's batch source and
+  runs ``sparsecore.preprocess_batch_host`` for each batch — which
+  itself fans the (group, device) build jobs out over the shared worker
+  pool (native C++ builder when built, NumPy oracle otherwise);
+- a bounded ring (``depth``, default 2 = classic double buffering)
+  holds finished batches, giving backpressure: the producer can run at
+  most ``depth`` batches ahead of the consumer, so host memory for the
+  padded buffers stays bounded;
+- the consumer iterates ``FedBatch``es; ``__next__`` blocks only when
+  the build has NOT finished under the device step it should hide
+  behind — and records exactly that blocked time, so
+  ``stats()['overlap_pct']`` is a DIRECT measurement of how much host
+  build time the device step hid (the metric ``bench.py`` journals),
+  not a subtraction of two noisy walls.
+
+Batches arrive strictly in source order and ``close()`` (or the context
+manager, or source exhaustion) drains the pipeline cleanly; a producer
+exception surfaces on the consumer's next ``__next__`` rather than
+dying silently on a background thread.
+
+The buffers each ``FedBatch`` carries are the hardware feed layout
+(``HostCsr`` per (group, hotness) x device): on SparseCore hardware the
+custom-call binding consumes them directly; on the emulation backend
+they are the measured host-side cost the pipeline exists to hide, while
+the jitted step recomputes the same content via the traced twin (the
+executable specification).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from typing import Any, Callable, Dict, Iterable, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from distributed_embeddings_tpu.parallel import sparsecore
+
+
+class FedBatch(NamedTuple):
+  """One prefetched batch: the caller's original item, its built CSR
+  buffers (``{(group_index, hotness): [HostCsr per device]}``), and the
+  build's wall time on the workers."""
+  item: Any
+  csrs: Dict[Tuple[int, int], List[Any]]
+  build_ms: float
+
+
+class _Done:
+  pass
+
+
+class _Error(NamedTuple):
+  exc: BaseException
+
+
+class CsrFeed:
+  """Double-buffered prefetching feed over a batch source.
+
+  Args:
+    dist: the ``DistributedEmbedding`` whose plan routes the ids.
+    source: iterable of batch items (consumed on the producer thread).
+    cats_fn: ``item -> list of per-table id arrays`` (the
+      ``preprocess_batch_host`` input); default treats the item itself
+      as the cats list.
+    max_ids_per_partition: calibrated per-group capacities
+      (``sparsecore.calibrate_max_ids_per_partition``); None sizes each
+      batch to its own worst partition.
+    depth: ring capacity — how many built batches may wait ahead of the
+      consumer (2 = double buffering).
+    num_workers: per-batch build fan-out (None = the shared pool).
+    native: builder selection ('auto' | 'native' | 'numpy').
+
+  Iterate it (``for fed in feed:``) or use it as a context manager;
+  ``close()`` is idempotent and always drains the producer.
+  """
+
+  def __init__(self, dist, source: Iterable,
+               cats_fn: Optional[Callable[[Any], List[np.ndarray]]] = None,
+               max_ids_per_partition: Optional[Tuple[int, ...]] = None,
+               depth: int = 2,
+               num_workers: Optional[int] = None,
+               native: str = 'auto'):
+    if depth < 1:
+      raise ValueError(f'depth must be >= 1, got {depth}')
+    self._dist = dist
+    self._source = iter(source)
+    self._cats_fn = cats_fn if cats_fn is not None else (lambda item: item)
+    self._caps = max_ids_per_partition
+    self._num_workers = num_workers
+    self.builder = sparsecore.resolve_builder(native)
+    self._ring: queue.Queue = queue.Queue(maxsize=depth)
+    self._stop = threading.Event()
+    self._closed = False
+    self.reset_stats()
+    self._thread = threading.Thread(target=self._produce,
+                                    name='csr-feed-producer', daemon=True)
+    self._thread.start()
+
+  # ------------------------------------------------------------- producer
+
+  def _produce(self):
+    try:
+      for item in self._source:
+        if self._stop.is_set():
+          return
+        t0 = time.perf_counter()
+        csrs = sparsecore.preprocess_batch_host(
+            self._dist, self._cats_fn(item),
+            max_ids_per_partition=self._caps, native=self.builder,
+            num_workers=self._num_workers)
+        build_ms = (time.perf_counter() - t0) * 1000.0
+        self._put(FedBatch(item, csrs, build_ms))
+      self._put(_Done())
+    except BaseException as e:  # surfaces on the consumer's next __next__
+      self._put(_Error(e))
+
+  def _put(self, msg):
+    """Bounded put that aborts promptly when the feed is closing (a
+    plain blocking put could deadlock close() against a full ring)."""
+    while not self._stop.is_set():
+      try:
+        self._ring.put(msg, timeout=0.05)
+        return
+      except queue.Full:
+        continue
+
+  # ------------------------------------------------------------- consumer
+
+  def __iter__(self):
+    return self
+
+  def __next__(self) -> FedBatch:
+    if self._closed:
+      raise StopIteration
+    t0 = time.perf_counter()
+    msg = self._ring.get()
+    blocked_ms = (time.perf_counter() - t0) * 1000.0
+    if isinstance(msg, _Done):
+      self.close()
+      raise StopIteration
+    if isinstance(msg, _Error):
+      self.close()
+      raise msg.exc
+    self._batches += 1
+    self._build_ms += msg.build_ms
+    self._blocked_ms += blocked_ms
+    return msg
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()
+    return False
+
+  def close(self):
+    """Stop the producer and drain the ring; idempotent.  Batches
+    already built but not consumed are discarded."""
+    if self._closed:
+      return
+    self._closed = True
+    self._stop.set()
+    while True:  # unblock a producer waiting on a full ring
+      try:
+        self._ring.get_nowait()
+      except queue.Empty:
+        break
+    self._thread.join(timeout=30.0)
+
+  # ---------------------------------------------------------------- stats
+
+  def reset_stats(self):
+    """Zero the overlap accounting — e.g. after the first batch, whose
+    build has no prior device step to hide behind, so steady-state
+    overlap is reported."""
+    self._batches = 0
+    self._build_ms = 0.0
+    self._blocked_ms = 0.0
+
+  def stats(self) -> Dict[str, Any]:
+    """Overlap accounting since the last ``reset_stats()``.
+
+    ``build_ms`` is the total wall time the workers spent building the
+    consumed batches; ``blocked_ms`` is the total time ``__next__``
+    waited for a build — i.e. host build time NOT hidden behind the
+    device step.  ``overlap_pct`` = share of build time hidden."""
+    build = self._build_ms
+    hidden = max(0.0, build - self._blocked_ms)
+    return {
+        'batches': self._batches,
+        'build_ms': round(build, 3),
+        'blocked_ms': round(self._blocked_ms, 3),
+        'overlap_pct': (round(100.0 * hidden / build, 1) if build > 0
+                        else None),
+        'builder': self.builder,
+    }

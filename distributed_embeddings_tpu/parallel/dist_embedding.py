@@ -285,6 +285,21 @@ class DistributedEmbedding:
           self.sparsecore_backend)
     return self._sc_backend_resolved
 
+  def make_csr_feed(self, source, cats_fn=None,
+                    max_ids_per_partition=None, depth: int = 2,
+                    num_workers=None, native: str = 'auto'):
+    """Pipelined host feed over a batch source: batch N+1's padded
+    static-CSR buffers build on worker threads while the device
+    executes batch N (``parallel/csr_feed.CsrFeed``; docs/design.md §8
+    "host feed pipeline").  ``cats_fn`` extracts the per-table id list
+    from a source item; pass calibrated ``max_ids_per_partition``
+    (``sparsecore.calibrate_max_ids_per_partition``) so every batch's
+    buffers share the static hardware capacity."""
+    from distributed_embeddings_tpu.parallel.csr_feed import CsrFeed
+    return CsrFeed(self, source, cats_fn=cats_fn,
+                   max_ids_per_partition=max_ids_per_partition,
+                   depth=depth, num_workers=num_workers, native=native)
+
   # ------------------------------------------------------------------ init
 
   def init(self, rng: Union[int, jax.Array]) -> Dict[str, jax.Array]:

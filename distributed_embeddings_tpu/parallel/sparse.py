@@ -269,19 +269,13 @@ class SparseAdagrad:
   dedup: bool = True
   capacity_fraction: float = 0.5
   capacity_rows: Optional[Tuple[Optional[int], ...]] = None
-  # opt-in fused Pallas apply (ops/pallas_rowwise.py): one DMA pass over
-  # the unique rows instead of three XLA random passes; takes effect on
-  # TPU for f32 tables at the 128-lane width — narrow widths engage it
-  # only through the lane-packed [rows/pack, 128] view (_lane_pack),
-  # silently falling back to the XLA path elsewhere
-  use_pallas_apply: bool = False
   # opt-in fused segment-walk apply (ops/pallas_segwalk.py): consumes
   # the SORTED raw stream directly — segment-sum + update in one pass,
-  # no compaction pipeline at all; same width/dtype support as above,
-  # serving narrow groups of ANY size under the default packed storage
-  # (only packed_storage=False adds the pack-divisibility and
-  # packed_dispatch_ok HBM gates, where huge narrow groups fall back to
-  # XLA).  Takes precedence over use_pallas_apply when both are set.
+  # no compaction pipeline at all; engages on TPU for f32 tables at the
+  # 128-lane width, serving narrow groups of ANY size under the default
+  # packed storage (only packed_storage=False adds the
+  # pack-divisibility and packed_dispatch_ok HBM gates, where huge
+  # narrow groups fall back to XLA).
   use_segwalk_apply: bool = False
   # stream payload dtype for the segwalk kernel (see SparseSGD)
   stream_dtype: str = 'float32'
@@ -329,16 +323,6 @@ class SparseAdagrad:
     pass per step (~143 ms each at synthetic-tiny scale, trace in
     docs/perf_notes.md).
     """
-    if self.use_pallas_apply:
-      from distributed_embeddings_tpu.ops import pallas_rowwise
-      interpret = pallas_rowwise.FORCE_INTERPRET
-      if ((jax.default_backend() == 'tpu' or interpret)
-          and pallas_rowwise.supported(table, state['acc'])):
-        t2, a2 = pallas_rowwise.adagrad_apply(
-            table, state['acc'], uids, sum_g, sum_sq,
-            jnp.asarray(lr, jnp.float32), dedup=self.dedup,
-            eps=self.epsilon, interpret=interpret)
-        return t2, {'acc': a2}
     add = sum_g * sum_g if self.dedup else sum_sq
     safe = jnp.clip(uids, 0, table.shape[0] - 1)
     # compacted ids are ascending; _distinct_oob makes them strictly
@@ -1076,6 +1060,49 @@ def make_hybrid_train_step(dist: DistributedEmbedding,
     return jitted(state, cats, batch)
 
   return run
+
+
+def run_pipelined(step, state, feed, batch_fn,
+                  on_step: Optional[Callable] = None):
+  """Drive a hybrid train step over a pipelined host feed
+  (``parallel/csr_feed.CsrFeed``): while the device executes batch N,
+  the feed's worker threads build batch N+1's padded static-CSR
+  buffers — the host-provisioning overlap of docs/design.md §8.
+
+  Each iteration synchronises on the step's loss: that blocking window
+  IS the device time the next batch's build hides behind, and it makes
+  the feed's ``stats()['overlap_pct']`` a direct measurement (the
+  consumer's blocked time in ``__next__`` is exactly the build time the
+  device did NOT hide).  The first batch's build has no prior step to
+  hide behind, so the feed's stats reset after it — the reported
+  overlap is steady-state.
+
+  Args:
+    step: the ``make_hybrid_train_step`` callable.
+    state: initial ``TrainState``.
+    feed: a ``CsrFeed`` (closed on exit, even on error).
+    batch_fn: ``fed -> (cats, batch)`` — the step's inputs from a
+      ``FedBatch`` (its ``item`` is the source item; its ``csrs`` are
+      the hardware feed buffers).
+    on_step: optional ``(i, fed, loss) -> None`` observer (loss is
+      already synchronised).
+
+  Returns:
+    ``(state, losses, feed_stats)`` — ``feed_stats`` is
+    ``CsrFeed.stats()`` at exit (steady-state overlap accounting).
+  """
+  losses = []
+  with feed:
+    for i, fed in enumerate(feed):
+      cats, batch = batch_fn(fed)
+      state, loss = step(state, cats, batch)
+      losses.append(float(loss))  # sync: the window the next build hides in
+      if i == 0:
+        feed.reset_stats()
+      if on_step is not None:
+        on_step(i, fed, loss)
+    stats = feed.stats()
+  return state, losses, stats
 
 
 def _calibration_mirror(dist: DistributedEmbedding, cpus):

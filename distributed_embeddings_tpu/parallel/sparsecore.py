@@ -53,8 +53,11 @@ secretly be something else.
 
 from __future__ import annotations
 
+import os
+import threading
 import time
 
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
@@ -311,6 +314,73 @@ def build_csr_host(routed: np.ndarray, rows_cap: int, num_sc: int,
       max_ids_per_partition=cap, dropped=dropped)
 
 
+def native_available() -> bool:
+  """Whether the C++ builder (cc/csr_builder.cc via csr_native) loads on
+  this host — building it on first call when a toolchain exists."""
+  from distributed_embeddings_tpu.parallel import csr_native
+  return csr_native.available()
+
+
+def resolve_builder(native: str = 'auto') -> str:
+  """Resolve the host-builder request 'auto' | 'native' | 'numpy' to the
+  concrete builder.  'auto' takes the C++ builder when it loads (the
+  production feed path, ~10-20x the NumPy transform on this host) and
+  falls back to NumPy otherwise; 'native' raises when unavailable so a
+  measurement labelled native can never silently be NumPy."""
+  if native not in ('auto', 'native', 'numpy'):
+    raise ValueError(f'unknown csr builder mode {native!r}')
+  if native == 'numpy':
+    return 'numpy'
+  if native_available():
+    return 'native'
+  if native == 'native':
+    raise RuntimeError(
+        'native CSR builder requested but cc/libdetcsr.so is not '
+        'buildable/loadable on this host (make -C '
+        'distributed_embeddings_tpu/cc)')
+  return 'numpy'
+
+
+def build_csr(routed: np.ndarray, rows_cap: int, num_sc: int,
+              combiner: Optional[str] = 'sum',
+              max_ids_per_partition: Optional[int] = None,
+              native: str = 'auto') -> HostCsr:
+  """The ONE builder entry the host feed uses: the native C++ twin when
+  built, else the NumPy oracle (``build_csr_host``) — bit-identical
+  output either way (fuzzed in tests/test_csr_native.py)."""
+  if resolve_builder(native) == 'native':
+    from distributed_embeddings_tpu.parallel import csr_native
+    return csr_native.build_csr(routed, rows_cap, num_sc, combiner,
+                                max_ids_per_partition)
+  return build_csr_host(routed, rows_cap, num_sc, combiner,
+                        max_ids_per_partition)
+
+
+# The (group, device) build jobs are embarrassingly parallel and the
+# native builder releases the GIL for the whole call, so shared thread
+# pools (one per requested size, process-lifetime, lock-guarded
+# creation) parallelise every feed on this host: CsrFeed's producer
+# calls this per BATCH, so pools must never be created/torn down on
+# that hot path.  The default size is the core count (capped): the
+# build is CPU-bound, more threads only contend.
+_POOLS: Dict[int, ThreadPoolExecutor] = {}
+_POOL_LOCK = threading.Lock()
+
+
+def default_build_workers() -> int:
+  return max(1, min(8, os.cpu_count() or 1))
+
+
+def _worker_pool(num_workers: Optional[int] = None) -> ThreadPoolExecutor:
+  size = num_workers if num_workers else default_build_workers()
+  with _POOL_LOCK:
+    pool = _POOLS.get(size)
+    if pool is None:
+      pool = _POOLS[size] = ThreadPoolExecutor(
+          max_workers=size, thread_name_prefix=f'csr-build-{size}')
+    return pool
+
+
 # --------------------------------------------------------------------------
 # executable emulation backend
 # --------------------------------------------------------------------------
@@ -479,61 +549,132 @@ def _route_ids_np(ids: np.ndarray, offs, vocab, rows_cap: int,
       np.int32)
 
 
+def _route_and_build(dist, cats, sub, dev, cap, num_sc: int, stride,
+                     builder: str) -> HostCsr:
+  """ONE (subgroup, device) unit of the host feed: stage the slot ids,
+  route them into this device's fused local-row space, and build the
+  padded partition-sorted CSR buffers.  Pure NumPy/native — safe to run
+  on any worker thread (the native calls release the GIL)."""
+  g = dist.plan.groups[sub.gi]
+  slot_ids = []
+  for s in range(sub.n_cap):
+    if s < len(sub.requests[dev]):
+      x = cats[sub.requests[dev][s].input_id]
+      x = x[:, None] if x.ndim == 1 else x
+    else:
+      x = np.full((cats[0].shape[0], sub.hotness), -1, np.int32)
+    slot_ids.append(np.ascontiguousarray(x, np.int32))
+  ids = np.stack(slot_ids)  # [n_cap, GB, h]
+  if builder == 'native':
+    from distributed_embeddings_tpu.parallel import csr_native
+    routed = csr_native.route_ids(ids, sub.offsets[dev], sub.vocab[dev],
+                                  g.rows_cap, sub.row_lo[dev],
+                                  sub.row_hi[dev], stride[dev])
+    return csr_native.build_csr(routed, g.rows_cap, num_sc,
+                                combiner=sub.lookup_combiner,
+                                max_ids_per_partition=cap)
+  routed = _route_ids_np(ids, sub.offsets[dev], sub.vocab[dev],
+                         g.rows_cap, sub.row_lo[dev], sub.row_hi[dev],
+                         stride[dev])
+  return build_csr_host(routed, g.rows_cap, num_sc,
+                        combiner=sub.lookup_combiner,
+                        max_ids_per_partition=cap)
+
+
 def preprocess_batch_host(dist, cats,
                           max_ids_per_partition: Optional[Tuple[int, ...]]
-                          = None) -> Dict[Tuple[int, int], List[HostCsr]]:
+                          = None, native: str = 'auto',
+                          num_workers: Optional[int] = None
+                          ) -> Dict[Tuple[int, int], List[HostCsr]]:
   """Per-batch HOST preprocessing for the real SC feed: route every
   subgroup's raw ids into each device's fused local-row space (the
-  NumPy twin of ``_route_ids``) and build the padded partition-sorted
-  CSR buffers per (subgroup, device).
+  native/NumPy twin of ``_route_ids``) and build the padded
+  partition-sorted CSR buffers per (subgroup, device).
+
+  The transform is embarrassingly parallel over (subgroup, device)
+  pairs (docs/perf_notes.md), so the build fans out over the shared
+  worker pool by default; results are identical at ANY worker count
+  (each pair's buffers depend only on its own inputs — asserted by the
+  thread-invariance test).  ``num_workers``: None = the shared
+  default-size pool (``default_build_workers()``), 0/1 = inline
+  serial, N > 1 = a cached process-lifetime pool of exactly N
+  workers.  ``native`` picks the builder (``resolve_builder``).
 
   Returns ``{(group_index, hotness): [HostCsr per device]}``.  This is
-  the function ``bench.py`` times (``measure_preprocess_ms``) to ground
-  the v5p projection's "including preprocessing" term in a number.
+  the function ``bench.py`` times (``measure_preprocess_ms``) and the
+  pipelined feed (``parallel/csr_feed.CsrFeed``) runs on its workers.
   """
   cats = [np.asarray(c) for c in cats]
   hotness = tuple(1 if c.ndim == 1 else c.shape[1] for c in cats)
   subs = dist._subgroups(hotness)
   num_sc = getattr(dist.plan, 'num_sc', 4)
-  out: Dict[Tuple[int, int], List[HostCsr]] = {}
-  for sub in subs:
-    g = dist.plan.groups[sub.gi]
-    # the SAME [D, n_cap] stride table the traced routing selects from
-    # (_SubGroup.row_stride) — re-deriving it here could silently drift
-    # from the real routed ids
-    stride = (sub.row_stride if sub.row_stride is not None else
+  builder = resolve_builder(native)
+  # the SAME [D, n_cap] stride table the traced routing selects from
+  # (_SubGroup.row_stride) — re-deriving it here could silently drift
+  # from the real routed ids
+  strides = [(sub.row_stride if sub.row_stride is not None else
               np.ones((dist.world_size, sub.n_cap), np.int32))
-    cap = None
-    if max_ids_per_partition is not None:
-      cap = max_ids_per_partition[sub.gi]
-    per_dev = []
+             for sub in subs]
+  caps = [None if max_ids_per_partition is None else
+          max_ids_per_partition[sub.gi] for sub in subs]
+  serial = num_workers is not None and num_workers <= 1
+  # explicit counts get a cached pool of exactly that size (never a
+  # per-call pool: CsrFeed resolves this once per batch)
+  pool = None if serial else _worker_pool(num_workers)
+  jobs = []  # (sub index within `subs`, dev, result-or-future)
+  for si, sub in enumerate(subs):
     for dev in range(dist.world_size):
-      slot_ids = []
-      for s in range(sub.n_cap):
-        if s < len(sub.requests[dev]):
-          x = cats[sub.requests[dev][s].input_id]
-          x = x[:, None] if x.ndim == 1 else x
-        else:
-          x = np.full((cats[0].shape[0], sub.hotness), -1, np.int32)
-        slot_ids.append(x.astype(np.int32))
-      ids = np.stack(slot_ids)  # [n_cap, GB, h]
-      routed = _route_ids_np(ids, sub.offsets[dev], sub.vocab[dev],
-                             g.rows_cap, sub.row_lo[dev], sub.row_hi[dev],
-                             stride[dev])
-      per_dev.append(
-          build_csr_host(routed, g.rows_cap, num_sc,
-                         combiner=sub.lookup_combiner,
-                         max_ids_per_partition=cap))
-    out[(sub.gi, sub.hotness)] = per_dev
+      args = (dist, cats, sub, dev, caps[si], num_sc, strides[si],
+              builder)
+      jobs.append((si, dev, _route_and_build(*args) if serial else
+                   pool.submit(_route_and_build, *args)))
+  per_sub: Dict[int, List[HostCsr]] = {si: [] for si in range(len(subs))}
+  for si, dev, job in jobs:  # device order preserved (si asc, dev asc)
+    per_sub[si].append(job if serial else job.result())
+  out: Dict[Tuple[int, int], List[HostCsr]] = {}
+  for si, sub in enumerate(subs):
+    out[(sub.gi, sub.hotness)] = per_sub[si]
   return out
+
+
+def _csrs_equal(a: Dict[Tuple[int, int], List[HostCsr]],
+                b: Dict[Tuple[int, int], List[HostCsr]]) -> bool:
+  """Bit-exact equality of two full preprocessed batches (every buffer
+  of every (group, device) pair) — the live oracle check the bench
+  journals alongside the native builder's numbers."""
+  if a.keys() != b.keys():
+    return False
+  for k in a:
+    if len(a[k]) != len(b[k]):
+      return False
+    for x, y in zip(a[k], b[k]):
+      if (x.max_ids_per_partition != y.max_ids_per_partition
+          or x.dropped != y.dropped):
+        return False
+      for fa, fb in zip(x[:4], y[:4]):
+        if not np.array_equal(fa, fb):
+          return False
+  return True
 
 
 def measure_preprocess_ms(dist, cats, repeats: int = 3,
                           max_ids_per_partition: Optional[Tuple[int, ...]]
                           = None) -> Dict[str, Any]:
-  """Time ``preprocess_batch_host`` on this host: min-of-k wall time per
-  batch plus the total id volume, for the bench artifact and
-  docs/perf_notes.md.
+  """Time the per-batch host feed on this host, for the bench artifact
+  and docs/perf_notes.md ("host feed pipeline").
+
+  Three measurements from the same batch and caps:
+
+  - ``csr_numpy_ns_per_id``: the single-threaded NumPy oracle — the
+    260 ns/id baseline of the round-6 note;
+  - ``csr_native_ns_per_id``: the C++ builder, single-threaded (absent
+    when no toolchain);
+  - ``csr_preprocess_ns_per_id`` (+ ``_ms``/``_ids``): the REAL feed
+    path — the resolved builder fanned out over the shared worker pool
+    — i.e. what ``CsrFeed`` pays per batch.  ``csr_preprocess_builder``
+    labels which builder that was, and ``csr_native_parity`` is a live
+    bit-exactness check of the native buffers against the NumPy oracle
+    on this very batch (never assumed from the test suite alone).
 
   The timed builds always run with STATIC per-group capacities — the
   caller's calibrated ``max_ids_per_partition`` when given, else caps
@@ -550,20 +691,38 @@ def measure_preprocess_ms(dist, cats, repeats: int = 3,
                          max(c.max_ids_per_partition for c in lst))
     caps = tuple(by_group.get(gi, 8)
                  for gi in range(len(dist.plan.groups)))
-  times = []
-  dropped = 0
-  for _ in range(max(1, repeats)):
-    t0 = time.perf_counter()
-    csrs = preprocess_batch_host(dist, cats, max_ids_per_partition=caps)
-    times.append((time.perf_counter() - t0) * 1000.0)
-    dropped = sum(c.dropped for lst in csrs.values() for c in lst)
   n_ids = int(sum(np.asarray(c).size for c in cats))
-  return {
-      'csr_preprocess_ms': round(min(times), 3),
+  repeats = max(1, repeats)
+
+  def timed(native: str, num_workers: Optional[int]):
+    times, last = [], None
+    for _ in range(repeats):
+      t0 = time.perf_counter()
+      last = preprocess_batch_host(dist, cats, max_ids_per_partition=caps,
+                                   native=native, num_workers=num_workers)
+      times.append((time.perf_counter() - t0) * 1000.0)
+    return min(times), last
+
+  ns = lambda ms: round(ms * 1e6 / max(n_ids, 1), 2)
+  np_ms, np_csrs = timed('numpy', num_workers=1)
+  out: Dict[str, Any] = {'csr_numpy_ns_per_id': ns(np_ms)}
+  builder = resolve_builder('auto')
+  if builder == 'native':
+    nat_ms, nat_csrs = timed('native', num_workers=1)
+    out['csr_native_ns_per_id'] = ns(nat_ms)
+    out['csr_native_parity'] = _csrs_equal(np_csrs, nat_csrs)
+  workers = default_build_workers()
+  feed_ms, feed_csrs = timed(builder, num_workers=None)
+  dropped = sum(c.dropped for lst in feed_csrs.values() for c in lst)
+  out.update({
+      'csr_preprocess_ms': round(feed_ms, 3),
       'csr_preprocess_ids': n_ids,
-      'csr_preprocess_ns_per_id': round(min(times) * 1e6 / max(n_ids, 1), 2),
+      'csr_preprocess_ns_per_id': ns(feed_ms),
+      'csr_preprocess_builder': (f'{builder}-parallel({workers})'
+                                 if workers > 1 else builder),
       'csr_dropped': dropped,
-  }
+  })
+  return out
 
 
 # --------------------------------------------------------------------------

@@ -43,12 +43,12 @@ def _segwalk_group_ok(g, dt) -> bool:
 
 
 def _group_table_aval(g, dt):
-  """The shape the KERNEL actually sees for this group: both kernels
-  are width-128-only at the kernel boundary, so narrow groups engage
-  through the lane-packed ``[rows_cap/pack, 128]`` view (the runtime's
-  ``_lane_pack`` for the rowwise apply, the in-kernel packed path for
-  the segment-walk) — the probe must mirror that or it misreports
-  exactly the fallback confusion it exists to prevent.  The runtime's
+  """The shape the KERNEL actually sees for this group: the kernel is
+  width-128-only at the kernel boundary, so narrow groups engage
+  through the lane-packed ``[rows_cap/pack, 128]`` view (the in-kernel
+  packed path for the segment-walk) — the probe must mirror that or it
+  misreports exactly the fallback confusion it exists to prevent.  The
+  runtime's
   packed dispatch additionally declines huge narrow groups whose
   lane-padded layout would blow HBM (``packed_dispatch_ok``); those
   groups are probed at their natural narrow width — which the kernels
@@ -65,32 +65,22 @@ def _group_table_aval(g, dt):
   return jax.ShapeDtypeStruct((g.rows_cap, w), dt)
 
 
-def eligibility_line(dist, param_dtype, fused_apply: bool,
-                     segwalk_apply: bool,
+def eligibility_line(dist, param_dtype, segwalk_apply: bool,
                      accum_dtype: str = 'float32',
                      sparsecore_apply: bool = False) -> str:
   """One line saying which fusion groups each requested fused kernel
   would actually serve, and whether it engages on this backend at all
-  (empty string when neither kernel is requested).  ``accum_dtype``
-  mirrors the dispatch's low-precision-accumulator gate
-  (``sparse._use_segwalk`` / ``pallas_rowwise.supported``): the rowwise
-  kernel is f32-only; segwalk serves bf16 accumulators only on bf16
-  tables (the pair-fetch path)."""
+  (empty string when no kernel is requested).  ``accum_dtype`` mirrors
+  the dispatch's low-precision-accumulator gate
+  (``sparse._use_segwalk``): segwalk serves bf16 accumulators only on
+  bf16 tables (the pair-fetch path)."""
   parts = []
   dt = jnp.dtype(param_dtype)
-  adt = jnp.dtype(accum_dtype)
   groups = dist.plan.groups
-  if fused_apply:
-    from distributed_embeddings_tpu.ops import pallas_rowwise
-    ok = sum(1 for g in groups if pallas_rowwise.supported(
-        _group_table_aval(g, dt),
-        _group_table_aval(g, adt)))
-    parts.append(f'fused_apply: {ok}/{len(groups)} groups eligible'
-                 f'{_active_suffix(pallas_rowwise.FORCE_INTERPRET)}')
   if segwalk_apply:
     from distributed_embeddings_tpu.ops import pallas_segwalk
     ok = (sum(1 for g in groups if _segwalk_group_ok(g, dt))
-          if pallas_segwalk.acc_dtype_ok(dt, adt) else 0)
+          if pallas_segwalk.acc_dtype_ok(dt, accum_dtype) else 0)
     parts.append(f'segwalk_apply: {ok}/{len(groups)} groups eligible'
                  f'{_active_suffix(pallas_segwalk.FORCE_INTERPRET, pallas_segwalk.ASSUME_TPU)}')
   if sparsecore_apply:

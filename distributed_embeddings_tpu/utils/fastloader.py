@@ -13,56 +13,34 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 from typing import Optional, Sequence
 
 import numpy as np
 
+from distributed_embeddings_tpu.utils import nativebuild
 from distributed_embeddings_tpu.utils.data import (BinaryCriteoReader,
                                                    smallest_int_dtype)
 
-_CC_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), 'cc')
-_SO_PATH = os.path.join(_CC_DIR, 'libdetfastloader.so')
-_CC_SRC = os.path.join(_CC_DIR, 'fastloader.cc')
+_SO_NAME = 'libdetfastloader.so'
+_SRC_NAMES = ('fastloader.cc',)
 
 _lib = None
 
 
 def build(quiet: bool = True) -> bool:
   """Builds the shared library with make; returns success."""
-  try:
-    subprocess.run(['make', '-C', _CC_DIR],
-                   check=True,
-                   capture_output=quiet)
-    return os.path.exists(_SO_PATH)
-  except (subprocess.CalledProcessError, FileNotFoundError):
-    return False
-
-
-def _stale() -> bool:
-  """True when the built library predates the source (a stale binary must
-  not silently shadow edited source — ADVICE.md round 1)."""
-  try:
-    return os.path.getmtime(_SO_PATH) < os.path.getmtime(_CC_SRC)
-  except OSError:
-    return True
+  return nativebuild.build(target=_SO_NAME, quiet=quiet)
 
 
 def _load():
   global _lib
   if _lib is not None:
     return _lib
-  if not os.path.exists(_SO_PATH) or _stale():
-    # build on demand (first use, or source newer than the binary); when
-    # the rebuild fails a stale binary must NOT shadow the edited source —
-    # fall back to the Python loader instead
-    if not build():
-      return None
-  try:
-    lib = ctypes.CDLL(_SO_PATH)
-  except OSError:
-    # wrong arch/libc for this platform: unavailable, not fatal
+  # build on demand (first use, or source newer than the binary — a stale
+  # binary must NOT shadow edited source); unavailable falls back to the
+  # Python loader (shared lifecycle: utils/nativebuild.py)
+  lib = nativebuild.load(_SO_NAME, _SRC_NAMES)
+  if lib is None:
     return None
   lib.det_loader_open.restype = ctypes.c_void_p
   lib.det_loader_open.argtypes = [

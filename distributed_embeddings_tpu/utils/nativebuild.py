@@ -1,0 +1,80 @@
+"""Shared build/staleness/load plumbing for the native C++ pieces.
+
+Both ctypes-backed libraries (``utils/fastloader.py`` ->
+``cc/libdetfastloader.so``, ``parallel/csr_native.py`` ->
+``cc/libdetcsr.so``) follow the same lifecycle: build on demand with the
+one ``cc/`` Makefile, refuse to let a stale binary shadow edited source
+(ADVICE.md round 1), and degrade to their pure-Python twin when the
+toolchain or platform cannot produce a loadable library.  This module is
+that lifecycle, once, so the two bindings cannot drift — and so tier-1
+tests share one visible skip reason when no C++ toolchain is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Sequence
+
+CC_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), 'cc')
+
+
+def so_path(so_name: str) -> str:
+  return os.path.join(CC_DIR, so_name)
+
+
+def src_path(src_name: str) -> str:
+  return os.path.join(CC_DIR, src_name)
+
+
+def build(target: Optional[str] = None, quiet: bool = True) -> bool:
+  """Runs make in cc/ (one named target, or everything); returns success.
+
+  False covers both a failed compile and a missing toolchain — callers
+  fall back to the Python twin either way, and ``toolchain_note`` gives
+  tests a visible skip reason.
+  """
+  cmd = ['make', '-C', CC_DIR] + ([target] if target else [])
+  try:
+    subprocess.run(cmd, check=True, capture_output=quiet)
+    return target is None or os.path.exists(so_path(target))
+  except (subprocess.CalledProcessError, FileNotFoundError):
+    return False
+
+
+def stale(so_name: str, src_names: Sequence[str]) -> bool:
+  """True when the built library predates ANY of its sources (a stale
+  binary must not silently shadow edited source)."""
+  try:
+    so_mtime = os.path.getmtime(so_path(so_name))
+    return any(so_mtime < os.path.getmtime(src_path(s)) for s in src_names)
+  except OSError:
+    return True
+
+
+def load(so_name: str, src_names: Sequence[str]) -> Optional[ctypes.CDLL]:
+  """Loads ``cc/<so_name>``, building (or rebuilding when stale) first.
+
+  Returns None when the library cannot be built or loaded on this
+  platform — unavailable, not fatal; callers fall back to Python.
+  """
+  if not os.path.exists(so_path(so_name)) or stale(so_name, src_names):
+    if not build(target=so_name):
+      return None
+  try:
+    return ctypes.CDLL(so_path(so_name))
+  except OSError:
+    # wrong arch/libc for this platform: unavailable, not fatal
+    return None
+
+
+def toolchain_note() -> str:
+  """One-line skip reason for tests gated on the native build."""
+  cxx = os.environ.get('CXX', 'g++')
+  try:
+    subprocess.run([cxx, '--version'], capture_output=True, check=True)
+    return f'native build failed despite {cxx} being present (see make -C cc)'
+  except (subprocess.CalledProcessError, FileNotFoundError):
+    return f'no C++ toolchain ({cxx} not found)'

@@ -19,7 +19,6 @@ def main():
   p.add_argument('--model', default='tiny')
   p.add_argument('--trace', default='')
   p.add_argument('--param_dtype', default='float32')
-  p.add_argument('--fused_apply', action='store_true')
   p.add_argument('--segwalk_apply', action='store_true')
   args = p.parse_args()
 
@@ -58,13 +57,11 @@ def main():
 
   opt = optax.adagrad(0.01, initial_accumulator_value=0.1, eps=1e-7)
   emb_opt = SparseAdagrad(learning_rate=0.01,
-                          use_pallas_apply=args.fused_apply,
                           use_segwalk_apply=args.segwalk_apply)
-  if args.fused_apply or args.segwalk_apply:
+  if args.segwalk_apply:
     from distributed_embeddings_tpu.utils.apply_eligibility import (
         eligibility_line)
-    print(eligibility_line(dist, args.param_dtype, args.fused_apply,
-                           args.segwalk_apply))
+    print(eligibility_line(dist, args.param_dtype, args.segwalk_apply))
   step = make_hybrid_train_step(dist, head_loss_fn, opt, emb_opt, jit=False)
 
   def run(st):

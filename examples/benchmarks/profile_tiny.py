@@ -9,7 +9,7 @@ Phases isolate the three candidate bottlenecks of the sparse trainer
   dense    - autodiff + optax dense-grad step (O(vocab) updates)
 
 Usage: python examples/benchmarks/profile_tiny.py --phase fwd [--model tiny]
-       [--fused_apply | --segwalk_apply]   (only --phase full runs the
+       [--segwalk_apply]                   (only --phase full runs the
                                             sparse apply these select)
 """
 
@@ -28,11 +28,10 @@ def main():
   p.add_argument('--model', default='tiny')
   p.add_argument('--batch', type=int, default=65536)
   p.add_argument('--steps', type=int, default=5)
-  p.add_argument('--fused_apply', action='store_true')
   p.add_argument('--segwalk_apply', action='store_true')
   args = p.parse_args()
-  if (args.fused_apply or args.segwalk_apply) and args.phase != 'full':
-    p.error('--fused_apply/--segwalk_apply only affect --phase full '
+  if args.segwalk_apply and args.phase != 'full':
+    p.error('--segwalk_apply only affects --phase full '
             '(the other phases never run the sparse apply)')
 
   import jax
@@ -71,13 +70,11 @@ def main():
 
   opt = optax.adagrad(0.01, initial_accumulator_value=0.1, eps=1e-7)
   emb_opt = SparseAdagrad(learning_rate=0.01,
-                          use_pallas_apply=args.fused_apply,
                           use_segwalk_apply=args.segwalk_apply)
-  if args.fused_apply or args.segwalk_apply:
+  if args.segwalk_apply:
     from distributed_embeddings_tpu.utils.apply_eligibility import (
         eligibility_line)
-    print(eligibility_line(dist, 'float32', args.fused_apply,
-                           args.segwalk_apply))
+    print(eligibility_line(dist, 'float32', args.segwalk_apply))
 
   if args.phase == 'fwd':
     def run(ep):

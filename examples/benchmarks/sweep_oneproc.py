@@ -119,11 +119,7 @@ def main():
         ('segwalk-bf16stream', {'use_segwalk_apply': True,
                                 'stream_dtype': 'bfloat16'}),
     ]
-    if param_dtype == 'float32':
-      # the rowwise kernel is f32-only: a bf16 'fused' phase would
-      # spend ~5 min of a tunnel window measuring its XLA fallback
-      variants.append(('fused', {'use_pallas_apply': True}))
-    else:
+    if param_dtype != 'float32':
       # the jumbo-scale configuration: bf16 tables + bf16 accumulators
       # + bf16 stream through the segwalk pair-fetch path (bf16 acc on
       # f32 tables would measure the XLA fallback — bf16 models only)
@@ -165,7 +161,6 @@ def main():
         step_ms = (time.perf_counter() - t0) / args.steps * 1000
         signal.alarm(0)
         note = eligibility_line(dist, param_dtype,
-                                flags.get('use_pallas_apply', False),
                                 flags.get('use_segwalk_apply', False),
                                 accum_dtype=flags.get('accum_dtype',
                                                       'float32'))

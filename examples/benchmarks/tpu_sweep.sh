@@ -40,12 +40,14 @@ run() {
 # per-row costs (minutes; small programs)
 T=540 run python examples/benchmarks/scatter_probe.py
 
-# 2. kernel microbenches at the exact dominant shapes (decide defaults).
-# DET_TESTS_REAL_TPU=1 stops conftest pinning the CPU backend — without
-# it every TPU-gated test silently SKIPS and the step reads as green
-# (wiring bug caught in round-4 rehearsal).
+# 2. kernel microbench at the exact dominant shape (decides defaults).
+# The segwalk entry is the ONE apply microbench (the rowwise kernel and
+# its A/B were deleted round 6 per the VERDICT r5 deadline —
+# docs/perf_notes.md "Kernel inventory").  DET_TESTS_REAL_TPU=1 stops
+# conftest pinning the CPU backend — without it every TPU-gated test
+# silently SKIPS and the step reads as green (wiring bug caught in
+# round-4 rehearsal).
 T=900 run env DET_TESTS_REAL_TPU=1 python -m pytest tests/test_pallas_tpu.py -q -s -k segwalk_apply_microbench
-T=900 run env DET_TESTS_REAL_TPU=1 python -m pytest tests/test_pallas_tpu.py -q -s -k rowwise_apply_microbench
 
 # 3. lookup microbenchmark (fwd/grad/apply at the reference's 1Mx128
 # shape — the pallas_lookup keep-or-demote decision, VERDICT r4 item 8)
@@ -82,13 +84,17 @@ if ! tail -c +$((OFF0 + 1)) "$LOG" \
     | tee -a "$LOG"
 fi
 
-# 7. ALL apply-variant A/Bs in one backend session: xla/segwalk/fused
-# at f32 + bf16 for tiny, plus the criteo trio; one JSON line each,
-# flushed as they land, SIGALRM per phase.
+# 7. ALL apply-variant A/Bs in one backend session: xla/segwalk (+ the
+# bf16-stream/acc variants) at f32 + bf16 for tiny, plus the criteo
+# trio; one JSON line each, flushed as they land, SIGALRM per phase.
 T=9000 run python examples/benchmarks/sweep_oneproc.py --steps 10
 
-# 8. Criteo-shaped DLRM end-to-end: loader throughput, steady-state
-# samples/s, AUC-vs-step curve (VERDICT r3 item 4)
+# 8. Criteo-shaped DLRM: FIRST the ~5-minute budget row (smaller batch,
+# low-effort compile, steps-only throughput, labelled) so a medium
+# window lands a DLRM line at all (VERDICT r5 item 6), THEN the full
+# end-to-end run: loader throughput, steady-state samples/s,
+# AUC-vs-step curve (VERDICT r3 item 4)
+T=480 run bash examples/dlrm/chip_run.sh --budget
 T=3600 run bash examples/dlrm/chip_run.sh
 
 # 9. steady-state trace decomposition of the default path

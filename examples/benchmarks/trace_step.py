@@ -1,7 +1,7 @@
 """Trace ONE steady-state hybrid sparse step (after layout stabilisation).
 
 Usage: python examples/benchmarks/trace_step.py [--trace /tmp/trace_step]
-       [--fused_apply] [--param_dtype bfloat16] [--model tiny]
+       [--segwalk_apply] [--param_dtype bfloat16] [--model tiny]
 """
 
 import argparse
@@ -18,7 +18,6 @@ def main():
   p.add_argument('--model', default='tiny')
   p.add_argument('--trace', default='')
   p.add_argument('--param_dtype', default='float32')
-  p.add_argument('--fused_apply', action='store_true')
   p.add_argument('--segwalk_apply', action='store_true')
   p.add_argument('--capacity_fraction', type=float, default=0.5)
   p.add_argument('--auto_capacity', action='store_true')
@@ -66,13 +65,11 @@ def main():
   emb_opt = SparseAdagrad(learning_rate=0.01,
                           capacity_fraction=args.capacity_fraction,
                           capacity_rows=capacity_rows,
-                          use_pallas_apply=args.fused_apply,
                           use_segwalk_apply=args.segwalk_apply)
-  if args.fused_apply or args.segwalk_apply:
+  if args.segwalk_apply:
     from distributed_embeddings_tpu.utils.apply_eligibility import (
         eligibility_line)
-    print(eligibility_line(dist, args.param_dtype, args.fused_apply,
-                           args.segwalk_apply))
+    print(eligibility_line(dist, args.param_dtype, args.segwalk_apply))
   step = jax.jit(make_hybrid_train_step(dist, head_loss_fn, opt, emb_opt,
                                         jit=False), donate_argnums=(0,))
   state = init_hybrid_train_state(dist, params, opt, emb_opt)

@@ -5,15 +5,32 @@
 # an AUC-vs-step curve, and report steady-state samples/s against the
 # reference's 9.16M samples/s 8xA100 number (chip-count caveat applies;
 # this is ONE v5e).
-# Usage: bash examples/dlrm/chip_run.sh [data_dir] [batch] [train_rows]
+#
+# --budget (VERDICT r5 item 6): the ~5-minute variant a medium tunnel
+# window can land — smaller batch, low-effort XLA compile
+# (--fast_compile, measured 2.75x faster), steps-only throughput with
+# NO eval, pipelined host feed on.  The printed lines carry the
+# fast_compile label so the row can never read as the official number.
+# Usage: bash examples/dlrm/chip_run.sh [--budget] [data_dir] [batch] [train_rows]
 set -eu
+BUDGET=0
+if [ "${1:-}" = "--budget" ]; then
+  BUDGET=1
+  shift
+fi
 cd "$(dirname "$0")/../.."
 DATA=${1:-/tmp/criteo_synth}
-BATCH=${2:-65536}
-ROWS=${3:-8388608}
+if [ "$BUDGET" = 1 ]; then
+  BATCH=${2:-8192}
+  ROWS=${3:-1048576}
+else
+  BATCH=${2:-65536}
+  ROWS=${3:-8388608}
+fi
 
-# build the native loader so the bench exercises it (falls back to the
-# Python twin if the toolchain is missing; main.py prints which)
+# build the native pieces (loader + CSR builder) so the run exercises
+# them (falls back to the Python twins if the toolchain is missing;
+# main.py prints which)
 make -C distributed_embeddings_tpu/cc >/dev/null 2>&1 || true
 
 if [ ! -f "$DATA/model_size.json" ]; then
@@ -21,11 +38,25 @@ if [ ! -f "$DATA/model_size.json" ]; then
     --train_rows "$ROWS" --eval_rows 524288 --preset onechip
 fi
 
+if [ "$BUDGET" = 1 ]; then
+  # steps-only labelled DLRM line: 40 steps past the 3-step warmup is a
+  # steady-state samples/s + loss-descent signal; no eval, no loader pass
+  python examples/dlrm/main.py \
+    --dataset_path "$DATA" \
+    --batch_size "$BATCH" \
+    --dp_input \
+    --fast_compile \
+    --csr_feed \
+    --max_steps 40
+  exit 0
+fi
+
 python examples/dlrm/main.py \
   --dataset_path "$DATA" \
   --batch_size "$BATCH" \
   --dp_input \
   --loader_bench \
+  --csr_feed \
   --eval_every 32 --eval_batches 4 \
   --eval
 

@@ -20,8 +20,9 @@ class BuildWithNativeLoader(build_py):
       subprocess.run(['make', '-C', 'distributed_embeddings_tpu/cc'],
                      check=True)
     except (OSError, subprocess.CalledProcessError) as e:
-      print(f'native fastloader not built ({e}); the package falls back '
-            'to the pure-Python loader or builds on first use')
+      print(f'native libraries not built ({e}); the package falls back '
+            'to the pure-Python loader / NumPy CSR builder or builds '
+            'on first use')
     super().run()
 
 

@@ -1,0 +1,181 @@
+"""Pipelined host feed (parallel/csr_feed.CsrFeed): ordering, drain,
+backpressure, error propagation, and the hybrid-trainer integration
+(``sparse.run_pipelined``).
+
+These tests run with WHATEVER builder resolves ('auto'): the pipeline
+semantics are builder-independent (the native/NumPy parity is pinned by
+tests/test_csr_native.py), so nothing here is toolchain-gated.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_embeddings_tpu.parallel import (CsrFeed,
+                                                 DistributedEmbedding,
+                                                 SparseSGD, TableConfig,
+                                                 create_mesh,
+                                                 init_hybrid_train_state,
+                                                 make_hybrid_train_step,
+                                                 run_pipelined,
+                                                 set_weights)
+from distributed_embeddings_tpu.parallel import sparsecore
+
+WORLD = 4
+CONFIGS = [TableConfig(120, 16, 'sum'), TableConfig(60, 16, 'mean'),
+           TableConfig(40, 8, 'sum')]
+
+
+@pytest.fixture(scope='module')
+def dist():
+  mesh = create_mesh(jax.devices()[:WORLD])
+  return DistributedEmbedding(CONFIGS, mesh=mesh, lookup_impl='sparsecore',
+                              row_slice=500)
+
+
+def _batches(n, seed=0):
+  rng = np.random.default_rng(seed)
+  return [(i, [rng.integers(0, c.input_dim,
+                            size=(WORLD * 4, 3)).astype(np.int32)
+               for c in CONFIGS]) for i in range(n)]
+
+
+def test_batches_arrive_in_order_with_correct_buffers(dist):
+  """Prefetched batches arrive strictly in source order, each carrying
+  the SAME buffers a synchronous build of that batch produces."""
+  src = _batches(9)
+  feed = CsrFeed(dist, src, cats_fn=lambda it: it[1])
+  got = list(feed)
+  assert [fed.item[0] for fed in got] == list(range(9))
+  for fed in got:
+    want = sparsecore.preprocess_batch_host(dist, fed.item[1],
+                                            num_workers=1)
+    assert sparsecore._csrs_equal(want, fed.csrs), fed.item[0]
+    assert fed.build_ms >= 0
+  stats = feed.stats()
+  assert stats['batches'] == 9
+  assert stats['build_ms'] > 0
+
+
+def test_exhaustion_closes_and_further_next_stops(dist):
+  feed = CsrFeed(dist, _batches(2), cats_fn=lambda it: it[1])
+  assert len(list(feed)) == 2
+  with pytest.raises(StopIteration):
+    next(feed)
+
+
+def test_early_close_drains_cleanly(dist):
+  """close() mid-stream (including with the bounded ring FULL, the
+  producer blocked on put) joins the producer and is idempotent."""
+  feed = CsrFeed(dist, _batches(20), cats_fn=lambda it: it[1], depth=1)
+  next(feed)
+  time.sleep(0.1)  # let the producer fill the depth-1 ring and block
+  feed.close()
+  feed.close()
+  assert not feed._thread.is_alive()
+  with pytest.raises(StopIteration):
+    next(feed)
+
+
+def test_context_manager_closes_on_break(dist):
+  with CsrFeed(dist, _batches(12), cats_fn=lambda it: it[1]) as feed:
+    for fed in feed:
+      if fed.item[0] == 2:
+        break
+  assert not feed._thread.is_alive()
+
+
+def test_backpressure_bounds_readahead(dist):
+  """The producer can run at most ``depth`` batches ahead: with the
+  consumer stalled, exactly depth builds finish (+1 possibly in
+  flight) — host memory for padded buffers stays bounded."""
+  built = []
+  src = ((built.append(i) or (i, cats)) for i, cats in _batches(30))
+  feed = CsrFeed(dist, src, cats_fn=lambda it: it[1], depth=2)
+  deadline = time.time() + 10
+  while len(built) < 3 and time.time() < deadline:
+    time.sleep(0.02)
+  time.sleep(0.3)  # would build all 30 if the ring were unbounded
+  assert len(built) <= 4, built  # depth(2) + in-build(1) + source pull(1)
+  feed.close()
+
+
+def test_producer_error_surfaces_on_next(dist):
+  def source():
+    yield from _batches(1)
+    raise RuntimeError('loader exploded')
+
+  feed = CsrFeed(dist, source(), cats_fn=lambda it: it[1])
+  next(feed)
+  with pytest.raises(RuntimeError, match='loader exploded'):
+    next(feed)
+  assert not feed._thread.is_alive()
+
+
+def test_overlap_accounting_direct(dist):
+  """blocked_ms counts ONLY time __next__ waited: with a slow consumer
+  (builds hidden behind 'device' time) overlap approaches 100%; the
+  stats reset drops the unhidden first batch."""
+  feed = CsrFeed(dist, _batches(6), cats_fn=lambda it: it[1])
+  first = True
+  for _ in feed:
+    if first:
+      feed.reset_stats()
+      first = False
+    time.sleep(0.08)  # the stand-in device step
+  stats = feed.stats()
+  assert stats['batches'] == 5
+  assert stats['overlap_pct'] is not None and stats['overlap_pct'] > 50.0, \
+      stats
+
+
+def test_run_pipelined_trains_and_matches_unpipelined(dist):
+  """The pipelined driver reproduces the plain loop bit-for-bit: same
+  losses, same final weights — the feed changes WHEN host work happens,
+  never what the step computes."""
+  rng = np.random.default_rng(3)
+  weights = [
+      rng.normal(size=(c.input_dim, c.output_dim)).astype(np.float32)
+      for c in CONFIGS
+  ]
+  total_w = sum(c.output_dim for c in CONFIGS)
+  kernel = jnp.asarray(rng.standard_normal((total_w, 1)).astype(np.float32)
+                       * 0.1)
+  batches = _batches(5, seed=11)
+  labels = jnp.asarray(np.ones((WORLD * 4, 1), np.float32))
+
+  def head_loss_fn(dense_params, emb_outs, b):
+    h = jnp.concatenate(list(emb_outs), axis=-1)
+    return jnp.mean((h @ dense_params['kernel'] - b)**2)
+
+  import optax
+  opt = SparseSGD(learning_rate=0.1)
+
+  def fresh_state():
+    return init_hybrid_train_state(dist, {
+        'embedding': set_weights(dist, weights),
+        'kernel': kernel
+    }, optax.sgd(0.1), opt)
+
+  step = make_hybrid_train_step(dist, head_loss_fn, optax.sgd(0.1), opt,
+                                donate=False)
+  # plain loop
+  s_plain = fresh_state()
+  plain_losses = []
+  for _, cats in batches:
+    s_plain, loss = step(s_plain, [jnp.asarray(c) for c in cats], labels)
+    plain_losses.append(float(loss))
+  # pipelined loop
+  feed = CsrFeed(dist, batches, cats_fn=lambda it: it[1])
+  s_pipe, pipe_losses, stats = run_pipelined(
+      step, fresh_state(), feed,
+      lambda fed: ([jnp.asarray(c) for c in fed.item[1]], labels))
+  assert pipe_losses == plain_losses
+  assert stats['batches'] == len(batches) - 1  # steady-state accounting
+  for a, b in zip(jax.tree.leaves(s_plain.params),
+                  jax.tree.leaves(s_pipe.params)):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -285,6 +285,24 @@ def test_eligibility_reports_packed_groups_served():
   assert _group_table_aval(gp, jnp.float32).shape == (gp.param_rows, 128)
 
 
+def test_eligibility_line_renders_every_branch():
+  """The artifact-label helper must RENDER for each requested kernel —
+  a crash here happens after bench's timed loop and loses the whole
+  artifact line (a deleted-variable regression in the round-6 rowwise
+  removal got exactly this far before review caught it)."""
+  from distributed_embeddings_tpu.utils.apply_eligibility import (
+      eligibility_line)
+  mesh = _mesh()
+  dist = DistributedEmbedding([TableConfig(64, 16, 'sum')] * WORLD,
+                              mesh=mesh)
+  assert eligibility_line(dist, 'float32', False) == ''
+  for accum in ('float32', 'bfloat16'):
+    line = eligibility_line(dist, 'float32', True, accum_dtype=accum)
+    assert 'segwalk_apply:' in line, (accum, line)
+  line = eligibility_line(dist, 'float32', True, sparsecore_apply=True)
+  assert 'segwalk_apply:' in line and 'sparsecore_apply:' in line, line
+
+
 def test_calibration_mirror_matches_packed_layout():
   """The CPU calibration mirror's zero params must match its plan's
   PHYSICAL (packed) layout, and its measurement forward must run —

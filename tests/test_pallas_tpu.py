@@ -72,95 +72,6 @@ def test_compiled_matches_oracle(w, dtype):
 
 
 @requires_tpu
-@pytest.mark.parametrize('w', [128])
-@pytest.mark.parametrize('dedup', [True, False])
-def test_rowwise_apply_compiled_matches_xla(w, dedup):
-  """Fused row-wise Adagrad apply (ops/pallas_rowwise.py) compiled on
-  the chip: the parity double-buffered DMA pipeline only exists on
-  hardware.  Width 128 only — narrow tables reach the kernel through
-  the producer's lane-packed view (sub-128-lane VMEM slices fail the
-  v5e compile, proven by tests/test_tpu_lowering.py)."""
-  from distributed_embeddings_tpu.ops import pallas_rowwise
-  rng = np.random.default_rng(2)
-  rows, c, valid = 100_000, 4096, 3777
-  table = jnp.asarray(rng.normal(size=(rows, w)).astype(np.float32))
-  acc = jnp.asarray(rng.uniform(0.1, 1.0, size=(rows, w)).astype(np.float32))
-  ids = np.sort(rng.choice(rows, size=valid, replace=False)).astype(np.int32)
-  uids = np.full((c,), rows, np.int32)
-  uids[:valid] = ids
-  g = rng.normal(size=(c, w)).astype(np.float32)
-  g[valid:] = 0
-  sq = (g * g).astype(np.float32)
-  got_t, got_a = pallas_rowwise.adagrad_apply(
-      table, acc, jnp.asarray(uids), jnp.asarray(g), jnp.asarray(sq),
-      0.05, dedup=dedup, eps=1e-7)
-  from test_pallas_rowwise import xla_reference  # single oracle source
-  want_t, want_a = xla_reference(table, acc, jnp.asarray(uids),
-                                 jnp.asarray(g), jnp.asarray(sq), 0.05,
-                                 dedup, 1e-7)
-  np.testing.assert_allclose(np.asarray(got_a), np.asarray(want_a),
-                             rtol=1e-6, atol=1e-6)
-  np.testing.assert_allclose(np.asarray(got_t), np.asarray(want_t),
-                             rtol=1e-5, atol=1e-5)
-
-
-@requires_tpu
-@pytest.mark.parametrize('w,c', [(128, 1 << 17)])
-def test_rowwise_apply_microbench(w, c):
-  """Fused apply vs the XLA gather+scatter-set+scatter-add formulation
-  at synthetic-tiny-like scale: [1M, 128] at 2^17 packed update rows is
-  exactly the lane-packed view of tiny's 8M-row width-16 big group
-  (the shape the production path feeds the kernel)."""
-  from distributed_embeddings_tpu.ops import pallas_rowwise
-  rng = np.random.default_rng(3)
-  rows = 1_000_000
-  iters = 5
-  table = jnp.zeros((rows, w), jnp.float32) + 0.5
-  acc = jnp.ones((rows, w), jnp.float32)
-  stacks = []
-  for _ in range(3):
-    pad = np.full((iters, c), rows, np.int32)
-    for i in range(iters):
-      u = np.unique(rng.integers(0, rows, size=c).astype(np.int32))
-      pad[i, :u.size] = u  # ascending uniques, sentinel tail
-    stacks.append(jnp.asarray(pad))
-  g = jnp.asarray(rng.normal(size=(c, w)).astype(np.float32))
-
-  def pl_fn(tab, ac, uids):
-    return pallas_rowwise.adagrad_apply(tab, ac, uids, g, None, 0.01,
-                                        dedup=True, eps=1e-7)
-
-  def xla_fn(tab, ac, uids):
-    safe = jnp.clip(uids, 0, rows - 1)
-    acc_rows = ac[safe] + g * g
-    ac2 = ac.at[uids].set(acc_rows, mode='drop')
-    upd = -0.01 * g * jax.lax.rsqrt(acc_rows + 1e-7)
-    return tab.at[uids].add(upd, mode='drop'), ac2
-
-  def bench(fn):
-    def run(tab, ac, s):
-      def body(carry, uids):
-        t2, a2 = fn(*carry, uids)
-        return (t2, a2), None
-      (t2, a2), _ = jax.lax.scan(body, (tab, ac), s)
-      return jnp.sum(t2[:8]) + jnp.sum(a2[:8])
-    f = jax.jit(run)
-    float(f(table, acc, stacks[0]))
-    times = []
-    for s in stacks[1:]:
-      start = time.perf_counter()
-      float(f(table, acc, s))
-      times.append(time.perf_counter() - start)
-    return min(times) / iters * 1e3
-
-  t_pl = bench(pl_fn)
-  t_xla = bench(xla_fn)
-  print(f'\nrowwise apply w={w} c={c}: pallas {t_pl:.1f} ms, '
-        f'xla {t_xla:.1f} ms ({t_xla / t_pl:.2f}x)')
-  assert t_pl < 5 * t_xla
-
-
-@requires_tpu
 @pytest.mark.parametrize('w,hot', [(8, 4), (32, 2), (64, 1), (128, 1)])
 def test_microbench_vs_xla_fallback(w, hot):
   """Record kernel-vs-XLA timings; the measured outcome (XLA's gather

@@ -28,8 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from distributed_embeddings_tpu.ops import (pallas_lookup, pallas_rowwise,
-                                            pallas_segwalk)
+from distributed_embeddings_tpu.ops import pallas_lookup, pallas_segwalk
 
 
 import os
@@ -101,22 +100,6 @@ def test_segwalk_prepacked_bf16_compiles_for_v5e(v5e, op):
   _compile_single(v5e, fn, ((rows // pack, 128), jnp.bfloat16),
                   ((rows // pack, 128), jnp.float32), ((n,), jnp.int32),
                   ((n, w), jnp.float32))
-
-
-@pytest.mark.parametrize('dedup', [True, False])
-def test_rowwise_apply_compiles_for_v5e(v5e, dedup):
-  # width 128 only: narrow tables arrive pre-packed to 128 lanes by
-  # parallel/sparse.py:_lane_pack
-  rows, c, w = 4096, 512, 128
-
-  def fn(table, acc, uids, g, sq):
-    return pallas_rowwise.adagrad_apply(table, acc, uids, g,
-                                        None if dedup else sq, 0.01,
-                                        dedup=dedup, eps=1e-7)
-
-  _compile_single(v5e, fn, ((rows, w), jnp.float32),
-                  ((rows, w), jnp.float32), ((c,), jnp.int32),
-                  ((c, w), jnp.float32), ((c, w), jnp.float32))
 
 
 @pytest.mark.parametrize('w,dtype', [(8, jnp.float32), (16, jnp.float32),
