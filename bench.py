@@ -426,6 +426,21 @@ def main():
   pool = [((jnp.asarray(num), tuple(jnp.asarray(c) for c in cats)),
            jnp.asarray(lab)) for (num, cats), lab in gen.pool]
 
+  # Every scalar pull below runs under a hung-step watchdog (the
+  # step-level sibling of init_backend's 180 s probe guard): a TPU
+  # backend that wedges MID-RUN makes the sync hang rather than raise,
+  # which used to burn the whole unattended window with no artifact.
+  # The watchdog dumps all-thread tracebacks, journals the event, and
+  # fails fast so _arm_watchdog's failure artifact still gets written.
+  # Budget: env DET_STEP_HANG_S (default 600 s — above the measured
+  # ~100 s double-compile warmup, far below the driver window).
+  from distributed_embeddings_tpu.utils import resilience
+  step_hang_s = float(os.environ.get('DET_STEP_HANG_S', '600'))
+
+  def sync_loss(loss, what):
+    return resilience.call_with_timeout(lambda: float(loss), step_hang_s,
+                                        what=what)
+
   # Warm up until the program is actually cached: the first call compiles,
   # and the second recompiles once more when XLA's chosen output layouts
   # for the donated state differ from the initial buffers' layouts — only
@@ -434,7 +449,8 @@ def main():
   warm_start = time.perf_counter()
   for i in range(max(3, args.warmup)):
     state, loss = step(state, pool[i % len(pool)])
-  float(loss)  # force full sync (block_until_ready is unreliable here)
+  # force full sync (block_until_ready is unreliable here)
+  sync_loss(loss, 'warmup step sync')
   warmup_s = time.perf_counter() - warm_start
 
   # Min-of-k windows (split_windows): the fastest window is the
@@ -447,7 +463,7 @@ def main():
     for _ in range(wsteps):
       state, loss = step(state, pool[i % len(pool)])
       i += 1
-    float(loss)
+    sync_loss(loss, f'measurement window sync at step {i}')
     window_ms.append((time.perf_counter() - t0) / wsteps * 1000)
 
   step_ms = min(window_ms)

@@ -21,6 +21,12 @@ from distributed_embeddings_tpu.parallel.checkpoint import (
     load_npz,
     save_train_npz,
     load_train_npz,
+    load_latest_valid,
+    plan_fingerprint,
+    prune_checkpoints,
+    read_manifest,
+    restore_train_state,
+    verify_npz,
 )
 from distributed_embeddings_tpu.parallel.grad import (broadcast_variables,
                                                       DistributedGradientTape,

@@ -10,12 +10,15 @@ two for the functional ``fit`` loop; both follow its callback contract
 
 from __future__ import annotations
 
+import os
+
 from typing import Callable, Dict, Optional
 
 import numpy as np
 
 from distributed_embeddings_tpu.parallel.checkpoint import (
-    get_optimizer_state, get_weights, save_train_npz)
+    get_optimizer_state, get_weights, is_hybrid_opt_state,
+    prune_checkpoints, save_train_npz)
 
 
 class CheckpointCallback:
@@ -25,27 +28,46 @@ class CheckpointCallback:
   reloads under any world size / strategy), the sparse-optimizer state
   when the hybrid step is in use, and the dense params/opt-state under
   flattened ``extra/`` keys (the same scheme ``examples/dlrm/main.py``
-  resumes from).
+  resumes from).  Every write is atomic with an embedded integrity
+  manifest (checkpoint.py ``_atomic_savez`` / ``verify_npz``) carrying
+  the step and the plan fingerprint — what ``load_latest_valid`` /
+  ``fit(resume_from=...)`` validate on auto-resume.
 
   Args:
     dist: the model's ``DistributedEmbedding``.
     path: target ``.npz`` path; ``{step}`` is formatted in when present
       (``'ckpt_{step}.npz'``), otherwise the file is overwritten in
-      place (atomic: written to ``path + '.tmp'`` then renamed).
+      place.  Both spellings write atomically (tmp + ``os.replace``).
     every: save every this-many steps (checked at ``fit``'s log points,
       so the effective cadence is ``lcm(every, log_every)``-ish: the
       callback fires at the first log point where ``step`` advanced past
       the next save mark).
     sparse: whether ``state`` is a hybrid-step state whose
       ``opt_state[1]`` is the sparse table optimizer (default: detect).
+    keep_last: retention for ``{step}``-templated paths — after each
+      save, checkpoints beyond the newest ``keep_last`` are pruned
+      (``None`` keeps everything; ignored for the overwrite-in-place
+      spelling, which holds one file by construction).
   """
 
   def __init__(self, dist, path: str, every: int = 1000,
-               sparse: Optional[bool] = None):
+               sparse: Optional[bool] = None,
+               keep_last: Optional[int] = None):
+    # invalid retention configs fail at construction, not 1000 steps
+    # into an unattended run (where they would either raise or —
+    # worse — silently never prune)
+    if keep_last is not None and keep_last < 1:
+      raise ValueError(f'keep_last must be >= 1, got {keep_last}')
+    if keep_last is not None and '{step' in os.path.dirname(path):
+      raise ValueError(
+          'keep_last retention needs the {step} placeholder in the FILE '
+          f'name, not a directory component: {path!r} (per-step '
+          'directories would each hold one file and never prune)')
     self.dist = dist
     self.path = path
     self.every = every
     self.sparse = sparse
+    self.keep_last = keep_last
     self._next = every
 
   def __call__(self, step: int, state, logs: Dict):
@@ -63,18 +85,7 @@ class CheckpointCallback:
     weights = get_weights(self.dist, emb)
     sparse = self.sparse
     if sparse is None:
-      # structural detection: the hybrid layout's second element is the
-      # sparse table-optimizer state — a dict keyed exactly by the plan's
-      # fusion-group names.  A plain isinstance(tuple) check is ambiguous
-      # (optax states are namedtuples and can carry dict fields) —
-      # advisor r4.
-      st = state.opt_state
-      group_names = {
-          f'group_{gi}' for gi in range(len(self.dist.plan.groups))
-      }
-      sparse = (isinstance(st, tuple) and len(st) == 2
-                and isinstance(st[1], dict)
-                and set(st[1].keys()) == group_names)
+      sparse = is_hybrid_opt_state(self.dist, state.opt_state)
     st_tables = (get_optimizer_state(self.dist, state.opt_state[1])
                  if sparse else None)
     extras = {'step': np.int64(step)}
@@ -87,14 +98,21 @@ class CheckpointCallback:
     for p, v in flat:
       extras['opt:' + jax.tree_util.keystr(p)] = np.asarray(v)
     path = self.path.format(step=step)
-    if path == self.path:  # no {step} placeholder: atomic overwrite
-      import os
-      # the tmp name must keep the .npz suffix: np.savez appends it
-      tmp = path + '.tmp.npz'
-      save_train_npz(tmp, weights, st_tables, extras=extras)
-      os.replace(tmp, path)
-    else:
-      save_train_npz(path, weights, st_tables, extras=extras)
+    # both spellings are atomic end to end: save_train_npz routes every
+    # write through checkpoint._atomic_savez (tmp + os.replace)
+    save_train_npz(path, weights, st_tables, extras=extras, plan=self.dist)
+    if path != self.path and self.keep_last is not None:
+      # retention over sibling step-templated files only: glob the
+      # template's {step} field (any format spec, e.g. {step:06d});
+      # literal segments are glob-escaped so names like 'ckpt[v2]_'
+      # match themselves, never a character class
+      import glob as glob_lib
+      import re
+      base = '*'.join(
+          glob_lib.escape(seg) for seg in
+          re.split(r'\{step[^}]*\}', os.path.basename(self.path)))
+      prune_checkpoints(os.path.dirname(os.path.abspath(path)) or '.',
+                        self.keep_last, pattern=base)
     logs['checkpoint'] = path
 
 

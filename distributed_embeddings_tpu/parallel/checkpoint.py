@@ -21,14 +21,19 @@ copy-on-write hazard exists.
 from __future__ import annotations
 
 import functools
+import glob as glob_lib
+import hashlib
+import json
+import os
 
-from typing import Dict, List, Optional, Sequence, Union
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_embeddings_tpu.parallel.dist_embedding import DistributedEmbedding
+from distributed_embeddings_tpu.utils import resilience
 
 WeightLike = Union[np.ndarray, str]
 
@@ -361,23 +366,268 @@ def _portable(a) -> np.ndarray:
   return a
 
 
+# --------------------------------------------------------------------------
+# checkpoint integrity: atomic writes, manifest + checksums, validated load
+# --------------------------------------------------------------------------
+
+MANIFEST_KEY = '__manifest__'
+MANIFEST_VERSION = 1
+
+
+def _atomic_savez(path: str, payload: Dict[str, np.ndarray]):
+  """The ONE write path for every npz this module produces: write to a
+  same-directory tmp file, flush + fsync, then ``os.replace`` — a crash
+  at any point leaves either the old file or the new one under the
+  canonical name, never a truncated hybrid (the non-atomic direct
+  writes were ISSUE 4 satellite #1)."""
+  path = os.fspath(path)
+  d = os.path.dirname(os.path.abspath(path)) or '.'
+  tmp = os.path.join(d, f'.{os.path.basename(path)}.tmp.{os.getpid()}')
+  try:
+    with open(tmp, 'wb') as f:
+      np.savez(f, **payload)
+      f.flush()
+      os.fsync(f.fileno())
+    os.replace(tmp, path)
+  finally:
+    if os.path.exists(tmp):
+      try:
+        os.remove(tmp)
+      except OSError:
+        pass
+
+
+def plan_fingerprint(obj) -> str:
+  """Stable fingerprint of the LOGICAL table set a checkpoint serialises
+  (per-table rows/width/combiner) — deliberately NOT the physical
+  layout: the resharding contract means a file written under one world
+  size / strategy loads under any other, so only a different *model*
+  (table shapes) makes a file unloadable.  Accepts a
+  ``DistributedEmbedding``, a ``ShardingPlan``, a ``TableConfig``
+  sequence, or an already-computed fingerprint string."""
+  if isinstance(obj, str):
+    return obj
+  configs = getattr(obj, 'table_configs', None)
+  if configs is None:
+    plan = getattr(obj, 'plan', None)
+    configs = plan.table_configs if plan is not None else obj
+  material = json.dumps(
+      [[int(c.input_dim), int(c.output_dim), c.combiner] for c in configs])
+  return hashlib.sha256(material.encode()).hexdigest()[:16]
+
+
+def _checksum(a: np.ndarray) -> str:
+  """sha256 over dtype + shape + raw bytes of one stored array."""
+  a = np.ascontiguousarray(a)
+  h = hashlib.sha256(f'{a.dtype.str}:{a.shape}:'.encode())
+  h.update(a.tobytes())
+  return h.hexdigest()
+
+
+def _build_manifest(payload: Dict[str, np.ndarray],
+                    step: Optional[int] = None,
+                    plan=None) -> np.ndarray:
+  man = {
+      'version': MANIFEST_VERSION,
+      'step': None if step is None else int(step),
+      'plan': None if plan is None else plan_fingerprint(plan),
+      'arrays': {
+          k: {'sha256': _checksum(v), 'dtype': np.asarray(v).dtype.str,
+              'shape': list(np.asarray(v).shape)}
+          for k, v in payload.items()
+      },
+  }
+  return np.array(json.dumps(man))
+
+
+def read_manifest(path: str) -> Optional[Dict]:
+  """The file's embedded manifest, or None for a legacy (pre-manifest)
+  npz — which stays loadable per the compatibility contract
+  (docs/design.md "Checkpoint manifest")."""
+  with np.load(path, allow_pickle=False) as data:
+    if MANIFEST_KEY not in data.files:
+      return None
+    return json.loads(str(data[MANIFEST_KEY][()]))
+
+
+def _load_verified(path: str, expect_plan=None
+                   ) -> Tuple[Dict[str, np.ndarray], Optional[Dict]]:
+  """ONE-pass verify + load: every member is read (and, for
+  manifest-bearing files, sha256-checked) exactly ONCE — a multi-GB
+  resume pays single I/O, not a verify pass followed by a re-read.
+  Returns ``(arrays, manifest)`` (manifest None for legacy files, which
+  pass on the structural read alone); raises ``ValueError`` carrying
+  the rejection reason otherwise."""
+  try:
+    with np.load(path, allow_pickle=False) as data:
+      files = list(data.files)
+      arrays_meta = None
+      man = None
+      if MANIFEST_KEY in files:
+        man = json.loads(str(data[MANIFEST_KEY][()]))
+        if expect_plan is not None and man.get('plan') is not None:
+          want = plan_fingerprint(expect_plan)
+          if man['plan'] != want:
+            raise ValueError(f'plan-mismatch: file plan {man["plan"]}, '
+                             f'expected {want}')
+        arrays_meta = man.get('arrays', {})
+        missing = [k for k in arrays_meta if k not in files]
+        if missing:
+          raise ValueError(f'missing array {missing[0]!r}')
+        stray = [k for k in files
+                 if k != MANIFEST_KEY and k not in arrays_meta]
+        if stray:
+          raise ValueError(f'arrays not in manifest: {stray}')
+      loaded = {}
+      for k in files:  # decompression errors surface truncation
+        if k == MANIFEST_KEY:
+          continue
+        a = data[k]
+        if (arrays_meta is not None
+            and _checksum(a) != arrays_meta[k]['sha256']):
+          raise ValueError(f'checksum mismatch on {k!r}')
+        loaded[k] = a
+      return loaded, man
+  except ValueError:
+    raise
+  except Exception as e:  # truncated zip, bad json, short member, ...
+    raise ValueError(f'unreadable: {e!r}') from e
+
+
+def verify_npz(path: str, expect_plan=None
+               ) -> Tuple[bool, str, Optional[Dict]]:
+  """Validate one checkpoint file: ``(ok, reason, manifest)``.
+
+  A manifest-bearing file must decompress, carry every manifested array
+  with a matching sha256, list no stray arrays, and (when
+  ``expect_plan`` is given) match the plan fingerprint.  A legacy file
+  without a manifest passes on a structural check only (every member
+  decompresses) with reason ``'legacy-no-manifest'`` — old round-trip
+  npz files keep loading.  Never raises: any unreadable file is
+  ``(False, 'unreadable: ...', None)``.
+  """
+  try:
+    _, man = _load_verified(path, expect_plan=expect_plan)
+  except ValueError as e:
+    return False, str(e), None
+  return True, 'ok' if man is not None else 'legacy-no-manifest', man
+
+
+def _step_hint(path: str) -> int:
+  """Numeric step parsed from the file name (last integer group, e.g.
+  ``ckpt_1000.npz`` -> 1000), -1 when absent — the mtime tie-breaker.
+  A lexical tie-break would rank ckpt_999 above ckpt_1000 on
+  filesystems with coarse mtime granularity (NFS, FAT)."""
+  import re
+  groups = re.findall(r'\d+', os.path.basename(path))
+  return int(groups[-1]) if groups else -1
+
+
+def _is_atomic_tmp(name: str) -> bool:
+  """Matches exactly ``_atomic_savez``'s tmp naming
+  (``.{basename}.tmp.{pid}``) — a user checkpoint merely CONTAINING
+  '.tmp' must stay visible to resume/retention."""
+  return name.startswith('.') and '.tmp.' in name
+
+
+def _candidates(directory: str, pattern: str) -> List[str]:
+  """Checkpoint files under ``directory`` newest-first (mtime, then the
+  numeric step in the name, then the name), in-flight atomic tmp files
+  excluded."""
+  paths = [p for p in glob_lib.glob(os.path.join(directory, pattern))
+           if not _is_atomic_tmp(os.path.basename(p))]
+  return sorted(paths,
+                key=lambda p: (os.path.getmtime(p), _step_hint(p), p),
+                reverse=True)
+
+
+def load_latest_valid(directory: str,
+                      expect_plan=None,
+                      pattern: str = '*.npz'):
+  """Scan ``directory`` newest-first and load the first VALID resumable
+  checkpoint: ``(path, (weights, table_states, extras))``.
+
+  Every rejected candidate (truncated, checksum-mismatched,
+  plan-mismatched, or structurally not a ``save_train_npz`` file) is
+  journaled with its reason (``checkpoint_rejected``) and skipped — the
+  auto-resume path falls back to the previous valid file instead of
+  dying on the artifact a crash corrupted.  Raises ``FileNotFoundError``
+  with the per-file reasons when nothing valid remains.
+  """
+  reasons = []
+  for path in _candidates(directory, pattern):
+    # single pass: each candidate's members are read + checksummed once
+    # (_load_verified), then parsed in memory — never re-read from disk
+    try:
+      arrays, _ = _load_verified(path, expect_plan=expect_plan)
+    except ValueError as e:
+      resilience.journal('checkpoint_rejected', path=path, reason=str(e))
+      reasons.append((path, str(e)))
+      continue
+    try:
+      payload = _parse_train_payload(arrays, path)
+    except Exception as e:  # valid npz but not a resumable train file
+      reason = f'not-a-train-checkpoint: {e!r}'
+      resilience.journal('checkpoint_rejected', path=path, reason=reason)
+      reasons.append((path, reason))
+      continue
+    return path, payload
+  detail = '; '.join(f'{os.path.basename(p)}: {r}' for p, r in reasons)
+  raise FileNotFoundError(
+      f'no valid checkpoint under {directory!r} (pattern {pattern!r})'
+      + (f' — rejected: {detail}' if detail else ''))
+
+
+def prune_checkpoints(directory: str, keep_last: int,
+                      pattern: str = '*.npz') -> List[str]:
+  """Retention: delete all but the newest ``keep_last`` checkpoints
+  matching ``pattern``; returns the removed paths (journaled)."""
+  if keep_last < 1:
+    raise ValueError(f'keep_last must be >= 1, got {keep_last}')
+  removed = []
+  for path in _candidates(directory, pattern)[keep_last:]:
+    try:
+      os.remove(path)
+      removed.append(path)
+    except OSError:
+      continue
+  if removed:
+    resilience.journal('checkpoint_pruned', removed=removed,
+                       keep_last=keep_last)
+  return removed
+
+
 def save_npz(path: str, weights: Sequence[np.ndarray]):
   """Save global weights the way the DLRM example does
-  (reference `examples/dlrm/main.py:246-248`)."""
-  np.savez(path, *[_portable(w) for w in weights])
+  (reference `examples/dlrm/main.py:246-248`) — atomically.
+
+  Deliberately NO embedded manifest: the weights-only ``arr_i`` archive
+  is the reference DLRM interchange format, and external readers (and
+  older checkouts) enumerate ``data.files`` positionally — an extra
+  member would land in their weights list.  Integrity manifests belong
+  to the resumable ``save_train_npz`` files, whose key scheme filters
+  unknown members; ``verify_npz`` treats these files as legacy
+  (structural check only)."""
+  payload = {f'arr_{i}': _portable(w) for i, w in enumerate(weights)}
+  _atomic_savez(path, payload)
 
 
 def load_npz(path: str) -> List[np.ndarray]:
   data = np.load(path)
-  return [data[k] for k in data.files]
+  return [data[k] for k in data.files if k != MANIFEST_KEY]
 
 
 def save_train_npz(path: str,
                    weights: Sequence[np.ndarray],
                    table_states: Optional[Sequence[Dict[str, np.ndarray]]]
                    = None,
-                   extras: Optional[Dict[str, np.ndarray]] = None):
-  """Save weights plus (optionally) sparse-optimizer state in one .npz.
+                   extras: Optional[Dict[str, np.ndarray]] = None,
+                   plan=None):
+  """Save weights plus (optionally) sparse-optimizer state in one .npz —
+  atomically (``_atomic_savez``), with an embedded integrity manifest
+  carrying per-array sha256 checksums, the step (from
+  ``extras['step']``) and the plan fingerprint when ``plan`` is given
+  (``load_latest_valid`` rejects files failing any of these).
 
   Keys: ``table{i}`` for weights, ``table{i}/{leaf}`` for state leaves —
   the global canonical layout, so the file reshards on load like the
@@ -393,31 +643,122 @@ def save_train_npz(path: str,
       payload[f'table{i}/{k}'] = _portable(v)
   for k, v in (extras or {}).items():
     payload[f'extra/{k}'] = _portable(v)
-  np.savez(path, **payload)
+  step = None
+  if extras and 'step' in extras:
+    step = int(np.asarray(extras['step']))
+  payload[MANIFEST_KEY] = _build_manifest(payload, step=step, plan=plan)
+  _atomic_savez(path, payload)
 
 
-def load_train_npz(path: str):
-  """Inverse of ``save_train_npz``:
-  returns ``(weights, table_states, extras)``."""
-  data = np.load(path)
-  table_keys = [k for k in data.files if k.startswith('table')]
+def _parse_train_payload(arrays: Dict[str, np.ndarray], path: str):
+  """``save_train_npz`` key scheme -> ``(weights, table_states,
+  extras)``; raises ``ValueError`` when the arrays are not a resumable
+  train checkpoint."""
+  table_keys = [k for k in arrays if k.startswith('table')]
   if not table_keys:
     raise ValueError(f'{path}: no table entries')
   n = 1 + max(int(k.split('/')[0][5:]) for k in table_keys)
   weights: List[Optional[np.ndarray]] = [None] * n
   states: List[Dict[str, np.ndarray]] = [dict() for _ in range(n)]
   extras: Dict[str, np.ndarray] = {}
-  for k in data.files:
+  for k, v in arrays.items():
     head, _, leaf = k.partition('/')
     if head == 'extra':
-      extras[leaf] = data[k]
+      extras[leaf] = v
       continue
     i = int(head[5:])
     if leaf:
-      states[i][leaf] = data[k]
+      states[i][leaf] = v
     else:
-      weights[i] = data[k]
+      weights[i] = v
   missing = [i for i, w in enumerate(weights) if w is None]
   if missing:
     raise ValueError(f'{path}: missing weight entries for tables {missing}')
   return weights, states, extras
+
+
+def load_train_npz(path: str):
+  """Inverse of ``save_train_npz``:
+  returns ``(weights, table_states, extras)``."""
+  data = np.load(path)
+  return _parse_train_payload(
+      {k: data[k] for k in data.files if k != MANIFEST_KEY}, path)
+
+
+# --------------------------------------------------------------------------
+# full train-state restore (the fit(resume_from=...) engine)
+# --------------------------------------------------------------------------
+
+
+def is_hybrid_opt_state(dist: DistributedEmbedding, opt_state) -> bool:
+  """Structural detection of the hybrid train-state optimizer layout:
+  a 2-tuple whose second element is a dict keyed exactly by the plan's
+  fusion-group names.  A plain ``isinstance(tuple)`` check is ambiguous
+  (optax states are namedtuples and can carry dict fields) — advisor
+  r4."""
+  group_names = {f'group_{gi}' for gi in range(len(dist.plan.groups))}
+  return (isinstance(opt_state, tuple) and len(opt_state) == 2
+          and isinstance(opt_state[1], dict)
+          and set(opt_state[1].keys()) == group_names)
+
+
+def _restore_like(template, saved: Dict[str, np.ndarray], prefix: str):
+  """Rebuild a pytree from flattened ``prefix + keystr(path)`` npz
+  entries, falling back to the template leaf where a key is absent."""
+  import jax.numpy as jnp
+  leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+  rebuilt = [
+      jnp.asarray(saved[prefix + jax.tree_util.keystr(p)])
+      if prefix + jax.tree_util.keystr(p) in saved else v
+      for p, v in leaves
+  ]
+  return jax.tree_util.tree_unflatten(treedef, rebuilt)
+
+
+def restore_train_state(dist: DistributedEmbedding, state, source: str):
+  """Restore a ``TrainState`` from a resumable checkpoint: embedding
+  tables reshard through ``set_weights``, sparse-optimizer tables
+  through ``set_optimizer_state``, dense params / optax state (incl.
+  schedule counters) from the flattened ``dense:`` / ``opt:`` extras,
+  and the step counter — so a resumed ``fit`` continues bit-exactly
+  (tests/test_fault_tolerance.py pins this against an uninterrupted
+  run).
+
+  ``source`` is either one ``.npz`` path (verified first; raises
+  ``ValueError`` on a corrupt/mismatched file) or a directory
+  (``load_latest_valid``: newest valid file wins, rejects journaled).
+  ``state`` supplies the structure to rebuild into — a fresh
+  ``init_train_state`` / ``init_hybrid_train_state``.
+
+  Returns ``(state, path)`` — the restored state and the file used.
+  """
+  import jax.numpy as jnp
+  if os.path.isdir(source):
+    path, (weights, st_tables, extras) = load_latest_valid(
+        source, expect_plan=dist)
+  else:
+    try:  # single pass: verified and parsed from one read
+      arrays, _ = _load_verified(source, expect_plan=dist)
+    except ValueError as e:
+      resilience.journal('checkpoint_rejected', path=source,
+                         reason=str(e))
+      raise ValueError(f'{source}: invalid checkpoint: {e}') from e
+    path = source
+    weights, st_tables, extras = _parse_train_payload(arrays, source)
+  new_params = dict(state.params)
+  new_params['embedding'] = set_weights(dist, weights)
+  dense_template = {k: v for k, v in new_params.items() if k != 'embedding'}
+  new_params.update(_restore_like(dense_template, extras, 'dense:'))
+  if is_hybrid_opt_state(dist, state.opt_state):
+    emb_opt_state = state.opt_state[1]
+    if any(st_tables):
+      emb_opt_state = set_optimizer_state(dist, emb_opt_state, st_tables)
+    opt_state = (_restore_like(state.opt_state[0], extras, 'opt:'),
+                 emb_opt_state)
+  else:
+    opt_state = _restore_like(state.opt_state, extras, 'opt:')
+  step = int(np.asarray(extras.get('step', 0)))
+  resilience.journal('resume', path=path, step=step)
+  new_state = type(state)(params=new_params, opt_state=opt_state,
+                          step=jnp.asarray(step, jnp.int32))
+  return new_state, path

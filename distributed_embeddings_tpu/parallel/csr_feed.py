@@ -27,6 +27,15 @@ manager, or source exhaustion) drains the pipeline cleanly; a producer
 exception surfaces on the consumer's next ``__next__`` rather than
 dying silently on a background thread.
 
+The feed is the degraded-mode boundary of an unattended run
+(docs/userguide.md "Fault tolerance"): transient ``IOError``/``OSError``
+from the source or the build retry with bounded exponential backoff, a
+producer thread that dies outright is respawned with its in-flight
+batch intact (zero loss), and a poison batch follows the
+``on_batch_error`` policy — ``'raise'`` (default) or ``'skip'`` with
+the skip counted in ``stats()`` and journaled
+(``utils/resilience.journal``), never silent.
+
 The buffers each ``FedBatch`` carries are the hardware feed layout
 (``HostCsr`` per (group, hotness) x device): on SparseCore hardware the
 custom-call binding consumes them directly; on the emulation backend
@@ -40,12 +49,14 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import weakref
 
 from typing import Any, Callable, Dict, Iterable, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
 from distributed_embeddings_tpu.parallel import sparsecore
+from distributed_embeddings_tpu.utils import resilience
 
 
 class FedBatch(NamedTuple):
@@ -65,6 +76,42 @@ class _Error(NamedTuple):
   exc: BaseException
 
 
+class _Item(NamedTuple):
+  """Ring message: a built batch tagged with its source ordinal so the
+  consumer can drop the duplicate a respawned producer may re-deliver
+  (the producer keeps the in-flight item across a worker death — zero
+  loss — at the cost of a possible re-build of an already-delivered
+  batch)."""
+  seq: int
+  fed: FedBatch
+
+
+_NO_ITEM = object()  # cursor sentinel: no source item pulled yet
+
+
+def _producer_main(ref: 'weakref.ref'):
+  """Producer thread body: a trampoline over bounded work units that
+  holds the feed only WEAKLY between units (the ``_ReadAhead`` pattern,
+  utils/data.py) — a feed abandoned without drain or ``close()`` gets
+  garbage-collected, the next deref returns None, and the thread exits
+  instead of blocking forever on the full ring."""
+  while True:
+    feed = ref()
+    if feed is None:
+      return  # feed abandoned: nobody will ever consume
+    try:
+      more = feed._produce_unit()
+    except (SystemExit, KeyboardInterrupt, GeneratorExit):
+      # abrupt worker death (fault-injected kill or interpreter
+      # teardown): no terminal marker — the consumer detects the dead
+      # thread and respawns it; feed._cursor/_pending still hold the
+      # batch that was in progress, so nothing is lost
+      return
+    if not more:
+      return
+    del feed
+
+
 class CsrFeed:
   """Double-buffered prefetching feed over a batch source.
 
@@ -81,6 +128,23 @@ class CsrFeed:
       consumer (2 = double buffering).
     num_workers: per-batch build fan-out (None = the shared pool).
     native: builder selection ('auto' | 'native' | 'numpy').
+    on_batch_error: poison-batch policy.  ``'raise'`` (default)
+      surfaces a batch whose build fails (after transient retries) on
+      the consumer's next ``__next__``; ``'skip'`` drops the batch,
+      counts it in ``stats()['skipped']`` and journals a
+      ``csr_feed_skipped_batch`` event — never silent.
+    io_retries: bounded-backoff retries for transient ``IOError`` /
+      ``OSError`` from the source pull or the build (zero data loss on
+      a recovered transient; ``resilience.retry_io``).
+    retry_base_s: backoff base delay (doubles per retry, capped 2 s).
+    max_respawns: how many times a producer thread that DIED without a
+      terminal message (e.g. a killed pool worker) is respawned.  The
+      in-flight item survives a death during the build or the delivery
+      — essentially all of producer wall time — so the stream continues
+      with zero loss; a kill landing INSIDE the source pull itself can
+      lose at most that one batch (unavoidable for a consuming
+      iterator, whose internal state the kill may already have
+      advanced).  Each respawn is journaled (``csr_feed_respawn``).
 
   Iterate it (``for fed in feed:``) or use it as a context manager;
   ``close()`` is idempotent and always drains the producer.
@@ -91,50 +155,130 @@ class CsrFeed:
                max_ids_per_partition: Optional[Tuple[int, ...]] = None,
                depth: int = 2,
                num_workers: Optional[int] = None,
-               native: str = 'auto'):
+               native: str = 'auto',
+               on_batch_error: str = 'raise',
+               io_retries: int = 3,
+               retry_base_s: float = 0.05,
+               max_respawns: int = 2):
     if depth < 1:
       raise ValueError(f'depth must be >= 1, got {depth}')
+    if on_batch_error not in ('raise', 'skip'):
+      raise ValueError(
+          f"on_batch_error must be 'raise' or 'skip', got {on_batch_error!r}")
     self._dist = dist
     self._source = iter(source)
     self._cats_fn = cats_fn if cats_fn is not None else (lambda item: item)
     self._caps = max_ids_per_partition
     self._num_workers = num_workers
     self.builder = sparsecore.resolve_builder(native)
+    self._on_batch_error = on_batch_error
+    self._io_retries = io_retries
+    self._retry_base_s = retry_base_s
+    self._max_respawns = max_respawns
     self._ring: queue.Queue = queue.Queue(maxsize=depth)
     self._stop = threading.Event()
     self._closed = False
+    # producer delivery state: ONE tuple (next seq to deliver, pulled
+    # item or _NO_ITEM), always replaced in a single store — an async
+    # kill can land on any bytecode boundary, and a half-updated
+    # seq/item pair would lose or mislabel a batch after respawn
+    self._cursor = (0, _NO_ITEM)
+    self._pending = None   # built message waiting for ring space
+    self._pending_terminal = False
+    self._last_seq = -1    # last ordinal the consumer returned
     self.reset_stats()
-    self._thread = threading.Thread(target=self._produce,
-                                    name='csr-feed-producer', daemon=True)
-    self._thread.start()
+    self._skipped = 0
+    self._io_retry_count = 0
+    self._respawns = 0
+    self._thread = self._spawn()
 
   # ------------------------------------------------------------- producer
 
-  def _produce(self):
-    try:
-      for item in self._source:
-        if self._stop.is_set():
-          return
-        t0 = time.perf_counter()
-        csrs = sparsecore.preprocess_batch_host(
-            self._dist, self._cats_fn(item),
-            max_ids_per_partition=self._caps, native=self.builder,
-            num_workers=self._num_workers)
-        build_ms = (time.perf_counter() - t0) * 1000.0
-        self._put(FedBatch(item, csrs, build_ms))
-      self._put(_Done())
-    except BaseException as e:  # surfaces on the consumer's next __next__
-      self._put(_Error(e))
+  def _spawn(self) -> threading.Thread:
+    t = threading.Thread(target=_producer_main, args=(weakref.ref(self),),
+                         name='csr-feed-producer', daemon=True)
+    t.start()
+    return t
 
-  def _put(self, msg):
-    """Bounded put that aborts promptly when the feed is closing (a
-    plain blocking put could deadlock close() against a full ring)."""
-    while not self._stop.is_set():
+  def _retry(self, fn, what: str):
+    """Bounded-backoff transient-I/O retry, counting retries into
+    ``stats()``."""
+
+    def counting_sleep(d):
+      self._io_retry_count += 1
+      time.sleep(d)
+
+    return resilience.retry_io(fn, retries=self._io_retries,
+                               base_delay_s=self._retry_base_s,
+                               what=what, sleep=counting_sleep)
+
+  def _produce_unit(self) -> bool:
+    """ONE bounded unit of producer work (the trampoline re-derefs the
+    feed between units).  Returns False when the producer should exit.
+
+    Delivery state lives on the FEED, not the thread: ``_cursor``
+    (next seq + pulled-but-undelivered item, replaced in single
+    stores) and ``_pending`` (built, not yet in the ring) survive a
+    killed thread, so a respawned producer resumes exactly where its
+    predecessor died — zero loss, duplicates fenced by the consumer's
+    seq check.  Kill-ordering invariant around a delivery: put, THEN
+    advance the cursor, THEN clear pending — a kill between any two of
+    those re-delivers a seq the consumer already fenced, never skips
+    one."""
+    if self._stop.is_set():
+      return False
+    seq, item = self._cursor
+    if self._pending is not None:
       try:
-        self._ring.put(msg, timeout=0.05)
-        return
+        self._ring.put(self._pending, timeout=0.05)
       except queue.Full:
-        continue
+        return True  # ring full: yield to the trampoline and retry
+      terminal = self._pending_terminal
+      if not terminal:
+        self._cursor = (seq + 1, _NO_ITEM)
+      self._pending = None
+      return not terminal
+    # NOTE the one hole in the zero-loss window: a kill landing between
+    # the source pull returning and the cursor store below (or inside
+    # the source's own __next__ after it advanced) loses that single
+    # batch — nanoseconds against the milliseconds of build time the
+    # cursor does protect, and unavoidable for a consuming iterator.
+    try:
+      if item is _NO_ITEM:
+        try:
+          # StopIteration passes through retry_io untouched (it is
+          # not an I/O error): source exhausted, clean shutdown
+          item = self._retry(lambda: next(self._source),
+                             'csr-feed source pull')
+        except StopIteration:
+          self._pending, self._pending_terminal = _Done(), True
+          return True
+        self._cursor = (seq, item)
+      try:
+        t0 = time.perf_counter()
+        csrs = self._retry(
+            lambda: sparsecore.preprocess_batch_host(
+                self._dist, self._cats_fn(item),
+                max_ids_per_partition=self._caps, native=self.builder,
+                num_workers=self._num_workers),
+            'csr-feed batch build')
+        build_ms = (time.perf_counter() - t0) * 1000.0
+      except Exception as e:  # poison batch (or exhausted retries)
+        if self._on_batch_error == 'skip':
+          self._skipped += 1
+          resilience.journal('csr_feed_skipped_batch', seq=seq,
+                             error=repr(e))
+          self._cursor = (seq + 1, _NO_ITEM)
+          return True
+        raise
+      self._pending = _Item(seq, FedBatch(item, csrs, build_ms))
+      self._pending_terminal = False
+      return True
+    except (SystemExit, KeyboardInterrupt, GeneratorExit):
+      raise  # abrupt kill: handled by the trampoline (respawnable)
+    except BaseException as e:  # surfaces on the consumer's next __next__
+      self._pending, self._pending_terminal = _Error(e), True
+      return True
 
   # ------------------------------------------------------------- consumer
 
@@ -145,18 +289,43 @@ class CsrFeed:
     if self._closed:
       raise StopIteration
     t0 = time.perf_counter()
-    msg = self._ring.get()
+    while True:
+      try:
+        msg = self._ring.get(timeout=0.1)
+      except queue.Empty:
+        # no message AND no live producer: the thread died without a
+        # terminal marker (a killed pool worker).  Respawn it — the
+        # in-flight item survived on self._cursor/_pending, so the
+        # stream resumes with zero loss — up to max_respawns, then
+        # fail loudly.
+        if not self._thread.is_alive():
+          if self._respawns < self._max_respawns:
+            self._respawns += 1
+            resilience.journal('csr_feed_respawn', count=self._respawns,
+                               next_seq=self._cursor[0])
+            self._thread = self._spawn()
+          else:
+            self.close()
+            raise RuntimeError(
+                f'csr-feed producer died {self._respawns + 1} times '
+                f'(max_respawns={self._max_respawns} exhausted); see the '
+                f'journal at {resilience.journal_path()}')
+        continue
+      if isinstance(msg, _Done):
+        self.close()
+        raise StopIteration
+      if isinstance(msg, _Error):
+        self.close()
+        raise msg.exc
+      if msg.seq <= self._last_seq:
+        continue  # duplicate re-built after a respawn: already delivered
+      break
     blocked_ms = (time.perf_counter() - t0) * 1000.0
-    if isinstance(msg, _Done):
-      self.close()
-      raise StopIteration
-    if isinstance(msg, _Error):
-      self.close()
-      raise msg.exc
+    self._last_seq = msg.seq
     self._batches += 1
-    self._build_ms += msg.build_ms
+    self._build_ms += msg.fed.build_ms
     self._blocked_ms += blocked_ms
-    return msg
+    return msg.fed
 
   def __enter__(self):
     return self
@@ -177,7 +346,18 @@ class CsrFeed:
         self._ring.get_nowait()
       except queue.Empty:
         break
-    self._thread.join(timeout=30.0)
+    # GC can drop the last feed reference inside the producer's own
+    # trampoline (running __del__ -> close there): never self-join
+    if self._thread is not threading.current_thread():
+      self._thread.join(timeout=30.0)
+
+  def __del__(self):
+    # an abandoned feed (iterator dropped without drain or close) must
+    # not leak a producer blocked forever on the full ring
+    try:
+      self.close()
+    except Exception:
+      pass  # interpreter teardown: module globals may be gone
 
   # ---------------------------------------------------------------- stats
 
@@ -195,7 +375,13 @@ class CsrFeed:
     ``build_ms`` is the total wall time the workers spent building the
     consumed batches; ``blocked_ms`` is the total time ``__next__``
     waited for a build — i.e. host build time NOT hidden behind the
-    device step.  ``overlap_pct`` = share of build time hidden."""
+    device step.  ``overlap_pct`` = share of build time hidden.
+
+    The resilience counters are feed-lifetime (NOT zeroed by
+    ``reset_stats``, which only re-bases the overlap accounting):
+    ``skipped`` poison batches dropped under ``on_batch_error='skip'``,
+    ``io_retries`` transient-I/O retries taken, ``respawns`` producer
+    threads respawned after a worker death."""
     build = self._build_ms
     hidden = max(0.0, build - self._blocked_ms)
     return {
@@ -205,4 +391,7 @@ class CsrFeed:
         'overlap_pct': (round(100.0 * hidden / build, 1) if build > 0
                         else None),
         'builder': self.builder,
+        'skipped': self._skipped,
+        'io_retries': self._io_retry_count,
+        'respawns': self._respawns,
     }

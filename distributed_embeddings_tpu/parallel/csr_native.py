@@ -36,6 +36,14 @@ _I32P = ctypes.POINTER(ctypes.c_int32)
 _F32P = ctypes.POINTER(ctypes.c_float)
 
 
+class NativeBuilderError(RuntimeError):
+  """The native builder was unavailable or rejected a call at runtime.
+  ``sparsecore._route_and_build`` catches this (and any other native
+  failure) and falls back to the bit-exact NumPy oracle for that job,
+  journaling the degradation — a broken .so must degrade a run's
+  throughput, never its correctness or its life."""
+
+
 def build(quiet: bool = True) -> bool:
   """Builds the shared library with make; returns success."""
   global _load_failed
@@ -92,7 +100,7 @@ def route_ids(ids: np.ndarray, offs, vocab, rows_cap: int, lo, hi,
   ``[n_cap, GB, h]``, per-slot routing constants ``[n_cap]``)."""
   lib = _load()
   if lib is None:
-    raise RuntimeError('native CSR builder not built')
+    raise NativeBuilderError('native CSR builder not built')
   ids = _i32(ids)
   n_cap = ids.shape[0]
   gbh = int(ids.size // max(n_cap, 1))
@@ -109,7 +117,7 @@ def partition_counts(routed: np.ndarray, rows_cap: int,
   """Per-partition valid-id counts (the capacity-sizing pass)."""
   lib = _load()
   if lib is None:
-    raise RuntimeError('native CSR builder not built')
+    raise NativeBuilderError('native CSR builder not built')
   routed = _i32(routed)
   counts = np.zeros((num_sc,), np.int32)
   lib.det_csr_counts(_ptr(routed.reshape(-1)), routed.size, rows_cap,
@@ -126,7 +134,7 @@ def build_csr(routed: np.ndarray, rows_cap: int, num_sc: int,
                                                               _round_up8)
   lib = _load()
   if lib is None:
-    raise RuntimeError('native CSR builder not built')
+    raise NativeBuilderError('native CSR builder not built')
   routed = _i32(routed)
   n_cap, gb, h = routed.shape
   flat = routed.reshape(-1)
@@ -144,8 +152,9 @@ def build_csr(routed: np.ndarray, rows_cap: int, num_sc: int,
                               _ptr(rp), _ptr(eids), _ptr(sids),
                               _ptr(gains))
   if dropped < 0:
-    raise ValueError(f'det_csr_build rejected arguments (num_sc={num_sc}, '
-                     f'cap={cap}, h={h})')
+    raise NativeBuilderError(
+        f'det_csr_build rejected arguments (num_sc={num_sc}, '
+        f'cap={cap}, h={h})')
   return HostCsr(row_pointers=rp, embedding_ids=eids, sample_ids=sids,
                  gains=gains, max_ids_per_partition=cap,
                  dropped=int(dropped))

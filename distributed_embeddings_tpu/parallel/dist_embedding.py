@@ -287,18 +287,26 @@ class DistributedEmbedding:
 
   def make_csr_feed(self, source, cats_fn=None,
                     max_ids_per_partition=None, depth: int = 2,
-                    num_workers=None, native: str = 'auto'):
+                    num_workers=None, native: str = 'auto',
+                    on_batch_error: str = 'raise',
+                    io_retries: int = 3,
+                    max_respawns: int = 2):
     """Pipelined host feed over a batch source: batch N+1's padded
     static-CSR buffers build on worker threads while the device
     executes batch N (``parallel/csr_feed.CsrFeed``; docs/design.md §8
     "host feed pipeline").  ``cats_fn`` extracts the per-table id list
     from a source item; pass calibrated ``max_ids_per_partition``
     (``sparsecore.calibrate_max_ids_per_partition``) so every batch's
-    buffers share the static hardware capacity."""
+    buffers share the static hardware capacity.  ``on_batch_error`` /
+    ``io_retries`` / ``max_respawns`` configure the feed's degraded
+    modes (poison-batch policy, transient-I/O backoff, producer
+    respawn — docs/userguide.md "Fault tolerance")."""
     from distributed_embeddings_tpu.parallel.csr_feed import CsrFeed
     return CsrFeed(self, source, cats_fn=cats_fn,
                    max_ids_per_partition=max_ids_per_partition,
-                   depth=depth, num_workers=num_workers, native=native)
+                   depth=depth, num_workers=num_workers, native=native,
+                   on_batch_error=on_batch_error, io_retries=io_retries,
+                   max_respawns=max_respawns)
 
   # ------------------------------------------------------------------ init
 

@@ -23,8 +23,10 @@ from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from distributed_embeddings_tpu.parallel import mesh as mesh_lib
+from distributed_embeddings_tpu.utils import resilience
 
 
 def broadcast_variables(params, root_rank: int = 0):
@@ -109,7 +111,11 @@ def fit(step_fn: Callable,
         eval_every: Optional[int] = None,
         callbacks=(),
         verbose: bool = True,
-        print_fn: Callable = print):
+        print_fn: Callable = print,
+        resume_from: Optional[str] = None,
+        dist=None,
+        terminate_on_nan: bool = False,
+        step_timeout_s: Optional[float] = None):
   """Keras-``fit``-like driver for the train steps built here.
 
   The reference's integration test trains its distributed layer through
@@ -136,6 +142,36 @@ def fit(step_fn: Callable,
       log/eval point (mutating ``logs`` is allowed; e.g. early stopping by
       raising ``StopIteration``).
     verbose: print one line per log point via ``print_fn``.
+    resume_from: a resumable checkpoint ``.npz`` path or a checkpoint
+      DIRECTORY (newest valid file wins; corrupt/plan-mismatched files
+      are rejected with a journaled reason —
+      ``checkpoint.load_latest_valid``).  Restores params + optimizer
+      state + step into ``state`` via ``checkpoint.restore_train_state``
+      and continues bit-exactly; the step counter resumes, so ``steps``
+      keeps meaning the TOTAL step budget and ``data`` must be
+      positioned at the first un-trained batch (deterministic sources:
+      skip ``int(state.step)`` batches).  Requires ``dist``.
+    dist: the model's ``DistributedEmbedding`` (needed only with
+      ``resume_from`` — it defines the resharding layout).
+    terminate_on_nan: stop the run when a non-finite loss appears in a
+      log window, with a clear message and a journaled
+      ``terminate_on_nan`` event naming the offending step
+      (``history['terminated_on_nan']``).  Without this guard a NaN
+      flows through silently AND defeats ``EarlyStopping`` (NaN
+      comparisons are always False, so ``patience`` never fires).
+    step_timeout_s: hung-device-step watchdog — every step dispatch and
+      every log-point device sync runs under this timeout (mirroring
+      bench.py's 180 s backend-probe guard: a downed TPU backend makes
+      syncs HANG, not raise).  On expiry: all-thread tracebacks dump to
+      stderr, a ``watchdog_fired`` event is journaled, and
+      ``resilience.StepHangError`` is raised — failing an unattended
+      window fast instead of wedging it.  Must exceed the worst-case
+      XLA compile of the first step.  ``None`` (default) adds zero
+      overhead; when set, each dispatch pays one watchdog thread
+      (~0.1 ms) — the cost of catching HOST-side hangs (a wedged feed
+      or loader inside ``step_fn``), which never reach the guarded
+      sync point; negligible against real device steps, but don't arm
+      it for microbenchmarks.
 
   Returns:
     ``(state, history)`` — ``history['step']`` / ``history['loss']`` hold
@@ -151,8 +187,31 @@ def fit(step_fn: Callable,
   window = []  # on-device losses since the last sync
   it = iter(data)
   i = 0
+  if resume_from is not None:
+    if dist is None:
+      raise ValueError('fit(resume_from=...) needs dist= (the '
+                       'DistributedEmbedding defining the resharding '
+                       'layout)')
+    from distributed_embeddings_tpu.parallel.checkpoint import (
+        restore_train_state)
+    state, ckpt_path = restore_train_state(dist, state, resume_from)
+    i = int(state.step)
+    if verbose:
+      print_fn(f'resumed from {ckpt_path} at step {i}')
   last_eval_at = None  # step of the last eval: the exit flush must not
   #                      re-eval a state already evaluated at this step
+
+  def sync_window(i):
+    """Host-sync the loss window — THE blocking point where a wedged
+    device program manifests, so the watchdog lives here (and around
+    each dispatch below)."""
+    stacked = jnp.stack(window)
+    window.clear()
+    if step_timeout_s is None:
+      return np.asarray(stacked)
+    return resilience.call_with_timeout(
+        lambda: np.asarray(jax.block_until_ready(stacked)),
+        step_timeout_s, what=f'device-step sync at step {i}')
 
   def flush(i, final=False):
     nonlocal last_eval_at
@@ -160,8 +219,19 @@ def fit(step_fn: Callable,
       return None
     logs = {}
     if window:
-      mean = float(jnp.mean(jnp.stack(window)))
-      window.clear()
+      n_window = len(window)
+      host = sync_window(i)
+      if terminate_on_nan and not np.isfinite(host).all():
+        bad = int(np.argmax(~np.isfinite(host)))
+        bad_step = i - n_window + bad + 1
+        resilience.journal('terminate_on_nan', step=bad_step,
+                           loss=repr(host[bad]))
+        history['terminated_on_nan'] = bad_step
+        print_fn(f'terminate_on_nan: non-finite loss at step {bad_step}; '
+                 'stopping (event journaled to '
+                 f'{resilience.journal_path()})')
+        raise StopIteration
+      mean = float(host.mean())
       logs['loss'] = mean
       history['step'].append(i)
       history['loss'].append(mean)
@@ -192,7 +262,12 @@ def fit(step_fn: Callable,
         args = next(it)
       except StopIteration:
         break
-      state, loss = step_fn(state, *args)
+      if step_timeout_s is not None:
+        state, loss = resilience.call_with_timeout(
+            lambda s=state, a=args: step_fn(s, *a),
+            step_timeout_s, what=f'train step dispatch at step {i}')
+      else:
+        state, loss = step_fn(state, *args)
       window.append(loss)
       i += 1
       if i % log_every == 0:

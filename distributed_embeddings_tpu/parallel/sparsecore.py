@@ -549,6 +549,23 @@ def _route_ids_np(ids: np.ndarray, offs, vocab, rows_cap: int,
       np.int32)
 
 
+_native_fallback_journaled = False
+_native_fallback_lock = threading.Lock()
+
+
+def _journal_native_fallback(e: BaseException):
+  """Journal the native→NumPy degradation once per process (the feed
+  calls the builder per (group, device) per batch — unthrottled, a
+  broken .so would flood the journal)."""
+  global _native_fallback_journaled
+  with _native_fallback_lock:
+    if _native_fallback_journaled:
+      return
+    _native_fallback_journaled = True
+  from distributed_embeddings_tpu.utils import resilience
+  resilience.journal('csr_native_fallback', error=repr(e))
+
+
 def _route_and_build(dist, cats, sub, dev, cap, num_sc: int, stride,
                      builder: str) -> HostCsr:
   """ONE (subgroup, device) unit of the host feed: stage the slot ids,
@@ -567,12 +584,19 @@ def _route_and_build(dist, cats, sub, dev, cap, num_sc: int, stride,
   ids = np.stack(slot_ids)  # [n_cap, GB, h]
   if builder == 'native':
     from distributed_embeddings_tpu.parallel import csr_native
-    routed = csr_native.route_ids(ids, sub.offsets[dev], sub.vocab[dev],
-                                  g.rows_cap, sub.row_lo[dev],
-                                  sub.row_hi[dev], stride[dev])
-    return csr_native.build_csr(routed, g.rows_cap, num_sc,
-                                combiner=sub.lookup_combiner,
-                                max_ids_per_partition=cap)
+    try:
+      routed = csr_native.route_ids(ids, sub.offsets[dev], sub.vocab[dev],
+                                    g.rows_cap, sub.row_lo[dev],
+                                    sub.row_hi[dev], stride[dev])
+      return csr_native.build_csr(routed, g.rows_cap, num_sc,
+                                  combiner=sub.lookup_combiner,
+                                  max_ids_per_partition=cap)
+    except Exception as e:
+      # a native builder that breaks MID-RUN (unloadable .so, rejected
+      # call) degrades to the bit-exact NumPy oracle for this job
+      # instead of killing the feed; journaled once per process so the
+      # slowdown is visible, never silent
+      _journal_native_fallback(e)
   routed = _route_ids_np(ids, sub.offsets[dev], sub.vocab[dev],
                          g.rows_cap, sub.row_lo[dev], sub.row_hi[dev],
                          stride[dev])

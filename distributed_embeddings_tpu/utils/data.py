@@ -29,6 +29,14 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from distributed_embeddings_tpu.utils import resilience
+
+# Transient-read retries for the raw-binary streams (bounded exponential
+# backoff, journaled — utils/resilience.retry_io): a single NFS/disk
+# hiccup used to be fatal on first occurrence and take the whole
+# unattended run down with it.
+IO_RETRIES = 3
+
 
 def smallest_int_dtype(num_categories: int):
   """Smallest signed integer dtype that can index ``num_categories``
@@ -89,11 +97,19 @@ class _Stream:
     return os.fstat(self.fd).st_size // self.row_bytes
 
   def read_rows(self, row0: int, nrows: int) -> np.ndarray:
-    raw = os.pread(self.fd, nrows * self.row_bytes, row0 * self.row_bytes)
-    if len(raw) != nrows * self.row_bytes:
-      raise IOError(
-          f'short read: wanted rows [{row0}, {row0 + nrows}) '
-          f'({nrows * self.row_bytes} bytes), got {len(raw)} bytes')
+    def fetch():
+      raw = os.pread(self.fd, nrows * self.row_bytes,
+                     row0 * self.row_bytes)
+      if len(raw) != nrows * self.row_bytes:
+        raise IOError(
+            f'short read: wanted rows [{row0}, {row0 + nrows}) '
+            f'({nrows * self.row_bytes} bytes), got {len(raw)} bytes')
+      return raw
+
+    # transient pread failures (and short reads, which a flaky mount
+    # produces) retry with bounded backoff before surfacing
+    raw = resilience.retry_io(fetch, retries=IO_RETRIES,
+                              what=f'stream read rows@{row0}')
     return np.frombuffer(raw, dtype=self.disk_dtype)
 
   def close(self):

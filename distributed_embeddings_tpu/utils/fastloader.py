@@ -17,7 +17,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from distributed_embeddings_tpu.utils import nativebuild
+from distributed_embeddings_tpu.utils import nativebuild, resilience
 from distributed_embeddings_tpu.utils.data import (BinaryCriteoReader,
                                                    smallest_int_dtype)
 
@@ -71,7 +71,13 @@ def available() -> bool:
 
 class FastBinaryCriteoReader:
   """Native-backed drop-in for ``BinaryCriteoReader`` (same constructor and
-  item contract: ``(numerical, categoricals, labels)`` per batch)."""
+  item contract: ``(numerical, categoricals, labels)`` per batch).
+
+  A non-zero return from the native decode (``det_loader_get`` — a
+  failed pread in the C++ ring) retries with bounded exponential
+  backoff (``io_retries`` retries, journaled) before raising: one
+  transient NFS/disk hiccup must not kill a multi-hour unattended run.
+  """
 
   def __init__(self,
                data_path: str,
@@ -84,7 +90,8 @@ class FastBinaryCriteoReader:
                valid: bool = False,
                offset: int = -1,
                lbs: int = -1,
-               dp_input: bool = False):
+               dp_input: bool = False,
+               io_retries: int = 3):
     lib = _load()
     if lib is None:
       raise RuntimeError(
@@ -116,6 +123,7 @@ class FastBinaryCriteoReader:
     self._lbs = lbs
     self._dp_input = dp_input
     self._valid = valid
+    self._io_retries = io_retries
     self._num_batches = lib.det_loader_num_batches(self._handle)
 
   def __len__(self):
@@ -137,14 +145,18 @@ class FastBinaryCriteoReader:
                  if self._num_numerical > 0 else None)
     cats = (np.empty((len(self._cat_ids), cat_rows), np.int32)
             if self._cat_ids else None)
-    rc = lib.det_loader_get(
-        h, idx, labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        numerical.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
-        if numerical is not None else None,
-        cats.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
-        if cats is not None else None)
-    if rc != 0:
-      raise IOError(f'native loader failed on batch {idx} (rc={rc})')
+    def fetch():
+      rc = lib.det_loader_get(
+          h, idx, labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+          numerical.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+          if numerical is not None else None,
+          cats.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+          if cats is not None else None)
+      if rc != 0:
+        raise IOError(f'native loader failed on batch {idx} (rc={rc})')
+
+    resilience.retry_io(fetch, retries=self._io_retries,
+                        what=f'native loader batch {idx}')
     cat_list = [cats[i] for i in range(len(self._cat_ids))] if (
         cats is not None) else None
     return numerical, cat_list, labels[:, None]

@@ -1,0 +1,168 @@
+"""Deterministic fault injectors for the fault-tolerance test harness.
+
+Every injector is reproducible (explicit offsets/seeds/indices, no wall
+clock) so ``tests/test_fault_tolerance.py`` can exercise each degraded
+path on the faked 8-device CPU mesh and assert the exact recovery the
+runtime promises:
+
+- ``truncate_file`` / ``flip_bytes``: corrupt a written checkpoint the
+  two ways a crash or bit-rot does (short file, damaged payload) —
+  ``checkpoint.load_latest_valid`` must reject both with a journaled
+  reason and fall back to the previous valid file.
+- ``FlakyIter`` / ``flaky_calls``: raise a transient ``IOError`` on the
+  Nth item/call, a configurable number of times, then succeed — the
+  loader/feed retry-with-backoff paths must recover with zero data
+  loss.
+- ``kill_thread``: asynchronously kill a worker thread (the CsrFeed
+  producer) — the feed must respawn it and continue the stream.
+- ``DelayedStep``: stall one train step past the watchdog timeout —
+  ``fit(step_timeout_s=...)`` must dump diagnostics and fail fast.
+
+These are test/ops tools, not production paths; nothing here is
+imported by the runtime modules.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+from typing import Callable, Iterable, Iterator, Optional, Sequence
+
+
+def truncate_file(path: str, nbytes: int = 64) -> int:
+  """Chop the last ``nbytes`` off ``path`` (a mid-write crash's short
+  file).  Returns the new size."""
+  size = os.path.getsize(path)
+  new = max(0, size - int(nbytes))
+  with open(path, 'r+b') as f:
+    f.truncate(new)
+  return new
+
+
+def flip_bytes(path: str,
+               offsets: Optional[Sequence[int]] = None,
+               count: int = 8,
+               seed: int = 0) -> list:
+  """XOR ``0xFF`` into ``count`` deterministic byte offsets (or the
+  explicit ``offsets``).  Default offsets are seeded positions inside
+  the middle 80% of the file, so the damage lands in array payload
+  (checksum territory) rather than only in zip metadata.  Returns the
+  offsets flipped."""
+  import numpy as np
+  size = os.path.getsize(path)
+  if offsets is None:
+    lo, hi = int(size * 0.1), max(int(size * 0.9), int(size * 0.1) + 1)
+    rng = np.random.default_rng(seed)
+    offsets = sorted(int(o) for o in rng.integers(lo, hi, size=count))
+  with open(path, 'r+b') as f:
+    for off in offsets:
+      f.seek(off)
+      b = f.read(1)
+      if not b:
+        continue
+      f.seek(off)
+      f.write(bytes([b[0] ^ 0xFF]))
+  return list(offsets)
+
+
+class FlakyIter:
+  """Iterator wrapper raising a transient error on selected items.
+
+  ``fail_at``: 0-based item indices that raise ``exc_factory()`` before
+  yielding; each index raises ``times`` times, then yields the item
+  normally on the next attempt (the transient recovers — no data is
+  lost under retry).  ``raised`` counts injected failures.
+  """
+
+  def __init__(self, source: Iterable, fail_at: Sequence[int],
+               times: int = 1,
+               exc_factory: Callable[[], BaseException] = lambda: IOError(
+                   'injected transient read failure')):
+    self._it: Iterator = iter(source)
+    self._fail_at = set(int(i) for i in fail_at)
+    self._times = times
+    self._exc_factory = exc_factory
+    self._idx = 0
+    self._fails_left = {i: times for i in self._fail_at}
+    self.raised = 0
+
+  def __iter__(self):
+    return self
+
+  def __next__(self):
+    i = self._idx
+    if self._fails_left.get(i, 0) > 0:
+      self._fails_left[i] -= 1
+      self.raised += 1
+      raise self._exc_factory()
+    self._idx += 1
+    return next(self._it)
+
+
+def flaky_calls(fn: Callable, fail_at: Sequence[int], times: int = 1,
+                exc_factory: Callable[[], BaseException] = lambda: IOError(
+                    'injected transient I/O failure')) -> Callable:
+  """Wrap ``fn`` so its Nth invocations (0-based, per ``fail_at``) raise
+  transiently: each listed call index raises ``times`` times, and the
+  retry of that same logical call (the next invocation) succeeds.  The
+  wrapper exposes ``.calls`` and ``.raised`` counters."""
+  state = {'calls': 0, 'raised': 0}
+  fails_left = {int(i): times for i in fail_at}
+  lock = threading.Lock()
+
+  def wrapper(*args, **kwargs):
+    with lock:
+      i = state['calls']
+      if fails_left.get(i, 0) > 0:
+        fails_left[i] -= 1
+        state['raised'] += 1
+        wrapper.raised = state['raised']
+        raise exc_factory()
+      state['calls'] += 1
+      wrapper.calls = state['calls']
+    return fn(*args, **kwargs)
+
+  wrapper.calls = 0
+  wrapper.raised = 0
+  return wrapper
+
+
+def kill_thread(thread: threading.Thread,
+                exc: type = SystemExit) -> bool:
+  """Asynchronously raise ``exc`` inside ``thread`` (the CPython
+  ``PyThreadState_SetAsyncExc`` mechanism) — the deterministic stand-in
+  for a pool worker dying mid-build.  Returns whether the exception was
+  scheduled (the thread must still be alive and run Python bytecode to
+  receive it)."""
+  if not thread.is_alive() or thread.ident is None:
+    return False
+  n = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+      ctypes.c_ulong(thread.ident), ctypes.py_object(exc))
+  if n > 1:  # multiple states matched: undo (CPython docs' safety rule)
+    ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(thread.ident), None)
+    return False
+  return n == 1
+
+
+class DelayedStep:
+  """Wrap a train-step callable so step ``at_step`` (0-based call
+  index) stalls ``delay_s`` seconds before dispatch — long enough to
+  trip ``fit(step_timeout_s=...)``'s watchdog in tests without
+  touching the device program."""
+
+  def __init__(self, step_fn: Callable, at_step: int, delay_s: float):
+    self._fn = step_fn
+    self._at = int(at_step)
+    self._delay = float(delay_s)
+    self.calls = 0
+
+  def __call__(self, *args, **kwargs):
+    import time
+    i = self.calls
+    self.calls += 1
+    if i == self._at:
+      time.sleep(self._delay)
+    return self._fn(*args, **kwargs)

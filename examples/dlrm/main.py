@@ -89,6 +89,20 @@ def parse_args():
   parser.add_argument('--load_state', default=None,
                       help='resume from a --save_state checkpoint (any '
                       'world size / strategy: the layout reshards on load)')
+  parser.add_argument('--resume_dir', default=None,
+                      help='auto-resume directory: load the NEWEST VALID '
+                      'checkpoint in it (corrupt/truncated/plan-mismatched '
+                      'files are rejected with a journaled reason and the '
+                      'previous valid one loads instead — '
+                      'checkpoint.load_latest_valid); an empty/missing '
+                      'dir starts fresh.  --load_state takes precedence.')
+  parser.add_argument('--on_batch_error', default='raise',
+                      choices=['raise', 'skip'],
+                      help="poison-batch policy for the --csr_feed "
+                      "pipeline: 'raise' fails the run on a batch whose "
+                      "build errors (after transient-I/O retries); 'skip' "
+                      'drops it, counts it in the feed stats and journals '
+                      'it — never silent')
   return parser.parse_args()
 
 
@@ -104,13 +118,11 @@ def main():
                                                    get_weights,
                                                    init_hybrid_train_state,
                                                    init_train_state,
-                                                   load_train_npz,
                                                    make_hybrid_train_step,
-                                                   make_train_step, save_npz,
-                                                   save_train_npz,
-                                                   set_optimizer_state,
-                                                   set_weights)
-  from distributed_embeddings_tpu.parallel.grad import TrainState
+                                                   make_train_step,
+                                                   restore_train_state,
+                                                   save_npz,
+                                                   save_train_npz)
   from distributed_embeddings_tpu.utils.data import DummyDataset
   from distributed_embeddings_tpu.utils.fastloader import (
       open_raw_binary_dataset)
@@ -215,38 +227,27 @@ def main():
     leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
     return {jax.tree_util.keystr(p): v for p, v in leaves}, treedef
 
-  def restore_like(template, saved, prefix):
-    flat, treedef = flat_with_paths(template)
-    leaves = [
-        jnp.asarray(saved[prefix + k]) if prefix + k in saved else v
-        for k, v in flat.items()
-    ]
-    return jax.tree_util.tree_unflatten(treedef, leaves)
-
+  # resume: one explicit checkpoint (--load_state) or auto-resume from
+  # the newest VALID file in --resume_dir (corrupt/plan-mismatched
+  # candidates are rejected with a journaled reason and the previous
+  # valid one loads instead).  restore_train_state reshards the tables
+  # + sparse-optimizer state and restores the dense params/optax state
+  # (incl. the schedule counts) from the flattened extras, so the MLP
+  # towers and both LR schedules resume exactly where they stopped.
   resume_step = 0
-  if args.load_state:
-    weights, st_tables, extras = load_train_npz(args.load_state)
-    new_params = dict(state.params)
-    new_params['embedding'] = set_weights(dist, weights)
-    # dense params + dense optax state (incl. the schedule count) travel
-    # in extras under flattened paths, so the MLP towers and both LR
-    # schedules resume exactly where they stopped
-    dense_template = {k: v for k, v in new_params.items()
-                      if k != 'embedding'}
-    restored_dense = restore_like(dense_template, extras, 'dense:')
-    new_params.update(restored_dense)
-    if args.trainer == 'sparse':
-      emb_opt_state = state.opt_state[1]
-      if any(st_tables):
-        emb_opt_state = set_optimizer_state(dist, emb_opt_state, st_tables)
-      opt_state = (restore_like(state.opt_state[0], extras, 'opt:'),
-                   emb_opt_state)
+  resume_source = args.load_state or (
+      args.resume_dir if args.resume_dir and os.path.isdir(args.resume_dir)
+      else None)
+  if resume_source is not None:
+    try:
+      state, ckpt_path = restore_train_state(dist, state, resume_source)
+    except FileNotFoundError as e:
+      if args.load_state:
+        raise
+      print(f'resume_dir: no valid checkpoint yet ({e}); starting fresh')
     else:
-      opt_state = restore_like(state.opt_state, extras, 'opt:')
-    resume_step = int(extras.get('step', 0))
-    state = TrainState(new_params, opt_state,
-                       jnp.asarray(resume_step, jnp.int32))
-    print(f'resumed from {args.load_state} at step {resume_step}')
+      resume_step = int(state.step)
+      print(f'resumed from {ckpt_path} at step {resume_step}')
 
   if args.loader_bench:
     # pure data-pipeline throughput, no device work: must exceed the
@@ -311,9 +312,11 @@ def main():
         params=state.params['embedding'])
     feed = CsrFeed(dist, data_iter,
                    cats_fn=lambda b: [np.asarray(c) for c in b[1]],
-                   max_ids_per_partition=sc_caps)
+                   max_ids_per_partition=sc_caps,
+                   on_batch_error=args.on_batch_error)
     print(f'csr_feed: pipelined host feed active '
-          f'({feed.builder} builder, caps calibrated from batch 0)')
+          f'({feed.builder} builder, caps calibrated from batch 0, '
+          f'on_batch_error={args.on_batch_error})')
     data_iter = (fed.item for fed in feed)
   for i, (numerical, cats, labels) in enumerate(data_iter):
     numerical = jnp.asarray(numerical)
@@ -353,6 +356,11 @@ def main():
             f"{fstats['blocked_ms']:.1f} ms -> {fstats['overlap_pct']}% "
             f"of host build time hidden behind the device step "
             f"({fstats['builder']} builder)")
+    if fstats['skipped'] or fstats['io_retries'] or fstats['respawns']:
+      print(f"csr_feed: degraded-mode events — {fstats['skipped']} "
+            f"batch(es) skipped, {fstats['io_retries']} I/O retries, "
+            f"{fstats['respawns']} producer respawn(s); details in the "
+            'fault journal')
   if loss is None:
     print('no batches to train on (resume skipped the whole dataset)')
     return
@@ -400,7 +408,8 @@ def main():
                  else state.opt_state)  # small with SGD; see --help
     for k, v in flat_with_paths(dense_opt)[0].items():
       extras['opt:' + k] = np.asarray(v)
-    save_train_npz(args.save_state, weights, st_tables, extras=extras)
+    save_train_npz(args.save_state, weights, st_tables, extras=extras,
+                   plan=dist)
     print(f'saved resumable state to {args.save_state}')
 
 

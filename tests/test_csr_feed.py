@@ -133,6 +133,49 @@ def test_overlap_accounting_direct(dist):
       stats
 
 
+def test_close_while_producer_blocked_on_full_ring(dist):
+  """close() racing a producer that is BLOCKED mid-put on a full ring
+  must join it within the timeout — no hang, no leaked thread."""
+  feed = CsrFeed(dist, _batches(30), cats_fn=lambda it: it[1], depth=1)
+  deadline = time.time() + 10
+  while feed._ring.qsize() < 1 and time.time() < deadline:
+    time.sleep(0.01)  # ring full; the producer is now blocked in _put
+  t = feed._thread
+  feed.close()
+  t.join(timeout=5.0)
+  assert not t.is_alive()
+
+
+def test_abandoned_feed_releases_producer(dist):
+  """An iterator abandoned without drain or close() (the caller just
+  drops it) must not leak a producer thread blocked forever on the
+  full ring — __del__ closes the feed."""
+  import gc
+  feed = CsrFeed(dist, _batches(20), cats_fn=lambda it: it[1], depth=1)
+  next(feed)
+  t = feed._thread
+  del feed
+  gc.collect()
+  t.join(timeout=10.0)
+  assert not t.is_alive()
+
+
+def test_source_raises_on_first_batch(dist):
+  """A source that explodes before yielding anything surfaces the error
+  on the FIRST __next__ — no hang, producer joined."""
+  def source():
+    raise RuntimeError('bad first batch')
+    yield  # pragma: no cover
+
+  feed = CsrFeed(dist, source(), cats_fn=lambda it: it[1])
+  with pytest.raises(RuntimeError, match='bad first batch'):
+    next(feed)
+  feed._thread.join(timeout=5.0)
+  assert not feed._thread.is_alive()
+  with pytest.raises(StopIteration):
+    next(feed)
+
+
 def test_run_pipelined_trains_and_matches_unpipelined(dist):
   """The pipelined driver reproduces the plain loop bit-for-bit: same
   losses, same final weights — the feed changes WHEN host work happens,

@@ -1,0 +1,601 @@
+"""Fault-tolerance suite (ISSUE 4): checkpoint integrity + auto-resume,
+resilient input pipeline, step watchdog, NaN guard — every degraded path
+driven by the deterministic injectors in ``utils/faultinject.py`` on the
+faked 8-device CPU mesh.
+
+The three acceptance proofs live here:
+- kill/resume: a run killed mid-stream resumes via ``fit(resume_from=)``
+  and matches the uninterrupted run bit-exactly;
+- corruption: truncated and byte-flipped checkpoints are rejected with
+  journaled reasons and the previous valid file loads;
+- pipeline resilience: injected transient IOErrors recover via
+  retry/backoff with zero data loss, and ``on_batch_error='skip'``
+  survives a poison batch with the skip counted in ``CsrFeed.stats()``.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from distributed_embeddings_tpu.parallel import (CheckpointCallback,
+                                                 CsrFeed,
+                                                 DistributedEmbedding,
+                                                 SparseAdagrad, TableConfig,
+                                                 create_mesh, fit,
+                                                 init_hybrid_train_state,
+                                                 init_train_state,
+                                                 load_latest_valid,
+                                                 make_hybrid_train_step,
+                                                 make_train_step,
+                                                 plan_fingerprint,
+                                                 restore_train_state,
+                                                 save_train_npz,
+                                                 set_weights, verify_npz)
+from distributed_embeddings_tpu.parallel import checkpoint as ckpt_lib
+from distributed_embeddings_tpu.parallel import sparsecore
+from distributed_embeddings_tpu.utils import faultinject, resilience
+from distributed_embeddings_tpu.utils.data import (BinaryCriteoReader,
+                                                   write_raw_binary_dataset)
+
+WORLD = 8
+BATCH = 16
+CONFIGS = [TableConfig(40, 8, combiner='sum'),
+           TableConfig(30, 8, combiner='mean')]
+
+
+@pytest.fixture(autouse=True)
+def _journal_to_tmp(tmp_path, monkeypatch):
+  """Isolate the jsonl journal per test; the in-memory ring is cleared
+  so ``resilience.recent()`` reflects only this test's events."""
+  monkeypatch.setenv('DET_FT_JOURNAL', str(tmp_path / 'ft_journal.jsonl'))
+  resilience.clear_recent()
+
+
+@pytest.fixture(scope='module')
+def hybrid():
+  """Deterministic hybrid trainer: dist, step_fn, fresh_state(),
+  and a materialised batch list (so interrupted/resumed runs replay
+  the exact same stream)."""
+  mesh = create_mesh(jax.devices()[:WORLD])
+  dist = DistributedEmbedding(CONFIGS, mesh=mesh)
+  rng = np.random.default_rng(0)
+  weights = [rng.normal(size=(c.input_dim, c.output_dim)).astype(np.float32)
+             for c in CONFIGS]
+  kernel = jnp.asarray(rng.normal(size=(16, 1)).astype(np.float32))
+
+  def head_loss_fn(dense, emb_outs, y):
+    x = jnp.concatenate(list(emb_outs), axis=1)
+    return jnp.mean((x @ dense['kernel'] - y) ** 2)
+
+  r = np.random.default_rng(7)
+  data = []
+  for _ in range(20):
+    cats = [jnp.asarray(r.integers(0, c.input_dim, (BATCH, 2)), jnp.int32)
+            for c in CONFIGS]
+    y = jnp.asarray(r.normal(size=(BATCH, 1)).astype(np.float32))
+    data.append((cats, y))
+
+  dense_opt = optax.adagrad(0.05)
+  emb_opt = SparseAdagrad(learning_rate=0.05)
+  step = make_hybrid_train_step(dist, head_loss_fn, dense_opt, emb_opt,
+                                donate=False)
+
+  def fresh_state():
+    params = {'embedding': set_weights(dist, weights), 'kernel': kernel}
+    return init_hybrid_train_state(dist, params, dense_opt, emb_opt)
+
+  return dist, step, fresh_state, data
+
+
+def _logical_leaves(dist, state):
+  """The state's LOGICAL content in the global canonical layout (the
+  checkpoint contract): per-table weights + sparse-optimizer tables,
+  dense params, dense optax leaves.  Device-side padding rows are
+  excluded by construction — they are never looked up, carry no
+  information, and legitimately differ between a fresh init (which
+  fills them with the initializer) and a resharded restore (which
+  zero-fills them, set_optimizer_state's documented contract)."""
+  from distributed_embeddings_tpu.parallel import (get_optimizer_state,
+                                                   get_weights)
+  leaves = list(get_weights(dist, state.params['embedding']))
+  dense = {k: v for k, v in state.params.items() if k != 'embedding'}
+  leaves += [np.asarray(v) for v in jax.tree_util.tree_leaves(dense)]
+  leaves += [np.asarray(v)
+             for v in jax.tree_util.tree_leaves(state.opt_state[0])]
+  for entry in get_optimizer_state(dist, state.opt_state[1]):
+    leaves += [entry[k] for k in sorted(entry)]
+  return leaves
+
+
+# --------------------------------------------------------------------------
+# acceptance proof 1: kill / resume bit-exact
+# --------------------------------------------------------------------------
+
+
+def test_kill_resume_bit_exact(hybrid, tmp_path):
+  """A run killed mid-stream (after its step-10 checkpoint, with steps
+  11-13 lost) resumes via fit(resume_from=<dir>) from a FRESH state and
+  matches the uninterrupted run's params + optimizer state bit-exactly
+  at step 20 on the same deterministic data."""
+  dist, step, fresh_state, data = hybrid
+  # uninterrupted reference
+  ref, _ = fit(step, fresh_state(), iter(data), steps=20, log_every=5,
+               verbose=False)
+  # interrupted run: checkpoints every 10 steps, "killed" after step 13
+  cb = CheckpointCallback(dist, str(tmp_path / 'ckpt_{step}.npz'), every=10)
+  fit(step, fresh_state(), iter(data[:13]), steps=13, log_every=5,
+      callbacks=[cb], verbose=False)
+  assert (tmp_path / 'ckpt_10.npz').exists()
+  # resume: fresh process = fresh state structure; data repositioned at
+  # the first un-trained batch (step counter restored to 10)
+  resumed, _ = fit(step, fresh_state(), iter(data[10:]), steps=20,
+                   log_every=5, resume_from=str(tmp_path), dist=dist,
+                   verbose=False)
+  assert int(resumed.step) == int(ref.step) == 20
+  ref_leaves = _logical_leaves(dist, ref)
+  res_leaves = _logical_leaves(dist, resumed)
+  assert len(ref_leaves) == len(res_leaves)
+  for a, b in zip(ref_leaves, res_leaves):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+  assert resilience.recent('resume')
+
+
+def test_restore_train_state_explicit_file(hybrid, tmp_path):
+  dist, step, fresh_state, data = hybrid
+  cb = CheckpointCallback(dist, str(tmp_path / 'one.npz'), every=5)
+  trained, _ = fit(step, fresh_state(), iter(data[:5]), steps=5,
+                   log_every=5, callbacks=[cb], verbose=False)
+  restored, path = restore_train_state(dist, fresh_state(),
+                                       str(tmp_path / 'one.npz'))
+  assert path == str(tmp_path / 'one.npz')
+  for a, b in zip(_logical_leaves(dist, trained),
+                  _logical_leaves(dist, restored)):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# --------------------------------------------------------------------------
+# acceptance proof 2: corruption rejected, previous valid file loads
+# --------------------------------------------------------------------------
+
+
+def _save_three(dist, tmp_path, weights):
+  st = [{'acc': np.full((c.input_dim, c.output_dim), 0.1, np.float32)}
+        for c in CONFIGS]
+  paths = []
+  for step_no in (10, 20, 30):
+    p = str(tmp_path / f'ckpt_{step_no}.npz')
+    save_train_npz(p, weights, st, extras={'step': np.int64(step_no)},
+                   plan=dist)
+    os.utime(p, (step_no, step_no))
+    paths.append(p)
+  return paths
+
+
+def test_corruption_truncate_and_flip_fall_back(hybrid, tmp_path):
+  dist = hybrid[0]
+  rng = np.random.default_rng(1)
+  weights = [rng.normal(size=(c.input_dim, c.output_dim)).astype(np.float32)
+             for c in CONFIGS]
+  p10, p20, p30 = _save_three(dist, tmp_path, weights)
+  man = ckpt_lib.read_manifest(p10)
+  assert man['step'] == 10 and man['plan'] == ckpt_lib.plan_fingerprint(
+      dist)
+  faultinject.truncate_file(p30, nbytes=512)     # mid-write crash
+  faultinject.flip_bytes(p20, count=8, seed=0)   # bit rot
+  path, (w, st, extras) = load_latest_valid(str(tmp_path), expect_plan=dist)
+  assert path == p10
+  assert int(extras['step']) == 10
+  for a, b in zip(weights, w):
+    np.testing.assert_array_equal(a, b)
+  rejected = resilience.recent('checkpoint_rejected')
+  assert {os.path.basename(e['path']) for e in rejected} == {
+      'ckpt_20.npz', 'ckpt_30.npz'}
+  assert all(e['reason'] for e in rejected)
+
+
+def test_plan_mismatch_rejected(hybrid, tmp_path):
+  dist = hybrid[0]
+  rng = np.random.default_rng(2)
+  weights = [rng.normal(size=(c.input_dim, c.output_dim)).astype(np.float32)
+             for c in CONFIGS]
+  p = str(tmp_path / 'ckpt_5.npz')
+  save_train_npz(p, weights, extras={'step': np.int64(5)}, plan=dist)
+  other = [TableConfig(41, 8, 'sum'), TableConfig(30, 8, 'mean')]
+  ok, reason, _ = verify_npz(p, expect_plan=other)
+  assert not ok and 'plan-mismatch' in reason
+  assert plan_fingerprint(dist) != plan_fingerprint(other)
+  with pytest.raises(FileNotFoundError, match='plan-mismatch'):
+    load_latest_valid(str(tmp_path), expect_plan=other)
+
+
+def test_legacy_manifestless_npz_still_loads(hybrid, tmp_path):
+  """Compatibility contract: pre-manifest round-trip files (plain
+  np.savez, no checksums) verify as legacy and load through
+  load_latest_valid / restore_train_state unchanged."""
+  rng = np.random.default_rng(3)
+  weights = {f'table{i}': rng.normal(size=(c.input_dim, c.output_dim)
+                                     ).astype(np.float32)
+             for i, c in enumerate(CONFIGS)}
+  legacy = str(tmp_path / 'legacy.npz')
+  np.savez(legacy, **weights)
+  ok, reason, man = verify_npz(legacy)
+  assert ok and reason == 'legacy-no-manifest' and man is None
+  path, (w, st, extras) = load_latest_valid(str(tmp_path))
+  assert path == legacy
+  np.testing.assert_array_equal(w[0], weights['table0'])
+
+
+def test_atomic_save_survives_midwrite_failure(hybrid, tmp_path,
+                                               monkeypatch):
+  """A writer that dies mid-serialisation must leave the previous file
+  intact under the canonical name and no tmp debris behind."""
+  dist = hybrid[0]
+  rng = np.random.default_rng(4)
+  weights = [rng.normal(size=(c.input_dim, c.output_dim)).astype(np.float32)
+             for c in CONFIGS]
+  p = str(tmp_path / 'state.npz')
+  save_train_npz(p, weights, extras={'step': np.int64(1)}, plan=dist)
+
+  real_savez = np.savez
+
+  def dying_savez(f, **payload):
+    f.write(b'partial garbage the crash leaves behind')
+    raise IOError('injected mid-write crash')
+
+  monkeypatch.setattr(np, 'savez', dying_savez)
+  with pytest.raises(IOError, match='mid-write'):
+    save_train_npz(p, weights, extras={'step': np.int64(2)}, plan=dist)
+  monkeypatch.setattr(np, 'savez', real_savez)
+  ok, reason, man = verify_npz(p, expect_plan=dist)
+  assert ok, reason
+  assert man['step'] == 1  # the OLD file, untouched
+  assert not [f for f in os.listdir(tmp_path) if '.tmp' in f]
+
+
+def test_checkpoint_callback_keep_last_retention(hybrid, tmp_path):
+  dist, step, fresh_state, data = hybrid
+  cb = CheckpointCallback(dist, str(tmp_path / 'ckpt_{step}.npz'),
+                          every=5, keep_last=2)
+  fit(step, fresh_state(), iter(data), steps=20, log_every=5,
+      callbacks=[cb], verbose=False)
+  left = sorted(f for f in os.listdir(tmp_path) if f.endswith('.npz'))
+  assert left == ['ckpt_15.npz', 'ckpt_20.npz']
+  assert resilience.recent('checkpoint_pruned')
+
+
+# --------------------------------------------------------------------------
+# NaN guard + step watchdog
+# --------------------------------------------------------------------------
+
+
+def _scalar_trainer():
+  opt = optax.sgd(0.01)
+
+  def loss_fn(params, x):
+    # sqrt(-1) -> NaN on the poisoned batch; params kept in the graph
+    return jnp.mean(jnp.sqrt(x) + 0.0 * params['w'])
+
+  step = make_train_step(loss_fn, opt, donate=False)
+  return step, init_train_state({'w': jnp.ones(())}, opt)
+
+
+def test_terminate_on_nan_stops_and_journals():
+  step, state = _scalar_trainer()
+  data = [(jnp.asarray(1.0),)] * 20
+  data[6] = (jnp.asarray(-1.0),)  # step 7 produces NaN
+  msgs = []
+  _, hist = fit(step, state, iter(data), steps=20, log_every=5,
+                terminate_on_nan=True, verbose=False,
+                print_fn=msgs.append)
+  assert hist['terminated_on_nan'] == 7
+  assert hist['step'] == [5]  # stopped at the step-10 flush, not later
+  events = resilience.recent('terminate_on_nan')
+  assert events and events[-1]['step'] == 7
+  assert any('terminate_on_nan' in m and 'step 7' in m for m in msgs)
+
+
+def test_nan_flows_silently_without_the_guard():
+  """The failure mode the guard exists for: without it the NaN sails
+  through all 20 steps (and would defeat EarlyStopping — NaN
+  comparisons are always False)."""
+  step, state = _scalar_trainer()
+  data = [(jnp.asarray(1.0),)] * 20
+  data[6] = (jnp.asarray(-1.0),)
+  _, hist = fit(step, state, iter(data), steps=20, log_every=5,
+                verbose=False)
+  assert len(hist['step']) == 4  # ran to completion
+  assert np.isnan(hist['loss'][1])
+
+
+def test_step_watchdog_fails_fast():
+  step, state = _scalar_trainer()
+  state, _ = step(state, jnp.asarray(1.0))  # compile outside the timeout
+  slow = faultinject.DelayedStep(step, at_step=3, delay_s=3.0)
+  data = [(jnp.asarray(1.0),)] * 10
+  t0 = time.perf_counter()
+  with pytest.raises(resilience.StepHangError, match='watchdog'):
+    fit(slow, state, iter(data), steps=10, log_every=2,
+        step_timeout_s=0.5, verbose=False)
+  assert time.perf_counter() - t0 < 3.0  # failed fast, not after the hang
+  assert resilience.recent('watchdog_fired')
+
+
+def test_watchdog_off_by_default_zero_overhead_path():
+  step, state = _scalar_trainer()
+  data = [(jnp.asarray(1.0),)] * 4
+  _, hist = fit(step, state, iter(data), steps=4, log_every=2,
+                verbose=False)
+  assert len(hist['loss']) == 2
+
+
+# --------------------------------------------------------------------------
+# acceptance proof 3: resilient input pipeline
+# --------------------------------------------------------------------------
+
+FEED_WORLD = 4
+FEED_CONFIGS = [TableConfig(60, 16, 'sum'), TableConfig(40, 8, 'sum')]
+
+
+@pytest.fixture(scope='module')
+def feed_dist():
+  mesh = create_mesh(jax.devices()[:FEED_WORLD])
+  return DistributedEmbedding(FEED_CONFIGS, mesh=mesh,
+                              lookup_impl='sparsecore')
+
+
+def _feed_batches(n, seed=0):
+  rng = np.random.default_rng(seed)
+  return [(i, [rng.integers(0, c.input_dim,
+                            size=(FEED_WORLD * 4, 3)).astype(np.int32)
+               for c in FEED_CONFIGS]) for i in range(n)]
+
+
+def test_feed_transient_io_retry_zero_loss(feed_dist):
+  src = faultinject.FlakyIter(_feed_batches(6), fail_at=[2, 4], times=1)
+  feed = CsrFeed(feed_dist, src, cats_fn=lambda it: it[1],
+                 retry_base_s=0.01)
+  got = [fed.item[0] for fed in feed]
+  assert got == list(range(6))  # zero loss, order preserved
+  assert src.raised == 2
+  stats = feed.stats()
+  assert stats['io_retries'] == 2
+  assert stats['skipped'] == 0
+  assert resilience.recent('io_retry')
+
+
+def test_feed_poison_batch_skip_policy(feed_dist):
+  batches = _feed_batches(6, seed=1)
+
+  def cats_fn(item):
+    if item[0] == 3:
+      raise ValueError('poison batch: undecodable ids')
+    return item[1]
+
+  feed = CsrFeed(feed_dist, batches, cats_fn=cats_fn,
+                 on_batch_error='skip', retry_base_s=0.01)
+  got = [fed.item[0] for fed in feed]
+  assert got == [0, 1, 2, 4, 5]  # the poison batch dropped, rest intact
+  stats = feed.stats()
+  assert stats['skipped'] == 1
+  events = resilience.recent('csr_feed_skipped_batch')
+  assert events and events[-1]['seq'] == 3
+  assert 'poison batch' in events[-1]['error']
+
+
+def test_feed_poison_batch_default_raises(feed_dist):
+  batches = _feed_batches(4, seed=2)
+
+  def cats_fn(item):
+    if item[0] == 1:
+      raise ValueError('poison batch')
+    return item[1]
+
+  feed = CsrFeed(feed_dist, batches, cats_fn=cats_fn, retry_base_s=0.01)
+  assert next(feed).item[0] == 0
+  with pytest.raises(ValueError, match='poison batch'):
+    for _ in feed:
+      pass
+  assert not feed._thread.is_alive()
+
+
+def test_feed_producer_killed_respawns_zero_loss(feed_dist):
+  """kill_thread (the died-pool-worker injector) lands while batch 2
+  builds; the respawned producer re-builds the in-flight batch and the
+  consumer sees the full ordered stream."""
+  batches = _feed_batches(7, seed=3)
+  entered = threading.Event()
+  killed_once = []
+
+  def cats_fn(item):
+    if item[0] == 2 and not killed_once:
+      killed_once.append(True)
+      entered.set()
+      time.sleep(0.5)  # the async kill is delivered when this returns
+    return item[1]
+
+  feed = CsrFeed(feed_dist, batches, cats_fn=cats_fn, depth=1)
+  got = [next(feed).item[0]]
+  assert entered.wait(timeout=10)
+  assert faultinject.kill_thread(feed._thread)
+  got += [fed.item[0] for fed in feed]
+  assert got == list(range(7))  # nothing lost, nothing duplicated
+  assert feed.stats()['respawns'] == 1
+  assert resilience.recent('csr_feed_respawn')
+
+
+def test_feed_producer_dead_beyond_max_respawns(feed_dist):
+  """A producer that dies on EVERY attempt exhausts max_respawns and
+  surfaces a loud error instead of spinning forever."""
+  batches = _feed_batches(4, seed=4)
+
+  def cats_fn(item):  # dies on every build attempt
+    raise SystemExit
+
+  feed = CsrFeed(feed_dist, batches, cats_fn=cats_fn, max_respawns=1)
+  with pytest.raises(RuntimeError, match='died'):
+    next(feed)
+  assert feed.stats()['respawns'] == 1
+
+
+def test_native_builder_runtime_failure_falls_back(feed_dist, monkeypatch):
+  """A native builder breaking MID-RUN degrades to the bit-exact NumPy
+  oracle (journaled once), never kills the feed."""
+  from distributed_embeddings_tpu.parallel import csr_native
+  batches = _feed_batches(2, seed=5)
+  want = sparsecore.preprocess_batch_host(feed_dist, batches[0][1],
+                                          native='numpy', num_workers=1)
+
+  def broken(*a, **k):
+    raise csr_native.NativeBuilderError('injected .so failure')
+
+  monkeypatch.setattr(csr_native, 'route_ids', broken)
+  monkeypatch.setattr(sparsecore, 'resolve_builder', lambda native: 'native')
+  monkeypatch.setattr(sparsecore, '_native_fallback_journaled', False)
+  got = sparsecore.preprocess_batch_host(feed_dist, batches[0][1],
+                                         native='native', num_workers=1)
+  assert sparsecore._csrs_equal(want, got)
+  events = resilience.recent('csr_native_fallback')
+  assert events and 'injected .so failure' in events[-1]['error']
+
+
+# --------------------------------------------------------------------------
+# raw-binary reader: transient pread retry
+# --------------------------------------------------------------------------
+
+
+def _write_tiny_dataset(root):
+  rng = np.random.default_rng(0)
+  rows, sizes = 32, [50, 70]
+  labels = rng.integers(0, 2, rows).astype(bool)
+  numerical = rng.normal(size=(rows, 3)).astype(np.float16)
+  cats = [rng.integers(0, s, rows) for s in sizes]
+  write_raw_binary_dataset(str(root), 'train', labels, numerical, cats,
+                           sizes)
+  return dict(data_path=str(root), batch_size=8, numerical_features=3,
+              categorical_features=[0, 1], categorical_feature_sizes=sizes,
+              prefetch_depth=0)
+
+
+def test_reader_transient_pread_recovers_zero_loss(tmp_path, monkeypatch):
+  kwargs = _write_tiny_dataset(tmp_path)
+  want = [(None if n is None else n.copy(),
+           [c.copy() for c in cs], l.copy())
+          for n, cs, l in BinaryCriteoReader(**kwargs)]
+  flaky = faultinject.flaky_calls(os.pread, fail_at=[1, 6], times=1)
+  monkeypatch.setattr(os, 'pread', flaky)
+  got = list(BinaryCriteoReader(**kwargs))
+  monkeypatch.undo()
+  assert flaky.raised == 2
+  assert len(got) == len(want)
+  for (gn, gc, gl), (wn, wc, wl) in zip(got, want):
+    np.testing.assert_array_equal(gn, wn)
+    np.testing.assert_array_equal(gl, wl)
+    for a, b in zip(gc, wc):
+      np.testing.assert_array_equal(a, b)
+  assert resilience.recent('io_retry')
+
+
+def test_reader_persistent_io_error_still_raises(tmp_path, monkeypatch):
+  kwargs = _write_tiny_dataset(tmp_path)
+  reader = BinaryCriteoReader(**kwargs)
+  # the first pread fails more times than the retry budget allows
+  flaky = faultinject.flaky_calls(os.pread, fail_at=[0], times=10)
+  monkeypatch.setattr(os, 'pread', flaky)
+  with pytest.raises(IOError):
+    reader[0]
+  assert resilience.recent('io_retry_exhausted')
+
+
+# --------------------------------------------------------------------------
+# resilience primitives
+# --------------------------------------------------------------------------
+
+
+def test_retry_io_backoff_schedule():
+  sleeps = []
+  calls = faultinject.flaky_calls(lambda: 'ok', fail_at=[0], times=2)
+  out = resilience.retry_io(calls, retries=3, base_delay_s=0.1,
+                            sleep=sleeps.append)
+  assert out == 'ok'
+  assert sleeps == [0.1, 0.2]  # exponential, bounded
+
+
+def test_retry_io_does_not_swallow_non_io():
+  with pytest.raises(ValueError):
+    resilience.retry_io(lambda: (_ for _ in ()).throw(ValueError('x')),
+                        retries=5, sleep=lambda d: None)
+
+
+def test_call_with_timeout_passthrough_and_hang():
+  assert resilience.call_with_timeout(lambda: 42, 5.0) == 42
+  with pytest.raises(ZeroDivisionError):
+    resilience.call_with_timeout(lambda: 1 // 0, 5.0)
+  with pytest.raises(resilience.StepHangError):
+    resilience.call_with_timeout(lambda: time.sleep(5), 0.2, what='t')
+
+
+def test_latest_valid_numeric_tiebreak_on_equal_mtime(hybrid, tmp_path):
+  """ckpt_1000 must outrank ckpt_999 even when coarse filesystem mtime
+  granularity makes their timestamps identical (a lexical tie-break
+  would resume the older step and prune the newer file)."""
+  dist = hybrid[0]
+  rng = np.random.default_rng(6)
+  weights = [rng.normal(size=(c.input_dim, c.output_dim)).astype(np.float32)
+             for c in CONFIGS]
+  for step_no in (999, 1000):
+    p = str(tmp_path / f'ckpt_{step_no}.npz')
+    save_train_npz(p, weights, extras={'step': np.int64(step_no)},
+                   plan=dist)
+    os.utime(p, (1000, 1000))  # same mtime tick
+  path, (_, _, extras) = load_latest_valid(str(tmp_path), expect_plan=dist)
+  assert path.endswith('ckpt_1000.npz')
+  assert int(extras['step']) == 1000
+  removed = ckpt_lib.prune_checkpoints(str(tmp_path), keep_last=1)
+  assert [os.path.basename(r) for r in removed] == ['ckpt_999.npz']
+
+
+def test_save_npz_keeps_reference_interchange_format(tmp_path):
+  """The weights-only archive must stay positionally enumerable (the
+  reference DLRM format external readers depend on): exactly one
+  member per table, NO manifest — while still writing atomically."""
+  w = [np.arange(6, dtype=np.float32).reshape(2, 3),
+       np.ones((3, 3), np.float32)]
+  p = str(tmp_path / 'w.npz')
+  ckpt_lib.save_npz(p, w)
+  with np.load(p) as data:
+    assert sorted(data.files) == ['arr_0', 'arr_1']  # no __manifest__
+    old_style = [data[k] for k in data.files]  # the pre-change reader
+  for a, b in zip(w, old_style):
+    np.testing.assert_array_equal(a, b)
+  ok, reason, _ = verify_npz(p)
+  assert ok and reason == 'legacy-no-manifest'
+  assert not [f for f in os.listdir(tmp_path) if '.tmp' in f]
+
+
+def test_retry_io_permanent_errno_fails_immediately():
+  calls = []
+
+  def missing():
+    calls.append(1)
+    raise FileNotFoundError(2, 'No such file', '/nope')
+
+  with pytest.raises(FileNotFoundError):
+    resilience.retry_io(missing, retries=5, sleep=lambda d: None)
+  assert len(calls) == 1  # no retry budget burned on a permanent error
+
+
+def test_flip_bytes_is_deterministic(tmp_path):
+  p = str(tmp_path / 'f.bin')
+  with open(p, 'wb') as f:
+    f.write(bytes(range(256)) * 8)
+  a = faultinject.flip_bytes(p, count=4, seed=9)
+  with open(p, 'wb') as f:
+    f.write(bytes(range(256)) * 8)
+  b = faultinject.flip_bytes(p, count=4, seed=9)
+  assert a == b

