@@ -111,6 +111,10 @@ class DLRM:
     dp_input: data-parallel categorical inputs (see DistributedEmbedding).
     compute_dtype: activation dtype (bfloat16 for the AMP-equivalent path,
       reference `examples/dlrm/README.md:8`).
+    hot_cache: frequency-aware hot-row sets forwarded to
+      ``DistributedEmbedding`` (``parallel/hotcache.py``; calibrate
+      with ``hotcache.calibrate_hot_sets`` over sample batches).
+      Requires ``dp_input=True``.
   """
   table_sizes: Sequence[int]
   embedding_dim: int = 128
@@ -124,6 +128,7 @@ class DLRM:
   dp_input: bool = True
   param_dtype: Any = jnp.float32
   compute_dtype: Any = jnp.float32
+  hot_cache: Any = None
 
   def __post_init__(self):
     if self.bottom_mlp_dims[-1] != self.embedding_dim:
@@ -150,7 +155,8 @@ class DLRM:
         dp_input=self.dp_input,
         mesh=self.mesh,
         param_dtype=self.param_dtype,
-        compute_dtype=self.compute_dtype)
+        compute_dtype=self.compute_dtype,
+        hot_cache=self.hot_cache)
 
   @property
   def num_interaction_features(self) -> int:

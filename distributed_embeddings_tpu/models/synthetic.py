@@ -240,6 +240,10 @@ class SyntheticModel:
     packed_storage: forwarded to the planner (lane-packed narrow groups).
     lookup_impl: forwarded to ``DistributedEmbedding`` ('sparsecore'
       engages the mod-sharded static-CSR path of docs/design.md §8).
+    hot_cache: forwarded to ``DistributedEmbedding`` — frequency-aware
+      hot-row sets (``parallel/hotcache.py``; the synthetic power-law
+      generators have a closed-form selection,
+      ``analytic_power_law_hot_sets``).  Requires ``dp_input=True``.
   """
   config: ModelConfig
   mesh: Optional[Mesh] = None
@@ -251,6 +255,7 @@ class SyntheticModel:
   compute_dtype: Any = jnp.float32
   packed_storage: bool = True
   lookup_impl: str = 'auto'
+  hot_cache: Any = None
 
   def __post_init__(self):
     tables, input_table_map, hotness = expand_tables(self.config)
@@ -267,7 +272,8 @@ class SyntheticModel:
         param_dtype=self.param_dtype,
         compute_dtype=self.compute_dtype,
         packed_storage=self.packed_storage,
-        lookup_impl=self.lookup_impl)
+        lookup_impl=self.lookup_impl,
+        hot_cache=self.hot_cache)
     total_width = sum(
         tables[t].output_dim for t in input_table_map)
     if self.config.interact_stride is not None:

@@ -50,6 +50,14 @@ from distributed_embeddings_tpu.parallel.sparse import (
     run_pipelined,
     sparse_apply_updates,
 )
+from distributed_embeddings_tpu.parallel.hotcache import (
+    HotSet,
+    analytic_power_law_hot_sets,
+    calibrate_hot_sets,
+    measure_exchange_counters,
+    power_law_hot_k,
+    select_hot_rows,
+)
 from distributed_embeddings_tpu.parallel.sparsecore import (
     StaticCsr,
     build_csr,

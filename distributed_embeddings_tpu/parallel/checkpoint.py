@@ -164,7 +164,49 @@ def set_weights(dist: DistributedEmbedding,
 
     params[f'group_{gi}'] = jax.make_array_from_callback(
         shape, sharding, make_shard)
+  params.update(_hot_leaves_from_tables(dist, loaded, dist.param_dtype))
   return params
+
+
+def _hot_leaves_from_tables(dist, tables, dtype, leaf_prefix='hot_group_'):
+  """Replicated hot-cache buffers built from GLOBAL canonical per-table
+  arrays (the ``set_weights``/``set_optimizer_state`` leg of the
+  design-§10 canonicalization contract: hot membership is a layout
+  detail, so a checkpoint restores into ANY hot set by re-slicing the
+  canonical rows).  Returns ``{}`` for cache-less layers."""
+  plan = dist.plan
+  out = {}
+  for gi in getattr(plan, 'hot_groups', []):
+    g = plan.groups[gi]
+    buf = np.zeros((g.hot_rows_cap, g.width), dtype)
+    for tid, cs, ce, off, k in g.hot_chunks:
+      ids = plan.hot_sets[tid].ids
+      buf[off:off + k] = np.asarray(
+          np.asarray(tables[tid])[ids, cs:ce], dtype=dtype)
+    sharding = NamedSharding(dist.mesh, P(None, None))
+    out[f'{leaf_prefix}{gi}'] = jax.make_array_from_callback(
+        buf.shape, sharding, lambda index, buf=buf: buf[index])
+  return out
+
+
+def _overlay_hot_rows(dist, result, leaves):
+  """Write the replicated hot-cache rows back into the global canonical
+  per-table arrays (the ``get_weights``/``get_optimizer_state`` leg):
+  the sharded slots of hot rows go stale while the row is hot, so the
+  hot buffer is authoritative for them."""
+  plan = dist.plan
+  for gi in getattr(plan, 'hot_groups', []):
+    g = plan.groups[gi]
+    leaf = leaves.get(gi)
+    if leaf is None:
+      continue
+    buf = np.asarray(jax.device_get(leaf))
+    for tid, cs, ce, off, k in g.hot_chunks:
+      ids = plan.hot_sets[tid].ids
+      if result[tid] is not None:
+        result[tid][ids, cs:ce] = buf[off:off + k].astype(
+            result[tid].dtype)
+  return result
 
 
 def get_weights(dist: DistributedEmbedding,
@@ -194,14 +236,18 @@ def get_weights(dist: DistributedEmbedding,
       for gi, g in enumerate(plan.groups)
   }
 
+  hot = bool(getattr(plan, 'hot_sets', None))
   result = []
   for tid, shards in enumerate(plan.shard_layout()):
     cfg = plan.table_configs[tid]
     if len(shards) == 1 and shards[0][7] == 1:
       dev, group_key, row_offset = shards[0][:3]
       gi = group_index[group_key]
-      result.append(
-          host_shards[gi][dev][row_offset:row_offset + cfg.input_dim, :])
+      piece = host_shards[gi][dev][row_offset:row_offset + cfg.input_dim, :]
+      # hot layers overwrite hot rows below — copy so the overlay never
+      # mutates the shared host shard buffer backing other tables
+      result.append(np.array(piece) if hot and tid in plan.hot_sets
+                    else piece)
       continue
     # paste row x column windows into the global [rows, width] canvas
     # (covers column slicing, contiguous AND mod row slicing, and plain
@@ -217,6 +263,15 @@ def get_weights(dist: DistributedEmbedding,
       out[row_start:row_end:row_stride, col_start:col_end] = (
           host_shards[gi][dev][row_offset:row_offset + span])
     result.append(out)
+  if hot:
+    # the sharded slots of hot rows are stale while the rows are hot
+    # (the runtime updates only the replicated buffer) — the buffer is
+    # authoritative, and writing it back here is what keeps hot
+    # membership invisible in saved state (design §10)
+    _overlay_hot_rows(dist, result, {
+        gi: params[f'hot_group_{gi}']
+        for gi in plan.hot_groups if f'hot_group_{gi}' in params
+    })
   return result
 
 
@@ -286,6 +341,21 @@ def get_optimizer_state(dist: DistributedEmbedding,
       if canvas is not None:
         entry[k] = canvas
     result.append(entry)
+  if getattr(plan, 'hot_sets', None):
+    # hot-row optimizer state lives in the replicated split buffers
+    # while the rows are hot — overlay it into the canonical per-table
+    # layout exactly like the weights (hot membership never reaches
+    # saved state)
+    for gi in plan.hot_groups:
+      leaves = opt_state.get(f'hot_group_{gi}', {})
+      for k, leaf in leaves.items():
+        buf = np.asarray(jax.device_get(leaf))
+        g = plan.groups[gi]
+        for tid, cs, ce, off, cnt in g.hot_chunks:
+          ids = plan.hot_sets[tid].ids
+          if k in result[tid] and result[tid][k].ndim == 2:
+            result[tid][k][ids, cs:ce] = buf[off:off + cnt].astype(
+                result[tid][k].dtype)
   return result
 
 
@@ -346,6 +416,26 @@ def set_optimizer_state(dist: DistributedEmbedding,
           dist.mesh, P(dist.axis_name, *([None] * (tmpl.ndim - 1))))
       new_state[gkey][k] = jax.make_array_from_callback(
           tmpl.shape, sharding, make_shard)
+  # replicated hot-cache split state: re-slice from the canonical
+  # per-table layout into WHATEVER hot set the live plan carries (the
+  # restore-into-a-different-hot-set leg of the design-§10 contract)
+  for gi in getattr(plan, 'hot_groups', []):
+    hkey = f'hot_group_{gi}'
+    if hkey not in opt_state:
+      continue
+    new_state[hkey] = {}
+    g = plan.groups[gi]
+    for k, tmpl in opt_state[hkey].items():
+      buf = np.zeros((g.hot_rows_cap, g.width), tmpl.dtype)
+      for tid, cs, ce, off, cnt in g.hot_chunks:
+        ids = plan.hot_sets[tid].ids
+        st = table_states[tid].get(k) if tid < len(table_states) else None
+        if st is not None:
+          buf[off:off + cnt] = np.asarray(
+              np.asarray(st)[ids, cs:ce], dtype=tmpl.dtype)
+      sharding = NamedSharding(dist.mesh, P(None, None))
+      new_state[hkey][k] = jax.make_array_from_callback(
+          buf.shape, sharding, lambda index, buf=buf: buf[index])
   return new_state
 
 
@@ -697,6 +787,9 @@ def is_hybrid_opt_state(dist: DistributedEmbedding, opt_state) -> bool:
   (optax states are namedtuples and can carry dict fields) — advisor
   r4."""
   group_names = {f'group_{gi}' for gi in range(len(dist.plan.groups))}
+  group_names |= {
+      f'hot_group_{gi}' for gi in getattr(dist.plan, 'hot_groups', [])
+  }
   return (isinstance(opt_state, tuple) and len(opt_state) == 2
           and isinstance(opt_state[1], dict)
           and set(opt_state[1].keys()) == group_names)

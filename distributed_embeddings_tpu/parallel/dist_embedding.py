@@ -101,6 +101,17 @@ class DistributedEmbedding:
       dispatch to the SC backend (see ``sparsecore_backend``), with
       combiner=None / very-wide / non-f32 groups falling back to the
       TensorCore paths.
+    hot_cache: optional frequency-aware hot-row sets (``HotSet`` dict
+      or sequence, ``parallel/hotcache.py``; docs/design.md §10).
+      Hot rows replicate into small per-group buffers
+      (``hot_group_{gi}`` parameter leaves) served locally on every
+      device; cold ids sort-unique per (source device, destination
+      slot) before the dp->mp exchange so each distinct row crosses
+      the wire once, with the inverse permutation scattering the
+      returned rows back.  Requires ``dp_input=True`` (the mp-input
+      path has no input exchange to cut).  Hot membership is a layout
+      detail: checkpoints stay global canonical and restore under any
+      other hot set.
     mod_sharding: row-sliced tables shard as ``id % m`` residue classes
       instead of contiguous windows (``ShardingPlan(mod_sharding=True)``).
       Default: True exactly when ``lookup_impl='sparsecore'``.
@@ -130,7 +141,8 @@ class DistributedEmbedding:
                packed_storage: bool = True,
                mod_sharding: Optional[bool] = None,
                num_sc: int = 4,
-               sparsecore_backend: str = 'auto'):
+               sparsecore_backend: str = 'auto',
+               hot_cache=None):
     if row_slice is not None and (isinstance(row_slice, bool)
                                   or not isinstance(row_slice,
                                                     (int, np.integer))):
@@ -178,6 +190,18 @@ class DistributedEmbedding:
     self.compute_dtype = jnp.dtype(compute_dtype or param_dtype)
 
     self.table_configs = _as_table_configs(embeddings)
+    if hot_cache and not dp_input:
+      raise ValueError(
+          'hot_cache requires dp_input=True: the cache partitions the '
+          'dp->mp id exchange, which the model-parallel input path does '
+          'not have')
+    if hot_cache and lookup_impl == 'sparsecore':
+      raise ValueError(
+          "hot_cache is incompatible with lookup_impl='sparsecore': the "
+          'cached dp forward takes the XLA hot/cold split path, so every '
+          'lookup would silently run TensorCore XLA under a sparsecore '
+          "label. Use lookup_impl='auto' with the cache, or disable "
+          'hot_cache to measure the SparseCore path.')
     self.plan = ShardingPlan(self.table_configs,
                              world_size=self.world_size,
                              strategy=strategy,
@@ -186,7 +210,10 @@ class DistributedEmbedding:
                              row_slice_threshold=row_slice,
                              packed_storage=packed_storage,
                              mod_sharding=mod_sharding,
-                             num_sc=num_sc)
+                             num_sc=num_sc,
+                             hot_sets=hot_cache)
+    self.hot_enabled = bool(self.plan.hot_sets)
+    self._hot_meta_cache = None
     self.num_inputs = len(self.plan.input_table_map)
     if lookup_impl == 'sparsecore':
       # per-group fallback is by design, but ZERO engaged groups means
@@ -431,7 +458,63 @@ class DistributedEmbedding:
                       in_specs=P(),
                       out_specs=out_specs,
                       check_vma=False))
-    return fn(rng)
+    params = fn(rng)
+    if self.hot_enabled:
+      params.update(self._init_hot(params))
+    return params
+
+  def _init_hot(self, params) -> Dict[str, jax.Array]:
+    """Fill the replicated hot buffers from the freshly built shards.
+
+    Each hot row is resident on exactly one shard
+    (``GroupSpec.hot_owner_rows``/``hot_owner_dst``); every device
+    gathers the rows it owns into a zero buffer and one ``psum``
+    replicates the union — so a cache-on layer initialises to exactly
+    the values the cache-off layer draws, canonically.
+    """
+    plan = self.plan
+    hot_gis = plan.hot_groups
+
+    def local_fn(params):
+      me = jax.lax.axis_index(self.axis_name)
+      out = {}
+      for gi in hot_gis:
+        g = plan.groups[gi]
+        table = params[f'group_{gi}'][0]
+
+        def one_dev(table, dev, g=g):
+          rows = g.hot_owner_rows[dev]
+          dst = g.hot_owner_dst[dev]
+          buf = jnp.zeros((g.hot_rows_cap, g.width), self.param_dtype)
+          if rows.size == 0:
+            return buf
+          vals = _gather_natural_rows(table, jnp.asarray(rows),
+                                      g.storage_pack)
+          return buf.at[jnp.asarray(dst)].set(
+              vals.astype(self.param_dtype))
+
+        branches = [
+            (lambda t, dev=dev, g=g: one_dev(t, dev, g))
+            for dev in range(self.world_size)
+        ]
+        buf = jax.lax.switch(me, branches, table)
+        out[f'hot_group_{gi}'] = (jax.lax.psum(buf, self.axis_name)
+                                  if self.world_size > 1 else buf)
+      return out
+
+    in_specs = ({
+        f'group_{gi}': P(self.axis_name, None, None)
+        for gi in range(len(plan.groups))
+    },)
+    out_specs = {f'hot_group_{gi}': P(None, None) for gi in hot_gis}
+    fn = jax.jit(
+        jax.shard_map(local_fn,
+                      mesh=self.mesh,
+                      in_specs=in_specs,
+                      out_specs=out_specs,
+                      check_vma=False))
+    return fn({k: v for k, v in params.items()
+               if not k.startswith('hot_')})
 
   # --------------------------------------------------------------- forward
 
@@ -471,7 +554,9 @@ class DistributedEmbedding:
       sharded over the mesh.
     """
     inputs, batch, hotness = self._prepare_inputs(inputs)
-    if self.dp_input:
+    if self.hot_enabled:
+      fwd = self._build_dp_forward_hot(batch, hotness)
+    elif self.dp_input:
       fwd = self._build_dp_forward(batch, hotness)
     else:
       fwd = self._build_mp_forward(batch, hotness)
@@ -934,7 +1019,9 @@ class DistributedEmbedding:
       ``sparse_apply_updates``.
     """
     inputs, batch, hotness = self._prepare_inputs(inputs)
-    if self.dp_input:
+    if self.hot_enabled:
+      fwd = self._build_dp_forward_hot(batch, hotness, with_residuals=True)
+    elif self.dp_input:
       fwd = self._build_dp_forward(batch, hotness, with_residuals=True)
     else:
       fwd = self._build_mp_forward(batch, hotness, with_residuals=True)
@@ -943,7 +1030,8 @@ class DistributedEmbedding:
     residuals = tuple(flat[self.num_inputs:])
     return outs, residuals, (batch, hotness)
 
-  def backward_to_mp(self, d_outs, global_batch: int, hotness: tuple):
+  def backward_to_mp(self, d_outs, global_batch: int, hotness: tuple,
+                     cats=None, with_sq: bool = False):
     """Transpose output cotangents back to per-subgroup mp-side grads.
 
     The manual transpose of the forward's output path (mp->dp all_to_all +
@@ -960,14 +1048,43 @@ class DistributedEmbedding:
     themselves must divide ``d_outs[i]`` by
     ``_valid_count(ids_i)[:, None]`` for each such input.
 
+    HOT-CACHE layers (``hot_enabled``) take a different transpose: the
+    cold cotangents rebuild the forward's per-(source, slot) unique
+    streams from ``cats`` (required here), segment-sum the occurrence
+    cotangents to those unique rows, and ship the DEDUPLICATED grads
+    through the a2a; hot-row cotangents segment-sum into the compact
+    replicated buffer and ``psum`` once.  Mean division happens
+    INTERNALLY (hot layers never need the caller-side pre-division).
+    Returns ``(gsubs, hot_grads)`` there — per-subgroup unique-stream
+    grads aligned with the cached residuals, plus per-hot-group
+    ``[hot_rows_cap, w]`` (or ``[.., 2w]`` with ``with_sq``) replicated
+    gradient buffers keyed by group index.
+
     Args:
       d_outs: per-input cotangents ``[GB, out_dim_i]`` (batch-sharded).
       global_batch / hotness: the forward call's signature.
+      cats: the forward's embedding inputs (hot-cache layers only).
+      with_sq: also produce per-occurrence squared-grad channels
+        (per-occurrence Adagrad semantics; hot-cache layers only).
 
     Returns:
       Tuple of per-subgroup ``[D, n_cap, GB, w]`` grads, mesh-sharded on
-      axis 0, aligned with ``forward_with_residuals``'s residuals.
+      axis 0, aligned with ``forward_with_residuals``'s residuals — or
+      ``(gsubs, hot_grads)`` for hot-cache layers (see above).
     """
+    if self.hot_enabled:
+      if cats is None:
+        raise ValueError('hot-cache backward needs cats= (the forward '
+                         'inputs rebuild the unique cold streams)')
+      inputs, _, _ = self._prepare_inputs(cats)
+      bwd = self._build_backward_hot(global_batch, tuple(hotness),
+                                     with_sq=with_sq)
+      flat = bwd(*d_outs, *inputs)
+      n_subs = len(self._subgroups(tuple(hotness)))
+      return tuple(flat[:n_subs]), {
+          gi: flat[n_subs + k]
+          for k, gi in enumerate(self.plan.hot_groups)
+      }
     bwd = self._build_backward(global_batch, tuple(hotness))
     return bwd(*d_outs)
 
@@ -1054,6 +1171,381 @@ class DistributedEmbedding:
                 P(self.axis_name, None, self.dcn_axis, None)
                 for _ in subs),
             check_vma=False))
+    self._fn_cache[key] = fn
+    return fn
+
+  # --------------------------- frequency-aware hot cache (design §10)
+
+  def _hot_meta(self):
+    """Python-time hot-cache metadata: per-table sorted hot-id
+    constants and, per input, the (group, column range, hot-buffer
+    offset) chunks its hot contribution reads."""
+    if self._hot_meta_cache is None:
+      plan = self.plan
+      table_ids = {
+          t: np.asarray(hs.ids, np.int32)
+          for t, hs in plan.hot_sets.items()
+      }
+      key_to_gi = {g.key: gi for gi, g in enumerate(plan.groups)}
+      chunk_off = {}
+      for gi, g in enumerate(plan.groups):
+        for tid, cs, ce, off, _ in g.hot_chunks:
+          chunk_off[(tid, cs, ce)] = (gi, off)
+      input_chunks: List[list] = [[] for _ in range(self.num_inputs)]
+      for i, reqs in enumerate(plan.input_requests):
+        tid = plan.input_table_map[i]
+        if tid not in table_ids:
+          continue
+        seen = set()
+        for r in reqs:
+          k = (r.col_start, r.col_end)
+          if k in seen:
+            continue
+          seen.add(k)
+          gi, off = chunk_off[(tid, r.col_start, r.col_end)]
+          assert key_to_gi[r.group_key] == gi
+          input_chunks[i].append((gi, r.col_start, r.col_end, off))
+      self._hot_meta_cache = dict(table_ids=table_ids,
+                                  input_chunks=input_chunks)
+    return self._hot_meta_cache
+
+  def _hot_membership(self, inputs, hotness):
+    """Per-input hot/cold partition (trace-time).
+
+    Returns one dict per input: ``x2`` the ``[B, h]`` int32 ids,
+    ``cold`` the same ids with hot AND padding positions dropped to the
+    ``-1`` sentinel (what the exchange ships), ``hot`` the ``[B, h]``
+    hot-buffer ranks (``-1`` where not hot; membership is tested on the
+    vocab-clipped id, so out-of-vocab ids follow the last row's
+    membership exactly like the baseline clip-then-lookup).
+    """
+    meta = self._hot_meta()
+    plan = self.plan
+    out = []
+    for i in range(self.num_inputs):
+      x = inputs[i]
+      x2 = (x[:, None] if x.ndim == 1 else x).astype(jnp.int32)
+      tid = plan.input_table_map[i]
+      H = meta['table_ids'].get(tid)
+      valid = x2 >= 0
+      vocab = plan.table_configs[tid].input_dim
+      # cold ids ship vocab-CLIPPED: routing clips identically, so the
+      # semantics are unchanged, while distinct out-of-vocab spellings
+      # of the last row unify in the dedup (and the id range stays
+      # strictly below the unique machinery's int32 sentinel)
+      clipped = jnp.clip(x2, 0, vocab - 1)
+      if H is None or H.size == 0:
+        out.append(dict(x2=x2, cold=jnp.where(valid, clipped, _SENTINEL),
+                        hot=None))
+        continue
+      Hc = jnp.asarray(H)
+      pos = jnp.searchsorted(Hc, clipped).astype(jnp.int32)
+      safe = jnp.minimum(pos, H.size - 1)
+      ishot = valid & (Hc[safe] == clipped)
+      out.append(dict(
+          x2=x2,
+          cold=jnp.where(ishot | ~valid, _SENTINEL, clipped),
+          hot=jnp.where(ishot, safe, -1)))
+    return out
+
+  def _build_dp_forward_hot(self, global_batch: int, hotness: tuple,
+                            with_residuals: bool = False):
+    """The hot-cache dp forward (docs/design.md §10).
+
+    Per subgroup: hot ids are served LOCALLY from the replicated
+    ``hot_group_{gi}`` buffer (no exchange at all) and dropped to the
+    sentinel in the send buffer; the remaining cold ids sort-unique per
+    (source device, destination slot) before the dp->mp all_to_all, the
+    owner gathers each distinct row ONCE, rows ride back through the
+    transpose all_to_all, and the inverse permutation scatters them to
+    their occurrences for the source-side combine.  Outputs merge
+    position-preservingly: each (input, column range) piece is the
+    f32 sum of its cold partials (row shards included — their
+    out-of-window rows come back zero, so the slot partials just add)
+    plus the hot partial, divided by the TRUE per-sample id count for
+    mean tables.  Contract: bit-exact vs the baseline for hotness-1
+    inputs; multi-hot bags that mix hot and cold ids re-associate the
+    f32 h-axis fold (hot terms sum after cold terms), bounded by
+    summation-order error only (pinned in tests/test_hotcache.py).
+
+    With ``with_residuals``, also returns per subgroup the OWNER-side
+    routed unique ids ``[D, n_cap, D*U, 1]`` (``U = local_batch * h``;
+    sentinel ``rows_cap`` padding) — already-deduplicated update
+    streams for the sparse backward.
+    """
+    key = ('dp_fwd_hot', global_batch, hotness, with_residuals)
+    if key in self._fn_cache:
+      return self._fn_cache[key]
+    D = self.world_size
+    slice_batch = global_batch // self.num_slices
+    local_batch = slice_batch // D
+    subs = self._subgroups(hotness)
+    meta = self._hot_meta()
+    plan = self.plan
+
+    def local_fn(params, *inputs):
+      me = jax.lax.axis_index(self.axis_name)
+      mem = self._hot_membership(inputs, hotness)
+      piece: Dict[tuple, Any] = {}
+      residuals = []
+      for sub in subs:
+        h = sub.hotness
+        U = local_batch * h
+        w = sub.group.width
+        rows_cap = plan.groups[sub.gi].rows_cap
+
+        def _cold(k, h=h):
+          if k == -1:
+            return jnp.full((local_batch, h), _SENTINEL, jnp.int32)
+          return mem[k]['cold']
+
+        send = _gather_slots(
+            D, sub.n_cap,
+            lambda dev, s, sub=sub: (sub.requests[dev][s].input_id
+                                     if s < len(sub.requests[dev]) else -1),
+            _cold)
+        # sort-unique per (dest device, slot): each distinct cold row
+        # crosses the wire once; inv maps every occurrence back
+        uniq, inv = _unique_with_inverse(
+            send.reshape(D * sub.n_cap, U), U)
+        send_u = uniq.reshape(D, sub.n_cap, U)
+        recv = (jax.lax.all_to_all(send_u, self.axis_name, 0, 0)
+                if D > 1 else send_u)
+        ids_u = recv.transpose(1, 0, 2).reshape(sub.n_cap, D * U)
+        routed = _route_ids(ids_u[..., None],
+                            jnp.asarray(sub.offsets)[me],
+                            jnp.asarray(sub.vocab)[me], rows_cap,
+                            jnp.asarray(sub.row_lo)[me],
+                            jnp.asarray(sub.row_hi)[me],
+                            (jnp.asarray(sub.row_stride)[me]
+                             if sub.has_mod_windows else None))
+        # one row gather per distinct id (combiner=None == masked
+        # row fetch); out-of-window ids of row shards return zero, so
+        # slot partials sum to the whole at the source
+        rows = self._lookup(params[f'group_{sub.gi}'][0], routed, None,
+                            pack=plan.groups[sub.gi].storage_pack)
+        if with_residuals:
+          residuals.append(routed[None])
+        back = rows.reshape(sub.n_cap, D, U, w).transpose(1, 0, 2, 3)
+        if D > 1:
+          back = jax.lax.all_to_all(back, self.axis_name, 0, 0)
+        rows_ext = jnp.concatenate(
+            [back, jnp.zeros((D, sub.n_cap, 1, w), back.dtype)], axis=2)
+        occ = jnp.take_along_axis(
+            rows_ext, inv.reshape(D, sub.n_cap, U)[..., None], axis=2)
+        comb = jnp.sum(
+            occ.reshape(D, sub.n_cap, local_batch, h, w).astype(
+                jnp.float32), axis=3)
+        for dev in range(D):
+          for s, r in enumerate(sub.requests[dev]):
+            k = (r.input_id, r.col_start, r.col_end)
+            piece[k] = (comb[dev, s] if k not in piece
+                        else piece[k] + comb[dev, s])
+
+      # hot partials: local gather from the replicated buffers
+      for i, chunks in enumerate(meta['input_chunks']):
+        hotm = mem[i]['hot']
+        if hotm is None:
+          continue
+        for gi, cs, ce, off in chunks:
+          buf = params[f'hot_group_{gi}']
+          ext = jnp.concatenate(
+              [buf, jnp.zeros((1, buf.shape[1]), buf.dtype)])
+          idx = jnp.where(hotm >= 0, off + hotm, buf.shape[0])
+          hp = jnp.sum(ext[idx].astype(jnp.float32), axis=1)
+          k = (i, cs, ce)
+          piece[k] = hp if k not in piece else piece[k] + hp
+
+      outs = []
+      for i in range(self.num_inputs):
+        tid = plan.input_table_map[i]
+        ranges = sorted({(r.col_start, r.col_end)
+                         for r in plan.input_requests[i]})
+        parts = [piece[(i, cs, ce)] for cs, ce in ranges]
+        out = parts[0] if len(parts) == 1 else jnp.concatenate(parts,
+                                                               axis=-1)
+        if plan.table_configs[tid].combiner == 'mean':
+          out = out / _valid_count(mem[i]['x2'])[:, None]
+        outs.append(out.astype(self.compute_dtype))
+      if with_residuals:
+        return tuple(outs) + tuple(residuals)
+      return tuple(outs)
+
+    bax = self._batch_axes
+    in_specs = (self._param_specs(),) + tuple(
+        P(bax) if h == 1 else P(bax, None) for h in hotness)
+    out_specs = tuple(P(bax, None) for _ in range(self.num_inputs))
+    if with_residuals:
+      out_specs = out_specs + tuple(
+          P(self.axis_name, None, self.dcn_axis, None) for _ in subs)
+    fn = jax.jit(
+        jax.shard_map(local_fn,
+                      mesh=self.mesh,
+                      in_specs=in_specs,
+                      out_specs=out_specs,
+                      check_vma=False))
+    self._fn_cache[key] = fn
+    return fn
+
+  def _param_specs(self):
+    """shard_map in_specs for the params pytree: fused group shards on
+    the mesh axis, hot-cache buffers replicated."""
+    specs = {
+        f'group_{gi}': P(self.axis_name, None, None)
+        for gi in range(len(self.plan.groups))
+    }
+    for gi in self.plan.hot_groups:
+      specs[f'hot_group_{gi}'] = P(None, None)
+    return specs
+
+  def _build_backward_hot(self, global_batch: int, hotness: tuple,
+                          with_sq: bool = False):
+    """Transpose of the hot-cache forward.
+
+    Cold: rebuild the per-(source, slot) unique streams from the raw
+    inputs (deterministic — the same ops the forward traced), pre-
+    divide mean cotangents by the true per-sample count, segment-sum
+    each occurrence's cotangent to its unique row
+    (``_dense_segment_sum``) and ship the
+    DEDUPLICATED ``[D, n_cap, U, w]`` grads through the a2a — aligned
+    with the forward's owner-side unique-id residuals.  Hot: every
+    occurrence's cotangent segment-sums into the compact replicated
+    buffer layout and ONE psum over the whole mesh replaces the
+    per-row scatters (the dense-add contract of design §10).  With
+    ``with_sq`` both streams carry a second ``w``-column block of
+    per-occurrence squared grads (per-occurrence Adagrad semantics).
+    """
+    key = ('bwd_hot', global_batch, hotness, with_sq)
+    if key in self._fn_cache:
+      return self._fn_cache[key]
+    D = self.world_size
+    slice_batch = global_batch // self.num_slices
+    local_batch = slice_batch // D
+    subs = self._subgroups(hotness)
+    meta = self._hot_meta()
+    plan = self.plan
+    psum_axes = ((self.axis_name, self.dcn_axis) if self.dcn_axis
+                 else (self.axis_name,))
+
+    def local_fn(*args):
+      d_outs = args[:self.num_inputs]
+      inputs = args[self.num_inputs:]
+      mem = self._hot_membership(inputs, hotness)
+      cot = []
+      for i in range(self.num_inputs):
+        c = d_outs[i].astype(jnp.float32)
+        tid = plan.input_table_map[i]
+        if plan.table_configs[tid].combiner == 'mean':
+          c = c / _valid_count(mem[i]['x2'])[:, None]
+        cot.append(c)
+
+      gsubs = []
+      for sub in subs:
+        h = sub.hotness
+        U = local_batch * h
+        w = sub.group.width
+        wc = 2 * w if with_sq else w
+
+        def _cold(k, h=h):
+          if k == -1:
+            return jnp.full((local_batch, h), _SENTINEL, jnp.int32)
+          return mem[k]['cold']
+
+        send = _gather_slots(
+            D, sub.n_cap,
+            lambda dev, s, sub=sub: (sub.requests[dev][s].input_id
+                                     if s < len(sub.requests[dev]) else -1),
+            _cold)
+        _, inv = _unique_with_inverse(send.reshape(D * sub.n_cap, U), U)
+        inv3 = inv.reshape(D, sub.n_cap, U)
+        occ_idx = jnp.repeat(
+            jnp.arange(local_batch, dtype=jnp.int32), h)
+        first_slot = {}
+        for dev in range(D):
+          for s, r in enumerate(sub.requests[dev]):
+            first_slot.setdefault(
+                (r.input_id, r.col_start, r.col_end), (dev, s))
+
+        def key_of(dev, s, sub=sub):
+          rs = sub.requests[dev]
+          if s < len(rs):
+            r = rs[s]
+            return (r.input_id, r.col_start, r.col_end)
+          return -1
+
+        def val_of(k, U=U, wc=wc, w=w, inv3=inv3, occ_idx=occ_idx,
+                   first_slot=first_slot):
+          if k == -1:
+            return jnp.zeros((U, wc), jnp.float32)
+          inp, cs, ce = k
+          # all slots sharing an input ship the same cold ids, so one
+          # slot's inverse serves every shard request of the input
+          dev, s = first_slot[k]
+          payload = cot[inp][:, cs:ce]
+          if with_sq:
+            payload = jnp.concatenate([payload, payload * payload],
+                                      axis=1)
+          return _dense_segment_sum(inv3[dev, s], payload, U,
+                                    row_index=occ_idx)
+
+        g = _gather_slots(D, sub.n_cap, key_of, val_of)
+        if D > 1:
+          g = jax.lax.all_to_all(g, self.axis_name, 0, 0)
+        gsubs.append(
+            g.transpose(1, 0, 2, 3).reshape(sub.n_cap, D * U, wc)[None])
+
+      hot_out = []
+      for gi in plan.hot_groups:
+        g = plan.groups[gi]
+        K = g.hot_rows_cap
+        wc = 2 * g.width if with_sq else g.width
+        # ONE dense segment sum per group over the concatenated hot
+        # occurrence streams of all its (input, chunk) pairs — a
+        # per-chunk sum would rebuild (and re-add) the [K, w] dense
+        # buffer once per input, multiplying the dominant memory
+        # traffic by the hot-input count
+        segs, rows, idxs = [], [], []
+        base = 0
+        for i, chunks in enumerate(meta['input_chunks']):
+          hotm = mem[i]['hot']
+          for cgi, cs, ce, off in chunks:
+            if cgi != gi or hotm is None:
+              continue
+            b, h = hotm.shape
+            segs.append(jnp.where(hotm >= 0, off + hotm, K).reshape(-1))
+            payload = cot[i][:, cs:ce]
+            if with_sq:
+              payload = jnp.concatenate([payload, payload * payload],
+                                        axis=1)
+            rows.append(payload)
+            idxs.append(base + jnp.repeat(
+                jnp.arange(b, dtype=jnp.int32), h))
+            base += b
+        if segs:
+          total = _dense_segment_sum(
+              jnp.concatenate(segs),
+              jnp.concatenate(rows), K,
+              row_index=jnp.concatenate(idxs))
+        else:
+          total = jnp.zeros((K, wc), jnp.float32)
+        hot_out.append(jax.lax.psum(total, psum_axes)
+                       if D > 1 or self.dcn_axis else total)
+
+      return tuple(gsubs) + tuple(hot_out)
+
+    bax = self._batch_axes
+    in_specs = tuple(
+        P(bax, None) for _ in range(self.num_inputs)) + tuple(
+            P(bax) if h == 1 else P(bax, None) for h in hotness)
+    out_specs = tuple(
+        P(self.axis_name, None, self.dcn_axis, None)
+        for _ in subs) + tuple(P(None, None) for _ in plan.hot_groups)
+    fn = jax.jit(
+        jax.shard_map(local_fn,
+                      mesh=self.mesh,
+                      in_specs=in_specs,
+                      out_specs=out_specs,
+                      check_vma=False))
     self._fn_cache[key] = fn
     return fn
 
@@ -1168,6 +1660,103 @@ def _route_ids(ids: jax.Array, offsets: jax.Array, vocab: jax.Array,
       mask = mask & (clipped % st == 0)
       clipped = clipped // st
   return jnp.where(mask, clipped + offsets[:, None, None], rows_cap)
+
+
+def _unique_with_inverse(ids: jax.Array, cap: int):
+  """Per-row sort-unique with inverse positions (the cold-id dedup of
+  the hot-cache exchange, docs/design.md §10).
+
+  ``ids``: ``[R, n]`` int32, ``< 0`` marks dropped (padding/hot)
+  positions.  Returns ``(uniq, inv)``: ``uniq`` ``[R, cap]`` the
+  distinct non-negative ids ascending with ``-1`` padding; ``inv``
+  ``[R, n]`` the position of each occurrence's id inside ``uniq``
+  (``cap`` for dropped occurrences — callers index a zero-extended
+  row buffer with it).  ``cap`` must bound the distinct count; callers
+  pass ``cap = n``, the guaranteed bound, so nothing can ever drop.
+  Pure sort/cumsum/gather — no scatter (compact_segments' rank
+  machinery, specialised to ids only).
+  """
+  n = ids.shape[1]
+  big = jnp.int32(np.iinfo(np.int32).max)
+
+  def one(row):
+    keyv = jnp.where(row >= 0, row, big)
+    order = jnp.argsort(keyv)
+    sid = keyv[order]
+    first = jnp.concatenate([jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+    real = sid < big
+    rank = jnp.cumsum((first & real).astype(jnp.int32)) - 1
+    key2 = jnp.where(first & real, rank, n)
+    order2 = jnp.argsort(key2)[:cap]
+    valid2 = key2[order2] < n
+    uvals = sid[order2]
+    uniq = jnp.where(valid2, uvals, -1)
+    # inverse positions by a searchsorted against the unique buffer
+    # (padding mapped past every real id keeps it ascending) — cheaper
+    # than a third argsort; dropped occurrences map to ``cap``
+    usearch = jnp.where(valid2, uvals, big)
+    inv = jnp.searchsorted(usearch, jnp.where(row >= 0, row, big),
+                           side='left').astype(jnp.int32)
+    inv = jnp.where(row >= 0, jnp.minimum(inv, cap), cap)
+    return uniq, inv
+
+  return jax.vmap(one)(ids)
+
+
+def _dense_segment_sum(seg: jax.Array, rows: jax.Array, num: int,
+                       row_index: Optional[jax.Array] = None) -> jax.Array:
+  """DENSE segment sum: sum ``rows[i]`` (or ``rows[row_index[i]]``)
+  into segment ``seg[i]``; segments ``>= num`` drop.  Returns
+  ``[num, w]`` f32.
+
+  Sort + cumsum-difference segment totals (the ``compact_segments``
+  machinery), then ONE scatter-set of each segment's total at its last
+  sorted position — ``n`` static rows with the sorted/unique hints the
+  apply path already relies on.  An earlier formulation built the
+  dense buffer scatter-free (two searchsorted gathers per OUTPUT row),
+  but that prices O(K log n) with K the hot-buffer rows: the hot-cache
+  regime is K >> n by construction (K grows with coverage, n is
+  batch-bound), measured 1.1 s/step on the CPU harness at K=2.2M vs
+  tens of ms for the n-bound scatter.
+  """
+  n = seg.shape[0]
+  order = jnp.argsort(seg)
+  s = seg[order]
+  payload = (rows[order] if row_index is None
+             else rows[jnp.take(row_index, order)]).astype(jnp.float32)
+  payload = jnp.where((s < num)[:, None], payload, 0.0)
+  is_last = jnp.concatenate([s[1:] != s[:-1], jnp.ones((1,), bool)])
+  csum = jnp.cumsum(payload, axis=0)
+  total = jnp.where(is_last[:, None], csum, 0.0)
+  excl = jnp.concatenate(
+      [jnp.zeros((1, rows.shape[-1]), jnp.float32), csum[:-1]])
+  is_first = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+  first_pos = jax.lax.cummax(
+      jnp.where(is_first, jnp.arange(n, dtype=jnp.int32), 0))
+  total = total - jnp.where(is_last[:, None], excl[first_pos], 0.0)
+  # each in-bounds segment writes exactly once (its last position);
+  # every other row scatters out of bounds and drops.  No sorted hint:
+  # the dropped rows' sentinel interleaves with the ascending targets.
+  dst = jnp.where(is_last & (s < num), s, num)
+  return jnp.zeros((num, rows.shape[-1]), jnp.float32).at[dst].set(
+      total, mode='drop')
+
+
+def _gather_natural_rows(table: jax.Array, idx: jax.Array,
+                         pack: int) -> jax.Array:
+  """Gather NATURAL-space rows ``idx`` from a (possibly lane-packed)
+  group table without ever reshaping the parameter (the relayout
+  discipline of design §7): packed rows fetch whole and lane-select by
+  mask + fold, exactly like ``_fused_lookup_packed``."""
+  if pack == 1:
+    return table[idx]
+  lanes = table.shape[1]
+  w = lanes // pack
+  pr = table[idx // pack]
+  lane_group = jax.lax.broadcasted_iota(jnp.int32, (lanes,), 0) // w
+  keep = lane_group[None, :] == (idx % pack)[:, None]
+  contrib = jnp.where(keep, pr, 0)
+  return jnp.sum(contrib.reshape(idx.shape[0], pack, w), axis=1)
 
 
 def _fused_lookup(table: jax.Array, routed: jax.Array,

@@ -1,0 +1,400 @@
+"""Frequency-aware hot-row cache: selection, calibration and counters.
+
+Power-law id streams concentrate most lookup traffic on a tiny head of
+each table (the synthetic workloads are power-law by construction,
+`models/synthetic.py`; production recommender ids are too — PAPERS.md:
+*Scalable Machine Learning Training Infrastructure for Online Ads
+Recommendation at Google* partitions embedding work by access
+frequency).  This module holds the frequency side of the hybrid scheme
+(docs/design.md §10):
+
+- ``HotSet``: the per-table top-K row set, chosen to hit an occurrence
+  *coverage* target under a replication-memory budget, with
+  deterministic tie-breaks (equal counts break toward the smaller id,
+  so two hosts computing the plan agree bit-for-bit).
+- ``calibrate_hot_sets``: count id frequencies over sample batches.
+- ``analytic_power_law_hot_sets``: the closed form for synthetic
+  power-law generators (`gen_power_law_data`) — no sampling pass.
+- ``measure_exchange_counters``: EXACT host-side counters for the two
+  quantities the cache exists to cut — rows crossing the dp<->mp
+  exchange and scatter rows in the sparse apply — computed from the id
+  streams plus the plan alone, so the proof is hardware-independent
+  (bench journals them per artifact).
+
+The runtime half (replicated hot buffer, sort-uniqued cold exchange)
+lives in ``parallel/dist_embedding.py`` / ``parallel/sparse.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class HotSet:
+  """The replicated-row set of one table.
+
+  Attributes:
+    table_id: global table index the set belongs to.
+    ids: sorted (ascending) unique row ids, ``np.int64``.  Sorted order
+      is load-bearing: the runtime membership test is a searchsorted
+      against this array, and the hot-buffer slot of a row is its rank
+      here.
+    coverage: fraction of lookup occurrences the set covered on the
+      calibration sample (or analytically); informational.
+  """
+  table_id: int
+  ids: np.ndarray
+  coverage: float = 0.0
+
+  def __post_init__(self):
+    ids = np.asarray(self.ids, dtype=np.int64)
+    if ids.ndim != 1:
+      raise ValueError(f'HotSet ids must be 1-D, got shape {ids.shape}')
+    if ids.size and ((np.diff(ids) <= 0).any() or ids[0] < 0):
+      raise ValueError('HotSet ids must be sorted, unique and >= 0')
+    object.__setattr__(self, 'ids', ids)
+
+  @property
+  def size(self) -> int:
+    return int(self.ids.size)
+
+  def fingerprint_material(self) -> str:
+    h = hashlib.sha256(self.ids.tobytes()).hexdigest()[:16]
+    return f'{self.table_id}:{self.size}:{h}'
+
+
+def select_hot_rows(counts: np.ndarray,
+                    coverage: float,
+                    max_rows: Optional[int] = None) -> np.ndarray:
+  """Pick the smallest prefix of rows (by descending count) whose
+  occurrence mass reaches ``coverage``, clamped to ``max_rows``.
+
+  Deterministic: equal counts tie-break toward the SMALLER id (the sort
+  key is ``(-count, id)``), so every host computes the same set.
+  Zero-count rows are never selected, whatever the coverage target.
+
+  Returns the selected ids, sorted ascending.
+  """
+  if not 0.0 < coverage <= 1.0:
+    raise ValueError(f'coverage must be in (0, 1], got {coverage}')
+  counts = np.asarray(counts, dtype=np.int64)
+  total = int(counts.sum())
+  if total == 0:
+    return np.zeros((0,), np.int64)
+  order = np.lexsort((np.arange(counts.size), -counts))
+  csum = np.cumsum(counts[order])
+  k = int(np.searchsorted(csum, int(np.ceil(coverage * total))) + 1)
+  k = min(k, int((counts > 0).sum()))
+  if max_rows is not None:
+    k = min(k, max(0, int(max_rows)))
+  return np.sort(order[:k]).astype(np.int64)
+
+
+def hot_row_bytes(width: int, state_copies: int = 1,
+                  itemsize: int = 4) -> int:
+  """Per-device byte cost of replicating one hot row:
+  ``width * itemsize`` for the parameter row, times ``1 + state_copies``
+  to fund each optimizer-state copy (e.g. Adagrad's accumulator)."""
+  return width * itemsize * (1 + max(0, state_copies))
+
+
+def calibrate_hot_sets(table_configs,
+                       input_table_map: Sequence[int],
+                       batches: Sequence[Sequence[np.ndarray]],
+                       coverage: float = 0.8,
+                       budget_bytes: Optional[int] = None,
+                       state_copies: int = 1,
+                       min_rows_per_table: int = 0
+                       ) -> Dict[int, HotSet]:
+  """Count id frequencies over sample batches and emit per-table hot sets.
+
+  Args:
+    table_configs: the layer's ``TableConfig`` list.
+    input_table_map: ``input[i]`` looks up ``table[input_table_map[i]]``
+      (shared tables accumulate counts from every mapped input).
+    batches: iterable of per-batch input lists (each a list of
+      ``[batch(, hot)]`` id arrays, ``-1`` padding allowed — the same
+      shape the layer consumes).  One representative batch is usually
+      enough for stationary power-law streams; pass several to smooth.
+    coverage: occurrence-coverage target per table (e.g. 0.8 = hot rows
+      absorb 80% of that table's lookups).
+    budget_bytes: optional PER-DEVICE replication budget over all
+      tables; each table's K clamps so the total fits (budget splits
+      proportionally to each table's would-be unclamped hot bytes).
+    state_copies: optimizer-state copies per hot row the budget must
+      also fund (1 for Adagrad's accumulator, 0 for SGD).
+    min_rows_per_table: floor on K for tables with any traffic.
+
+  Returns:
+    ``{table_id: HotSet}`` for tables with a non-empty selection.
+  """
+  n_tables = len(table_configs)
+  counts = [np.zeros((c.input_dim,), np.int64) for c in table_configs]
+  for batch in batches:
+    if len(batch) != len(input_table_map):
+      raise ValueError(
+          f'calibration batch has {len(batch)} inputs, expected '
+          f'{len(input_table_map)}')
+    for inp, ids in enumerate(batch):
+      tid = input_table_map[inp]
+      # padding dropped + out-of-vocab ids clipped to the last row,
+      # exactly as the runtime routes them (_route_ids)
+      a = _clip_valid(ids, table_configs[tid].input_dim)
+      counts[tid] += np.bincount(a, minlength=table_configs[tid].input_dim)
+
+  # unclamped selections first, then proportional budget split
+  raw = {
+      tid: select_hot_rows(counts[tid], coverage)
+      for tid in range(n_tables) if counts[tid].sum() > 0
+  }
+  if min_rows_per_table:
+    # the documented floor applies budget or no budget (capped at the
+    # rows actually seen: replicating never-hit rows buys nothing)
+    for tid, ids in raw.items():
+      floor = min(min_rows_per_table, int((counts[tid] > 0).sum()))
+      if ids.size < floor:
+        raw[tid] = select_hot_rows(counts[tid], 1.0, max_rows=floor)
+  if budget_bytes is not None:
+    per_row = {
+        tid: hot_row_bytes(table_configs[tid].output_dim, state_copies)
+        for tid in raw
+    }
+    want = {tid: ids.size * per_row[tid] for tid, ids in raw.items()}
+    total_want = sum(want.values())
+    if total_want > budget_bytes:
+      scale = budget_bytes / max(1, total_want)
+      raw = {
+          tid: select_hot_rows(
+              counts[tid], coverage,
+              max_rows=max(min_rows_per_table if ids.size else 0,
+                           int(ids.size * scale)))
+          for tid, ids in raw.items()
+      }
+  out = {}
+  for tid, ids in raw.items():
+    if ids.size == 0:
+      continue
+    total = int(counts[tid].sum())
+    cov = float(counts[tid][ids].sum() / total) if total else 0.0
+    out[tid] = HotSet(table_id=tid, ids=ids, coverage=cov)
+  return out
+
+
+def power_law_hot_k(num_rows: int, alpha: float, coverage: float) -> int:
+  """Closed-form K for the synthetic generator's power law: ids come
+  from ``power_law(1, rows + 1, alpha, U[0,1)) - 1``
+  (models/synthetic.py), so the occurrence CDF of ``id < K`` is
+  ``((K + 1)^g - 1) / ((rows + 1)^g - 1)`` with ``g = 1 - alpha``.
+  Returns the smallest K with CDF >= coverage (the head rows ARE the
+  hot rows: mass is monotone decreasing in id)."""
+  if alpha <= 0:
+    # uniform ids: no head to cache; coverage * rows is the honest K
+    return int(np.ceil(coverage * num_rows))
+  g = 1.0 - alpha
+  lo, hi = 1.0, float(num_rows + 1)
+  if abs(g) < 1e-12:
+    # alpha == 1 (Zipf): the CDF's g->0 limit is log(K+1)/log(rows+1)
+    k = hi**coverage - 1.0
+  else:
+    target = coverage * (hi**g - lo**g) + lo**g
+    k = target**(1.0 / g) - 1.0
+  return max(1, min(num_rows, int(np.ceil(k))))
+
+
+def analytic_power_law_hot_sets(table_configs,
+                                alpha: float,
+                                coverage: float = 0.8,
+                                budget_bytes: Optional[int] = None,
+                                state_copies: int = 1,
+                                min_table_rows: int = 1024
+                                ) -> Dict[int, HotSet]:
+  """Hot sets for the synthetic power-law workloads without a counting
+  pass: top-K = ids ``[0, K)`` with K from ``power_law_hot_k``.  Tables
+  under ``min_table_rows`` are skipped (their whole vocabulary already
+  fits in cache-resident working set; replicating them buys nothing the
+  dedup doesn't).  ``budget_bytes`` clamps the TOTAL replicated bytes,
+  biggest tables clamped proportionally like ``calibrate_hot_sets``."""
+  ks = {}
+  for tid, cfg in enumerate(table_configs):
+    if cfg.input_dim < min_table_rows:
+      continue
+    ks[tid] = power_law_hot_k(cfg.input_dim, alpha, coverage)
+  if budget_bytes is not None:
+    per_row = {
+        tid: hot_row_bytes(table_configs[tid].output_dim, state_copies)
+        for tid in ks
+    }
+    total_want = sum(k * per_row[t] for t, k in ks.items())
+    if total_want > budget_bytes:
+      scale = budget_bytes / max(1, total_want)
+      ks = {t: max(1, int(k * scale)) for t, k in ks.items()}
+  out = {}
+  for tid, k in ks.items():
+    if k <= 0:
+      continue
+    g = 1.0 - alpha
+    if alpha > 0:
+      hi = float(table_configs[tid].input_dim + 1)
+      if abs(g) < 1e-12:
+        cov = float(np.log(k + 1.0) / np.log(hi))
+      else:
+        cov = float(((k + 1)**g - 1.0) / (hi**g - 1.0))
+    else:
+      cov = k / table_configs[tid].input_dim
+    out[tid] = HotSet(table_id=tid, ids=np.arange(k, dtype=np.int64),
+                      coverage=cov)
+  return out
+
+
+# ---------------------------------------------------------------------------
+# exact host-side counters: the journaled proof (bench.py)
+# ---------------------------------------------------------------------------
+
+
+def _clip_valid(ids: np.ndarray, vocab: int) -> np.ndarray:
+  """Valid (non-padding) ids of one input, OOV clipped like the runtime."""
+  a = np.asarray(ids).reshape(-1)
+  a = a[a >= 0]
+  return np.minimum(a, vocab - 1)
+
+
+def measure_exchange_counters(dist, cats,
+                              hot_sets: Optional[Dict[int, 'HotSet']] = None
+                              ) -> Dict[str, float]:
+  """Exact per-step exchange/scatter counters from the id streams + plan.
+
+  Mirrors the runtime routing in NumPy (per the plan's subgroup request
+  table) and reports, for ONE batch:
+
+  - ``alltoall_rows_sent_off``: valid id occurrences crossing the
+    dp->mp exchange on the baseline path (each request ships its
+    input's full id list to its owner).
+  - ``alltoall_rows_sent``: rows crossing with the cache on — hot ids
+    never ship, the rest sort-unique per (source device, destination
+    slot) so each distinct row crosses once.
+  - ``hot_hit_rate``: hot fraction of valid occurrences (0.0 with no
+    hot sets).
+  - ``unique_cold_rows``: the distinct cold rows behind
+    ``alltoall_rows_sent`` (identical to it by construction; kept as
+    its own key so the artifact names the quantity).
+  - ``scatter_rows_per_step_off`` / ``scatter_rows_per_step``: unique
+    update rows the sparse apply must scatter, summed over fusion
+    groups at the max-over-devices count (the wall-clock-relevant
+    static row count a perfectly calibrated capacity pays); with the
+    cache on, hot rows leave the scatter entirely (they apply as one
+    dense add on the replicated buffer).
+
+  ``hot_sets`` defaults to the plan's own
+  (``dist.plan.hot_sets``); pass ``{}`` to compute the off-path
+  counters for a cache-less layer.
+  """
+  plan = dist.plan
+  if hot_sets is None:
+    hot_sets = getattr(plan, 'hot_sets', None) or {}
+  D = dist.world_size
+  cats = [np.asarray(c) for c in cats]
+  batch = cats[0].shape[0]
+  if batch % (D * dist.num_slices):
+    raise ValueError(f'batch {batch} not divisible by device count')
+  local_batch = batch // (D * dist.num_slices)
+  hotness = tuple(1 if c.ndim == 1 else c.shape[1] for c in cats)
+  subs = dist._subgroups(hotness)
+
+  hot_ids = {t: hs.ids for t, hs in hot_sets.items() if hs.ids.size}
+  total_valid = 0
+  total_hot = 0
+  total_cold = 0  # counted independently of total_hot: the artifact's
+  #                 hit + cold fractions cross-check each other
+  for inp, ids in enumerate(cats):
+    tid = plan.input_table_map[inp]
+    v = _clip_valid(ids, plan.table_configs[tid].input_dim)
+    total_valid += v.size
+    if tid in hot_ids:
+      m = np.isin(v, hot_ids[tid])
+      total_hot += int(m.sum())
+      total_cold += int((~m).sum())
+    else:
+      total_cold += v.size
+
+  sent_off = 0
+  sent_on = 0
+  # per (device, group): routed fused-row streams for the scatter counts
+  routed_off: Dict[tuple, List[np.ndarray]] = {}
+  routed_on: Dict[tuple, List[np.ndarray]] = {}
+  # hot membership depends only on the input, not on which (device, slot)
+  # request consumes it — a row-sliced table repeats the same input across
+  # D shard slots, so cache the isin/unique work per input (and per
+  # source block for the wire counters)
+  blk_counts: Dict[tuple, tuple] = {}  # (input, src) -> (valid, uniq cold)
+  owner_ids: Dict[int, tuple] = {}  # input -> (v_all, cold_all)
+  for sub in subs:
+    for dev in range(D):
+      for s, r in enumerate(sub.requests[dev]):
+        tid = r.table_id
+        vocab = plan.table_configs[tid].input_dim
+        x = cats[r.input_id]
+        x2 = x.reshape(batch, -1)
+        for src in range(D * dist.num_slices):
+          key = (r.input_id, src)
+          if key not in blk_counts:
+            blk = x2[src * local_batch:(src + 1) * local_batch].reshape(-1)
+            v = _clip_valid(blk, vocab)
+            if tid in hot_ids:
+              cold = v[~np.isin(v, hot_ids[tid])]
+            else:
+              cold = v
+            blk_counts[key] = (v.size, np.unique(cold).size)
+          n_valid, n_uniq_cold = blk_counts[key]
+          sent_off += n_valid
+          sent_on += n_uniq_cold
+        # owner-side routed rows (full batch arrives at the owner)
+        if r.input_id not in owner_ids:
+          v_all = _clip_valid(x2.reshape(-1), vocab)
+          cold_all = (v_all[~np.isin(v_all, hot_ids[tid])]
+                      if tid in hot_ids else v_all)
+          owner_ids[r.input_id] = (v_all, cold_all)
+        v_all, cold_all = owner_ids[r.input_id]
+        if r.row_stride > 1:
+          mine = v_all[(v_all % r.row_stride) == r.row_start]
+          rows = r.row_offset + (mine - r.row_start) // r.row_stride
+        else:
+          mine = v_all[(v_all >= r.row_start) & (v_all < r.row_end)]
+          rows = r.row_offset + mine - r.row_start
+        routed_off.setdefault((dev, sub.gi), []).append(rows)
+        if tid in hot_ids:
+          if r.row_stride > 1:
+            mine = cold_all[(cold_all % r.row_stride) == r.row_start]
+            rows_c = r.row_offset + (mine - r.row_start) // r.row_stride
+          else:
+            mine = cold_all[(cold_all >= r.row_start)
+                            & (cold_all < r.row_end)]
+            rows_c = r.row_offset + mine - r.row_start
+          routed_on.setdefault((dev, sub.gi), []).append(rows_c)
+        else:
+          routed_on.setdefault((dev, sub.gi), []).append(rows)
+
+  def scatter_rows(routed: Dict[tuple, List[np.ndarray]]) -> int:
+    per_group: Dict[int, int] = {}
+    for (dev, gi), streams in routed.items():
+      u = np.unique(np.concatenate(streams)).size if streams else 0
+      per_group[gi] = max(per_group.get(gi, 0), u)
+    return int(sum(per_group.values()))
+
+  return {
+      'alltoall_rows_sent_off': int(sent_off),
+      'alltoall_rows_sent': int(sent_on),
+      'unique_cold_rows': int(sent_on),
+      'hot_hit_rate': round(total_hot / total_valid, 4) if total_valid
+                      else 0.0,
+      'cold_occurrence_fraction': round(total_cold / total_valid, 4)
+                                  if total_valid else 0.0,
+      'total_id_occurrences': int(total_valid),
+      'scatter_rows_per_step_off': scatter_rows(routed_off),
+      'scatter_rows_per_step': scatter_rows(routed_on),
+  }

@@ -42,7 +42,13 @@ mapping exactly so save/load can reshard to any world size.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from distributed_embeddings_tpu.parallel.hotcache import HotSet
 
 
 @dataclasses.dataclass
@@ -185,6 +191,22 @@ class GroupSpec:
   # per-step packing reshapes (8x HBM on synthetic-tiny's 29.1M-row
   # width-16 group, docs/perf_notes.md round 3).  1 = natural storage.
   storage_pack: int = 1
+  # ---- frequency-aware hot cache (docs/design.md §10) ----
+  # hot_chunks: the group's slice of the replicated hot buffer — one
+  # entry per distinct (table, column range) this group serves whose
+  # table has a HotSet: (table_id, col_start, col_end, offset, count),
+  # rows [offset, offset + count) of the ``[hot_rows_cap, width]``
+  # replicated buffer holding that table's hot rows (HotSet.ids order)
+  # at those columns.  Empty when the plan has no hot sets.
+  hot_chunks: List[Tuple[int, int, int, int, int]] = \
+      dataclasses.field(default_factory=list)
+  hot_rows_cap: int = 0
+  # per-device init/ownership map: hot_owner_rows[d] are fused-space
+  # local rows on device d whose values belong at hot-buffer positions
+  # hot_owner_dst[d] (each hot row is resident on exactly one shard;
+  # the replicated buffer initialises by gather + psum from these)
+  hot_owner_rows: Optional[List[np.ndarray]] = None
+  hot_owner_dst: Optional[List[np.ndarray]] = None
 
   @property
   def param_rows(self) -> int:
@@ -390,6 +412,16 @@ class ShardingPlan:
     num_sc: emulated/physical SparseCores per chip (v5p: 4, v6e: 2);
       metadata consumed by the CSR partition transform
       (parallel/sparsecore.py), not by placement.
+    hot_sets: optional frequency-aware hot-row sets — a
+      ``{table_id: HotSet}`` dict or a ``HotSet`` sequence
+      (``parallel/hotcache.py``; docs/design.md §10).  Hot rows
+      replicate into a small per-group buffer on every device; the
+      runtime serves them locally and strips them from the dp->mp
+      exchange.  The plan records each group's hot-buffer layout
+      (``GroupSpec.hot_chunks``) and per-device ownership map; hot
+      membership is a LAYOUT detail — checkpoints stay global
+      canonical and restore under any other hot set
+      (parallel/checkpoint.py).
   """
 
   def __init__(self,
@@ -401,7 +433,8 @@ class ShardingPlan:
                row_slice_threshold: Optional[int] = None,
                packed_storage: bool = True,
                mod_sharding: bool = False,
-               num_sc: int = 4):
+               num_sc: int = 4,
+               hot_sets=None):
     if strategy not in ('basic', 'memory_balanced', 'memory_optimized'):
       raise ValueError(f'Unsupported shard strategy {strategy}')
     # Single-process case may skip collectives; mirror the reference's
@@ -430,6 +463,28 @@ class ShardingPlan:
     # natural layout is what both the emulation backend and the hardware
     # binding consume
     self.packed_storage = bool(packed_storage) and not self.mod_sharding
+    # frequency-aware hot sets: normalise to {table_id: HotSet} and
+    # validate against the table set (empty sets dropped — a table
+    # without hot rows simply takes the plain cold path)
+    self.hot_sets: Dict[int, HotSet] = {}
+    if hot_sets:
+      items = (hot_sets.values() if isinstance(hot_sets, dict)
+               else list(hot_sets))
+      for hs in items:
+        if not isinstance(hs, HotSet):
+          raise TypeError(f'hot_sets entries must be HotSet, got {type(hs)}')
+        if hs.table_id < 0 or hs.table_id >= len(self.table_configs):
+          raise ValueError(f'HotSet table_id {hs.table_id} out of range')
+        if hs.ids.size and hs.ids[-1] >= \
+            self.table_configs[hs.table_id].input_dim:
+          raise ValueError(
+              f'HotSet for table {hs.table_id} contains row '
+              f'{int(hs.ids[-1])} past input_dim '
+              f'{self.table_configs[hs.table_id].input_dim}')
+        if hs.table_id in self.hot_sets:
+          raise ValueError(f'duplicate HotSet for table {hs.table_id}')
+        if hs.ids.size:
+          self.hot_sets[hs.table_id] = hs
 
     # --- 1a. row slicing (beyond the reference; see slice_table_row) -----
     # A qualifying table is sliced along rows only (its shards span every
@@ -661,6 +716,9 @@ class ShardingPlan:
         for r in dev_reqs:
           self.input_requests[r.input_id].append(r)
 
+    if self.hot_sets:
+      self._attach_hot_layout()
+
     # Output slices of each input arrive in device order.  Distinct column
     # ranges must tile [0, output_dim) exactly; requests SHARING a column
     # range are row shards whose outputs sum at assembly, and their row
@@ -701,6 +759,84 @@ class ShardingPlan:
         i = j
       if expect_col != cfg.output_dim:
         raise AssertionError(f'input {inp}: column slices do not cover table')
+
+  def _attach_hot_layout(self):
+    """Compute each group's hot-buffer layout + per-device owner map.
+
+    A group's hot buffer concatenates, per distinct (table, column
+    range) the group serves, that table's hot rows at those columns —
+    in (table_id, col_start) order, each chunk's rows in HotSet.ids
+    (ascending id) order.  The owner map records, per device, which
+    fused-space local rows hold each hot row's resident value (exactly
+    one shard owns any row), for the init-time gather + psum that
+    fills the replicated buffer (DistributedEmbedding._init_hot).
+    """
+    for g in self.groups:
+      seen = {}
+      for dev in range(self.world_size):
+        for lt in g.member_tables[dev]:
+          if lt.table_id in self.hot_sets:
+            seen.setdefault((lt.table_id, lt.col_start, lt.col_end), True)
+      chunks = []
+      offset = 0
+      for tid, cs, ce in sorted(seen):
+        k = self.hot_sets[tid].size
+        chunks.append((tid, cs, ce, offset, k))
+        offset += k
+      g.hot_chunks = chunks
+      g.hot_rows_cap = _round_up(offset, 8) if offset else 0
+      if not chunks:
+        continue
+      chunk_off = {(t, cs, ce): off for t, cs, ce, off, _ in chunks}
+      owner_rows = []
+      owner_dst = []
+      for dev in range(self.world_size):
+        rows_d: List[int] = []
+        dst_d: List[int] = []
+        row_offset = 0
+        for lt in g.member_tables[dev]:
+          if lt.table_id in self.hot_sets:
+            ids = self.hot_sets[lt.table_id].ids
+            off = chunk_off[(lt.table_id, lt.col_start, lt.col_end)]
+            if lt.row_stride > 1:
+              sel = np.nonzero(ids % lt.row_stride == lt.row_start)[0]
+              local = (ids[sel] - lt.row_start) // lt.row_stride
+            else:
+              sel = np.nonzero((ids >= lt.row_start)
+                               & (ids < lt.row_end))[0]
+              local = ids[sel] - lt.row_start
+            rows_d.extend((row_offset + local).tolist())
+            dst_d.extend((off + sel).tolist())
+          row_offset += lt.input_dim
+        owner_rows.append(np.asarray(rows_d, np.int32))
+        owner_dst.append(np.asarray(dst_d, np.int32))
+      g.hot_owner_rows = owner_rows
+      g.hot_owner_dst = owner_dst
+
+  @property
+  def hot_groups(self) -> List[int]:
+    """Indices of fusion groups carrying a non-empty hot buffer."""
+    return [gi for gi, g in enumerate(self.groups) if g.hot_chunks]
+
+  def fingerprint(self) -> str:
+    """Stable fingerprint of the PHYSICAL plan, hot set included.
+
+    Distinct from ``checkpoint.plan_fingerprint`` by design: that one
+    hashes only the logical table set (checkpoints reshard across
+    physical layouts, hot membership included), while this one changes
+    whenever anything that alters the compiled program does — world
+    size, strategy, slicing, storage, mod windows, and the exact hot
+    row sets (test_planner pins the sensitivity).
+    """
+    material = json.dumps([
+        self.world_size, self.strategy, self.column_slice_threshold,
+        self.row_slice_threshold, self.mod_sharding, self.packed_storage,
+        self.num_sc, list(self.input_table_map),
+        [[c.input_dim, c.output_dim, c.combiner]
+         for c in self.table_configs],
+        sorted(hs.fingerprint_material() for hs in self.hot_sets.values()),
+    ])
+    return hashlib.sha256(material.encode()).hexdigest()[:16]
 
   # ---- parity / introspection views (reference attribute contracts) -----
 

@@ -226,7 +226,10 @@ class SparseSGD:
   sc_apply_kind = 'sgd'
 
   def init(self, dist: DistributedEmbedding, params) -> Dict:
-    return {f'group_{gi}': {} for gi in range(len(dist.plan.groups))}
+    out = {f'group_{gi}': {} for gi in range(len(dist.plan.groups))}
+    for gi in getattr(dist.plan, 'hot_groups', []):
+      out[f'hot_group_{gi}'] = {}
+    return out
 
   def apply_unique(self, table, state, uids, sum_g, sum_sq, lr):
     """Apply one step at COMPACTED unique rows (``compact_segments``)."""
@@ -238,6 +241,14 @@ class SparseSGD:
     uids = _distinct_oob(uids, table.shape[0])
     return table.at[uids].add(update, mode='drop', unique_indices=True,
                               indices_are_sorted=True), state
+
+  def apply_hot(self, hot, state, sum_g, sum_sq, lr):
+    """DENSE step on a replicated hot-cache buffer (design §10):
+    ``sum_g`` is the mesh-psummed per-row gradient sum — untouched
+    rows carry exact zeros, so one elementwise add updates every hot
+    row with the same arithmetic the scatter would."""
+    del sum_sq
+    return hot + (-lr * sum_g).astype(hot.dtype), state
 
 
 @dataclasses.dataclass(frozen=True)
@@ -299,7 +310,7 @@ class SparseAdagrad:
 
   def init(self, dist: DistributedEmbedding, params) -> Dict:
     adt = jnp.dtype(self.accum_dtype)
-    return {
+    out = {
         f'group_{gi}': {
             'acc':
                 jnp.full_like(params[f'group_{gi}'],
@@ -307,6 +318,17 @@ class SparseAdagrad:
                               dtype=adt)
         } for gi in range(len(dist.plan.groups))
     }
+    for gi in getattr(dist.plan, 'hot_groups', []):
+      # replicated split state for the hot-cache rows (design §10);
+      # the row's accumulator lives HERE while the row is hot — the
+      # checkpoint boundary canonicalises it back into the per-table
+      # layout, so hot membership never reaches saved state
+      out[f'hot_group_{gi}'] = {
+          'acc': jnp.full_like(params[f'hot_group_{gi}'],
+                               self.initial_accumulator_value,
+                               dtype=adt)
+      }
+    return out
 
   def apply_unique(self, table, state, uids, sum_g, sum_sq, lr):
     """One step at COMPACTED unique rows.
@@ -345,6 +367,20 @@ class SparseAdagrad:
     return table.at[uids].add(update, mode='drop', unique_indices=True,
                               indices_are_sorted=True), {'acc': acc}
 
+  def apply_hot(self, hot, state, sum_g, sum_sq, lr):
+    """DENSE Adagrad step on a replicated hot-cache buffer: the same
+    accumulate-then-read arithmetic as ``apply_unique`` (dedup
+    semantics square the mesh-psummed row sum; per-occurrence
+    semantics consume the psummed squared channel), elementwise — no
+    scatter.  Untouched rows see ``add == 0`` and ``update == 0``, so
+    they are bit-preserved (incl. bf16 accumulator stores: the f32
+    up-cast/round-trip of a bf16 value is exact)."""
+    add = sum_g * sum_g if self.dedup else sum_sq
+    acc_rows = state['acc'].astype(jnp.float32) + add
+    update = (-lr * sum_g * jax.lax.rsqrt(acc_rows + self.epsilon)).astype(
+        hot.dtype)
+    return hot + update, {'acc': acc_rows.astype(state['acc'].dtype)}
+
 
 @dataclasses.dataclass(frozen=True)
 class SparseAdam:
@@ -363,6 +399,16 @@ class SparseAdam:
   supports_lane_packing = False
 
   def init(self, dist: DistributedEmbedding, params) -> Dict:
+    if getattr(dist, 'hot_enabled', False):
+      # lazy Adam's per-row step counter is not a dense elementwise
+      # quantity: advancing it only for touched hot rows needs a
+      # data-dependent mask whose semantics the split state does not
+      # carry.  Fail actionably instead of training wrong.
+      raise ValueError(
+          'SparseAdam does not support hot_cache layers (the lazy '
+          'per-row step counter has no dense replicated-buffer '
+          'equivalent). Use SparseSGD/SparseAdagrad, or build the '
+          'layer without hot_cache.')
     out = {}
     for gi, g in enumerate(dist.plan.groups):
       if (g.storage_pack > 1
@@ -756,16 +802,27 @@ def _segwalk_apply(optimizer, table, state, flat_ids, flat_g, lr,
 
 def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
                         global_batch: int, hotness: tuple):
-  """shard_map'd per-device sparse update over all fusion groups."""
+  """shard_map'd per-device sparse update over all fusion groups.
+
+  Hot-cache layers (``dist.hot_enabled``): the per-subgroup streams
+  arrive ALREADY deduplicated per (source device, slot) — the same
+  compact/apply pipeline runs over far fewer rows — and the trailing
+  args carry one replicated ``[hot_rows_cap, w]`` (``2w`` with
+  per-occurrence squares) gradient buffer per hot group, applied as a
+  DENSE elementwise optimizer step (``apply_hot``) with no scatter."""
   key = ('sparse_apply', optimizer, global_batch, hotness)
   if key in dist._fn_cache:
     return dist._fn_cache[key]
   subs = dist._subgroups(hotness)
   ax = dist.axis_name
+  hot_gis = list(getattr(dist.plan, 'hot_groups', []))
+  cached = bool(getattr(dist, 'hot_enabled', False))
+  needs_sq = bool(getattr(optimizer, 'needs_sq', True))
 
   def local_fn(params, opt_state, lr, *res_and_g):
     residuals = res_and_g[:len(subs)]
-    gs = res_and_g[len(subs):]
+    gs = res_and_g[len(subs):2 * len(subs)]
+    hot_gs = res_and_g[2 * len(subs):]
     new_params = dict(params)
     new_state = dict(opt_state)
     fence = lr  # serialisation token threaded through the group applies
@@ -787,6 +844,11 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
       # consumer) and w<128 rows store T(8,128) lane-padded — at m ~ n
       # that re-buys the round-4 padding blowup (+3.3 GiB measured on
       # medium@32) — so those groups keep the fused broadcast.
+      # hot-cache streams are already per-(source, slot) deduplicated
+      # h=1 rows whose cotangents were pre-divided (mean) and, for
+      # per-occurrence-squares optimizers, carry the squared channel as
+      # trailing columns — segment-summed additively, never re-squared
+      wc = 2 * w if (cached and needs_sq) else w
       n_total = sum(residuals[si][0].size for si, _ in slots)
       m_total = sum(residuals[si][0].shape[0] * residuals[si][0].shape[1]
                     for si, _ in slots)
@@ -795,7 +857,8 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
       for si, sub in slots:
         ids = residuals[si][0]            # [n_cap, GB, h]
         gg = gs[si][0].astype(jnp.float32)  # [n_cap, GB, w]
-        if group.combiner == 'mean' and not sub.mean_row_sliced:
+        if group.combiner == 'mean' and not sub.mean_row_sliced \
+            and not cached:
           cnt = jnp.sum(ids < rows_cap, axis=2).astype(jnp.float32)
           gg = gg / jnp.maximum(cnt, 1.0)[..., None]
         # mean_row_sliced: the cotangent arrives pre-divided by the TRUE
@@ -804,14 +867,14 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
         n_cap, gb, h = ids.shape
         ids_list.append(ids.reshape(-1))
         if use_idx:
-          grad_list.append(gg.reshape(-1, w))
+          grad_list.append(gg.reshape(-1, wc))
           gidx_list.append(
               row_off + jnp.repeat(
                   jnp.arange(n_cap * gb, dtype=jnp.int32), h))
           row_off += n_cap * gb
         else:
-          pos_g = jnp.broadcast_to(gg[:, :, None, :], ids.shape + (w,))
-          grad_list.append(pos_g.reshape(-1, w))
+          pos_g = jnp.broadcast_to(gg[:, :, None, :], ids.shape + (wc,))
+          grad_list.append(pos_g.reshape(-1, wc))
       flat_ids = jnp.concatenate(ids_list) if len(ids_list) > 1 \
           else ids_list[0]
       g_rows = jnp.concatenate(grad_list) if len(grad_list) > 1 \
@@ -856,18 +919,20 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
         # (uniques + sentinel <= rows_cap + 2): a fraction/calibrated
         # cap could silently drop segments here, where no correction
         # wave runs (the wave guards only the post-gather apply).
-        needs_sq = bool(getattr(optimizer, 'needs_sq', True))
         pcap = _guaranteed_cap(flat_ids.shape[0], rows_cap)
+        # cached streams carry squares as trailing payload columns —
+        # they segment-sum additively with the grads and split at the
+        # same column offsets after the gather
         uids_s, sum_g_s, sum_sq_s, _ = compact_segments(
-            flat_ids, g_rows, pcap, rows_cap, with_sq=needs_sq,
-            g_index=g_idx)
+            flat_ids, g_rows, pcap, rows_cap,
+            with_sq=needs_sq and not cached, g_index=g_idx)
         # ONE DCN collective per group: ids ride as a bitcast f32
         # column alongside the grad (and square) payload
         packed = [
             jax.lax.bitcast_convert_type(uids_s, jnp.float32)[:, None],
             sum_g_s
         ]
-        if needs_sq:
+        if needs_sq and not cached:
           packed.append(sum_sq_s)
         gathered = jax.lax.all_gather(jnp.concatenate(packed, axis=1),
                                       dist.dcn_axis, axis=0, tiled=True)
@@ -875,6 +940,11 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
         flat_g = gathered[:, 1:1 + w]
         if needs_sq:
           flat_sq = gathered[:, 1 + w:]
+      if cached and needs_sq and flat_g is None:
+        # single-slice cached stream: split the additive squared-grad
+        # channel off the payload columns for the flat_sq apply path
+        flat_g = g_rows[:, :w]
+        flat_sq = g_rows[:, w:]
       spack = getattr(group, 'storage_pack', 1)
       if flat_sq is None and _use_sparsecore(optimizer, dist,
                                              params[key][0], spack):
@@ -922,22 +992,53 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
       new_params[key] = table[None]
       new_state[key] = {k: v[None] for k, v in state2.items()}
       fence = table[0, 0]
+
+    # hot-cache buffers: ONE dense elementwise step per hot group on
+    # the mesh-psummed gradient sums — the dense add that replaces K
+    # random-access scatter rows per hot id (design §10).  The grads
+    # arrived replicated (the backward psums them), so every replica
+    # applies identically and the buffers stay in sync.
+    for k_idx, gi in enumerate(hot_gis):
+      hk = f'hot_group_{gi}'
+      hg = hot_gs[k_idx].astype(jnp.float32)
+      hw = dist.plan.groups[gi].width
+      sum_g = hg[:, :hw]
+      sum_sq = hg[:, hw:] if needs_sq else None
+      hot_new, hstate = optimizer.apply_hot(params[hk], opt_state[hk],
+                                            sum_g, sum_sq, lr)
+      new_params[hk] = hot_new
+      new_state[hk] = hstate
     return new_params, new_state
 
   n_groups = len(dist.plan.groups)
   param_specs = {f'group_{gi}': P(ax, None, None) for gi in range(n_groups)}
+  for gi in hot_gis:
+    param_specs[f'hot_group_{gi}'] = P(None, None)
+
+  def _state_spec(opt_state):
+    # sharded group leaves are [D, ...] on axis 0; hot-cache leaves are
+    # replicated [hot_rows_cap, w] buffers
+    out = {}
+    for k, leaves in opt_state.items():
+      if k.startswith('hot_group_'):
+        out[k] = jax.tree.map(
+            lambda x: P(*([None] * x.ndim)), leaves)
+      else:
+        out[k] = jax.tree.map(
+            lambda x: P(ax, *([None] * (x.ndim - 1))), leaves)
+    return out
 
   def apply(params, opt_state, lr, *res_and_g):
-    # every optimizer-state leaf is [D, ...] sharded on axis 0 (and,
+    # every sharded optimizer-state leaf is [D, ...] on axis 0 (and,
     # on a two-axis mesh, replicated over the slice axis)
-    state_spec = jax.tree.map(
-        lambda x: P(ax, *([None] * (x.ndim - 1))), opt_state)
+    state_spec = _state_spec(opt_state)
     fn = jax.shard_map(
         local_fn,
         mesh=dist.mesh,
         in_specs=(param_specs, state_spec, P()) + tuple(
             P(ax, None, dist.dcn_axis, None)
-            for _ in range(2 * len(subs))),
+            for _ in range(2 * len(subs))) + tuple(
+                P(None, None) for _ in hot_gis),
         out_specs=(param_specs, state_spec),
         check_vma=False)
     return fn(params, opt_state, lr, *res_and_g)
@@ -948,11 +1049,23 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
 
 def sparse_apply_updates(dist: DistributedEmbedding, optimizer, params,
                          opt_state, residuals, gsubs, lr,
-                         global_batch: int, hotness: tuple):
-  """Apply one sparse optimizer step to the embedding params."""
+                         global_batch: int, hotness: tuple,
+                         hot_grads=None):
+  """Apply one sparse optimizer step to the embedding params.
+
+  ``hot_grads``: for hot-cache layers, the ``{group_index: [K, w]}``
+  replicated hot-row gradient buffers from ``backward_to_mp``."""
   fn = _build_sparse_apply(dist, optimizer, global_batch, hotness)
+  hot_list = []
+  if hot_grads:
+    hot_list = [hot_grads[gi] for gi in dist.plan.hot_groups]
+  elif dist.plan.hot_groups:
+    raise ValueError(
+        'sparse_apply_updates on a hot-cache layer requires hot_grads= '
+        '(the {group_index: [K, w]} replicated hot-row gradient buffers '
+        'that backward_to_mp returns alongside gsubs)')
   return fn(params, opt_state, jnp.asarray(lr, jnp.float32),
-            *residuals, *gsubs)
+            *residuals, *gsubs, *hot_list)
 
 
 def make_hybrid_train_step(dist: DistributedEmbedding,
@@ -1008,6 +1121,27 @@ def make_hybrid_train_step(dist: DistributedEmbedding,
         d_dense, dense_opt_state, dense_params)
     new_dense = jax.tree.map(lambda p, u: p + u.astype(p.dtype),
                              dense_params, updates)
+
+    if getattr(dist, 'hot_enabled', False):
+      # hot-cache layers: the backward rebuilds the unique cold
+      # streams from the raw inputs, divides mean cotangents
+      # internally, and returns the replicated hot-row grad buffers
+      # alongside the deduplicated per-subgroup streams
+      cats_dense = [
+          x.to_padded_dense(dist._ragged_cap(x))
+          if isinstance(x, RaggedBatch) else x for x in cats
+      ]
+      gsubs, hot_grads = dist.backward_to_mp(
+          list(d_emb), global_batch, hotness, cats=cats_dense,
+          with_sq=bool(getattr(emb_optimizer, 'needs_sq', False)))
+      lr = (lr_schedule(state.step) if lr_schedule is not None
+            else emb_optimizer.learning_rate)
+      new_emb, emb_opt_state = sparse_apply_updates(
+          dist, emb_optimizer, emb_params, emb_opt_state, residuals,
+          gsubs, lr, global_batch, hotness, hot_grads=hot_grads)
+      params = {**new_dense, 'embedding': new_emb}
+      return TrainState(params, (dense_opt_state, emb_opt_state),
+                        state.step + 1), loss
 
     # row-sliced MEAN inputs: the forward divided the owner-side partial
     # sums by the true per-sample id count; the manual transpose must
@@ -1133,7 +1267,11 @@ def _calibration_mirror(dist: DistributedEmbedding, cpus):
       # windows; the mirror must reproduce them or every calibrated
       # capacity would describe the wrong id->device map
       mod_sharding=dist.plan.mod_sharding,
-      num_sc=dist.plan.num_sc)
+      num_sc=dist.plan.num_sc,
+      # hot-cache plans strip hot ids and dedup the cold exchange; the
+      # mirror must reproduce BOTH or the calibrated capacities would
+      # describe the un-cached (far larger) streams
+      hot_cache=dist.plan.hot_sets or None)
   # the mirror's params must match ITS plan's physical layout (packed
   # [param_rows, param_width] for storage-packed groups)
   zeros = {
@@ -1141,6 +1279,10 @@ def _calibration_mirror(dist: DistributedEmbedding, cpus):
                                g.param_width), dist.param_dtype)
       for gi, g in enumerate(mirror.plan.groups)
   }
+  for gi in mirror.plan.hot_groups:
+    g = mirror.plan.groups[gi]
+    zeros[f'hot_group_{gi}'] = np.zeros((g.hot_rows_cap, g.width),
+                                        dist.param_dtype)
   return mirror, zeros
 
 

@@ -48,6 +48,18 @@ if [ "$BUDGET" = 1 ]; then
     --fast_compile \
     --csr_feed \
     --max_steps 40
+
+  # cheap hot-cache A/B (design §10): the same 40-step steps-only row
+  # with the frequency-aware cache calibrated + on — compare the two
+  # steady-state samples/s lines (the cache-off row above is the
+  # baseline arm)
+  python examples/dlrm/main.py \
+    --dataset_path "$DATA" \
+    --batch_size "$BATCH" \
+    --dp_input \
+    --fast_compile \
+    --hot_cache \
+    --max_steps 40
   exit 0
 fi
 
@@ -59,6 +71,21 @@ python examples/dlrm/main.py \
   --csr_feed \
   --eval_every 32 --eval_batches 4 \
   --eval
+
+# cheap hot-cache A/B (design §10): two short steps-only rows, cache
+# off vs on, same batch — the steady-state samples/s pair is the chip
+# measurement of the exchange/scatter cut the CPU counters predict
+python examples/dlrm/main.py \
+  --dataset_path "$DATA" \
+  --batch_size "$BATCH" \
+  --dp_input \
+  --max_steps 40
+python examples/dlrm/main.py \
+  --dataset_path "$DATA" \
+  --batch_size "$BATCH" \
+  --dp_input \
+  --hot_cache \
+  --max_steps 40
 
 # AMP-analog variant (reference examples/dlrm/README.md:8, 10.4M
 # samples/s 8xA100 fp16 = f32 variables + half-precision compute):

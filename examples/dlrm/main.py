@@ -42,6 +42,24 @@ def parse_args():
   parser.add_argument('--row_slice', type=int, default=None,
                       help='element threshold above which tables shard '
                       'along rows (fits tables bigger than one chip)')
+  parser.add_argument('--hot_cache', action='store_true',
+                      help='frequency-aware hot-row cache (design §10): '
+                      'a calibration pass counts id frequencies over '
+                      '--hot_calib_batches sample batches, the top rows '
+                      'per table (to --hot_coverage occurrence coverage) '
+                      'replicate on every device and leave the dp<->mp '
+                      'exchange; cold ids sort-unique before the '
+                      'exchange.  Requires --dp_input')
+  parser.add_argument('--hot_coverage', type=float, default=0.8,
+                      help='per-table occurrence-coverage target for the '
+                      'hot set calibration')
+  parser.add_argument('--hot_calib_batches', type=int, default=2,
+                      help='sample batches the calibration pass counts '
+                      '(power-law id streams are stationary; one or two '
+                      'batches are representative)')
+  parser.add_argument('--hot_budget_mb', type=float, default=None,
+                      help='per-device replication budget for hot rows + '
+                      'optimizer state (None = coverage-sized)')
   parser.add_argument('--param_dtype', default='float32',
                       choices=['float32', 'bfloat16'],
                       help='table + MLP storage dtype (bfloat16 halves '
@@ -138,6 +156,54 @@ def main():
 
   mesh = create_mesh()
   world = len(mesh.devices.ravel())
+
+  # frequency-aware hot cache (design §10): calibration pass over a few
+  # sample batches -> per-table HotSets wired into the planner.  Uses a
+  # throwaway reader so the training iterator's position is untouched.
+  hot_sets = None
+  if args.hot_cache:
+    if not args.dp_input:
+      raise SystemExit('--hot_cache requires --dp_input (the cache '
+                       'partitions the dp->mp id exchange, which only '
+                       'the data-parallel input path has)')
+    if args.trainer != 'sparse':
+      raise SystemExit('--hot_cache pairs with --trainer sparse (the '
+                       'split hot/cold optimizer state lives in the '
+                       'sparse row-wise path)')
+    from distributed_embeddings_tpu.parallel import TableConfig, hotcache
+    cal_ids = list(range(len(table_sizes)))
+    if args.dataset_path is not None:
+      cal_ds = open_raw_binary_dataset(
+          data_path=args.dataset_path, batch_size=args.batch_size,
+          numerical_features=args.num_numerical_features,
+          categorical_features=cal_ids,
+          categorical_feature_sizes=table_sizes, prefetch_depth=2,
+          drop_last_batch=True, offset=0, lbs=args.batch_size,
+          dp_input=True)
+    else:
+      cal_ds = DummyDataset(args.batch_size, args.num_numerical_features,
+                            len(cal_ids), args.hot_calib_batches)
+    cfgs = [TableConfig(s, args.embedding_dim) for s in table_sizes]
+    batches = []
+    try:
+      for bi, (_, cats_b, _) in enumerate(cal_ds):
+        if bi >= args.hot_calib_batches:
+          break
+        batches.append([np.asarray(c) for c in cats_b])
+    finally:
+      # release the throwaway reader's prefetch thread + fds now rather
+      # than carrying them through the whole training run
+      if hasattr(cal_ds, 'close'):
+        cal_ds.close()
+    hot_sets = hotcache.calibrate_hot_sets(
+        cfgs, cal_ids, batches, coverage=args.hot_coverage,
+        budget_bytes=(int(args.hot_budget_mb * 2**20)
+                      if args.hot_budget_mb else None))
+    print(f'hot_cache: calibrated '
+          f'{sum(h.size for h in hot_sets.values())} hot rows over '
+          f'{len(hot_sets)} table(s) from {len(batches)} batch(es) '
+          f'(coverage target {args.hot_coverage})')
+
   model = DLRM(table_sizes=table_sizes,
                embedding_dim=args.embedding_dim,
                bottom_mlp_dims=[int(d) for d in args.bottom_mlp_dims.split(',')],
@@ -150,7 +216,8 @@ def main():
                dp_input=args.dp_input,
                param_dtype=jnp.dtype(args.param_dtype),
                compute_dtype=jnp.dtype(args.compute_dtype
-                                       or args.param_dtype))
+                                       or args.param_dtype),
+               hot_cache=hot_sets)
   params = model.init(0)
 
   if args.dp_input:

@@ -214,3 +214,122 @@ def test_fuzz_sparse_train_step(seed):
                                err_msg=f'seed {seed} table {t} '
                                f'({configs[t].combiner}, world {world}, '
                                f'row_thr {row_thr})')
+
+
+@pytest.mark.parametrize('seed', range(3))
+def test_fuzz_hot_cache_parity(seed):
+  """Frequency-aware hot cache (design §10) vs the baseline path over
+  fuzzed (plan, batch, hot-set) configurations: forward outputs are
+  BIT-EXACT f32 for hotness-1 inputs (multi-hot bags mixing hot and
+  cold ids re-associate the f32 bag fold — summation-order tolerance
+  only), and after 10 training steps the canonical weights and
+  optimizer state match within dtype tolerance."""
+  import optax
+  from distributed_embeddings_tpu.parallel import (SparseAdagrad, SparseSGD,
+                                                   get_optimizer_state,
+                                                   init_hybrid_train_state,
+                                                   make_hybrid_train_step)
+  from distributed_embeddings_tpu.parallel.hotcache import HotSet
+  rng = np.random.default_rng(3000 + seed)
+  world = int(rng.choice([2, 4, 8]))
+  two_axis = world >= 4 and rng.random() < 0.35
+  mesh = (create_mesh((2, world // 2)) if two_axis
+          else create_mesh(jax.devices()[:world]))
+  n_tables = world + int(rng.integers(0, 3))
+  configs = []
+  for _ in range(n_tables):
+    rows = int(rng.integers(16, 200))
+    width = int(rng.choice([4, 8, 16]))
+    configs.append(TableConfig(rows, width, rng.choice(['sum', 'mean'])))
+  sizes = [c.size for c in configs]
+  row_thr = (int(rng.integers(min(sizes), max(sizes) + 1))
+             if rng.random() < 0.5 else None)
+  # fuzzed hot sets: a random subset of tables, random sorted id sets
+  hot_sets = {}
+  for tid, c in enumerate(configs):
+    if rng.random() < 0.7:
+      k = int(rng.integers(1, max(2, c.input_dim // 3)))
+      ids = np.sort(rng.choice(c.input_dim, size=k, replace=False))
+      hot_sets[tid] = HotSet(tid, ids.astype(np.int64))
+  if not hot_sets:
+    hot_sets[0] = HotSet(0, np.array([0]))
+
+  def build(cache):
+    try:
+      return DistributedEmbedding(configs, mesh=mesh, row_slice=row_thr,
+                                  dp_input=True, hot_cache=cache)
+    except ValueError as e:
+      if 'Not enough table' in str(e):
+        pytest.skip(str(e))
+      raise
+
+  d_off, d_on = build(None), build(hot_sets)
+  weights = [
+      (rng.normal(size=(c.input_dim, c.output_dim)) * 0.1).astype(
+          np.float32) for c in configs
+  ]
+  batch = world * 2
+  ids = []
+  for c in configs:
+    h = int(rng.integers(1, 4))
+    x = rng.integers(0, c.input_dim, size=(batch, h)).astype(np.int32)
+    if h > 1:
+      x[rng.integers(0, batch), rng.integers(1, h)] = -1
+    if rng.random() < 0.5:
+      x[rng.integers(0, batch), 0] = c.input_dim + 2  # out-of-vocab
+    ids.append(x.squeeze(1) if h == 1 and rng.random() < 0.5 else x)
+  jids = [jnp.asarray(x) for x in ids]
+
+  # ---- forward parity ---------------------------------------------------
+  o_off = d_off.apply(set_weights(d_off, weights), jids)
+  o_on = d_on.apply(set_weights(d_on, weights), jids)
+  for t, (a, b) in enumerate(zip(o_off, o_on)):
+    hot1 = ids[t].ndim == 1 or ids[t].shape[1] == 1
+    if hot1:
+      # one id per sample: a position is either hot or cold, the other
+      # side contributes an exact zero — bit-exact
+      np.testing.assert_array_equal(
+          np.asarray(a), np.asarray(b),
+          err_msg=f'seed {seed} input {t} (world {world}, '
+          f'row_thr {row_thr}, two_axis {two_axis})')
+    else:
+      np.testing.assert_allclose(
+          np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6,
+          err_msg=f'seed {seed} input {t}')
+
+  # ---- 10-step optimizer-state parity -----------------------------------
+  opt = (SparseSGD(learning_rate=0.02) if rng.random() < 0.5
+         else SparseAdagrad(learning_rate=0.02,
+                            accum_dtype=str(rng.choice(
+                                ['float32', 'bfloat16']))))
+  total_w = sum(c.output_dim for c in configs)
+  kernel = jnp.asarray(
+      rng.standard_normal((total_w, 1)).astype(np.float32) * 0.1)
+  labels = jnp.asarray(rng.integers(0, 2, (batch, 1)).astype(np.float32))
+
+  def head_loss_fn(dense_params, emb_outs, b):
+    h = jnp.concatenate(list(emb_outs), axis=-1)
+    return jnp.mean((h @ dense_params['kernel'] - b)**2)
+
+  results = {}
+  for name, dist in (('off', d_off), ('on', d_on)):
+    state = init_hybrid_train_state(dist, {
+        'embedding': set_weights(dist, weights), 'kernel': kernel
+    }, optax.sgd(0.02), opt)
+    step = make_hybrid_train_step(dist, head_loss_fn, optax.sgd(0.02),
+                                  opt, donate=False)
+    for _ in range(10):
+      state, loss = step(state, jids, labels)
+    assert np.isfinite(float(loss))
+    results[name] = (get_weights(dist, state.params['embedding']),
+                     get_optimizer_state(dist, state.opt_state[1]))
+  for t in range(n_tables):
+    np.testing.assert_allclose(
+        results['off'][0][t], results['on'][0][t], rtol=2e-4, atol=3e-6,
+        err_msg=f'seed {seed} table {t} weights ({type(opt).__name__})')
+    for k in results['off'][1][t]:
+      np.testing.assert_allclose(
+          np.asarray(results['off'][1][t][k], np.float32),
+          np.asarray(results['on'][1][t][k], np.float32),
+          rtol=5e-3, atol=5e-4,
+          err_msg=f'seed {seed} table {t} state {k}')

@@ -1,0 +1,320 @@
+"""Frequency-aware hot cache (design §10): selection, runtime parity,
+split optimizer state, and the checkpoint canonicalization contract.
+
+The load-bearing claims pinned here:
+
+- the cached forward is BIT-EXACT vs the baseline for hotness-1 inputs
+  (including combiner=None), and exact modulo f32 bag-summation order
+  for multi-hot bags that mix hot and cold ids;
+- 10 training steps with the cache on land on the same canonical
+  weights/optimizer state as the baseline (both optimizers, bf16
+  accumulators included);
+- a checkpoint written under one hot set restores bit-exactly under a
+  DIFFERENT hot set and under no cache at all (hot membership is a
+  layout detail, never semantic).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from distributed_embeddings_tpu.parallel import (DistributedEmbedding,
+                                                 SparseAdagrad, SparseAdam,
+                                                 SparseSGD, TableConfig,
+                                                 create_mesh, get_weights,
+                                                 get_optimizer_state,
+                                                 init_hybrid_train_state,
+                                                 make_hybrid_train_step,
+                                                 set_optimizer_state,
+                                                 set_weights)
+from distributed_embeddings_tpu.parallel import hotcache
+from distributed_embeddings_tpu.parallel.hotcache import HotSet
+
+CONFIGS = [
+    TableConfig(100, 8, 'sum'),
+    TableConfig(64, 8, 'sum'),
+    TableConfig(200, 16, 'mean'),
+    TableConfig(50, 4, None),
+]
+HOT = {
+    0: HotSet(0, np.array([0, 1, 2, 3, 7, 11])),
+    2: HotSet(2, np.arange(20)),
+    3: HotSet(3, np.array([5, 49])),
+}
+
+
+def _weights(rng):
+  return [(rng.normal(size=(c.input_dim, c.output_dim)) * 0.1).astype(
+      np.float32) for c in CONFIGS]
+
+
+def _ids(rng, batch):
+  ids = []
+  for c in CONFIGS:
+    if c.combiner is None:
+      x = rng.integers(0, c.input_dim, size=(batch,)).astype(np.int32)
+    else:
+      x = rng.integers(0, c.input_dim, size=(batch, 3)).astype(np.int32)
+      x[rng.integers(0, batch), 1] = -1          # padding
+    ids.append(x)
+  ids[0][0, 0] = CONFIGS[0].input_dim + 3        # out-of-vocab
+  return ids
+
+
+class TestSelection:
+
+  def test_hotset_validation(self):
+    with pytest.raises(ValueError):
+      HotSet(0, np.array([3, 1, 2]))             # unsorted
+    with pytest.raises(ValueError):
+      HotSet(0, np.array([1, 1, 2]))             # duplicate
+    with pytest.raises(ValueError):
+      HotSet(0, np.array([-1, 2]))               # negative
+
+  def test_calibrate_counts_and_shared_tables(self):
+    cfgs = [TableConfig(10, 4, 'sum')]
+    # two inputs share the table: counts accumulate over both
+    batch = [np.array([[0, 0, 1]]), np.array([[0, 2, -1]])]
+    out = hotcache.calibrate_hot_sets(cfgs, [0, 0], [batch], coverage=0.6)
+    assert list(out[0].ids) == [0]               # 3/5 occurrences
+    out = hotcache.calibrate_hot_sets(cfgs, [0, 0], [batch], coverage=0.9)
+    assert list(out[0].ids) == [0, 1, 2]
+
+  def test_analytic_power_law_matches_sampled(self):
+    # the closed-form K covers what the sampled stream says it covers
+    from distributed_embeddings_tpu.models.synthetic import \
+        gen_power_law_data
+    rows, alpha = 5000, 1.05
+    k = hotcache.power_law_hot_k(rows, alpha, 0.8)
+    rng = np.random.default_rng(0)
+    ids = gen_power_law_data(rng, 20000, 1, rows, alpha).reshape(-1)
+    got = (ids < k).mean()
+    assert 0.75 < got < 0.88, (k, got)
+
+
+class TestForwardParity:
+
+  def _layers(self, mesh, **kw):
+    off = DistributedEmbedding(CONFIGS, mesh=mesh, dp_input=True, **kw)
+    on = DistributedEmbedding(CONFIGS, mesh=mesh, dp_input=True,
+                              hot_cache=HOT, **kw)
+    return off, on
+
+  @pytest.mark.parametrize('row_thr', [None, 600])
+  def test_forward_matches_baseline(self, row_thr):
+    mesh = create_mesh(jax.devices()[:4])
+    off, on = self._layers(mesh, row_slice=row_thr)
+    rng = np.random.default_rng(0)
+    w = _weights(rng)
+    p_on = set_weights(on, w)
+    p_off = set_weights(off, w)
+    ids = _ids(rng, 8)
+    o_off = off.apply(p_off, [jnp.asarray(x) for x in ids])
+    o_on = on.apply(p_on, [jnp.asarray(x) for x in ids])
+    for i, (a, b) in enumerate(zip(o_off, o_on)):
+      # multi-hot bags mixing hot and cold ids re-associate the f32
+      # h-axis fold (hot terms add after cold terms) — summation-order
+      # error only; hotness-1 inputs are bit-exact below
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                 rtol=1e-6, atol=1e-6,
+                                 err_msg=f'input {i}')
+    # combiner=None (hotness-1): a position is either hot or cold, the
+    # other side contributes an exact zero — bit-exact
+    np.testing.assert_array_equal(np.asarray(o_off[3]),
+                                  np.asarray(o_on[3]))
+
+  def test_init_is_canonical(self):
+    # cache-on init gathers its hot buffer FROM the shards: both
+    # layouts canonicalise to identical global tables
+    mesh = create_mesh(jax.devices()[:4])
+    off, on = self._layers(mesh)
+    w_off = get_weights(off, off.init(0))
+    w_on = get_weights(on, on.init(0))
+    for a, b in zip(w_off, w_on):
+      np.testing.assert_array_equal(a, b)
+
+  def test_requires_dp_input(self):
+    with pytest.raises(ValueError, match='dp_input'):
+      DistributedEmbedding(CONFIGS, mesh=create_mesh(jax.devices()[:2]),
+                           dp_input=False, hot_cache=HOT)
+
+  def test_sparse_adam_refuses_hot_cache(self):
+    mesh = create_mesh(jax.devices()[:2])
+    on = DistributedEmbedding(CONFIGS[:2], mesh=mesh, dp_input=True,
+                              hot_cache={0: HOT[0]})
+    with pytest.raises(ValueError, match='SparseAdam'):
+      SparseAdam().init(on, on.init(0))
+
+
+def _head_loss(dense_params, emb_outs, labels):
+  h = jnp.concatenate(list(emb_outs), axis=-1)
+  return jnp.mean((h @ dense_params['kernel'] - labels) ** 2)
+
+
+def _train(dist, opt, weights, kernel, labels, steps=10, batch=8):
+  params = {'embedding': set_weights(dist, weights), 'kernel': kernel}
+  state = init_hybrid_train_state(dist, params, optax.sgd(0.02), opt)
+  step = make_hybrid_train_step(dist, _head_loss, optax.sgd(0.02), opt,
+                                donate=False)
+  for s in range(steps):
+    rng = np.random.default_rng(100 + s)
+    ids = _ids(rng, batch)
+    state, loss = step(state, [jnp.asarray(x) for x in ids], labels)
+  assert np.isfinite(float(loss))
+  return state
+
+
+@pytest.mark.parametrize('optname', ['sgd', 'adagrad', 'adagrad_sq',
+                                     'adagrad_bf16'])
+def test_train_parity_10_steps(optname):
+  """Canonical weights + optimizer state match the baseline after 10
+  steps — the split hot/cold state is semantically invisible."""
+  mk = {
+      'sgd': lambda: SparseSGD(learning_rate=0.02),
+      'adagrad': lambda: SparseAdagrad(learning_rate=0.02),
+      'adagrad_sq': lambda: SparseAdagrad(learning_rate=0.02, dedup=False),
+      'adagrad_bf16': lambda: SparseAdagrad(learning_rate=0.02,
+                                            accum_dtype='bfloat16'),
+  }[optname]
+  mesh = create_mesh(jax.devices()[:4])
+  rng = np.random.default_rng(1)
+  weights = _weights(rng)
+  kernel = jnp.asarray(
+      rng.standard_normal((sum(c.output_dim for c in CONFIGS), 1)).astype(
+          np.float32) * 0.1)
+  labels = jnp.asarray(rng.integers(0, 2, (8, 1)).astype(np.float32))
+  states = {}
+  for name, cache in (('off', None), ('on', HOT)):
+    dist = DistributedEmbedding(CONFIGS, mesh=mesh, dp_input=True,
+                                row_slice=600, hot_cache=cache)
+    states[name] = (dist, _train(dist, mk(), weights, kernel, labels))
+  w_off = get_weights(*[states['off'][0], states['off'][1].params['embedding']])
+  w_on = get_weights(*[states['on'][0], states['on'][1].params['embedding']])
+  for t, (a, b) in enumerate(zip(w_off, w_on)):
+    np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-6,
+                               err_msg=f'{optname} table {t}')
+  s_off = get_optimizer_state(states['off'][0], states['off'][1].opt_state[1])
+  s_on = get_optimizer_state(states['on'][0], states['on'][1].opt_state[1])
+  for t, (a, b) in enumerate(zip(s_off, s_on)):
+    for k in a:
+      np.testing.assert_allclose(
+          np.asarray(a[k], np.float32), np.asarray(b[k], np.float32),
+          rtol=5e-3, atol=5e-4, err_msg=f'{optname} state {t}/{k}')
+
+
+def test_two_axis_mesh_parity():
+  """The cache composes with the (dcn x data) multi-slice topology:
+  hot grads psum over BOTH axes, cold streams ride the existing
+  cross-slice gather."""
+  cfgs = CONFIGS[:2]
+  hot = {0: HOT[0], 1: HotSet(1, np.arange(8))}
+  rng = np.random.default_rng(3)
+  weights = [(rng.normal(size=(c.input_dim, c.output_dim)) * 0.1).astype(
+      np.float32) for c in cfgs]
+  kernel = jnp.asarray(rng.standard_normal((16, 1)).astype(np.float32) * 0.1)
+  labels = jnp.asarray(rng.integers(0, 2, (16, 1)).astype(np.float32))
+  got = {}
+  for name, mesh in (('flat', create_mesh(jax.devices()[:2])),
+                     ('2ax', create_mesh((2, 2)))):
+    dist = DistributedEmbedding(cfgs, mesh=mesh, dp_input=True,
+                                hot_cache=hot)
+    opt = SparseAdagrad(learning_rate=0.05)
+    state = init_hybrid_train_state(
+        dist, {'embedding': set_weights(dist, weights), 'kernel': kernel},
+        optax.sgd(0.05), opt)
+    step = make_hybrid_train_step(dist, _head_loss, optax.sgd(0.05), opt,
+                                  donate=False)
+    ids = [np.random.default_rng(7).integers(
+        0, c.input_dim, size=(16, 2)).astype(np.int32) for c in cfgs]
+    for _ in range(5):
+      state, _ = step(state, [jnp.asarray(x) for x in ids], labels)
+    got[name] = get_weights(dist, state.params['embedding'])
+  for a, b in zip(got['flat'], got['2ax']):
+    np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+
+
+def test_checkpoint_across_hot_sets_bit_exact():
+  """The acceptance pin: train under hot set A, save the canonical
+  checkpoint, restore under (a) no cache and (b) a DIFFERENT hot set B
+  — forwards agree bit-exactly and optimizer state round-trips, so hot
+  membership is never observable in saved state."""
+  import os
+  import tempfile
+  from distributed_embeddings_tpu.parallel import (load_train_npz,
+                                                   save_train_npz)
+  mesh = create_mesh(jax.devices()[:4])
+  cfgs = [TableConfig(100, 8, 'sum'), TableConfig(64, 8, 'sum'),
+          TableConfig(50, 8, None)]
+  hsA = {0: HotSet(0, np.array([0, 1, 2, 3, 7, 11])),
+         1: HotSet(1, np.arange(10))}
+  hsB = {0: HotSet(0, np.array([40, 41, 42])),
+         2: HotSet(2, np.array([5, 9]))}
+  rng = np.random.default_rng(2)
+  weights = [(rng.normal(size=(c.input_dim, c.output_dim)) * 0.1).astype(
+      np.float32) for c in cfgs]
+  kernel = jnp.asarray(rng.standard_normal((24, 1)).astype(np.float32) * 0.1)
+  labels = jnp.asarray(rng.integers(0, 2, (8, 1)).astype(np.float32))
+  dA = DistributedEmbedding(cfgs, mesh=mesh, dp_input=True, hot_cache=hsA)
+  opt = SparseAdagrad(learning_rate=0.05)
+  state = init_hybrid_train_state(
+      dA, {'embedding': set_weights(dA, weights), 'kernel': kernel},
+      optax.sgd(0.05), opt)
+  step = make_hybrid_train_step(dA, _head_loss, optax.sgd(0.05), opt,
+                                donate=False)
+  ids = [rng.integers(0, c.input_dim, size=(8,)).astype(np.int32)
+         for c in cfgs]
+  for _ in range(3):
+    state, _ = step(state, [jnp.asarray(x) for x in ids], labels)
+
+  wA = get_weights(dA, state.params['embedding'])
+  sA = get_optimizer_state(dA, state.opt_state[1])
+  with tempfile.TemporaryDirectory() as td:
+    path = os.path.join(td, 'ck.npz')
+    save_train_npz(path, wA, sA, plan=dA)
+    # the file carries only canonical per-table arrays — no hot leaves
+    with np.load(path) as data:
+      assert not any('hot' in k for k in data.files), data.files
+    wl, sl, _ = load_train_npz(path)
+
+  outs = {}
+  for name, cache in (('off', None), ('B', hsB)):
+    d2 = DistributedEmbedding(cfgs, mesh=mesh, dp_input=True,
+                              hot_cache=cache)
+    p2 = set_weights(d2, wl)
+    outs[name] = [np.asarray(x)
+                  for x in d2.apply(p2, [jnp.asarray(x) for x in ids])]
+    s2 = set_optimizer_state(d2, SparseAdagrad(learning_rate=0.05).init(
+        d2, p2), sl)
+    for t, entry in enumerate(get_optimizer_state(d2, s2)):
+      np.testing.assert_array_equal(np.asarray(sA[t]['acc']),
+                                    np.asarray(entry['acc']))
+  oA = dA.apply(state.params['embedding'], [jnp.asarray(x) for x in ids])
+  for a, b, c in zip(outs['off'], outs['B'], oA):
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(a, np.asarray(c))
+
+
+def test_exchange_counters_consistency():
+  """The journaled counters cross-check: hit + cold fractions sum to 1,
+  rows sent never exceed the occurrence count, and the cache only ever
+  shrinks both exchanged rows and scatter rows."""
+  mesh = create_mesh(jax.devices()[:4])
+  dist = DistributedEmbedding(CONFIGS, mesh=mesh, dp_input=True,
+                              hot_cache=HOT, row_slice=600)
+  rng = np.random.default_rng(5)
+  cats = _ids(rng, 16)
+  c = hotcache.measure_exchange_counters(dist, cats)
+  assert abs(c['hot_hit_rate'] + c['cold_occurrence_fraction'] - 1.0) \
+      < 1e-6, c
+  assert c['alltoall_rows_sent'] <= c['alltoall_rows_sent_off']
+  assert c['scatter_rows_per_step'] <= c['scatter_rows_per_step_off']
+  assert 0 < c['hot_hit_rate'] < 1
+  # cache-less layers: identical off/on counters, zero hit rate
+  off = DistributedEmbedding(CONFIGS, mesh=mesh, dp_input=True,
+                             row_slice=600)
+  c0 = hotcache.measure_exchange_counters(off, cats, hot_sets={})
+  assert c0['hot_hit_rate'] == 0.0
+  assert c0['alltoall_rows_sent'] <= c0['alltoall_rows_sent_off']

@@ -5,8 +5,11 @@ integration tests (`tests/dist_model_parallel_test.py`); here the planner is
 pure Python and device-free, so its semantics are tested directly.
 """
 
+import numpy as np
 import pytest
 
+from distributed_embeddings_tpu.parallel.hotcache import (HotSet,
+                                                          select_hot_rows)
 from distributed_embeddings_tpu.parallel.planner import (
     TableConfig, ShardingPlan, slice_table_column, auto_column_slice_threshold,
     apply_strategy)
@@ -229,6 +232,80 @@ class TestShardingPlan:
     plan = ShardingPlan(configs, world_size=2)
     assert len(plan.widths_list_flat) == 3
     assert all(w == 4 for w in plan.widths_list_flat)
+
+
+class TestHotSetSelection:
+  """Frequency-aware hot-row selection (parallel/hotcache.py) and the
+  planner's hot-buffer layout + fingerprint (design §10)."""
+
+  def test_coverage_target_honored(self):
+    counts = np.array([50, 30, 10, 5, 3, 2])  # total 100
+    assert list(select_hot_rows(counts, 0.5)) == [0]
+    assert list(select_hot_rows(counts, 0.8)) == [0, 1]
+    assert list(select_hot_rows(counts, 0.9)) == [0, 1, 2]
+    assert list(select_hot_rows(counts, 1.0)) == [0, 1, 2, 3, 4, 5]
+
+  def test_memory_budget_clamps_k(self):
+    counts = np.array([50, 30, 10, 5, 3, 2])
+    assert list(select_hot_rows(counts, 1.0, max_rows=2)) == [0, 1]
+    assert select_hot_rows(counts, 1.0, max_rows=0).size == 0
+
+  def test_deterministic_tie_breaks(self):
+    # equal counts break toward the SMALLER id, so every host agrees:
+    # the two 9s (ids 1, 3) rank first; the tie among the 5s (ids 0, 2,
+    # 4) resolves in ascending id order
+    counts = np.array([5, 9, 5, 9, 5])
+    assert list(select_hot_rows(counts, 0.5)) == [1, 3]
+    assert list(select_hot_rows(counts, 0.66)) == [0, 1, 3]
+    assert list(select_hot_rows(counts, 0.7)) == [0, 1, 2, 3]
+
+  def test_zero_count_rows_never_selected(self):
+    counts = np.array([0, 10, 0])
+    assert list(select_hot_rows(counts, 1.0)) == [1]
+
+  def test_plan_carries_hot_layout(self):
+    configs = make_configs([40, 30], width=4, combiner='sum')
+    hs = {0: HotSet(0, np.array([1, 5, 9])), 1: HotSet(1, np.array([0]))}
+    plan = ShardingPlan(configs, world_size=2, hot_sets=hs)
+    assert plan.hot_groups  # at least one group carries a hot buffer
+    total = sum(k for g in plan.groups for *_, k in g.hot_chunks)
+    assert total == 4
+    for g in plan.groups:
+      if g.hot_chunks:
+        assert g.hot_rows_cap % 8 == 0
+        # every hot row is owned by exactly one device
+        owned = sum(d.size for d in g.hot_owner_dst)
+        assert owned == sum(k for *_, k in g.hot_chunks)
+        all_dst = np.concatenate([d for d in g.hot_owner_dst])
+        assert np.unique(all_dst).size == all_dst.size
+
+  def test_hot_set_validation(self):
+    configs = make_configs([10], width=4)
+    with pytest.raises(ValueError, match='past input_dim'):
+      ShardingPlan(configs, world_size=1,
+                   hot_sets=[HotSet(0, np.array([10]))])
+    with pytest.raises(ValueError, match='out of range'):
+      ShardingPlan(configs, world_size=1,
+                   hot_sets=[HotSet(3, np.array([0]))])
+
+  def test_fingerprint_sensitive_to_hot_set(self):
+    configs = make_configs([40, 30], width=4, combiner='sum')
+    base = ShardingPlan(configs, world_size=2)
+    a = ShardingPlan(configs, world_size=2,
+                     hot_sets=[HotSet(0, np.array([1, 5]))])
+    b = ShardingPlan(configs, world_size=2,
+                     hot_sets=[HotSet(0, np.array([1, 6]))])
+    # the PHYSICAL plan fingerprint separates all three...
+    assert len({base.fingerprint(), a.fingerprint(), b.fingerprint()}) == 3
+    # ...and is stable for an identical plan
+    a2 = ShardingPlan(configs, world_size=2,
+                      hot_sets=[HotSet(0, np.array([1, 5]))])
+    assert a.fingerprint() == a2.fingerprint()
+    # while the CHECKPOINT fingerprint (logical table set) ignores hot
+    # membership by design: files reshard across hot sets
+    from distributed_embeddings_tpu.parallel.checkpoint import \
+        plan_fingerprint
+    assert plan_fingerprint(base) == plan_fingerprint(a)
 
 
 class TestCapacityPaddingFootprint:
