@@ -262,6 +262,18 @@ def main():
                       'off/on plus both step times (the headline value '
                       'stays the cache-OFF number, comparable with '
                       'prior rounds)')
+  parser.add_argument('--overlap_chunks', type=int, default=None,
+                      help='chunked dp<->mp exchange A/B (parallel/'
+                      'overlap.py, design §11): split each subgroup\'s '
+                      'exchange buffers into k static slot chunks and '
+                      'software-pipeline collective against compute.  '
+                      'The HEADLINE number stays the monolithic '
+                      '(chunks=1, program-identical to pre-chunking) '
+                      'step; the artifact journals a2a_off_ms / '
+                      'a2a_on_ms / a2a_exchange_ms (directly measured '
+                      'exchange-only wall) and the derived '
+                      'a2a_overlap_pct.  Default: 4 for the sparse '
+                      'trainer off the sparsecore path; 1 skips the A/B')
   parser.add_argument('--hot_coverage', type=float, default=0.85,
                       help='per-table occurrence coverage target for the '
                       'hot set (0.85 measured: 8.5x fewer exchanged '
@@ -346,6 +358,23 @@ def main():
                        '(--alpha > 0): uniform ids have no head to '
                        'cache, and the analytic hot set would replicate '
                        'coverage*rows of every table')
+  use_chunks = args.overlap_chunks
+  if use_chunks is None:
+    use_chunks = (4 if (args.trainer == 'sparse'
+                        and args.lookup_impl != 'sparsecore') else 1)
+  elif use_chunks > 1:
+    # explicit --overlap_chunks: fail fast (same discipline as
+    # --hot_cache) instead of journaling an artifact without the
+    # requested measurement
+    if args.trainer != 'sparse':
+      raise SystemExit('--overlap_chunks > 1 requires --trainer sparse '
+                       '(the chunked pipeline lives in the sparse '
+                       'dp<->mp exchange)')
+    if args.lookup_impl == 'sparsecore':
+      raise SystemExit('--overlap_chunks > 1 is incompatible with '
+                       '--lookup_impl sparsecore (that path pipelines '
+                       'through the static-CSR host feed; design §11 '
+                       'refusal matrix)')
   model = SyntheticModel(config,
                          mesh=mesh,
                          dp_input=True,
@@ -628,6 +657,70 @@ def main():
       hot_stats = (hot_stats or {})
       hot_stats['hot_cache_error'] = f'{type(e).__name__}: {e}'
 
+  # Chunked-exchange overlap A/B (parallel/overlap.py, design §11;
+  # ISSUE 6).  Three directly-measured numbers: the OFF arm is the
+  # headline step itself (overlap_chunks=1 IS the monolithic program —
+  # the official number doubles as the A/B baseline, so the off arm is
+  # program-identical to pre-chunking by construction); the ON arm
+  # re-measures the same step built with overlap_chunks=k under the
+  # same warmup discipline and min-of-k windows; the DENOMINATOR is the
+  # exchange-only wall (measure_exchange_ms: the chunked id/row
+  # collectives with no lookup/combine between them).  a2a_overlap_pct
+  # = (off - on) / exchange — the hidden fraction of the exchange wall,
+  # measured the same way csr_feed_overlap_pct prices the host build.
+  # Never fatal.
+  a2a_stats = None
+  if use_chunks > 1 and args.trainer == 'sparse':
+    try:
+      from distributed_embeddings_tpu.parallel import overlap as overlap_lib
+      exchange_ms = overlap_lib.measure_exchange_ms(
+          model.dist_embedding, [jnp.asarray(c) for c in cats0], chunks=1)
+      model_chk = SyntheticModel(config,
+                                 mesh=mesh,
+                                 dp_input=True,
+                                 row_slice=args.row_slice,
+                                 param_dtype=jnp.dtype(args.param_dtype),
+                                 compute_dtype=compute_dtype,
+                                 packed_storage=args.packed_storage,
+                                 lookup_impl=args.lookup_impl,
+                                 overlap_chunks=use_chunks)
+      chk_params = model_chk.init(0)
+      # chunking never changes the residual streams (bit-exact vs the
+      # monolithic program), so the headline run's calibrated
+      # capacities describe the chunked arm exactly — no recalibration
+      chk_raw = make_hybrid_train_step(model_chk.dist_embedding,
+                                       head_loss_fn, optimizer, emb_opt,
+                                       jit=False)
+      copts = ({'exec_time_optimization_effort': -1.0,
+                'memory_fitting_effort': -1.0}
+               if args.fast_compile else None)
+      chk_step = jax.jit(
+          lambda st, batch: chk_raw(st, list(batch[0][1]),
+                                    (batch[0][0], batch[1])),
+          donate_argnums=(0,), compiler_options=copts)
+      cstate = init_hybrid_train_state(model_chk.dist_embedding,
+                                       chk_params, optimizer, emb_opt)
+      for i in range(max(3, args.warmup)):
+        cstate, closs = chk_step(cstate, pool[i % len(pool)])
+      sync_loss(closs, 'chunked-exchange warmup sync')
+      chk_window_ms = []
+      i = 0
+      for wsteps in split_windows(args.steps, args.measure_windows):
+        t0 = time.perf_counter()
+        for _ in range(wsteps):
+          cstate, closs = chk_step(cstate, pool[i % len(pool)])
+          i += 1
+        sync_loss(closs, f'chunked-exchange window sync at step {i}')
+        chk_window_ms.append((time.perf_counter() - t0) / wsteps * 1000)
+      a2a_stats = overlap_lib.a2a_overlap_stats(
+          step_ms, min(chk_window_ms), exchange_ms, use_chunks,
+          group_chunks=overlap_lib.group_chunk_counts(
+              model_chk.dist_embedding.plan),
+          window_ms=chk_window_ms)
+      del cstate
+    except Exception as e:
+      a2a_stats = {'a2a_overlap_error': f'{type(e).__name__}: {e}'}
+
   n_dev = len(devices)
   backend = devices[0].platform
   # the baselines are AT global batch 65536: a reduced-batch chip run
@@ -701,6 +794,8 @@ def main():
     result.update(csr_stats)
   if hot_stats:
     result.update(hot_stats)
+  if a2a_stats:
+    result.update(a2a_stats)
   if on_cpu:
     # a sweep window may have landed an on-chip line earlier this round;
     # carry it (labelled, with its own sha/timestamp) so the artifact is

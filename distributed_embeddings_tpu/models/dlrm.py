@@ -115,6 +115,10 @@ class DLRM:
       ``DistributedEmbedding`` (``parallel/hotcache.py``; calibrate
       with ``hotcache.calibrate_hot_sets`` over sample batches).
       Requires ``dp_input=True``.
+    overlap_chunks: chunked dp<->mp exchange with compute-collective
+      overlap, forwarded to ``DistributedEmbedding`` (docs/design.md
+      §11).  1 (default) is the monolithic program; requires
+      ``dp_input=True`` when > 1.
   """
   table_sizes: Sequence[int]
   embedding_dim: int = 128
@@ -129,6 +133,7 @@ class DLRM:
   param_dtype: Any = jnp.float32
   compute_dtype: Any = jnp.float32
   hot_cache: Any = None
+  overlap_chunks: int = 1
 
   def __post_init__(self):
     if self.bottom_mlp_dims[-1] != self.embedding_dim:
@@ -156,7 +161,8 @@ class DLRM:
         mesh=self.mesh,
         param_dtype=self.param_dtype,
         compute_dtype=self.compute_dtype,
-        hot_cache=self.hot_cache)
+        hot_cache=self.hot_cache,
+        overlap_chunks=self.overlap_chunks)
 
   @property
   def num_interaction_features(self) -> int:

@@ -244,6 +244,10 @@ class SyntheticModel:
       hot-row sets (``parallel/hotcache.py``; the synthetic power-law
       generators have a closed-form selection,
       ``analytic_power_law_hot_sets``).  Requires ``dp_input=True``.
+    overlap_chunks: forwarded to ``DistributedEmbedding`` — chunked
+      dp<->mp exchange with compute-collective overlap (docs/design.md
+      §11).  1 (default) is the monolithic program; requires
+      ``dp_input=True`` when > 1.
   """
   config: ModelConfig
   mesh: Optional[Mesh] = None
@@ -256,6 +260,7 @@ class SyntheticModel:
   packed_storage: bool = True
   lookup_impl: str = 'auto'
   hot_cache: Any = None
+  overlap_chunks: int = 1
 
   def __post_init__(self):
     tables, input_table_map, hotness = expand_tables(self.config)
@@ -273,7 +278,8 @@ class SyntheticModel:
         compute_dtype=self.compute_dtype,
         packed_storage=self.packed_storage,
         lookup_impl=self.lookup_impl,
-        hot_cache=self.hot_cache)
+        hot_cache=self.hot_cache,
+        overlap_chunks=self.overlap_chunks)
     total_width = sum(
         tables[t].output_dim for t in input_table_map)
     if self.config.interact_stride is not None:

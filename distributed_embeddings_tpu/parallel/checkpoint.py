@@ -353,8 +353,16 @@ def get_optimizer_state(dist: DistributedEmbedding,
         g = plan.groups[gi]
         for tid, cs, ce, off, cnt in g.hot_chunks:
           ids = plan.hot_sets[tid].ids
-          if k in result[tid] and result[tid][k].ndim == 2:
+          if k not in result[tid]:
+            continue
+          if result[tid][k].ndim == 2:
             result[tid][k][ids, cs:ce] = buf[off:off + cnt].astype(
+                result[tid][k].dtype)
+          elif result[tid][k].ndim == 1:
+            # per-row leaf (e.g. SparseAdam's step counter 't'):
+            # identical across column slices, so chunks of different
+            # column ranges overwrite with the same values
+            result[tid][k][ids] = buf[off:off + cnt].astype(
                 result[tid][k].dtype)
   return result
 
@@ -426,14 +434,18 @@ def set_optimizer_state(dist: DistributedEmbedding,
     new_state[hkey] = {}
     g = plan.groups[gi]
     for k, tmpl in opt_state[hkey].items():
-      buf = np.zeros((g.hot_rows_cap, g.width), tmpl.dtype)
+      shape = ((g.hot_rows_cap, g.width) if tmpl.ndim == 2
+               else (g.hot_rows_cap,))
+      buf = np.zeros(shape, tmpl.dtype)
       for tid, cs, ce, off, cnt in g.hot_chunks:
         ids = plan.hot_sets[tid].ids
         st = table_states[tid].get(k) if tid < len(table_states) else None
         if st is not None:
-          buf[off:off + cnt] = np.asarray(
-              np.asarray(st)[ids, cs:ce], dtype=tmpl.dtype)
-      sharding = NamedSharding(dist.mesh, P(None, None))
+          st = np.asarray(st)
+          # per-row [rows] leaves (SparseAdam 't') slice by id only
+          sl = st[ids, cs:ce] if tmpl.ndim == 2 else st[ids]
+          buf[off:off + cnt] = np.asarray(sl, dtype=tmpl.dtype)
+      sharding = NamedSharding(dist.mesh, P(*([None] * tmpl.ndim)))
       new_state[hkey][k] = jax.make_array_from_callback(
           buf.shape, sharding, lambda index, buf=buf: buf[index])
   return new_state

@@ -43,6 +43,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_embeddings_tpu.ops.ragged import RaggedBatch
 from distributed_embeddings_tpu.parallel import mesh as mesh_lib
+from distributed_embeddings_tpu.parallel.overlap import (chunk_bounds,
+                                                         effective_chunks)
 from distributed_embeddings_tpu.parallel.planner import (GroupSpec,
                                                          ShardingPlan,
                                                          TableConfig)
@@ -124,6 +126,21 @@ class DistributedEmbedding:
       measurement is never silently something else);
       'custom_call' demands the real binding; 'emulate' forces the
       emulation anywhere.
+    overlap_chunks: split each subgroup's dp<->mp exchange buffers into
+      this many static chunks along the SLOT axis and software-pipeline
+      them — chunk k's ``all_to_all`` is issued while chunk k-1's local
+      gather/combine (forward) or segment-sum (backward) executes, so
+      XLA's latency-hiding scheduler can overlap collective and compute
+      (docs/design.md §11).  Slots are independent, so the chunked
+      program is BIT-EXACT vs the monolithic one; ``overlap_chunks=1``
+      (default) IS the monolithic program.  Refusal matrix (§11):
+      requires ``dp_input=True``; incompatible with
+      ``lookup_impl='sparsecore'`` (that path's pipelining is the
+      static-CSR host feed); incompatible with row-sliced tables
+      UNLESS ``hot_cache`` is on (the uncached forward merges row-shard
+      outputs through per-input ``psum_scatter`` slots that have no
+      chunk-aligned exchange; the cached forward's row shards ride the
+      slot exchange and chunk fine).
   """
 
   def __init__(self,
@@ -142,7 +159,8 @@ class DistributedEmbedding:
                mod_sharding: Optional[bool] = None,
                num_sc: int = 4,
                sparsecore_backend: str = 'auto',
-               hot_cache=None):
+               hot_cache=None,
+               overlap_chunks: int = 1):
     if row_slice is not None and (isinstance(row_slice, bool)
                                   or not isinstance(row_slice,
                                                     (int, np.integer))):
@@ -190,6 +208,25 @@ class DistributedEmbedding:
     self.compute_dtype = jnp.dtype(compute_dtype or param_dtype)
 
     self.table_configs = _as_table_configs(embeddings)
+    if (isinstance(overlap_chunks, bool)
+        or not isinstance(overlap_chunks, (int, np.integer))
+        or overlap_chunks < 1):
+      raise ValueError(
+          f'overlap_chunks must be an int >= 1, got {overlap_chunks!r}')
+    overlap_chunks = int(overlap_chunks)
+    if overlap_chunks > 1 and not dp_input:
+      raise ValueError(
+          'overlap_chunks > 1 requires dp_input=True: the chunked '
+          'pipeline overlaps the dp->mp id exchange, which the '
+          'model-parallel input path does not have')
+    if overlap_chunks > 1 and lookup_impl == 'sparsecore':
+      raise ValueError(
+          "overlap_chunks > 1 is incompatible with "
+          "lookup_impl='sparsecore': the SparseCore path pipelines "
+          'through the static-CSR host feed (design §8); chunking its '
+          'TensorCore fallback would measure the wrong program. Use '
+          "lookup_impl='auto' with overlap_chunks, or overlap_chunks=1 "
+          'for the SparseCore path.')
     if hot_cache and not dp_input:
       raise ValueError(
           'hot_cache requires dp_input=True: the cache partitions the '
@@ -211,8 +248,19 @@ class DistributedEmbedding:
                              packed_storage=packed_storage,
                              mod_sharding=mod_sharding,
                              num_sc=num_sc,
-                             hot_sets=hot_cache)
+                             hot_sets=hot_cache,
+                             overlap_chunks=overlap_chunks)
     self.hot_enabled = bool(self.plan.hot_sets)
+    self.overlap_chunks = self.plan.overlap_chunks
+    if overlap_chunks > 1 and any(self.plan.row_sliced) \
+        and not self.hot_enabled:
+      raise ValueError(
+          'overlap_chunks > 1 with row-sliced tables requires '
+          'hot_cache: the uncached forward merges row-shard outputs '
+          'through per-input psum_scatter slots whose exchange has no '
+          'chunk alignment (docs/design.md §11 refusal matrix). '
+          'Enable hot_cache (its row shards ride the chunked slot '
+          'exchange), disable row_slice, or set overlap_chunks=1.')
     self._hot_meta_cache = None
     self.num_inputs = len(self.plan.input_table_map)
     if lookup_impl == 'sparsecore':
@@ -858,6 +906,61 @@ class DistributedEmbedding:
             lambda dev, s, sub=sub: (sub.requests[dev][s].input_id
                                      if s < len(sub.requests[dev]) else -1),
             _ids)
+        n_chunks = effective_chunks(self.overlap_chunks, sub.n_cap)
+        if n_chunks > 1:
+          # Chunked software-pipelined exchange (docs/design.md §11):
+          # the slot axis splits into static chunks; chunk k's dp->mp
+          # all_to_all is issued BEFORE chunk k-1's route/lookup/return
+          # leg is traced, so the collective and the previous chunk's
+          # compute carry no dependency and XLA's latency-hiding
+          # scheduler can run them concurrently.  Slots are independent
+          # — the concatenated chunk outputs are bit-identical to the
+          # monolithic buffers (row-sliced plans, whose psum_scatter
+          # merge slots would break that alignment, refuse chunking at
+          # construction, so every slot rides the a2a buffer here).
+          assert not sub.merge_inputs and not sub.mean_row_sliced
+          table = params[f'group_{sub.gi}'][0]
+          rows_cap = self.plan.groups[sub.gi].rows_cap
+          spack = self.plan.groups[sub.gi].storage_pack
+          w = sub.group.width
+          offs = jnp.asarray(sub.offsets)[me]
+          voc = jnp.asarray(sub.vocab)[me]
+          rlo = jnp.asarray(sub.row_lo)[me]
+          rhi = jnp.asarray(sub.row_hi)[me]
+          rst = (jnp.asarray(sub.row_stride)[me]
+                 if sub.has_mod_windows else None)
+          routed_parts, back_parts = [], []
+
+          def process(lo, hi, recv_c, sub=sub, h=h, table=table,
+                      rows_cap=rows_cap, spack=spack, w=w, offs=offs,
+                      voc=voc, rlo=rlo, rhi=rhi, rst=rst,
+                      routed_parts=routed_parts, back_parts=back_parts):
+            ids_c = recv_c.transpose(1, 0, 2, 3).reshape(
+                hi - lo, slice_batch, h)
+            routed_c = _route_ids(ids_c, offs[lo:hi], voc[lo:hi],
+                                  rows_cap, rlo[lo:hi], rhi[lo:hi],
+                                  rst[lo:hi] if rst is not None else None)
+            out_c = self._lookup(table, routed_c, sub.lookup_combiner,
+                                 pack=spack)
+            routed_parts.append(routed_c)
+            back_c = out_c.reshape(hi - lo, D, local_batch,
+                                   w).transpose(1, 0, 2, 3)
+            if D > 1:
+              back_c = jax.lax.all_to_all(back_c, self.axis_name, 0, 0)
+            back_parts.append(back_c)
+
+          pending = None
+          for lo, hi in chunk_bounds(sub.n_cap, n_chunks):
+            recv_c = (jax.lax.all_to_all(send[:, lo:hi], self.axis_name,
+                                         0, 0) if D > 1
+                      else send[:, lo:hi])
+            if pending is not None:
+              process(*pending)
+            pending = (lo, hi, recv_c)
+          process(*pending)
+          residuals.append(jnp.concatenate(routed_parts, axis=0)[None])
+          sub_back.append(jnp.concatenate(back_parts, axis=1))
+          continue
         # --- dp -> mp all_to_all (reference hvd.alltoall 'inp_dp_to_mp',
         # dist_model_parallel.py:404) -------------------------------------
         recv = (jax.lax.all_to_all(send, self.axis_name, 0, 0)
@@ -1031,7 +1134,8 @@ class DistributedEmbedding:
     return outs, residuals, (batch, hotness)
 
   def backward_to_mp(self, d_outs, global_batch: int, hotness: tuple,
-                     cats=None, with_sq: bool = False):
+                     cats=None, with_sq: bool = False,
+                     with_touch: bool = False):
     """Transpose output cotangents back to per-subgroup mp-side grads.
 
     The manual transpose of the forward's output path (mp->dp all_to_all +
@@ -1066,6 +1170,9 @@ class DistributedEmbedding:
       cats: the forward's embedding inputs (hot-cache layers only).
       with_sq: also produce per-occurrence squared-grad channels
         (per-occurrence Adagrad semantics; hot-cache layers only).
+      with_touch: also produce a trailing occurrence-count column on
+        the replicated hot-grad buffers (the touched-row mask lazy
+        Adam's dense hot apply needs; hot-cache layers only).
 
     Returns:
       Tuple of per-subgroup ``[D, n_cap, GB, w]`` grads, mesh-sharded on
@@ -1078,7 +1185,8 @@ class DistributedEmbedding:
                          'inputs rebuild the unique cold streams)')
       inputs, _, _ = self._prepare_inputs(cats)
       bwd = self._build_backward_hot(global_batch, tuple(hotness),
-                                     with_sq=with_sq)
+                                     with_sq=with_sq,
+                                     with_touch=with_touch)
       flat = bwd(*d_outs, *inputs)
       n_subs = len(self._subgroups(tuple(hotness)))
       return tuple(flat[:n_subs]), {
@@ -1123,7 +1231,20 @@ class DistributedEmbedding:
             return d_outs[k[0]][:, k[1]:k[2]]
 
           drecv = _gather_slots(D, n_slots, key_of, val_of)
-          if D > 1:
+          n_chunks = effective_chunks(self.overlap_chunks, n_slots)
+          if n_chunks > 1:
+            # chunked gradient exchange (design §11): the cotangent a2a
+            # splits along the slot axis into independent collectives
+            # the scheduler can overlap with the dense backward and the
+            # downstream per-chunk apply; the concatenation is
+            # bit-identical to the monolithic transfer (pure movement)
+            parts = []
+            for lo, hi in chunk_bounds(n_slots, n_chunks):
+              p = drecv[:, lo:hi]
+              parts.append(jax.lax.all_to_all(p, self.axis_name, 0, 0)
+                           if D > 1 else p)
+            drecv = jnp.concatenate(parts, axis=1)
+          elif D > 1:
             drecv = jax.lax.all_to_all(drecv, self.axis_name, 0, 0)
           return drecv.transpose(1, 0, 2, 3).reshape(
               n_slots, slice_batch, w)
@@ -1309,33 +1430,93 @@ class DistributedEmbedding:
         uniq, inv = _unique_with_inverse(
             send.reshape(D * sub.n_cap, U), U)
         send_u = uniq.reshape(D, sub.n_cap, U)
-        recv = (jax.lax.all_to_all(send_u, self.axis_name, 0, 0)
-                if D > 1 else send_u)
-        ids_u = recv.transpose(1, 0, 2).reshape(sub.n_cap, D * U)
-        routed = _route_ids(ids_u[..., None],
-                            jnp.asarray(sub.offsets)[me],
-                            jnp.asarray(sub.vocab)[me], rows_cap,
-                            jnp.asarray(sub.row_lo)[me],
-                            jnp.asarray(sub.row_hi)[me],
-                            (jnp.asarray(sub.row_stride)[me]
-                             if sub.has_mod_windows else None))
-        # one row gather per distinct id (combiner=None == masked
-        # row fetch); out-of-window ids of row shards return zero, so
-        # slot partials sum to the whole at the source
-        rows = self._lookup(params[f'group_{sub.gi}'][0], routed, None,
-                            pack=plan.groups[sub.gi].storage_pack)
-        if with_residuals:
-          residuals.append(routed[None])
-        back = rows.reshape(sub.n_cap, D, U, w).transpose(1, 0, 2, 3)
-        if D > 1:
-          back = jax.lax.all_to_all(back, self.axis_name, 0, 0)
-        rows_ext = jnp.concatenate(
-            [back, jnp.zeros((D, sub.n_cap, 1, w), back.dtype)], axis=2)
-        occ = jnp.take_along_axis(
-            rows_ext, inv.reshape(D, sub.n_cap, U)[..., None], axis=2)
-        comb = jnp.sum(
-            occ.reshape(D, sub.n_cap, local_batch, h, w).astype(
-                jnp.float32), axis=3)
+        n_chunks = effective_chunks(self.overlap_chunks, sub.n_cap)
+        if n_chunks > 1:
+          # chunked cold exchange (design §11): the per-(source, slot)
+          # dedup above is slot-local, so the slot axis chunks exactly
+          # like the uncached path — chunk k's a2a is issued before
+          # chunk k-1's gather/inverse-scatter/combine is traced, and
+          # the concatenated per-chunk combines are bit-identical to
+          # the monolithic comb (row shards included: their
+          # out-of-window rows come back zero per slot, not per merge)
+          offs = jnp.asarray(sub.offsets)[me]
+          voc = jnp.asarray(sub.vocab)[me]
+          rlo = jnp.asarray(sub.row_lo)[me]
+          rhi = jnp.asarray(sub.row_hi)[me]
+          rst = (jnp.asarray(sub.row_stride)[me]
+                 if sub.has_mod_windows else None)
+          inv3 = inv.reshape(D, sub.n_cap, U)
+          table = params[f'group_{sub.gi}'][0]
+          spack = plan.groups[sub.gi].storage_pack
+          routed_parts, comb_parts = [], []
+
+          def process(lo, hi, recv_c, sub=sub, h=h, U=U, w=w,
+                      rows_cap=rows_cap, table=table, spack=spack,
+                      offs=offs, voc=voc, rlo=rlo, rhi=rhi, rst=rst,
+                      inv3=inv3, routed_parts=routed_parts,
+                      comb_parts=comb_parts):
+            ids_c = recv_c.transpose(1, 0, 2).reshape(hi - lo, D * U)
+            routed_c = _route_ids(ids_c[..., None], offs[lo:hi],
+                                  voc[lo:hi], rows_cap, rlo[lo:hi],
+                                  rhi[lo:hi],
+                                  rst[lo:hi] if rst is not None else None)
+            rows_c = self._lookup(table, routed_c, None, pack=spack)
+            routed_parts.append(routed_c)
+            back_c = rows_c.reshape(hi - lo, D, U,
+                                    w).transpose(1, 0, 2, 3)
+            if D > 1:
+              back_c = jax.lax.all_to_all(back_c, self.axis_name, 0, 0)
+            rows_ext_c = jnp.concatenate(
+                [back_c, jnp.zeros((D, hi - lo, 1, w), back_c.dtype)],
+                axis=2)
+            occ_c = jnp.take_along_axis(rows_ext_c,
+                                        inv3[:, lo:hi][..., None],
+                                        axis=2)
+            comb_parts.append(
+                jnp.sum(
+                    occ_c.reshape(D, hi - lo, local_batch, h, w).astype(
+                        jnp.float32), axis=3))
+
+          pending = None
+          for lo, hi in chunk_bounds(sub.n_cap, n_chunks):
+            recv_c = (jax.lax.all_to_all(send_u[:, lo:hi],
+                                         self.axis_name, 0, 0)
+                      if D > 1 else send_u[:, lo:hi])
+            if pending is not None:
+              process(*pending)
+            pending = (lo, hi, recv_c)
+          process(*pending)
+          if with_residuals:
+            residuals.append(jnp.concatenate(routed_parts, axis=0)[None])
+          comb = jnp.concatenate(comb_parts, axis=1)
+        else:
+          recv = (jax.lax.all_to_all(send_u, self.axis_name, 0, 0)
+                  if D > 1 else send_u)
+          ids_u = recv.transpose(1, 0, 2).reshape(sub.n_cap, D * U)
+          routed = _route_ids(ids_u[..., None],
+                              jnp.asarray(sub.offsets)[me],
+                              jnp.asarray(sub.vocab)[me], rows_cap,
+                              jnp.asarray(sub.row_lo)[me],
+                              jnp.asarray(sub.row_hi)[me],
+                              (jnp.asarray(sub.row_stride)[me]
+                               if sub.has_mod_windows else None))
+          # one row gather per distinct id (combiner=None == masked
+          # row fetch); out-of-window ids of row shards return zero, so
+          # slot partials sum to the whole at the source
+          rows = self._lookup(params[f'group_{sub.gi}'][0], routed, None,
+                              pack=plan.groups[sub.gi].storage_pack)
+          if with_residuals:
+            residuals.append(routed[None])
+          back = rows.reshape(sub.n_cap, D, U, w).transpose(1, 0, 2, 3)
+          if D > 1:
+            back = jax.lax.all_to_all(back, self.axis_name, 0, 0)
+          rows_ext = jnp.concatenate(
+              [back, jnp.zeros((D, sub.n_cap, 1, w), back.dtype)], axis=2)
+          occ = jnp.take_along_axis(
+              rows_ext, inv.reshape(D, sub.n_cap, U)[..., None], axis=2)
+          comb = jnp.sum(
+              occ.reshape(D, sub.n_cap, local_batch, h, w).astype(
+                  jnp.float32), axis=3)
         for dev in range(D):
           for s, r in enumerate(sub.requests[dev]):
             k = (r.input_id, r.col_start, r.col_end)
@@ -1399,7 +1580,8 @@ class DistributedEmbedding:
     return specs
 
   def _build_backward_hot(self, global_batch: int, hotness: tuple,
-                          with_sq: bool = False):
+                          with_sq: bool = False,
+                          with_touch: bool = False):
     """Transpose of the hot-cache forward.
 
     Cold: rebuild the per-(source, slot) unique streams from the raw
@@ -1415,7 +1597,7 @@ class DistributedEmbedding:
     ``with_sq`` both streams carry a second ``w``-column block of
     per-occurrence squared grads (per-occurrence Adagrad semantics).
     """
-    key = ('bwd_hot', global_batch, hotness, with_sq)
+    key = ('bwd_hot', global_batch, hotness, with_sq, with_touch)
     if key in self._fn_cache:
       return self._fn_cache[key]
     D = self.world_size
@@ -1489,7 +1671,19 @@ class DistributedEmbedding:
                                     row_index=occ_idx)
 
         g = _gather_slots(D, sub.n_cap, key_of, val_of)
-        if D > 1:
+        n_chunks = effective_chunks(self.overlap_chunks, sub.n_cap)
+        if n_chunks > 1:
+          # chunked deduplicated-gradient exchange (design §11): the
+          # per-slot segment sums above are slot-local, so the slot
+          # axis chunks into independent collectives; concatenation is
+          # bit-identical to the monolithic transfer
+          parts = []
+          for lo, hi in chunk_bounds(sub.n_cap, n_chunks):
+            p = g[:, lo:hi]
+            parts.append(jax.lax.all_to_all(p, self.axis_name, 0, 0)
+                         if D > 1 else p)
+          g = jnp.concatenate(parts, axis=1)
+        elif D > 1:
           g = jax.lax.all_to_all(g, self.axis_name, 0, 0)
         gsubs.append(
             g.transpose(1, 0, 2, 3).reshape(sub.n_cap, D * U, wc)[None])
@@ -1498,7 +1692,12 @@ class DistributedEmbedding:
       for gi in plan.hot_groups:
         g = plan.groups[gi]
         K = g.hot_rows_cap
-        wc = 2 * g.width if with_sq else g.width
+        wch = 2 * g.width if with_sq else g.width
+        if with_touch:
+          # trailing occurrence-count column (segment-summed ones): the
+          # dense lazy-Adam hot apply needs the touched-row mask, which
+          # a zero gradient sum cannot encode (design §11)
+          wch += 1
         # ONE dense segment sum per group over the concatenated hot
         # occurrence streams of all its (input, chunk) pairs — a
         # per-chunk sum would rebuild (and re-add) the [K, w] dense
@@ -1517,6 +1716,9 @@ class DistributedEmbedding:
             if with_sq:
               payload = jnp.concatenate([payload, payload * payload],
                                         axis=1)
+            if with_touch:
+              payload = jnp.concatenate(
+                  [payload, jnp.ones((b, 1), jnp.float32)], axis=1)
             rows.append(payload)
             idxs.append(base + jnp.repeat(
                 jnp.arange(b, dtype=jnp.int32), h))
@@ -1527,9 +1729,21 @@ class DistributedEmbedding:
               jnp.concatenate(rows), K,
               row_index=jnp.concatenate(idxs))
         else:
-          total = jnp.zeros((K, wc), jnp.float32)
-        hot_out.append(jax.lax.psum(total, psum_axes)
-                       if D > 1 or self.dcn_axis else total)
+          total = jnp.zeros((K, wch), jnp.float32)
+        if D > 1 or self.dcn_axis:
+          n_chunks = effective_chunks(self.overlap_chunks, K)
+          if n_chunks > 1:
+            # chunked hot-grad replication (design §11): the one psum
+            # per group splits along the row axis so chunk k's psum can
+            # overlap chunk k-1's dense apply_hot; per-chunk psums of
+            # row slices perform the identical adds — bit-exact
+            total = jnp.concatenate([
+                jax.lax.psum(total[lo:hi], psum_axes)
+                for lo, hi in chunk_bounds(K, n_chunks)
+            ], axis=0)
+          else:
+            total = jax.lax.psum(total, psum_axes)
+        hot_out.append(total)
 
       return tuple(gsubs) + tuple(hot_out)
 

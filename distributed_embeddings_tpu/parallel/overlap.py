@@ -1,0 +1,195 @@
+"""Chunked dp<->mp exchange: compute-collective overlap helpers.
+
+The dp<->mp ``all_to_all``s of the sparse step are synchronous barriers:
+the device idles while ids ship out and rows ship back
+(docs/design.md §11).  ``DistributedEmbedding(overlap_chunks=k)`` splits
+each per-subgroup send/recv buffer into ``k`` static chunks along the
+SLOT axis and software-pipelines them — chunk ``k``'s collective is
+issued while chunk ``k-1``'s local gather/combine (forward) or
+segment-sum/apply (backward/apply) executes, so XLA's latency-hiding
+scheduler can run the collective and the compute concurrently on
+hardware with async collectives.  Slots are independent by construction
+(each slot is one table request with its own fused-row window), so the
+chunked program is BIT-EXACT vs the monolithic one: chunk outputs
+concatenate back to the very arrays the monolithic path produces.
+
+This module holds the shared chunk geometry (one definition so the
+runtime, the apply layer and the planner can never disagree about chunk
+boundaries), the overlap metric bench.py journals, and the
+exchange-only measurement behind its denominator.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def effective_chunks(requested: int, n_slots: int) -> int:
+  """Chunk count actually usable for an ``n_slots``-slot buffer: at
+  least 1, never more than the slot count (a slot is the smallest unit
+  whose shapes stay static when sliced)."""
+  return max(1, min(int(requested), max(1, int(n_slots))))
+
+
+def chunk_bounds(n_slots: int, chunks: int) -> List[Tuple[int, int]]:
+  """Static ``[lo, hi)`` slot ranges splitting ``n_slots`` into
+  ``chunks`` contiguous chunks.
+
+  Uneven splits are first-chunks-bigger (the same remainder rule as
+  ``bench.split_windows``), so chunk counts that do not divide the slot
+  capacity stay fully supported — every chunk keeps its own static
+  shape and the concatenation of the ranges tiles ``[0, n_slots)``
+  exactly.
+  """
+  chunks = effective_chunks(chunks, n_slots)
+  base, rem = divmod(int(n_slots), chunks)
+  bounds = []
+  lo = 0
+  for i in range(chunks):
+    hi = lo + base + (1 if i < rem else 0)
+    bounds.append((lo, hi))
+    lo = hi
+  assert lo == n_slots
+  return bounds
+
+
+def overlap_pct(off_ms: float, on_ms: float, exchange_ms: float) -> float:
+  """Hidden fraction of the exchange cost, from the off/on A/B.
+
+  ``off_ms`` is the monolithic (``overlap_chunks=1``, program-identical
+  to pre-chunking) step time, ``on_ms`` the chunked step time and
+  ``exchange_ms`` the directly measured cost of the exchanges alone
+  (``measure_exchange_ms``).  The step-time delta the chunking removed
+  can only have come out of the exchange wall, so
+  ``(off - on) / exchange`` is the fraction of that wall the pipeline
+  hid — the same quantity ``csr_feed_overlap_pct`` reports for the
+  host-feed pipeline (hidden build time / total build time), with the
+  device-side exchange in the role of the host build.  Clamped to
+  [0, 1]: a noise-negative delta reads as 0 (nothing hidden), never as
+  a negative overlap, and the metric never exceeds the exchange cost
+  that was there to hide.  ``exchange_ms <= 0`` returns 0.0 (no
+  exchange to hide — e.g. a one-device mesh).
+  """
+  if exchange_ms <= 0:
+    return 0.0
+  return round(min(1.0, max(0.0, (off_ms - on_ms) / exchange_ms)), 4)
+
+
+def a2a_overlap_stats(off_ms: float, on_ms: float, exchange_ms: float,
+                      chunks: int,
+                      group_chunks: Optional[List[int]] = None,
+                      window_ms: Optional[List[float]] = None
+                      ) -> Dict[str, object]:
+  """The journaled artifact block for the exchange-overlap A/B
+  (bench.py): raw off/on/exchange numbers plus the derived
+  ``a2a_overlap_pct`` so a suspicious line carries its own evidence."""
+  out = {
+      'overlap_chunks': int(chunks),
+      'a2a_off_ms': round(float(off_ms), 3),
+      'a2a_on_ms': round(float(on_ms), 3),
+      'a2a_exchange_ms': round(float(exchange_ms), 3),
+      'a2a_overlap_pct': overlap_pct(off_ms, on_ms, exchange_ms),
+  }
+  if group_chunks is not None:
+    out['a2a_group_chunks'] = [int(c) for c in group_chunks]
+  if window_ms is not None:
+    out['a2a_window_ms'] = [round(float(w), 3) for w in window_ms]
+  return out
+
+
+def measure_exchange_ms(dist, cats, chunks: Optional[int] = None,
+                        repeats: int = 5) -> float:
+  """Per-step wall time of the dp<->mp exchanges ALONE.
+
+  Builds (and times) a jitted program that runs exactly the chunked id
+  exchange and the row-return exchange of every subgroup — the send
+  buffers are assembled from the real inputs, each chunk's dp->mp
+  ``all_to_all`` ships the real ids, and the return leg ships a
+  width-``w`` broadcast of the received ids (real bytes that cannot
+  constant-fold away) — with no lookup/combine in between.  This is the
+  denominator of ``overlap_pct``: the exchange wall the pipeline tries
+  to hide.  Min over ``repeats`` timed calls after one warmup.
+
+  On a single-device mesh the collectives vanish (``D == 1`` skips
+  them, exactly like the runtime) and the returned time is only the
+  buffer plumbing — ``overlap_pct`` then reports against that
+  near-zero wall, which is the honest statement that there was no
+  exchange to hide.
+  """
+  import time
+
+  import jax
+  import jax.numpy as jnp
+  from jax.sharding import PartitionSpec as P
+
+  from distributed_embeddings_tpu.parallel import dist_embedding as de
+
+  cats = [jnp.asarray(c) for c in cats]
+  inputs, global_batch, hotness = dist._prepare_inputs(cats)
+  if not dist.dp_input:
+    raise ValueError('measure_exchange_ms needs a dp_input layer (the '
+                     'measured exchange is the dp<->mp pair)')
+  D = dist.world_size
+  slice_batch = global_batch // dist.num_slices
+  local_batch = slice_batch // D
+  subs = dist._subgroups(hotness)
+  req = dist.overlap_chunks if chunks is None else int(chunks)
+
+  def local_fn(*inputs):
+    total = jnp.zeros((), jnp.float32)
+    for sub in subs:
+      h = sub.hotness
+      w = sub.group.width
+
+      def _ids(k, sub=sub, h=h):
+        if k == -1:
+          return jnp.full((local_batch, h), -1, jnp.int32)
+        x = inputs[k]
+        x = x[:, None] if x.ndim == 1 else x
+        return x.astype(jnp.int32)
+
+      send = de._gather_slots(
+          D, sub.n_cap,
+          lambda dev, s, sub=sub: (sub.requests[dev][s].input_id
+                                   if s < len(sub.requests[dev]) else -1),
+          _ids)
+      for lo, hi in chunk_bounds(sub.n_cap, req):
+        part = send[:, lo:hi]
+        recv = (jax.lax.all_to_all(part, dist.axis_name, 0, 0)
+                if D > 1 else part)
+        ids = recv.transpose(1, 0, 2, 3).reshape(hi - lo, slice_batch, h)
+        # return leg: the received ids broadcast to the row width —
+        # real data-dependent bytes, so the collective cannot fold away
+        rows = jnp.broadcast_to(
+            ids[:, :, 0, None].astype(jnp.float32),
+            (hi - lo, slice_batch, w))
+        back = rows.reshape(hi - lo, D, local_batch, w).transpose(1, 0, 2, 3)
+        if D > 1:
+          back = jax.lax.all_to_all(back, dist.axis_name, 0, 0)
+        total = total + jnp.sum(back)
+    return total
+
+  bax = dist._batch_axes
+  fn = jax.jit(
+      jax.shard_map(local_fn,
+                    mesh=dist.mesh,
+                    in_specs=tuple(
+                        P(bax) if h == 1 else P(bax, None)
+                        for h in hotness),
+                    out_specs=P(),
+                    check_vma=False))
+  fn(*inputs).block_until_ready()  # compile + warmup
+  best = float('inf')
+  for _ in range(max(1, int(repeats))):
+    t0 = time.perf_counter()
+    fn(*inputs).block_until_ready()
+    best = min(best, (time.perf_counter() - t0) * 1000.0)
+  return best
+
+
+def group_chunk_counts(plan) -> List[int]:
+  """Per-fusion-group effective chunk counts recorded by the planner
+  (``GroupSpec.overlap_chunks``), for the journaled artifact."""
+  return [g.overlap_chunks for g in plan.groups]

@@ -207,6 +207,13 @@ class GroupSpec:
   # the replicated buffer initialises by gather + psum from these)
   hot_owner_rows: Optional[List[np.ndarray]] = None
   hot_owner_dst: Optional[List[np.ndarray]] = None
+  # ---- chunked dp<->mp exchange (docs/design.md §11) ----
+  # effective chunk count for this group's slot-axis exchange buffers:
+  # min(plan.overlap_chunks, n_cap) — a slot is the smallest unit whose
+  # shapes stay static when sliced, so a group with fewer slots than the
+  # requested chunk count runs at its slot count (n_cap == 1 groups are
+  # monolithic by construction).  1 = the monolithic program.
+  overlap_chunks: int = 1
 
   @property
   def param_rows(self) -> int:
@@ -422,6 +429,14 @@ class ShardingPlan:
       membership is a LAYOUT detail — checkpoints stay global
       canonical and restore under any other hot set
       (parallel/checkpoint.py).
+    overlap_chunks: split each group's dp<->mp exchange buffers into
+      this many static chunks along the slot axis and software-pipeline
+      them against the per-chunk lookup/combine (docs/design.md §11).
+      The plan records the requested count plus each group's effective
+      count (``GroupSpec.overlap_chunks = min(requested, n_cap)``), and
+      the physical fingerprint covers it — chunking changes the
+      compiled program, never the math.  1 (default) IS the monolithic
+      program.
   """
 
   def __init__(self,
@@ -434,7 +449,8 @@ class ShardingPlan:
                packed_storage: bool = True,
                mod_sharding: bool = False,
                num_sc: int = 4,
-               hot_sets=None):
+               hot_sets=None,
+               overlap_chunks: int = 1):
     if strategy not in ('basic', 'memory_balanced', 'memory_optimized'):
       raise ValueError(f'Unsupported shard strategy {strategy}')
     # Single-process case may skip collectives; mirror the reference's
@@ -459,6 +475,12 @@ class ShardingPlan:
     if num_sc <= 0:
       raise ValueError(f'num_sc must be positive, got {num_sc}')
     self.num_sc = int(num_sc)
+    if (isinstance(overlap_chunks, bool)
+        or not isinstance(overlap_chunks, (int, np.integer))
+        or overlap_chunks < 1):
+      raise ValueError(
+          f'overlap_chunks must be an int >= 1, got {overlap_chunks!r}')
+    self.overlap_chunks = int(overlap_chunks)
     # mod plans never lane-pack: SC padding granularity is 8, and the
     # natural layout is what both the emulation backend and the hardware
     # binding consume
@@ -701,15 +723,18 @@ class ShardingPlan:
       if self.packed_storage and 8 <= width < 128 and 128 % width == 0:
         pack = 128 // width
         assert rows_cap % pack == 0, (rows_cap, width)
+      n_cap = max(len(r) for r in reqs)
       spec = GroupSpec(key=key,
                        width=width,
                        combiner=combiner,
                        rows=rows,
                        rows_cap=rows_cap,
-                       n_cap=max(len(r) for r in reqs),
+                       n_cap=n_cap,
                        requests=reqs,
                        member_tables=members,
-                       storage_pack=pack)
+                       storage_pack=pack,
+                       overlap_chunks=max(
+                           1, min(self.overlap_chunks, max(1, n_cap))))
       self.groups.append(spec)
       for dev_reqs in reqs:
         self.requests.extend(dev_reqs)
@@ -835,6 +860,10 @@ class ShardingPlan:
         [[c.input_dim, c.output_dim, c.combiner]
          for c in self.table_configs],
         sorted(hs.fingerprint_material() for hs in self.hot_sets.values()),
+        # chunked-exchange geometry (docs/design.md §11): chunking never
+        # changes the math, but it changes the compiled program and the
+        # per-chunk buffer sizes capacity calibration describes
+        self.overlap_chunks,
     ])
     return hashlib.sha256(material.encode()).hexdigest()[:16]
 

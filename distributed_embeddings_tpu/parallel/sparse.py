@@ -45,6 +45,8 @@ from distributed_embeddings_tpu.ops.ragged import RaggedBatch
 from distributed_embeddings_tpu.parallel.dist_embedding import (
     DistributedEmbedding, _valid_count)
 from distributed_embeddings_tpu.parallel.grad import TrainState
+from distributed_embeddings_tpu.parallel.overlap import (chunk_bounds,
+                                                         effective_chunks)
 
 
 def compact_segments(ids: jax.Array,
@@ -221,6 +223,7 @@ class SparseSGD:
   use_sparsecore_apply: bool = False
 
   needs_sq = False
+  needs_touch = False
   supports_lane_packing = True
   # capability tag for the SC grad custom calls (sparsecore.apply_supported)
   sc_apply_kind = 'sgd'
@@ -242,12 +245,12 @@ class SparseSGD:
     return table.at[uids].add(update, mode='drop', unique_indices=True,
                               indices_are_sorted=True), state
 
-  def apply_hot(self, hot, state, sum_g, sum_sq, lr):
+  def apply_hot(self, hot, state, sum_g, sum_sq, lr, count=None):
     """DENSE step on a replicated hot-cache buffer (design §10):
     ``sum_g`` is the mesh-psummed per-row gradient sum — untouched
     rows carry exact zeros, so one elementwise add updates every hot
     row with the same arithmetic the scatter would."""
-    del sum_sq
+    del sum_sq, count
     return hot + (-lr * sum_g).astype(hot.dtype), state
 
 
@@ -298,6 +301,7 @@ class SparseAdagrad:
   # same CSR buffers (the squares are a second segment-sum payload)
   use_sparsecore_apply: bool = False
 
+  needs_touch = False
   supports_lane_packing = True
   # capability tag for the SC grad custom calls (sparsecore.apply_supported)
   sc_apply_kind = 'adagrad'
@@ -367,7 +371,7 @@ class SparseAdagrad:
     return table.at[uids].add(update, mode='drop', unique_indices=True,
                               indices_are_sorted=True), {'acc': acc}
 
-  def apply_hot(self, hot, state, sum_g, sum_sq, lr):
+  def apply_hot(self, hot, state, sum_g, sum_sq, lr, count=None):
     """DENSE Adagrad step on a replicated hot-cache buffer: the same
     accumulate-then-read arithmetic as ``apply_unique`` (dedup
     semantics square the mesh-psummed row sum; per-occurrence
@@ -375,6 +379,7 @@ class SparseAdagrad:
     scatter.  Untouched rows see ``add == 0`` and ``update == 0``, so
     they are bit-preserved (incl. bf16 accumulator stores: the f32
     up-cast/round-trip of a bf16 value is exact)."""
+    del count
     add = sum_g * sum_g if self.dedup else sum_sq
     acc_rows = state['acc'].astype(jnp.float32) + add
     update = (-lr * sum_g * jax.lax.rsqrt(acc_rows + self.epsilon)).astype(
@@ -386,7 +391,16 @@ class SparseAdagrad:
 class SparseAdam:
   """Row-wise *lazy* Adam: moments and bias-correction step advance only for
   rows touched this batch (the sparse-friendly variant; nonlinear in the
-  row grad, so duplicates are always deduped first)."""
+  row grad, so duplicates are always deduped first).
+
+  Hot-cache layers (design §10) are supported: the replicated hot
+  buffers carry split ``m``/``v`` moments plus the per-row step counter
+  ``t``, and the backward ships a trailing occurrence-COUNT column with
+  the hot gradients (``needs_touch``) — the touched-row mask
+  ``apply_unique`` derives from stream membership, which a zero
+  gradient sum cannot encode densely.  ``apply_hot`` then runs the
+  exact ``apply_unique`` arithmetic elementwise on touched rows and
+  bit-preserves the rest."""
   learning_rate: float = 0.001
   b1: float = 0.9
   b2: float = 0.999
@@ -395,21 +409,25 @@ class SparseAdam:
   capacity_rows: Optional[Tuple[Optional[int], ...]] = None
 
   needs_sq = False
+  # hot-cache backward must ship the occurrence-count channel: the lazy
+  # per-row step counter advances exactly for TOUCHED rows (see above)
+  needs_touch = True
   # the per-row step counter 't' is not an elementwise-lane quantity
   supports_lane_packing = False
 
   def init(self, dist: DistributedEmbedding, params) -> Dict:
-    if getattr(dist, 'hot_enabled', False):
-      # lazy Adam's per-row step counter is not a dense elementwise
-      # quantity: advancing it only for touched hot rows needs a
-      # data-dependent mask whose semantics the split state does not
-      # carry.  Fail actionably instead of training wrong.
-      raise ValueError(
-          'SparseAdam does not support hot_cache layers (the lazy '
-          'per-row step counter has no dense replicated-buffer '
-          'equivalent). Use SparseSGD/SparseAdagrad, or build the '
-          'layer without hot_cache.')
     out = {}
+    for gi in getattr(dist.plan, 'hot_groups', []):
+      # replicated split state for hot rows (design §10): moments plus
+      # the per-row step counter live HERE while the row is hot; the
+      # checkpoint boundary canonicalises them back into the per-table
+      # layout (per-row 't' overlays like the row-window leaves)
+      hp = params[f'hot_group_{gi}']
+      out[f'hot_group_{gi}'] = {
+          'm': jnp.zeros_like(hp, dtype=jnp.float32),
+          'v': jnp.zeros_like(hp, dtype=jnp.float32),
+          't': jnp.zeros(hp.shape[:1], jnp.int32),
+      }
     for gi, g in enumerate(dist.plan.groups):
       if (g.storage_pack > 1
           and not packed_dispatch_ok(g.rows_cap, g.width)):
@@ -460,6 +478,40 @@ class SparseAdam:
     update = (-lr * mhat / (jnp.sqrt(vhat) + self.epsilon)).astype(table.dtype)
     return table.at[ids].add(update, mode='drop', **hints), {'m': m, 'v': v,
                                                              't': t}
+
+  def apply_hot(self, hot, state, sum_g, sum_sq, lr, count=None):
+    """DENSE lazy-Adam step on a replicated hot-cache buffer.
+
+    ``count`` is the mesh-psummed per-row occurrence count
+    (``backward_to_mp(with_touch=True)``): rows with ``count > 0`` run
+    exactly the ``apply_unique`` arithmetic on the deduplicated
+    mesh-psummed row sum (t advances, moments decay-and-add, bias
+    correction reads the advanced t); rows with ``count == 0`` are
+    bit-preserved — the lazy semantics a zero gradient sum alone could
+    not reproduce (a touched row with zero summed gradient still decays
+    its moments and advances its step)."""
+    del sum_sq
+    if count is None:
+      raise ValueError(
+          'SparseAdam.apply_hot needs the occurrence-count channel: '
+          'call backward_to_mp(with_touch=True) (make_hybrid_train_step '
+          'does this for needs_touch optimizers)')
+    touched = count[:, 0] > 0
+    t = state['t'] + touched.astype(state['t'].dtype)
+    m_rows = self.b1 * state['m'] + (1 - self.b1) * sum_g
+    v_rows = self.b2 * state['v'] + (1 - self.b2) * sum_g * sum_g
+    # untouched rows keep t == 0; clamp the bias-correction exponent so
+    # their (masked-away) update lane never divides by zero
+    tf = jnp.maximum(t, 1).astype(jnp.float32)[:, None]
+    mhat = m_rows / (1 - self.b1**tf)
+    vhat = v_rows / (1 - self.b2**tf)
+    update = -lr * mhat / (jnp.sqrt(vhat) + self.epsilon)
+    mask = touched[:, None]
+    return (hot + jnp.where(mask, update, 0.0).astype(hot.dtype), {
+        'm': jnp.where(mask, m_rows, state['m']),
+        'v': jnp.where(mask, v_rows, state['v']),
+        't': t,
+    })
 
 
 def _lane_pack(uids, sum_g, sum_sq, pack: int, rows_cap: int):
@@ -518,9 +570,33 @@ def _capacity(optimizer, n: int, rows_cap: int,
   return min(cap_safe, max(8, -(-int(n * frac) // 8) * 8))
 
 
+def _apply_unique_chunked(optimizer, table, state, uids, sum_g, sum_sq,
+                          lr, n_chunks: int):
+  """Feed one compacted unique-row stream to ``apply_unique`` in
+  ``n_chunks`` static row chunks (docs/design.md §11).
+
+  The compacted rows are UNIQUE, so the chunk applies touch disjoint
+  table/state rows and threading the table through them is bit-exact vs
+  the single call — while the one monolithic scatter/gather pipeline
+  becomes ``n_chunks`` independent pieces the scheduler can interleave
+  with the still-arriving chunked gradient exchange.  The compacted
+  buffer is rank-ordered (ascending ids, sentinels last), so the tail
+  chunks carry only dropped sentinel rows and every chunk keeps the
+  sorted-indices scatter hint."""
+  k = effective_chunks(n_chunks, uids.shape[0])
+  if k == 1:
+    return optimizer.apply_unique(table, state, uids, sum_g, sum_sq, lr)
+  for lo, hi in chunk_bounds(uids.shape[0], k):
+    table, state = optimizer.apply_unique(
+        table, state, uids[lo:hi], sum_g[lo:hi],
+        None if sum_sq is None else sum_sq[lo:hi], lr)
+  return table, state
+
+
 def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
                      rows_cap: int, cap_rows: Optional[int] = None,
-                     flat_sq=None, storage_pack: int = 1, g_index=None):
+                     flat_sq=None, storage_pack: int = 1, g_index=None,
+                     n_chunks: int = 1):
   """Compact duplicate update rows, then run the optimizer on the unique
   rows only.
 
@@ -563,6 +639,15 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
   views (exact: untouched lanes receive zero gradient, and Adagrad's
   accumulator/denominator math is elementwise).
 
+  ``n_chunks > 1`` (``DistributedEmbedding(overlap_chunks=)``,
+  docs/design.md §11): the compacted unique-row stream feeds
+  ``apply_unique`` in static row chunks (``_apply_unique_chunked``) —
+  bit-exact, because compacted rows are disjoint — so the apply's
+  scatters pipeline against the chunked gradient exchange instead of
+  forming one monolithic tail.  The correction wave stays monolithic
+  (it is the rare ``lax.cond`` branch; chunking it would only grow the
+  untaken branch's traced program).
+
   Overflow structure: the capped apply runs UNconditionally and a
   ``lax.cond`` wraps only the rare *correction* wave for the segments
   the cap dropped.  The waves touch disjoint unique rows, so applying
@@ -594,7 +679,7 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
           for k, v in state.items()}
     t2, s2 = _dedup_and_apply(optimizer, tn, sn, flat_ids, flat_g, lr,
                               rows_cap, cap_rows=cap_rows, flat_sq=flat_sq,
-                              g_index=g_index)
+                              g_index=g_index, n_chunks=n_chunks)
     return t2.reshape(packed_shape), {
         k: (v.reshape(packed_shape) if v.shape == (rows_cap, w) else v)
         for k, v in s2.items()
@@ -628,18 +713,21 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
   if storage_packed:
     # updates lane-pack against the physically packed operand directly
     pids, g_p, sq_p = _lane_pack(uids, sum_g, sum_sq, pack, rows_cap)
-    t2, s2 = optimizer.apply_unique(table, state, pids, g_p, sq_p, lr)
+    t2, s2 = _apply_unique_chunked(optimizer, table, state, pids, g_p,
+                                   sq_p, lr, n_chunks)
   elif packable:
     pids, g_p, sq_p = _lane_pack(uids, sum_g, sum_sq, pack, rows_cap)
     ptable = table.reshape(rows_cap // pack, pack * w)
     pstate = {
         k: v.reshape(rows_cap // pack, pack * w) for k, v in state.items()
     }
-    t2, s2 = optimizer.apply_unique(ptable, pstate, pids, g_p, sq_p, lr)
+    t2, s2 = _apply_unique_chunked(optimizer, ptable, pstate, pids, g_p,
+                                   sq_p, lr, n_chunks)
     t2 = t2.reshape(rows_cap, w)
     s2 = {k: v.reshape(rows_cap, w) for k, v in s2.items()}
   else:
-    t2, s2 = optimizer.apply_unique(table, state, uids, sum_g, sum_sq, lr)
+    t2, s2 = _apply_unique_chunked(optimizer, table, state, uids, sum_g,
+                                   sum_sq, lr, n_chunks)
 
   if cap >= cap_safe:
     return t2, s2
@@ -818,6 +906,11 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
   hot_gis = list(getattr(dist.plan, 'hot_groups', []))
   cached = bool(getattr(dist, 'hot_enabled', False))
   needs_sq = bool(getattr(optimizer, 'needs_sq', True))
+  needs_touch = cached and bool(getattr(optimizer, 'needs_touch', False))
+  # chunked gradient-apply (design §11): the XLA apply paths feed
+  # apply_unique/apply_hot per chunk; the segwalk/SparseCore kernels
+  # are single-pass streaming applies and consume the full stream
+  n_chunks = getattr(dist.plan, 'overlap_chunks', 1)
 
   def local_fn(params, opt_state, lr, *res_and_g):
     residuals = res_and_g[:len(subs)]
@@ -982,13 +1075,15 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
                                            state_g, flat_ids, g_rows, lr,
                                            rows_cap, cap_rows=cap_rows,
                                            storage_pack=spack,
-                                           g_index=g_idx)
+                                           g_index=g_idx,
+                                           n_chunks=n_chunks)
         else:  # multi-slice: the DCN exchange already compacted
           table, state2 = _dedup_and_apply(optimizer, params[key][0],
                                            state_g, flat_ids, flat_g, lr,
                                            rows_cap, cap_rows=cap_rows,
                                            flat_sq=flat_sq,
-                                           storage_pack=spack)
+                                           storage_pack=spack,
+                                           n_chunks=n_chunks)
       new_params[key] = table[None]
       new_state[key] = {k: v[None] for k, v in state2.items()}
       fence = table[0, 0]
@@ -1003,9 +1098,38 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
       hg = hot_gs[k_idx].astype(jnp.float32)
       hw = dist.plan.groups[gi].width
       sum_g = hg[:, :hw]
-      sum_sq = hg[:, hw:] if needs_sq else None
-      hot_new, hstate = optimizer.apply_hot(params[hk], opt_state[hk],
-                                            sum_g, sum_sq, lr)
+      sum_sq = hg[:, hw:2 * hw] if needs_sq else None
+      # trailing occurrence-count column (needs_touch optimizers:
+      # lazy Adam's dense touched-row mask, design §11)
+      cnt_off = 2 * hw if needs_sq else hw
+      count = hg[:, cnt_off:cnt_off + 1] if needs_touch else None
+      K = hg.shape[0]
+      kch = effective_chunks(n_chunks, K)
+      if kch == 1:
+        hot_new, hstate = optimizer.apply_hot(params[hk], opt_state[hk],
+                                              sum_g, sum_sq, lr,
+                                              count=count)
+      else:
+        # chunked dense hot apply (design §11): apply_hot is
+        # elementwise per row, so row-range chunks are bit-exact — and
+        # chunk k's step can execute while chunk k+1's psummed
+        # gradient slice is still in flight (the backward psums the
+        # hot grads in the same row chunks)
+        pieces, spieces = [], []
+        for lo, hi in chunk_bounds(K, kch):
+          hp, hs = optimizer.apply_hot(
+              params[hk][lo:hi],
+              {kk: vv[lo:hi] for kk, vv in opt_state[hk].items()},
+              sum_g[lo:hi],
+              None if sum_sq is None else sum_sq[lo:hi], lr,
+              count=None if count is None else count[lo:hi])
+          pieces.append(hp)
+          spieces.append(hs)
+        hot_new = jnp.concatenate(pieces, axis=0)
+        hstate = ({} if not spieces[0] else {
+            kk: jnp.concatenate([s[kk] for s in spieces], axis=0)
+            for kk in spieces[0]
+        })
       new_params[hk] = hot_new
       new_state[hk] = hstate
     return new_params, new_state
@@ -1133,7 +1257,8 @@ def make_hybrid_train_step(dist: DistributedEmbedding,
       ]
       gsubs, hot_grads = dist.backward_to_mp(
           list(d_emb), global_batch, hotness, cats=cats_dense,
-          with_sq=bool(getattr(emb_optimizer, 'needs_sq', False)))
+          with_sq=bool(getattr(emb_optimizer, 'needs_sq', False)),
+          with_touch=bool(getattr(emb_optimizer, 'needs_touch', False)))
       lr = (lr_schedule(state.step) if lr_schedule is not None
             else emb_optimizer.learning_rate)
       new_emb, emb_opt_state = sparse_apply_updates(
@@ -1271,7 +1396,12 @@ def _calibration_mirror(dist: DistributedEmbedding, cpus):
       # hot-cache plans strip hot ids and dedup the cold exchange; the
       # mirror must reproduce BOTH or the calibrated capacities would
       # describe the un-cached (far larger) streams
-      hot_cache=dist.plan.hot_sets or None)
+      hot_cache=dist.plan.hot_sets or None,
+      # chunking never changes the residual streams (bit-exact), but
+      # the mirror's plan must carry the same geometry so its physical
+      # fingerprint — and the per-chunk buffer sizes the calibrated
+      # capacities get split into — describe the real program
+      overlap_chunks=dist.plan.overlap_chunks)
   # the mirror's params must match ITS plan's physical layout (packed
   # [param_rows, param_width] for storage-packed groups)
   zeros = {

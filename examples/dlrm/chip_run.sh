@@ -60,6 +60,18 @@ if [ "$BUDGET" = 1 ]; then
     --fast_compile \
     --hot_cache \
     --max_steps 40
+
+  # cheap chunked-exchange A/B (design §11): the same steps-only row
+  # with the dp<->mp exchanges split into 4 pipelined chunks — the
+  # --max_steps 40 row above (overlap_chunks=1, program-identical to
+  # pre-chunking) is the off arm
+  python examples/dlrm/main.py \
+    --dataset_path "$DATA" \
+    --batch_size "$BATCH" \
+    --dp_input \
+    --fast_compile \
+    --overlap_chunks 4 \
+    --max_steps 40
   exit 0
 fi
 
@@ -85,6 +97,19 @@ python examples/dlrm/main.py \
   --batch_size "$BATCH" \
   --dp_input \
   --hot_cache \
+  --max_steps 40
+
+# chunked-exchange A/B (design §11): the off arm is the plain
+# --max_steps 40 row above (overlap_chunks=1 IS the monolithic
+# program); the on arm pipelines each exchange in 4 slot chunks so the
+# device overlaps collective and compute — the steady-state samples/s
+# pair is the chip measurement of the hidden exchange wall the bench's
+# a2a_overlap_pct predicts
+python examples/dlrm/main.py \
+  --dataset_path "$DATA" \
+  --batch_size "$BATCH" \
+  --dp_input \
+  --overlap_chunks 4 \
   --max_steps 40
 
 # AMP-analog variant (reference examples/dlrm/README.md:8, 10.4M

@@ -50,6 +50,12 @@ def parse_args():
                       'replicate on every device and leave the dp<->mp '
                       'exchange; cold ids sort-unique before the '
                       'exchange.  Requires --dp_input')
+  parser.add_argument('--overlap_chunks', type=int, default=1,
+                      help='split each dp<->mp exchange into this many '
+                      'static slot chunks and software-pipeline '
+                      'collective against compute (docs/design.md §11). '
+                      '1 = the monolithic program; > 1 requires '
+                      '--dp_input and --trainer sparse')
   parser.add_argument('--hot_coverage', type=float, default=0.8,
                       help='per-table occurrence-coverage target for the '
                       'hot set calibration')
@@ -160,6 +166,16 @@ def main():
   # frequency-aware hot cache (design §10): calibration pass over a few
   # sample batches -> per-table HotSets wired into the planner.  Uses a
   # throwaway reader so the training iterator's position is untouched.
+  if args.overlap_chunks > 1:
+    if not args.dp_input:
+      raise SystemExit('--overlap_chunks > 1 requires --dp_input (the '
+                       'chunked pipeline overlaps the dp->mp id '
+                       'exchange, which only the data-parallel input '
+                       'path has)')
+    if args.trainer != 'sparse':
+      raise SystemExit('--overlap_chunks > 1 pairs with --trainer '
+                       'sparse (the chunked gradient exchange/apply '
+                       'lives in the sparse row-wise path)')
   hot_sets = None
   if args.hot_cache:
     if not args.dp_input:
@@ -217,7 +233,8 @@ def main():
                param_dtype=jnp.dtype(args.param_dtype),
                compute_dtype=jnp.dtype(args.compute_dtype
                                        or args.param_dtype),
-               hot_cache=hot_sets)
+               hot_cache=hot_sets,
+               overlap_chunks=args.overlap_chunks)
   params = model.init(0)
 
   if args.dp_input:

@@ -11,9 +11,22 @@ import os
 # Must be set before the first JAX backend initialisation.
 _flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _flags:
-  os.environ['XLA_FLAGS'] = (_flags +
-                             ' --xla_force_host_platform_device_count=8')
+  _flags += ' --xla_force_host_platform_device_count=8'
+if (os.environ.get('DET_TESTS_REAL_TPU') != '1'
+    and 'intra_op_parallelism_threads' not in _flags):
+  # 8 faked devices x an intra-op Eigen pool each oversubscribes the
+  # 2-core CI host ~16x; the XLA-CPU collective rendezvous occasionally
+  # deadlocks CPU-idle under that thrash (observed twice across PR 5
+  # runs — same tests pass in isolation).  One intra-op thread per
+  # faked device keeps the schedulable thread count at the device
+  # count, which is the configuration the suite was stable under.
+  _flags += ' --xla_cpu_multi_thread_eigen=false intra_op_parallelism_threads=1'
+os.environ['XLA_FLAGS'] = _flags
 os.environ['JAX_ENABLE_X64'] = '0'
+
+import threading  # noqa: E402
+
+import pytest  # noqa: E402
 
 import jax  # noqa: E402
 
@@ -31,3 +44,39 @@ jax.config.update(
     'jax_compilation_cache_dir',
     os.path.join(os.path.dirname(os.path.dirname(__file__)), '.jax_cache'))
 jax.config.update('jax_persistent_cache_min_compile_time_secs', 2)
+
+
+@pytest.fixture(autouse=True)
+def _hang_alarm(request):
+  """Per-test alarm: dump all-thread tracebacks BEFORE tier-1's outer
+  timeout wedges silently.
+
+  If the known XLA-CPU rendezvous flake (a shard_map collective
+  deadlocking CPU-idle under thread oversubscription) recurs, the outer
+  pytest timeout kills the whole run with no evidence of which test or
+  which thread wedged.  This alarm fires first and writes the evidence:
+  the resilience diagnostics dump (all-thread tracebacks, PR 3's
+  watchdog machinery) plus a journaled ``test_alarm_fired`` event naming
+  the test.  Dump-only — the test keeps running (a slow-but-alive test
+  on a loaded host must not be killed by its diagnostics).  Tune or
+  disable with ``DET_TEST_ALARM_S`` (seconds; 0 disables).
+  """
+  timeout_s = float(os.environ.get('DET_TEST_ALARM_S', '420'))
+  if timeout_s <= 0:
+    yield
+    return
+  from distributed_embeddings_tpu.utils import resilience
+
+  def fire():
+    resilience.dump_diagnostics(f'test alarm ({timeout_s:g}s): '
+                                f'{request.node.nodeid}')
+    resilience.journal('test_alarm_fired', test=request.node.nodeid,
+                       timeout_s=timeout_s)
+
+  timer = threading.Timer(timeout_s, fire)
+  timer.daemon = True
+  timer.start()
+  try:
+    yield
+  finally:
+    timer.cancel()

@@ -217,6 +217,175 @@ def test_fuzz_sparse_train_step(seed):
 
 
 @pytest.mark.parametrize('seed', range(3))
+def test_fuzz_chunked_exchange_parity(seed):
+  """Chunked dp<->mp exchange (design §11) vs the monolithic program
+  over fuzzed (plan, batch, chunk-count, hot-set) draws — including
+  ``overlap_chunks`` that do not divide the slot capacity evenly.
+
+  Contract (same shape as PR 5's hot-cache fuzz): forward outputs are
+  BIT-EXACT f32 for hotness-1 inputs and 1e-6 for multi-hot (bag-fold
+  order only); the isolated backward+apply chain is BIT-EXACT under
+  fixed cotangents (chunk boundaries move pure data movement and
+  disjoint-row applies, never math); 10 full training steps then match
+  within the dtype tolerances — e2e steps jit the dense head into two
+  DIFFERENT programs, and XLA may re-associate its f32 reductions
+  (1-ulp cotangent noise, which lazy Adam's sign-like update can
+  amplify on near-zero-gradient rows), so e2e is tolerance-pinned
+  exactly like the hot-cache fuzz below.
+  """
+  import optax
+  from distributed_embeddings_tpu.parallel import (SparseAdagrad, SparseAdam,
+                                                   SparseSGD,
+                                                   get_optimizer_state,
+                                                   init_hybrid_train_state,
+                                                   make_hybrid_train_step)
+  from distributed_embeddings_tpu.parallel.hotcache import HotSet
+  from distributed_embeddings_tpu.parallel.sparse import sparse_apply_updates
+  rng = np.random.default_rng(4000 + seed)
+  world = int(rng.choice([2, 4, 8]))
+  two_axis = world >= 4 and rng.random() < 0.35
+  mesh = (create_mesh((2, world // 2)) if two_axis
+          else create_mesh(jax.devices()[:world]))
+  n_tables = world + int(rng.integers(0, 3))
+  configs = []
+  for _ in range(n_tables):
+    rows = int(rng.integers(16, 200))
+    width = int(rng.choice([4, 8, 16]))
+    configs.append(TableConfig(rows, width, rng.choice(['sum', 'mean'])))
+  # sometimes a hot-cache layer: its cold exchange and hot psum chunk too
+  hot_sets = None
+  if rng.random() < 0.5:
+    hot_sets = {}
+    for tid, c in enumerate(configs):
+      if rng.random() < 0.6:
+        k = int(rng.integers(1, max(2, c.input_dim // 3)))
+        hids = np.sort(rng.choice(c.input_dim, size=k, replace=False))
+        hot_sets[tid] = HotSet(tid, hids.astype(np.int64))
+    hot_sets = hot_sets or None
+  # chunk counts meant NOT to divide slot capacities evenly (3, 5, 7
+  # vs slot counts that are typically 1..n_tables-ish)
+  chunks = int(rng.choice([2, 3, 4, 5, 7]))
+
+  def build(k):
+    try:
+      return DistributedEmbedding(configs, mesh=mesh, dp_input=True,
+                                  hot_cache=hot_sets, overlap_chunks=k)
+    except ValueError as e:
+      if 'Not enough table' in str(e):
+        pytest.skip(str(e))
+      raise
+
+  d_mono, d_chk = build(1), build(chunks)
+  assert d_chk.plan.overlap_chunks == chunks
+  # the plan records each group's EFFECTIVE count and fingerprints it
+  for g in d_chk.plan.groups:
+    assert 1 <= g.overlap_chunks <= max(1, g.n_cap)
+  assert d_mono.plan.fingerprint() != d_chk.plan.fingerprint()
+  weights = [
+      (rng.normal(size=(c.input_dim, c.output_dim)) * 0.1).astype(
+          np.float32) for c in configs
+  ]
+  batch = world * 2
+  ids = []
+  for c in configs:
+    h = int(rng.integers(1, 4))
+    x = rng.integers(0, c.input_dim, size=(batch, h)).astype(np.int32)
+    if h > 1:
+      x[rng.integers(0, batch), rng.integers(1, h)] = -1
+    if rng.random() < 0.5:
+      x[rng.integers(0, batch), 0] = c.input_dim + 2  # out-of-vocab
+    ids.append(x.squeeze(1) if h == 1 and rng.random() < 0.5 else x)
+  jids = [jnp.asarray(x) for x in ids]
+
+  # ---- forward parity (+ isolated backward/apply bit-exactness) ---------
+  p_mono = set_weights(d_mono, weights)
+  p_chk = set_weights(d_chk, weights)
+  o_mono = d_mono.apply(p_mono, jids)
+  o_chk = d_chk.apply(p_chk, jids)
+  for t, (a, b) in enumerate(zip(o_mono, o_chk)):
+    hot1 = ids[t].ndim == 1 or ids[t].shape[1] == 1
+    if hot1:
+      np.testing.assert_array_equal(
+          np.asarray(a), np.asarray(b),
+          err_msg=f'seed {seed} input {t} (world {world}, '
+          f'chunks {chunks}, two_axis {two_axis}, hot {bool(hot_sets)})')
+    else:
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                 rtol=1e-6, atol=1e-6,
+                                 err_msg=f'seed {seed} input {t} '
+                                 f'(chunks {chunks})')
+  if not hot_sets:
+    # isolated backward + apply under FIXED cotangents: bit-exact (the
+    # hot-cache backward needs the raw cats and rebuilds its own
+    # cotangent layout; its e2e coverage is the training loop below)
+    om, rm, meta = d_mono.forward_with_residuals(p_mono, jids)
+    oc, rc, metac = d_chk.forward_with_residuals(p_chk, jids)
+    d_outs = [
+        jnp.asarray(rng.normal(size=np.asarray(o).shape).astype(np.float32))
+        for o in om
+    ]
+    g_mono = d_mono.backward_to_mp(list(d_outs), meta[0], meta[1])
+    g_chk = d_chk.backward_to_mp(list(d_outs), metac[0], metac[1])
+    for t, (a, b) in enumerate(zip(g_mono, g_chk)):
+      np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                    err_msg=f'seed {seed} bwd sub {t}')
+    opt_iso = SparseAdagrad(learning_rate=0.05)
+    nm, _ = sparse_apply_updates(d_mono, opt_iso, p_mono,
+                                 opt_iso.init(d_mono, p_mono), rm,
+                                 list(g_mono), 0.05, meta[0], meta[1])
+    nc, _ = sparse_apply_updates(d_chk, opt_iso, p_chk,
+                                 opt_iso.init(d_chk, p_chk), rc,
+                                 list(g_chk), 0.05, metac[0], metac[1])
+    for t, (a, b) in enumerate(zip(get_weights(d_mono, nm),
+                                   get_weights(d_chk, nc))):
+      np.testing.assert_array_equal(a, b,
+                                    err_msg=f'seed {seed} apply table {t}')
+
+  # ---- 10-step optimizer-state parity -----------------------------------
+  r = rng.random()
+  if r < 0.4:
+    opt = SparseSGD(learning_rate=0.02)
+  elif r < 0.75:
+    opt = SparseAdagrad(learning_rate=0.02,
+                        accum_dtype=str(rng.choice(['float32', 'bfloat16'])))
+  else:
+    opt = SparseAdam(learning_rate=0.005)
+  total_w = sum(c.output_dim for c in configs)
+  kernel = jnp.asarray(
+      rng.standard_normal((total_w, 1)).astype(np.float32) * 0.1)
+  labels = jnp.asarray(rng.integers(0, 2, (batch, 1)).astype(np.float32))
+
+  def head_loss_fn(dense_params, emb_outs, b):
+    h = jnp.concatenate(list(emb_outs), axis=-1)
+    return jnp.mean((h @ dense_params['kernel'] - b)**2)
+
+  results = {}
+  for name, dist in (('mono', d_mono), ('chunked', d_chk)):
+    state = init_hybrid_train_state(dist, {
+        'embedding': set_weights(dist, weights), 'kernel': kernel
+    }, optax.sgd(0.02), opt)
+    step = make_hybrid_train_step(dist, head_loss_fn, optax.sgd(0.02),
+                                  opt, donate=False)
+    for _ in range(10):
+      state, loss = step(state, jids, labels)
+    assert np.isfinite(float(loss))
+    results[name] = (get_weights(dist, state.params['embedding']),
+                     get_optimizer_state(dist, state.opt_state[1]))
+  for t in range(n_tables):
+    np.testing.assert_allclose(
+        results['mono'][0][t], results['chunked'][0][t],
+        rtol=2e-4, atol=3e-6,
+        err_msg=f'seed {seed} table {t} weights ({type(opt).__name__}, '
+        f'chunks {chunks}, hot {bool(hot_sets)})')
+    for k in results['mono'][1][t]:
+      np.testing.assert_allclose(
+          np.asarray(results['mono'][1][t][k], np.float32),
+          np.asarray(results['chunked'][1][t][k], np.float32),
+          rtol=5e-3, atol=5e-4,
+          err_msg=f'seed {seed} table {t} state {k}')
+
+
+@pytest.mark.parametrize('seed', range(3))
 def test_fuzz_hot_cache_parity(seed):
   """Frequency-aware hot cache (design §10) vs the baseline path over
   fuzzed (plan, batch, hot-set) configurations: forward outputs are

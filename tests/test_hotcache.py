@@ -7,8 +7,9 @@ The load-bearing claims pinned here:
   (including combiner=None), and exact modulo f32 bag-summation order
   for multi-hot bags that mix hot and cold ids;
 - 10 training steps with the cache on land on the same canonical
-  weights/optimizer state as the baseline (both optimizers, bf16
-  accumulators included);
+  weights/optimizer state as the baseline (all three optimizers — lazy
+  Adam via the occurrence-count channel, PR 6 — bf16 accumulators
+  included);
 - a checkpoint written under one hot set restores bit-exactly under a
   DIFFERENT hot set and under no cache at all (hot membership is a
   layout detail, never semantic).
@@ -141,12 +142,22 @@ class TestForwardParity:
       DistributedEmbedding(CONFIGS, mesh=create_mesh(jax.devices()[:2]),
                            dp_input=False, hot_cache=HOT)
 
-  def test_sparse_adam_refuses_hot_cache(self):
+  def test_sparse_adam_hot_split_state(self):
+    # PR 6: SparseAdam supports hot-cache layers — the replicated hot
+    # buffers carry split m/v moments plus the per-row step counter 't'
+    # (the backward ships the occurrence-count channel its lazy
+    # touched-row mask needs)
     mesh = create_mesh(jax.devices()[:2])
     on = DistributedEmbedding(CONFIGS[:2], mesh=mesh, dp_input=True,
                               hot_cache={0: HOT[0]})
-    with pytest.raises(ValueError, match='SparseAdam'):
-      SparseAdam().init(on, on.init(0))
+    assert SparseAdam.needs_touch
+    st = SparseAdam().init(on, on.init(0))
+    (gi,) = on.plan.hot_groups
+    hot = st[f'hot_group_{gi}']
+    K = on.plan.groups[gi].hot_rows_cap
+    w = on.plan.groups[gi].width
+    assert hot['m'].shape == (K, w) and hot['v'].shape == (K, w)
+    assert hot['t'].shape == (K,) and hot['t'].dtype == jnp.int32
 
 
 def _head_loss(dense_params, emb_outs, labels):
@@ -168,16 +179,19 @@ def _train(dist, opt, weights, kernel, labels, steps=10, batch=8):
 
 
 @pytest.mark.parametrize('optname', ['sgd', 'adagrad', 'adagrad_sq',
-                                     'adagrad_bf16'])
+                                     'adagrad_bf16', 'adam'])
 def test_train_parity_10_steps(optname):
   """Canonical weights + optimizer state match the baseline after 10
-  steps — the split hot/cold state is semantically invisible."""
+  steps — the split hot/cold state is semantically invisible (lazy
+  Adam included: its per-row step counter advances via the
+  occurrence-count channel, PR 6)."""
   mk = {
       'sgd': lambda: SparseSGD(learning_rate=0.02),
       'adagrad': lambda: SparseAdagrad(learning_rate=0.02),
       'adagrad_sq': lambda: SparseAdagrad(learning_rate=0.02, dedup=False),
       'adagrad_bf16': lambda: SparseAdagrad(learning_rate=0.02,
                                             accum_dtype='bfloat16'),
+      'adam': lambda: SparseAdam(learning_rate=0.01),
   }[optname]
   mesh = create_mesh(jax.devices()[:4])
   rng = np.random.default_rng(1)
@@ -295,6 +309,48 @@ def test_checkpoint_across_hot_sets_bit_exact():
   for a, b, c in zip(outs['off'], outs['B'], oA):
     np.testing.assert_array_equal(a, b)
     np.testing.assert_array_equal(a, np.asarray(c))
+
+
+def test_adam_hot_checkpoint_roundtrip():
+  """SparseAdam's split hot state round-trips the checkpoint boundary:
+  the per-row step counter 't' (a 1-D hot leaf) canonicalises into the
+  global per-table layout and restores bit-exactly under a DIFFERENT
+  hot set and under no cache at all."""
+  mesh = create_mesh(jax.devices()[:4])
+  cfgs = [TableConfig(100, 8, 'sum'), TableConfig(64, 8, 'sum')]
+  hsA = {0: HotSet(0, np.array([0, 1, 2, 3, 7, 11]))}
+  hsB = {0: HotSet(0, np.array([40, 41, 42])),
+         1: HotSet(1, np.array([5, 9]))}
+  rng = np.random.default_rng(4)
+  weights = [(rng.normal(size=(c.input_dim, c.output_dim)) * 0.1).astype(
+      np.float32) for c in cfgs]
+  kernel = jnp.asarray(rng.standard_normal((16, 1)).astype(np.float32) * 0.1)
+  labels = jnp.asarray(rng.integers(0, 2, (8, 1)).astype(np.float32))
+  dA = DistributedEmbedding(cfgs, mesh=mesh, dp_input=True, hot_cache=hsA)
+  opt = SparseAdam(learning_rate=0.01)
+  state = init_hybrid_train_state(
+      dA, {'embedding': set_weights(dA, weights), 'kernel': kernel},
+      optax.sgd(0.05), opt)
+  step = make_hybrid_train_step(dA, _head_loss, optax.sgd(0.05), opt,
+                                donate=False)
+  ids = [rng.integers(0, c.input_dim, size=(8,)).astype(np.int32)
+         for c in cfgs]
+  for _ in range(3):
+    state, _ = step(state, [jnp.asarray(x) for x in ids], labels)
+  sA = get_optimizer_state(dA, state.opt_state[1])
+  # some hot row was touched: its canonical 't' advanced
+  assert any(np.any(np.asarray(s['t']) > 0) for s in sA)
+  for name, cache in (('off', None), ('B', hsB)):
+    d2 = DistributedEmbedding(cfgs, mesh=mesh, dp_input=True,
+                              hot_cache=cache)
+    p2 = set_weights(d2, get_weights(dA, state.params['embedding']))
+    s2 = set_optimizer_state(d2, SparseAdam(learning_rate=0.01).init(d2, p2),
+                             sA)
+    for t, entry in enumerate(get_optimizer_state(d2, s2)):
+      for k in ('m', 'v', 't'):
+        np.testing.assert_array_equal(
+            np.asarray(sA[t][k]), np.asarray(entry[k]),
+            err_msg=f'{name} table {t} leaf {k}')
 
 
 def test_exchange_counters_consistency():
