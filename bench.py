@@ -281,6 +281,30 @@ def main():
   parser.add_argument('--hot_budget_mb', type=float, default=None,
                       help='per-device replication budget for the hot '
                       'rows + optimizer state (None = unbudgeted)')
+  parser.add_argument('--table_dtype', default=None,
+                      choices=['none', 'float32', 'int8', 'float8_e4m3'],
+                      help='quantized table storage A/B (parallel/'
+                      'quantization.py, design §12): per-row-scaled '
+                      'int8 / float8_e4m3 payloads, dequantized at the '
+                      'gather.  The HEADLINE number stays the '
+                      'unquantized arm; the artifact journals '
+                      'table_bytes_per_row off/on (exact byte '
+                      'accounting) plus both step times.  Default: '
+                      'int8 A/B for the sparse trainer off the '
+                      "sparsecore path; 'none'/'float32' skips it")
+  parser.add_argument('--cold_tier_budget_mb', type=float, default=None,
+                      help='host-DRAM cold-tier phase (parallel/'
+                      'coldtier.py, design §12): per-device HBM byte '
+                      'budget the resident head must fit — the tail '
+                      'rows pin in host memory and stream through the '
+                      'deduplicated cold exchange, double-buffered '
+                      'behind device steps.  Default: auto-size to '
+                      '~60%% of the quantized arm\'s resident table '
+                      'bytes so the tier is genuinely exercised (the '
+                      'table does NOT fit without it); 0 skips the '
+                      'phase.  Journals cold_tier_fetch_rows/bytes '
+                      '(exact cross-checkable counters) and the '
+                      'DIRECTLY measured cold_tier_overlap_pct')
   parser.add_argument('--measure_windows', type=int, default=3,
                       help='min-of-k measurement: split --steps into k '
                       'windows and report the fastest window, immunising '
@@ -375,6 +399,46 @@ def main():
                        '--lookup_impl sparsecore (that path pipelines '
                        'through the static-CSR host feed; design §11 '
                        'refusal matrix)')
+  quant_dtype = args.table_dtype
+  if quant_dtype is None:
+    # default: journal the int8 storage A/B for every sparse power-law
+    # run off the sparsecore path (the headline number stays the
+    # unquantized arm — comparable with prior rounds)
+    quant_dtype = ('int8' if (args.trainer == 'sparse'
+                              and args.lookup_impl != 'sparsecore'
+                              and args.param_dtype == 'float32')
+                   else 'none')
+  elif quant_dtype not in ('none', 'float32'):
+    # explicit --table_dtype: fail fast on unsupported combinations
+    # (same discipline as --hot_cache) instead of journaling an
+    # artifact without the requested measurement
+    if args.trainer != 'sparse':
+      raise SystemExit('--table_dtype requires --trainer sparse '
+                       '(dense autodiff cannot differentiate through '
+                       'integer payloads; design §12 refusal matrix)')
+    if args.param_dtype != 'float32':
+      raise SystemExit('--table_dtype requires --param_dtype float32 '
+                       '(the per-row scale carries the dynamic range; '
+                       'design §12 refusal matrix)')
+  use_quant = quant_dtype not in ('none', 'float32')
+  if args.cold_tier_budget_mb is not None and args.cold_tier_budget_mb > 0:
+    # explicit budget: fail fast like --hot_cache / --table_dtype
+    if args.trainer != 'sparse':
+      raise SystemExit('--cold_tier_budget_mb requires --trainer sparse')
+    if not use_hot:
+      raise SystemExit('--cold_tier_budget_mb requires the hot cache '
+                       '(the tier rides the deduplicated cold '
+                       'exchange; design §12 refusal matrix) — drop '
+                       '--no-hot_cache or use a power-law workload')
+    if args.param_dtype != 'float32':
+      raise SystemExit('--cold_tier_budget_mb requires --param_dtype '
+                       'float32 (the host tier stores f32 tails; '
+                       'design §12 refusal matrix)')
+  use_tier = (args.trainer == 'sparse' and use_hot
+              and args.lookup_impl != 'sparsecore'
+              and args.param_dtype == 'float32'
+              and (args.cold_tier_budget_mb is None
+                   or args.cold_tier_budget_mb > 0))
   model = SyntheticModel(config,
                          mesh=mesh,
                          dp_input=True,
@@ -721,6 +785,208 @@ def main():
     except Exception as e:
       a2a_stats = {'a2a_overlap_error': f'{type(e).__name__}: {e}'}
 
+  # Quantized table storage A/B (parallel/quantization.py, design §12;
+  # ISSUE 7).  The OFF arm is the headline step (unquantized, program-
+  # identical to pre-PR); the ON arm re-measures the same model with
+  # per-row-scaled int8/fp8 payloads under the same warmup discipline
+  # and min-of-k windows.  The byte counters are EXACT (plan-derived
+  # row-bytes accounting, hardware-independent): table_bytes_per_row is
+  # payload-only with the per-row scale overhead journaled by name
+  # alongside, so the honest all-in ratio is one line away.  Never
+  # fatal.
+  quant_stats = None
+  if use_quant:
+    try:
+      from distributed_embeddings_tpu.parallel import (
+          quantization as quant_lib)
+      item = jnp.dtype(args.param_dtype).itemsize
+      off_b = quant_lib.table_bytes_stats(model.dist_embedding.plan,
+                                          item)
+      model_q = SyntheticModel(config,
+                               mesh=mesh,
+                               dp_input=True,
+                               row_slice=args.row_slice,
+                               param_dtype=jnp.dtype(args.param_dtype),
+                               compute_dtype=compute_dtype,
+                               packed_storage=args.packed_storage,
+                               lookup_impl=args.lookup_impl,
+                               table_dtype=quant_dtype)
+      on_b = quant_lib.table_bytes_stats(model_q.dist_embedding.plan,
+                                         item)
+      q_params = model_q.init(0)
+      # quantization never changes the id streams, so the headline
+      # run's calibrated capacities describe this arm exactly
+      q_raw = make_hybrid_train_step(model_q.dist_embedding,
+                                     head_loss_fn, optimizer, emb_opt,
+                                     jit=False)
+      copts = ({'exec_time_optimization_effort': -1.0,
+                'memory_fitting_effort': -1.0}
+               if args.fast_compile else None)
+      q_step = jax.jit(
+          lambda st, batch: q_raw(st, list(batch[0][1]),
+                                  (batch[0][0], batch[1])),
+          donate_argnums=(0,), compiler_options=copts)
+      qstate = init_hybrid_train_state(model_q.dist_embedding, q_params,
+                                       optimizer, emb_opt)
+      for i in range(max(3, args.warmup)):
+        qstate, qloss = q_step(qstate, pool[i % len(pool)])
+      sync_loss(qloss, 'quantized-storage warmup sync')
+      q_window_ms = []
+      i = 0
+      for wsteps in split_windows(args.steps, args.measure_windows):
+        t0 = time.perf_counter()
+        for _ in range(wsteps):
+          qstate, qloss = q_step(qstate, pool[i % len(pool)])
+          i += 1
+        sync_loss(qloss, f'quantized-storage window sync at step {i}')
+        q_window_ms.append((time.perf_counter() - t0) / wsteps * 1000)
+      quant_stats = {
+          'table_dtype': quant_dtype,
+          'table_bytes_per_row_off': off_b['table_bytes_per_row'],
+          'table_bytes_per_row': on_b['table_bytes_per_row'],
+          'table_scale_bytes_per_row': on_b['table_scale_bytes_per_row'],
+          'table_total_bytes_per_row': on_b['table_total_bytes_per_row'],
+          'table_bytes_reduction': round(
+              off_b['table_bytes_per_row'] /
+              max(on_b['table_bytes_per_row'], 1e-9), 3),
+          'table_rows': on_b['table_rows'],
+          'quant_ab_off_ms': round(step_ms, 3),
+          'quant_ab_on_ms': round(min(q_window_ms), 3),
+          'quant_window_ms': [round(x, 3) for x in q_window_ms],
+      }
+      del qstate
+    except Exception as e:
+      quant_stats = {'quant_storage_error': f'{type(e).__name__}: {e}'}
+
+  # Host-DRAM cold-tier phase (parallel/coldtier.py, design §12;
+  # ISSUE 7).  The per-device HBM budget is sized (auto: ~60% of the
+  # quantized arm's resident table bytes) so the tables do NOT fit
+  # without the tier — the same plan with cold_tier off must REFUSE
+  # with the OOM-shaped construction error, and that refusal is
+  # journaled as part of the artifact.  The run streams tail rows
+  # host->device through ColdFetchPipeline (the fetch pre-pass double-
+  # buffered behind device steps); counters are exact per-batch row/
+  # byte accounting and cold_tier_overlap_pct is DIRECTLY measured
+  # from consumer blocked time (the CsrFeed accounting, never inferred
+  # from a wall-clock subtraction).  Never fatal.
+  tier_stats = None
+  if use_tier:
+    try:
+      from distributed_embeddings_tpu.parallel import (
+          coldtier as coldtier_lib)
+      tier_dtype = quant_dtype if use_quant else None
+      probe = SyntheticModel(config,
+                             mesh=mesh,
+                             dp_input=True,
+                             row_slice=args.row_slice,
+                             param_dtype=jnp.dtype(args.param_dtype),
+                             compute_dtype=compute_dtype,
+                             packed_storage=args.packed_storage,
+                             lookup_impl=args.lookup_impl,
+                             hot_cache=hs,
+                             table_dtype=tier_dtype)
+      full_bytes = probe.dist_embedding.plan.resident_table_bytes()
+      budget = (int(args.cold_tier_budget_mb * 2**20)
+                if args.cold_tier_budget_mb
+                else max(int(full_bytes * 0.6),
+                         probe.dist_embedding.plan.hot_buffer_bytes()
+                         + 4096))
+      del probe
+      mk = dict(config=config, mesh=mesh, dp_input=True,
+                row_slice=args.row_slice,
+                param_dtype=jnp.dtype(args.param_dtype),
+                compute_dtype=compute_dtype,
+                packed_storage=args.packed_storage,
+                lookup_impl=args.lookup_impl, hot_cache=hs,
+                table_dtype=tier_dtype, device_hbm_budget=budget)
+      # the off arm MUST refuse: same budget, no tier — the §12
+      # OOM-shaped construction error, journaled verbatim
+      try:
+        SyntheticModel(**mk)
+        refusal = ('MISSING: over-budget plan without cold_tier did '
+                   'NOT refuse — §12 gate broken')
+      except ValueError as e:
+        refusal = str(e)[:200]
+      model_t = SyntheticModel(**mk, cold_tier=True)
+      t_params = model_t.init(0)
+      emb_opt_t = emb_opt
+      if args.auto_capacity:
+        import dataclasses as _dc
+        from distributed_embeddings_tpu.parallel import (
+            calibrate_capacity_rows)
+        emb_opt_t = _dc.replace(
+            emb_opt,
+            capacity_rows=calibrate_capacity_rows(
+                model_t.dist_embedding,
+                [jnp.asarray(c) for c in cats0],
+                params=t_params['embedding']))
+      # make_hybrid_train_step owns the tier protocol (host fetch
+      # outside the jit boundary, writeback after the step) — use its
+      # jitted runner directly instead of bench's own jit wrapper
+      t_run = make_hybrid_train_step(model_t.dist_embedding,
+                                     head_loss_fn, optimizer, emb_opt_t,
+                                     jit=True, donate=False)
+      tstate = init_hybrid_train_state(model_t.dist_embedding, t_params,
+                                       optimizer, emb_opt_t)
+      n_meas = max(args.steps, 8)
+      n_warm = max(3, args.warmup)
+
+      def cats_src():
+        for j in range(n_warm + n_meas):
+          yield [np.asarray(c) for c in gen.pool[j % len(gen.pool)][0][1]]
+
+      pipe = coldtier_lib.ColdFetchPipeline(model_t.dist_embedding,
+                                            cats_src())
+      fetch_rows_t = 0
+      fetch_bytes_t = 0
+      fetch_scale_t = 0
+      per_group_rows = None
+      row_bytes_pg = None
+      j = 0
+      t0 = None
+      for cats, fetch in pipe:
+        (num, _), lab = gen.pool[j % len(gen.pool)]
+        tstate, tloss = t_run(tstate, cats, (jnp.asarray(num),
+                                             jnp.asarray(lab)),
+                              cold_fetch=fetch)
+        if j >= n_warm:
+          fs = coldtier_lib.fetch_stats(model_t.dist_embedding, fetch)
+          fetch_rows_t += fs['cold_tier_fetch_rows']
+          fetch_bytes_t += fs['cold_tier_fetch_bytes']
+          fetch_scale_t += fs['cold_tier_fetch_scale_bytes']
+          row_bytes_pg = fs['cold_tier_row_bytes_per_group']
+          pg = fs['cold_tier_fetch_rows_per_group']
+          per_group_rows = (pg if per_group_rows is None else
+                            [a + b for a, b in zip(per_group_rows, pg)])
+        j += 1
+        if j == n_warm:
+          # steady state: batch 0's fetch had no prior step to hide
+          # behind, and warmup compiles are not representative walls
+          sync_loss(tloss, 'cold-tier warmup sync')
+          pipe.reset_stats()
+          t0 = time.perf_counter()
+      sync_loss(tloss, 'cold-tier measurement sync')
+      tier_ms = (time.perf_counter() - t0) / n_meas * 1000
+      pstats = pipe.stats()
+      tier_stats = coldtier_lib.tier_stats(model_t.dist_embedding)
+      tier_stats.update({
+          'cold_tier': True,
+          'cold_tier_off_refusal': refusal,
+          'cold_tier_step_ms': round(tier_ms, 3),
+          'cold_tier_steps_measured': n_meas,
+          'cold_tier_fetch_rows': int(fetch_rows_t),
+          'cold_tier_fetch_bytes': int(fetch_bytes_t),
+          'cold_tier_fetch_scale_bytes': int(fetch_scale_t),
+          'cold_tier_fetch_rows_per_group': per_group_rows,
+          'cold_tier_row_bytes_per_group': row_bytes_pg,
+          'cold_tier_build_ms': pstats['build_ms'],
+          'cold_tier_blocked_ms': pstats['blocked_ms'],
+          'cold_tier_overlap_pct': pstats['overlap_pct'],
+      })
+      del tstate
+    except Exception as e:
+      tier_stats = {'cold_tier_error': f'{type(e).__name__}: {e}'}
+
   n_dev = len(devices)
   backend = devices[0].platform
   # the baselines are AT global batch 65536: a reduced-batch chip run
@@ -796,6 +1062,10 @@ def main():
     result.update(hot_stats)
   if a2a_stats:
     result.update(a2a_stats)
+  if quant_stats:
+    result.update(quant_stats)
+  if tier_stats:
+    result.update(tier_stats)
   if on_cpu:
     # a sweep window may have landed an on-chip line earlier this round;
     # carry it (labelled, with its own sha/timestamp) so the artifact is

@@ -119,6 +119,9 @@ class DLRM:
       overlap, forwarded to ``DistributedEmbedding`` (docs/design.md
       §11).  1 (default) is the monolithic program; requires
       ``dp_input=True`` when > 1.
+    table_dtype / cold_tier / device_hbm_budget / cold_fetch_rows:
+      quantized table storage and the host-DRAM cold tier, forwarded
+      to ``DistributedEmbedding`` (docs/design.md §12).
   """
   table_sizes: Sequence[int]
   embedding_dim: int = 128
@@ -134,6 +137,10 @@ class DLRM:
   compute_dtype: Any = jnp.float32
   hot_cache: Any = None
   overlap_chunks: int = 1
+  table_dtype: Any = None
+  cold_tier: bool = False
+  device_hbm_budget: Optional[int] = None
+  cold_fetch_rows: Any = None
 
   def __post_init__(self):
     if self.bottom_mlp_dims[-1] != self.embedding_dim:
@@ -162,7 +169,11 @@ class DLRM:
         param_dtype=self.param_dtype,
         compute_dtype=self.compute_dtype,
         hot_cache=self.hot_cache,
-        overlap_chunks=self.overlap_chunks)
+        overlap_chunks=self.overlap_chunks,
+        table_dtype=self.table_dtype,
+        cold_tier=self.cold_tier,
+        device_hbm_budget=self.device_hbm_budget,
+        cold_fetch_rows=self.cold_fetch_rows)
 
   @property
   def num_interaction_features(self) -> int:

@@ -248,6 +248,10 @@ class SyntheticModel:
       dp<->mp exchange with compute-collective overlap (docs/design.md
       §11).  1 (default) is the monolithic program; requires
       ``dp_input=True`` when > 1.
+    table_dtype / cold_tier / device_hbm_budget / cold_fetch_rows:
+      forwarded to ``DistributedEmbedding`` — quantized table storage
+      (per-row-scaled int8 / float8_e4m3 payloads) and the host-DRAM
+      cold tier (docs/design.md §12).
   """
   config: ModelConfig
   mesh: Optional[Mesh] = None
@@ -261,6 +265,10 @@ class SyntheticModel:
   lookup_impl: str = 'auto'
   hot_cache: Any = None
   overlap_chunks: int = 1
+  table_dtype: Any = None
+  cold_tier: bool = False
+  device_hbm_budget: Optional[int] = None
+  cold_fetch_rows: Any = None
 
   def __post_init__(self):
     tables, input_table_map, hotness = expand_tables(self.config)
@@ -279,7 +287,11 @@ class SyntheticModel:
         packed_storage=self.packed_storage,
         lookup_impl=self.lookup_impl,
         hot_cache=self.hot_cache,
-        overlap_chunks=self.overlap_chunks)
+        overlap_chunks=self.overlap_chunks,
+        table_dtype=self.table_dtype,
+        cold_tier=self.cold_tier,
+        device_hbm_budget=self.device_hbm_budget,
+        cold_fetch_rows=self.cold_fetch_rows)
     total_width = sum(
         tables[t].output_dim for t in input_table_map)
     if self.config.interact_stride is not None:

@@ -13,6 +13,8 @@ from distributed_embeddings_tpu.parallel.planner import (
 )
 from distributed_embeddings_tpu.parallel.dist_embedding import DistributedEmbedding
 from distributed_embeddings_tpu.parallel.checkpoint import (
+    QuantizedWeight,
+    export_tables,
     get_weights,
     set_weights,
     get_optimizer_state,
@@ -68,3 +70,12 @@ from distributed_embeddings_tpu.parallel.sparsecore import (
     preprocess_batch_host,
 )
 from distributed_embeddings_tpu.parallel.csr_feed import CsrFeed, FedBatch
+from distributed_embeddings_tpu.parallel.coldtier import (
+    ColdFetchPipeline,
+    HostTier,
+)
+from distributed_embeddings_tpu.parallel.quantization import (
+    QuantSpec,
+    resolve_table_dtype,
+    table_bytes_stats,
+)

@@ -17,7 +17,7 @@ from typing import Callable, Dict, Optional
 import numpy as np
 
 from distributed_embeddings_tpu.parallel.checkpoint import (
-    get_optimizer_state, get_weights, is_hybrid_opt_state,
+    export_tables, get_optimizer_state, get_weights, is_hybrid_opt_state,
     prune_checkpoints, save_train_npz)
 
 
@@ -82,7 +82,9 @@ class CheckpointCallback:
       raise ValueError(
           "CheckpointCallback expects state.params['embedding'] (the "
           'hybrid train-state layout)')
-    weights = get_weights(self.dist, emb)
+    # quantized plans (design §12) export payload+scale pairs so the
+    # saved file carries quantized table bytes, not a 4x f32 blow-up
+    weights = export_tables(self.dist, emb)
     sparse = self.sparse
     if sparse is None:
       sparse = is_hybrid_opt_state(self.dist, state.opt_state)

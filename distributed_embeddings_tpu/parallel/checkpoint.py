@@ -32,10 +32,76 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+import dataclasses
+
+from distributed_embeddings_tpu.parallel import quantization
 from distributed_embeddings_tpu.parallel.dist_embedding import DistributedEmbedding
 from distributed_embeddings_tpu.utils import resilience
 
-WeightLike = Union[np.ndarray, str]
+
+@dataclasses.dataclass
+class QuantizedWeight:
+  """One table's canonical QUANTIZED checkpoint entry (design §12):
+  ``payload`` ``[rows, width]`` at int8/float8_e4m3, ``scale``
+  ``[rows]`` f32 power-of-two per-row scales.  ``values()`` is the
+  exact dequantization (po2 scales only shift exponents), so restoring
+  into an f32 plan — or requantizing into any quantized plan whose
+  shard rows span full logical rows — is bit-lossless.
+
+  Scale granularity contract: the canonical file carries ONE scale per
+  LOGICAL row.  Shards spanning full rows (plain, row-sliced and
+  cold-tier tables — the beyond-HBM regime this exists for) round-trip
+  bit-exactly.  A COLUMN-sliced quantized table stores per-slice scales
+  at runtime; its first save re-rounds each slice onto the coarser
+  row grid (error bounded by one quantization step; every later
+  save/restore of the same values is bit-stable).  Pinned in
+  tests/test_quantized_storage.py.
+  """
+  payload: np.ndarray
+  scale: np.ndarray
+  dtype_name: str
+
+  @property
+  def shape(self):
+    return self.payload.shape
+
+  def values(self) -> np.ndarray:
+    return quantization.dequantize_np(self.payload,
+                                      self.scale.reshape(-1, 1))
+
+  @classmethod
+  def from_values(cls, values: np.ndarray, spec) -> 'QuantizedWeight':
+    payload, scale = quantization.quantize_np(
+        np.asarray(values, np.float32), spec)
+    return cls(payload=payload, scale=scale.reshape(-1),
+               dtype_name=spec.name)
+
+
+WeightLike = Union[np.ndarray, str, QuantizedWeight]
+
+
+def _canonical_values(w) -> np.ndarray:
+  """Any weight entry (array, .npy path, QuantizedWeight) as its exact
+  canonical f32 (or original-dtype) value array."""
+  if isinstance(w, QuantizedWeight):
+    return w.values()
+  return _load(w)
+
+
+def export_tables(dist: DistributedEmbedding, params,
+                  gather: str = 'auto',
+                  chunk_elems: int = None) -> List[WeightLike]:
+  """The canonical per-table checkpoint entries for THIS plan: plain
+  f32 arrays for unquantized plans, ``QuantizedWeight`` payload+scale
+  pairs (4x smaller on disk for int8) for quantized ones — what
+  ``save_train_npz`` should be handed so saved files carry
+  payload+scales only (design §12)."""
+  kw = {} if chunk_elems is None else {'chunk_elems': chunk_elems}
+  tables = get_weights(dist, params, gather=gather, **kw)
+  spec = getattr(dist.plan, 'table_spec', None)
+  if spec is None:
+    return tables
+  return [QuantizedWeight.from_values(t, spec) for t in tables]
 
 # Default streaming-gather chunk: 2**27 elements (512 MiB f32) per fetch,
 # the same order as the reference's 128M-element scatter chunks
@@ -109,6 +175,42 @@ def _host_shards(dist: DistributedEmbedding, arr: jax.Array, gather: str,
   return shards
 
 
+def _value_shards(dist: DistributedEmbedding, params, gi: int,
+                  gather: str, chunk_elems: int) -> List[np.ndarray]:
+  """Per-device ``[rows_cap, width]`` VALUE shards of one fusion group.
+
+  The one place storage layout (design §12) unfolds back into values:
+  the device payload is gathered and — for quantized plans —
+  dequantized against its ``scale_group_{gi}`` leaf (exact: po2 scales
+  only shift exponents), and cold-tier groups append their host-DRAM
+  tail rows, so every caller downstream of here sees the full fused
+  natural rows regardless of ``table_dtype`` or tier split."""
+  g = dist.plan.groups[gi]
+  quant = getattr(dist, 'quant', None)
+  shards = [
+      s.reshape(g.device_rows, g.width) for s in
+      _host_shards(dist, params[f'group_{gi}'], gather, chunk_elems)
+  ]
+  if quant is not None:
+    sshards = _host_shards(dist, params[f'scale_group_{gi}'], gather,
+                           chunk_elems)
+    shards = [
+        quantization.dequantize_np(p, s.reshape(-1, 1))
+        for p, s in zip(shards, sshards)
+    ]
+  if g.tier_rows and dist.cold_tier is not None:
+    tails = []
+    for dev in range(dist.world_size):
+      t = dist.cold_tier.payload[gi][dev]
+      if quant is not None:
+        t = quantization.dequantize_np(t, dist.cold_tier.scale[gi][dev])
+      tails.append(np.asarray(t, shards[dev].dtype))
+    shards = [
+        np.concatenate([h, t], axis=0) for h, t in zip(shards, tails)
+    ]
+  return shards
+
+
 def set_weights(dist: DistributedEmbedding,
                 weights: Sequence[WeightLike]) -> Dict[str, jax.Array]:
   """Build the sharded parameter pytree from global per-table weights.
@@ -130,23 +232,24 @@ def set_weights(dist: DistributedEmbedding,
         f'You called set_weights with a weight list of length '
         f'{len(weights)}, but the layer was expecting '
         f'{len(plan.table_configs)} weights.')
-  loaded = [_load(w) for w in weights]
+  # canonical VALUES: QuantizedWeight entries dequantize exactly here,
+  # then the live plan re-quantizes / re-tiers below into WHATEVER
+  # table_dtype / tier split it carries (design §12 — mirrors the hot-set
+  # canonicalization: storage layout never leaks into saved state)
+  loaded = [_canonical_values(w) for w in weights]
   for tid, (w, cfg) in enumerate(zip(loaded, plan.table_configs)):
     if tuple(w.shape) != (cfg.input_dim, cfg.output_dim):
       raise ValueError(
           f'table {tid}: expected shape {(cfg.input_dim, cfg.output_dim)}, '
           f'got {tuple(w.shape)}')
 
+  quant = getattr(dist, 'quant', None)
   params = {}
   for gi, g in enumerate(plan.groups):
-    # packed-storage groups live device-side as [rows_cap/pack, 128]
-    # (GroupSpec.storage_pack); the host-side regrouping reshape is free
-    # (row-major) and keeps the checkpoint contract natural-space
-    shape = (dist.world_size, g.param_rows, g.param_width)
     sharding = NamedSharding(dist.mesh, P(dist.axis_name, None, None))
 
-    def make_shard(index, g=g):
-      dev = index[0].start if index[0].start is not None else 0
+    def full_rows(dev, g=g, dtype=None):
+      dtype = dtype or dist.param_dtype
       chunks = []
       for lt in g.member_tables[dev]:
         # row_stride > 1: a mod-sharded window (residue class) — numpy's
@@ -155,15 +258,74 @@ def set_weights(dist: DistributedEmbedding,
             np.asarray(
                 loaded[lt.table_id][lt.row_start:lt.row_end:lt.row_stride,
                                     lt.col_start:lt.col_end],
-                dtype=dist.param_dtype))
+                dtype=dtype))
       pad_rows = g.rows_cap - g.rows[dev]
       if pad_rows or not chunks:
-        chunks.append(np.zeros((pad_rows, g.width), dist.param_dtype))
-      full = np.concatenate(chunks, axis=0)
-      return full.reshape(g.param_rows, g.param_width)[None]
+        chunks.append(np.zeros((pad_rows, g.width), dtype))
+      return np.concatenate(chunks, axis=0)
 
+    if quant is None and g.tier_rows == 0:
+      # packed-storage groups live device-side as [rows_cap/pack, 128]
+      # (GroupSpec.storage_pack); the host-side regrouping reshape is
+      # free (row-major) and keeps the checkpoint contract natural-space
+      def make_shard(index, g=g):
+        dev = index[0].start if index[0].start is not None else 0
+        return full_rows(dev, g).reshape(g.param_rows, g.param_width)[None]
+
+      params[f'group_{gi}'] = jax.make_array_from_callback(
+          (dist.world_size, g.param_rows, g.param_width), sharding,
+          make_shard)
+      continue
+    # quantized and/or cold-tier group (design §12): quantize each
+    # device's rows host-side (bitwise-identical to the traced
+    # requant), split the tail off into the host tier, ship the head.
+    # Quantized/tiered plans always store natural (planner contract).
+    # Quantization happens on FULL-WIDTH rows — the canonical per-row
+    # grid — and the payload is sliced after: a column-sliced shard
+    # then carries the row scale (value-exact; the runtime's per-slice
+    # refresh only ever moves to a finer grid), so untrained
+    # set->get->export round-trips are bit-stable for every layout.
+    res = g.device_rows
+
+    def quant_rows(dev, g=g):
+      pays, scales = [], []
+      for lt in g.member_tables[dev]:
+        rows = np.asarray(
+            loaded[lt.table_id][lt.row_start:lt.row_end:lt.row_stride],
+            np.float32)
+        fp, fs = quantization.quantize_np(rows, quant)
+        pays.append(fp[:, lt.col_start:lt.col_end])
+        scales.append(fs)
+      pad_rows = g.rows_cap - g.rows[dev]
+      if pad_rows or not pays:
+        pays.append(np.zeros((pad_rows, g.width), quant.dtype))
+        scales.append(np.ones((pad_rows, 1), np.float32))
+      return np.concatenate(pays, axis=0), np.concatenate(scales, axis=0)
+
+    heads, head_scales, tails, tail_scales = [], [], [], []
+    for dev in range(dist.world_size):
+      if quant is not None:
+        payload, scale = quant_rows(dev)
+      else:
+        payload, scale = full_rows(dev, g, dtype=dist.param_dtype), None
+      heads.append(payload[:res])
+      if scale is not None:
+        head_scales.append(scale[:res])
+      if g.tier_rows:
+        tails.append(payload[res:])
+        if scale is not None:
+          tail_scales.append(scale[res:])
+    if g.tier_rows:
+      dist.cold_tier.set_tail(gi, 'payload', np.stack(tails))
+      if tail_scales:
+        dist.cold_tier.set_tail(gi, 'scale', np.stack(tail_scales))
     params[f'group_{gi}'] = jax.make_array_from_callback(
-        shape, sharding, make_shard)
+        (dist.world_size, res, g.width), sharding,
+        lambda index, hs=heads: hs[index[0].start or 0][None])
+    if quant is not None:
+      params[f'scale_group_{gi}'] = jax.make_array_from_callback(
+          (dist.world_size, res, 1), sharding,
+          lambda index, ss=head_scales: ss[index[0].start or 0][None])
   params.update(_hot_leaves_from_tables(dist, loaded, dist.param_dtype))
   return params
 
@@ -173,19 +335,40 @@ def _hot_leaves_from_tables(dist, tables, dtype, leaf_prefix='hot_group_'):
   arrays (the ``set_weights``/``set_optimizer_state`` leg of the
   design-§10 canonicalization contract: hot membership is a layout
   detail, so a checkpoint restores into ANY hot set by re-slicing the
-  canonical rows).  Returns ``{}`` for cache-less layers."""
+  canonical rows).  Quantized plans (design §12) quantize the
+  replicated buffer per row exactly like the device init, emitting the
+  ``hot_scale_group_{gi}`` leaf alongside.  Returns ``{}`` for
+  cache-less layers."""
   plan = dist.plan
+  quant = (getattr(dist, 'quant', None)
+           if leaf_prefix == 'hot_group_' else None)
   out = {}
   for gi in getattr(plan, 'hot_groups', []):
     g = plan.groups[gi]
-    buf = np.zeros((g.hot_rows_cap, g.width), dtype)
-    for tid, cs, ce, off, k in g.hot_chunks:
-      ids = plan.hot_sets[tid].ids
-      buf[off:off + k] = np.asarray(
-          np.asarray(tables[tid])[ids, cs:ce], dtype=dtype)
     sharding = NamedSharding(dist.mesh, P(None, None))
-    out[f'{leaf_prefix}{gi}'] = jax.make_array_from_callback(
-        buf.shape, sharding, lambda index, buf=buf: buf[index])
+    if quant is not None:
+      # the canonical per-ROW grid, like the sharded leaves: quantize
+      # full-width hot rows, then slice the payload per chunk
+      payload = np.zeros((g.hot_rows_cap, g.width), quant.dtype)
+      scale = np.ones((g.hot_rows_cap, 1), np.float32)
+      for tid, cs, ce, off, k in g.hot_chunks:
+        ids = plan.hot_sets[tid].ids
+        fp, fs = quantization.quantize_np(
+            np.asarray(np.asarray(tables[tid])[ids], np.float32), quant)
+        payload[off:off + k] = fp[:, cs:ce]
+        scale[off:off + k] = fs
+      out[f'{leaf_prefix}{gi}'] = jax.make_array_from_callback(
+          payload.shape, sharding, lambda index, b=payload: b[index])
+      out[f'hot_scale_group_{gi}'] = jax.make_array_from_callback(
+          scale.shape, sharding, lambda index, b=scale: b[index])
+    else:
+      buf = np.zeros((g.hot_rows_cap, g.width), dtype)
+      for tid, cs, ce, off, k in g.hot_chunks:
+        ids = plan.hot_sets[tid].ids
+        buf[off:off + k] = np.asarray(
+            np.asarray(tables[tid])[ids, cs:ce], dtype=dtype)
+      out[f'{leaf_prefix}{gi}'] = jax.make_array_from_callback(
+          buf.shape, sharding, lambda index, buf=buf: buf[index])
   return out
 
 
@@ -231,9 +414,8 @@ def get_weights(dist: DistributedEmbedding,
   plan = dist.plan
   group_index = {g.key: gi for gi, g in enumerate(plan.groups)}
   host_shards = {
-      gi: [s.reshape(g.rows_cap, g.width) for s in
-           _host_shards(dist, params[f'group_{gi}'], gather, chunk_elems)]
-      for gi, g in enumerate(plan.groups)
+      gi: _value_shards(dist, params, gi, gather, chunk_elems)
+      for gi in range(len(plan.groups))
   }
 
   hot = bool(getattr(plan, 'hot_sets', None))
@@ -267,11 +449,21 @@ def get_weights(dist: DistributedEmbedding,
     # the sharded slots of hot rows are stale while the rows are hot
     # (the runtime updates only the replicated buffer) — the buffer is
     # authoritative, and writing it back here is what keeps hot
-    # membership invisible in saved state (design §10)
-    _overlay_hot_rows(dist, result, {
-        gi: params[f'hot_group_{gi}']
-        for gi in plan.hot_groups if f'hot_group_{gi}' in params
-    })
+    # membership invisible in saved state (design §10).  Quantized hot
+    # buffers dequantize first (exact, §12) so the overlay writes
+    # values like every other path.
+    leaves = {}
+    for gi in plan.hot_groups:
+      hk = f'hot_group_{gi}'
+      if hk not in params:
+        continue
+      buf = np.asarray(jax.device_get(params[hk]))
+      if getattr(dist, 'quant', None) is not None:
+        buf = quantization.dequantize_np(
+            buf, np.asarray(jax.device_get(
+                params[f'hot_scale_group_{gi}'])))
+      leaves[gi] = buf
+    _overlay_hot_rows(dist, result, leaves)
   return result
 
 
@@ -310,10 +502,23 @@ def get_optimizer_state(dist: DistributedEmbedding,
       # elementwise leaves follow the params' (possibly packed) physical
       # layout — regroup to natural rows; per-row leaves are natural
       host[(gi, k)] = [
-          s.reshape(g.rows_cap, g.width)
+          s.reshape(g.device_rows, g.width)
           if s.shape == (g.param_rows, g.param_width) else s
           for s in shards
       ]
+      if g.tier_rows:
+        # cold-tier groups (design §12): the tail rows' optimizer state
+        # lives in the host tier — append it so the canonical layout
+        # covers the full table (zeros if the leaf was never created,
+        # e.g. state gathered before the first train step)
+        tier = getattr(dist, 'cold_tier', None)
+        tail = tier.opt[gi].get(k) if tier is not None else None
+        host[(gi, k)] = [
+            np.concatenate([
+                h, (np.asarray(tail[dev], h.dtype) if tail is not None
+                    else np.zeros((g.tier_rows,) + h.shape[1:], h.dtype))
+            ]) for dev, h in enumerate(host[(gi, k)])
+        ]
 
   result = []
   for tid, shards in enumerate(plan.shard_layout()):
@@ -389,8 +594,7 @@ def set_optimizer_state(dist: DistributedEmbedding,
     gkey = f'group_{gi}'
     new_state[gkey] = {}
     for k, tmpl in opt_state.get(gkey, {}).items():
-      def make_shard(index, g=g, k=k, tmpl=tmpl):
-        dev = index[0].start if index[0].start is not None else 0
+      def full_state_rows(dev, g=g, k=k, tmpl=tmpl):
         dtype = tmpl.dtype
         chunks = []
         for lt in g.member_tables[dev]:
@@ -410,7 +614,33 @@ def set_optimizer_state(dist: DistributedEmbedding,
           pad_shape = ((pad_rows, g.width) if tmpl.ndim == 3
                        else (pad_rows,))
           chunks.append(np.zeros(pad_shape, dtype))
-        full = np.concatenate(chunks, axis=0)
+        return np.concatenate(chunks, axis=0)
+
+      # canonical device-major sharding (the template may still carry the
+      # single-device sharding optimizer.init created it with)
+      sharding = NamedSharding(
+          dist.mesh, P(dist.axis_name, *([None] * (tmpl.ndim - 1))))
+      if g.tier_rows:
+        # cold-tier group (design §12): tail rows' state lives in the
+        # host tier — split it off host-side, ship the head (tiered
+        # groups are natural and elementwise-only, planner contract)
+        res = g.device_rows
+        heads, tails = [], []
+        for dev in range(dist.world_size):
+          full = full_state_rows(dev)
+          heads.append(full[:res])
+          tails.append(full[res:])
+        if getattr(dist, 'cold_tier', None) is not None:
+          dist.cold_tier.opt[gi][k] = np.stack(tails)
+        new_state[gkey][k] = jax.make_array_from_callback(
+            tmpl.shape, sharding,
+            lambda index, hs=heads: hs[index[0].start or 0][None])
+        continue
+
+      def make_shard(index, g=g, tmpl=tmpl,
+                     full_state_rows=full_state_rows):
+        dev = index[0].start if index[0].start is not None else 0
+        full = full_state_rows(dev)
         if tmpl.ndim == 3 and tmpl.shape[1:] == (g.param_rows,
                                                  g.param_width):
           # elementwise leaf of a packed-storage group: regroup to the
@@ -418,10 +648,6 @@ def set_optimizer_state(dist: DistributedEmbedding,
           full = full.reshape(g.param_rows, g.param_width)
         return full[None]
 
-      # canonical device-major sharding (the template may still carry the
-      # single-device sharding optimizer.init created it with)
-      sharding = NamedSharding(
-          dist.mesh, P(dist.axis_name, *([None] * (tmpl.ndim - 1))))
       new_state[gkey][k] = jax.make_array_from_callback(
           tmpl.shape, sharding, make_shard)
   # replicated hot-cache split state: re-slice from the canonical
@@ -461,11 +687,36 @@ def _portable(a) -> np.ndarray:
   Every other kind passes through unchanged: numpy serialises complex,
   string/bytes, object-free structured and bool arrays natively, and
   the old blanket up-cast silently truncated complex extras and garbled
-  non-numeric ones (ADVICE.md round 5, low #3)."""
+  non-numeric ones (ADVICE.md round 5, low #3).
+
+  ``QuantizedWeight`` entries (design §12) dequantize to their EXACT
+  f32 values (po2 scales: the multiply only shifts exponents, so this
+  is value-lossless) — the fallback for key schemes with no sidecar
+  slot (the positional ``arr_i`` interchange format).
+  ``save_train_npz`` instead keeps the pair AS payload+scale members
+  (int8 natively; fp8 payloads as a uint8 bit-view plus a dtype tag —
+  the blanket f32 up-cast would have kept the values but quadrupled
+  the file, defeating quantized storage on disk)."""
+  if isinstance(a, QuantizedWeight):
+    return a.values()
   a = np.asarray(a)
   if a.dtype.kind == 'V' and a.dtype.names is None:
     return a.astype(np.float32)
   return a
+
+
+def _quantized_members(i: int, w: QuantizedWeight) -> Dict[str, np.ndarray]:
+  """``save_train_npz`` members of one quantized table: the payload
+  under the plain ``table{i}`` key (fp8 as a uint8 bit-view — np.savez
+  would garble the ml_dtypes array, see ``_portable``) plus
+  ``table{i}:scale`` / ``table{i}:dtype`` sidecars.  Bit-lossless by
+  construction; ``_parse_train_payload`` reassembles the pair."""
+  p = np.asarray(w.payload)
+  return {
+      f'table{i}': p if p.dtype.kind == 'i' else p.view(np.uint8),
+      f'table{i}:scale': np.asarray(w.scale, np.float32).reshape(-1),
+      f'table{i}:dtype': np.array(w.dtype_name),
+  }
 
 
 # --------------------------------------------------------------------------
@@ -734,12 +985,21 @@ def save_train_npz(path: str,
   Keys: ``table{i}`` for weights, ``table{i}/{leaf}`` for state leaves —
   the global canonical layout, so the file reshards on load like the
   weight-only path — and ``extra/{name}`` for scalar metadata such as the
-  step counter.
+  step counter.  ``QuantizedWeight`` entries (``export_tables`` on a
+  quantized plan, design §12) store payload+scale losslessly with
+  ``table{i}:scale`` / ``table{i}:dtype`` sidecar members — int8 files
+  carry ~4x fewer table bytes than f32 and restore bit-exactly into
+  any plan.
   """
   if table_states is not None and len(table_states) != len(weights):
     raise ValueError(f'got {len(table_states)} per-table states for '
                      f'{len(weights)} weight tables')
-  payload = {f'table{i}': _portable(w) for i, w in enumerate(weights)}
+  payload = {}
+  for i, w in enumerate(weights):
+    if isinstance(w, QuantizedWeight):
+      payload.update(_quantized_members(i, w))
+    else:
+      payload[f'table{i}'] = _portable(w)
   for i, entry in enumerate(table_states or []):
     for k, v in entry.items():
       payload[f'table{i}/{k}'] = _portable(v)
@@ -755,24 +1015,42 @@ def save_train_npz(path: str,
 def _parse_train_payload(arrays: Dict[str, np.ndarray], path: str):
   """``save_train_npz`` key scheme -> ``(weights, table_states,
   extras)``; raises ``ValueError`` when the arrays are not a resumable
-  train checkpoint."""
+  train checkpoint.  Tables with ``table{i}:scale`` sidecars reassemble
+  into ``QuantizedWeight`` pairs (fp8 payloads bit-view back from their
+  uint8 storage) — ``set_weights`` dequantizes them exactly on load."""
   table_keys = [k for k in arrays if k.startswith('table')]
   if not table_keys:
     raise ValueError(f'{path}: no table entries')
-  n = 1 + max(int(k.split('/')[0][5:]) for k in table_keys)
-  weights: List[Optional[np.ndarray]] = [None] * n
+  n = 1 + max(
+      int(k.split('/')[0].partition(':')[0][5:]) for k in table_keys)
+  weights: List[Optional[WeightLike]] = [None] * n
   states: List[Dict[str, np.ndarray]] = [dict() for _ in range(n)]
+  sidecars: Dict[int, Dict[str, np.ndarray]] = {}
   extras: Dict[str, np.ndarray] = {}
   for k, v in arrays.items():
     head, _, leaf = k.partition('/')
     if head == 'extra':
       extras[leaf] = v
       continue
-    i = int(head[5:])
-    if leaf:
+    name, _, tag = head.partition(':')
+    i = int(name[5:])
+    if tag:
+      sidecars.setdefault(i, {})[tag] = v
+    elif leaf:
       states[i][leaf] = v
     else:
       weights[i] = v
+  for i, sc in sidecars.items():
+    if 'scale' not in sc or weights[i] is None:
+      raise ValueError(f'{path}: incomplete quantized entry for table {i}')
+    spec = quantization.resolve_table_dtype(str(sc['dtype'][()])
+                                            if 'dtype' in sc else 'int8')
+    p = np.asarray(weights[i])
+    if p.dtype != spec.dtype:
+      p = p.view(spec.dtype)  # fp8 stored as its uint8 bit-view
+    weights[i] = QuantizedWeight(payload=p,
+                                 scale=np.asarray(sc['scale'], np.float32),
+                                 dtype_name=spec.name)
   missing = [i for i, w in enumerate(weights) if w is None]
   if missing:
     raise ValueError(f'{path}: missing weight entries for tables {missing}')

@@ -1,0 +1,385 @@
+"""Host-DRAM cold tier: host arrays, per-batch fetch, writeback, pipeline.
+
+The host half of docs/design.md §12.  A cold-tier plan keeps only each
+fusion group's device-resident head (``GroupSpec.resident_rows``) in
+HBM; the tail rows live here, in per-(group, device) host arrays
+(``HostTier``), quantized exactly like the device payload.  Per batch:
+
+1. ``compute_fetch_rows`` mirrors the runtime routing in NumPy (the
+   same id->owner map ``hotcache.measure_exchange_counters`` uses):
+   clip valid ids, strip hot ids, route to each owner device's fused
+   local rows, keep rows ``>= resident_rows``, and DEDUPLICATE — the
+   fetch list is exactly the tail slice of the deduplicated cold
+   exchange the hot-cache forward already performs.
+2. ``build_fetch`` gathers those rows (payload + scale + optimizer
+   rows) from the host tier into padded, static-shape device buffers.
+3. The device step gathers tail rows from the buffers
+   (``dist_embedding._tiered_gather``), the sparse apply updates them
+   alongside the resident head, and returns the touched rows as a
+   writeback output.
+4. ``write_back`` stores the updated (re-quantized) rows into the tier.
+
+``ColdFetchPipeline`` double-buffers step 1 — the expensive host pass —
+on a worker thread while the device runs the previous step (the same
+shape as ``CsrFeed``'s host-build overlap); the payload gather of step
+2 stays on the consumer side, AFTER the previous step's writeback, so
+pipelining never reads stale rows.  Its ``stats()`` measure the hidden
+fraction directly from consumer blocked time (``cold_tier_overlap_pct``
+is measured, never inferred).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from distributed_embeddings_tpu.parallel import quantization
+
+_FETCH_MARGIN = 1.5
+_FETCH_ALIGN = 64
+
+
+class HostTier:
+  """Per-(group, device) host arrays holding the tail rows
+  ``[resident_rows, rows_cap)`` of every cold-tier group: quantized
+  payload, per-row scales (quantized plans), and optimizer-state rows
+  (``ensure_opt``)."""
+
+  def __init__(self, plan, quant):
+    self.plan = plan
+    self.quant = quant
+    dt = np.dtype(quant.dtype) if quant is not None else np.float32
+    self.payload: Dict[int, np.ndarray] = {}
+    self.scale: Dict[int, np.ndarray] = {}
+    self.opt: Dict[int, Dict[str, np.ndarray]] = {}
+    for gi in plan.cold_tier_groups:
+      g = plan.groups[gi]
+      self.payload[gi] = np.zeros(
+          (plan.world_size, g.tier_rows, g.width), dt)
+      if quant is not None:
+        self.scale[gi] = np.ones(
+            (plan.world_size, g.tier_rows, 1), np.float32)
+      self.opt[gi] = {}
+
+  def set_tail(self, gi: int, leaf: str, arr: np.ndarray):
+    """Install one group's full tail (``[D, tier_rows, ...]``)."""
+    target = self.payload if leaf == 'payload' else self.scale
+    want = target[gi].shape if gi in target else None
+    arr = np.asarray(arr)
+    if want is not None and arr.shape != want:
+      raise ValueError(f'tier tail for group {gi}/{leaf}: expected '
+                       f'shape {want}, got {arr.shape}')
+    target[gi] = arr.astype(target[gi].dtype) if gi in target else arr
+
+  def ensure_opt(self, leaf: str, fill: float, dtype):
+    """Create (idempotently) one optimizer-state leaf's tail arrays,
+    filled with the optimizer's init value — the host half of e.g.
+    Adagrad's accumulator for tier rows."""
+    for gi in self.plan.cold_tier_groups:
+      if leaf in self.opt[gi]:
+        continue
+      g = self.plan.groups[gi]
+      self.opt[gi][leaf] = np.full(
+          (self.plan.world_size, g.tier_rows, g.width), fill,
+          np.dtype(dtype))
+
+  def host_bytes(self) -> int:
+    total = sum(a.nbytes for a in self.payload.values())
+    total += sum(a.nbytes for a in self.scale.values())
+    total += sum(a.nbytes for d in self.opt.values() for a in d.values())
+    return int(total)
+
+
+@dataclasses.dataclass
+class ColdFetch:
+  """One batch's host->device fetch: ``device`` is the jit-safe pytree
+  the forward/apply consume; ``rows_np``/``counts`` are the host-side
+  bookkeeping ``write_back`` needs."""
+  device: Dict[int, Dict]
+  rows_np: Dict[int, List[np.ndarray]]
+  counts: Dict[int, List[int]]
+
+
+def _cold_ids_per_input(dist, inputs):
+  """Per input: valid, vocab-clipped, hot-stripped ids of the GLOBAL
+  batch — the id population of the deduplicated cold exchange (mirrors
+  ``hotcache.measure_exchange_counters``)."""
+  plan = dist.plan
+  out = {}
+  for i, x in enumerate(inputs):
+    tid = plan.input_table_map[i]
+    vocab = plan.table_configs[tid].input_dim
+    a = np.asarray(x).reshape(-1)
+    a = np.minimum(a[a >= 0], vocab - 1)
+    hs = plan.hot_sets.get(tid)
+    if hs is not None and hs.ids.size:
+      pos = np.searchsorted(hs.ids, a)
+      safe = np.minimum(pos, hs.ids.size - 1)
+      a = a[hs.ids[safe] != a]
+    out[i] = a
+  return out
+
+
+def compute_fetch_rows(dist, inputs):
+  """The host pre-pass: per (tiered group, owner device), the SORTED
+  deduplicated fused-local tail rows this batch's cold exchange will
+  gather there.  Returns ``(rows, counts)``."""
+  plan = dist.plan
+  cold = _cold_ids_per_input(dist, inputs)
+  rows: Dict[int, List[np.ndarray]] = {}
+  counts: Dict[int, List[int]] = {}
+  for gi in plan.cold_tier_groups:
+    g = plan.groups[gi]
+    res = g.device_rows
+    rows[gi] = []
+    counts[gi] = []
+    for dev in range(plan.world_size):
+      parts = []
+      for r in g.requests[dev]:
+        v = cold[r.input_id]
+        mine = v[(v >= r.row_start) & (v < r.row_end)]
+        local = r.row_offset + (mine - r.row_start)
+        parts.append(local[local >= res])
+      u = (np.unique(np.concatenate(parts)).astype(np.int64)
+           if parts else np.zeros((0,), np.int64))
+      rows[gi].append(u)
+      counts[gi].append(int(u.size))
+  return rows, counts
+
+
+def _ensure_caps(dist, counts):
+  """First-batch calibration of the static per-group fetch capacity
+  (margin + alignment); a later batch needing more rows than the
+  calibrated cap REFUSES actionably instead of silently dropping."""
+  for gi, per_dev in counts.items():
+    need = max(per_dev) if per_dev else 0
+    cap = dist._cold_fetch_caps.get(gi)
+    if cap is None:
+      cap = max(_FETCH_ALIGN,
+                -(-int(need * _FETCH_MARGIN) // _FETCH_ALIGN)
+                * _FETCH_ALIGN)
+      cap = min(cap, dist.plan.groups[gi].tier_rows)
+      cap = max(cap, min(_FETCH_ALIGN, dist.plan.groups[gi].tier_rows))
+      dist._cold_fetch_caps[gi] = cap
+    if need > cap:
+      raise ValueError(
+          f'cold-tier fetch overflow on group {gi}: this batch needs '
+          f'{need} tail rows on one device but the static fetch '
+          f'capacity is {cap}. Construct the layer with '
+          f'cold_fetch_rows={{{gi}: {int(need * _FETCH_MARGIN)}}} (or '
+          'a larger global value) so the buffers are sized for the '
+          'workload — silent dropping is never an option '
+          '(docs/design.md §12).')
+
+
+def build_fetch(dist, inputs, rows=None) -> ColdFetch:
+  """Assemble one batch's device-ready fetch buffers from the tier.
+
+  ``rows``: optional precomputed ``(rows, counts)`` from
+  ``compute_fetch_rows`` (the pipelined path — the payload gather
+  below must still run AFTER the previous step's writeback)."""
+  import jax.numpy as jnp
+  plan = dist.plan
+  tier = dist.cold_tier
+  if tier is None:
+    return ColdFetch(device={}, rows_np={}, counts={})
+  if rows is None:
+    rows, counts = compute_fetch_rows(dist, inputs)
+  else:
+    rows, counts = rows
+  _ensure_caps(dist, counts)
+  device = {}
+  for gi in plan.cold_tier_groups:
+    g = plan.groups[gi]
+    res = g.device_rows
+    cap = dist._cold_fetch_caps[gi]
+    D = plan.world_size
+    rows_pad = np.full((D, cap), g.rows_cap, np.int32)
+    payload = np.zeros((D, cap, g.width), tier.payload[gi].dtype)
+    scale = (np.ones((D, cap, 1), np.float32)
+             if gi in tier.scale else None)
+    opt = {k: np.zeros((D, cap, g.width), v.dtype)
+           for k, v in tier.opt[gi].items()}
+    for dev in range(D):
+      n = counts[gi][dev]
+      if not n:
+        continue
+      idx = rows[gi][dev][:n] - res
+      rows_pad[dev, :n] = rows[gi][dev][:n]
+      payload[dev, :n] = tier.payload[gi][dev, idx]
+      if scale is not None:
+        scale[dev, :n] = tier.scale[gi][dev, idx]
+      for k in opt:
+        opt[k][dev, :n] = tier.opt[gi][k][dev, idx]
+    entry = {'rows': jnp.asarray(rows_pad),
+             'payload': jnp.asarray(payload)}
+    if scale is not None:
+      entry['scale'] = jnp.asarray(scale)
+    if opt:
+      entry['opt'] = {k: jnp.asarray(v) for k, v in opt.items()}
+    device[gi] = entry
+  return ColdFetch(device=device, rows_np=rows, counts=counts)
+
+
+def write_back(dist, fetch: ColdFetch, writeback):
+  """Store one step's updated tail rows (payload/scale/optimizer rows,
+  already re-quantized device-side) into the host tier, aligned with
+  the fetch's row lists."""
+  import jax
+  tier = dist.cold_tier
+  for gi, wb in writeback.items():
+    g = dist.plan.groups[gi]
+    res = g.device_rows
+    host = {k: np.asarray(jax.device_get(v)) for k, v in wb.items()
+            if k != 'opt'}
+    host_opt = {k: np.asarray(jax.device_get(v))
+                for k, v in wb.get('opt', {}).items()}
+    for dev in range(dist.plan.world_size):
+      n = fetch.counts[gi][dev]
+      if not n:
+        continue
+      idx = fetch.rows_np[gi][dev][:n] - res
+      if 'payload' in host:
+        tier.payload[gi][dev, idx] = host['payload'][dev, :n]
+      if 'scale' in host and gi in tier.scale:
+        tier.scale[gi][dev, idx] = host['scale'][dev, :n]
+      for k, v in host_opt.items():
+        tier.opt[gi][k][dev, idx] = v[dev, :n].astype(
+            tier.opt[gi][k].dtype)
+
+
+# ---------------------------------------------------------------------------
+# journaled counters (bench.py; design §12)
+# ---------------------------------------------------------------------------
+
+
+def fetch_stats(dist, fetch: ColdFetch) -> dict:
+  """Exact per-batch fetch accounting: rows and bytes crossing
+  host->device, per group and total.  The cross-check pinned by
+  tests/test_bench_artifact.py: ``cold_tier_fetch_bytes`` equals the
+  sum over groups of fetched rows x that group's quantized payload
+  row bytes, with scale bytes counted by name alongside."""
+  plan = dist.plan
+  spec = plan.table_spec
+  item = plan.param_itemsize
+  per_group_rows = []
+  per_group_row_bytes = []
+  total_rows = 0
+  total_bytes = 0
+  total_scale_bytes = 0
+  for gi in plan.cold_tier_groups:
+    g = plan.groups[gi]
+    n = int(sum(fetch.counts.get(gi, [])))
+    rb = quantization.payload_bytes_per_row(g.width, spec, item)
+    per_group_rows.append(n)
+    per_group_row_bytes.append(rb)
+    total_rows += n
+    total_bytes += n * rb
+    if spec is not None:
+      total_scale_bytes += n * quantization.SCALE_BYTES
+  return {
+      'cold_tier_fetch_rows': int(total_rows),
+      'cold_tier_fetch_bytes': int(total_bytes),
+      'cold_tier_fetch_scale_bytes': int(total_scale_bytes),
+      'cold_tier_fetch_rows_per_group': per_group_rows,
+      'cold_tier_row_bytes_per_group': per_group_row_bytes,
+  }
+
+
+def tier_stats(dist) -> dict:
+  """Static tier geometry for the artifact: resident vs host bytes and
+  the per-group head/tail row split."""
+  plan = dist.plan
+  return {
+      'cold_tier_groups': list(plan.cold_tier_groups),
+      'cold_tier_resident_rows': [
+          plan.groups[gi].device_rows for gi in plan.cold_tier_groups
+      ],
+      'cold_tier_tail_rows': [
+          plan.groups[gi].tier_rows for gi in plan.cold_tier_groups
+      ],
+      'cold_tier_resident_bytes': int(plan.resident_table_bytes()),
+      'cold_tier_host_bytes': (int(dist.cold_tier.host_bytes())
+                               if dist.cold_tier else 0),
+      'device_hbm_budget': plan.device_hbm_budget,
+  }
+
+
+class ColdFetchPipeline:
+  """Double-buffer the host fetch pre-pass behind device execution.
+
+  Wraps an iterator of ``cats`` batches; a worker thread runs
+  ``compute_fetch_rows`` for batch N+1 while the consumer's device step
+  runs batch N.  The payload gather (``build_fetch``) stays on the
+  CONSUMER side, after the previous step's writeback landed, so
+  prefetching never reads stale tier rows — only the routing/dedup
+  (the expensive part) overlaps.
+
+  ``stats()['overlap_pct']`` is DIRECTLY measured: 1 - blocked/build,
+  where ``blocked_ms`` is the consumer's wait inside ``__next__`` and
+  ``build_ms`` the worker's wall — the same accounting ``CsrFeed``
+  journals for the static-CSR host build.
+  """
+
+  def __init__(self, dist, cats_iter, depth: int = 2):
+    self.dist = dist
+    self._q: queue.Queue = queue.Queue(maxsize=max(1, int(depth)))
+    self._build_ms = 0.0
+    self._blocked_ms = 0.0
+    self._batches = 0
+    self._err = None
+
+    def producer():
+      try:
+        for cats in cats_iter:
+          t0 = time.perf_counter()
+          prepped, _, _ = dist._prepare_inputs(list(cats))
+          rows = compute_fetch_rows(dist, prepped)
+          self._build_ms += (time.perf_counter() - t0) * 1000.0
+          self._q.put((cats, prepped, rows))
+      except BaseException as e:  # surfaced on the consumer side
+        self._err = e
+      finally:
+        self._q.put(None)
+
+    self._thread = threading.Thread(target=producer, daemon=True,
+                                    name='cold-tier-prefetch')
+    self._thread.start()
+
+  def __iter__(self):
+    return self
+
+  def __next__(self):
+    t0 = time.perf_counter()
+    item = self._q.get()
+    self._blocked_ms += (time.perf_counter() - t0) * 1000.0
+    if item is None:
+      if self._err is not None:
+        raise self._err
+      raise StopIteration
+    cats, prepped, rows = item
+    fetch = build_fetch(self.dist, prepped, rows=rows)
+    self._batches += 1
+    return cats, fetch
+
+  def reset_stats(self):
+    self._build_ms = 0.0
+    self._blocked_ms = 0.0
+    self._batches = 0
+
+  def stats(self) -> dict:
+    build = self._build_ms
+    blocked = self._blocked_ms
+    pct = 0.0 if build <= 0 else min(1.0, max(0.0, 1.0 - blocked / build))
+    return {
+        'batches': self._batches,
+        'build_ms': round(build, 3),
+        'blocked_ms': round(blocked, 3),
+        'overlap_pct': round(pct, 4),
+    }

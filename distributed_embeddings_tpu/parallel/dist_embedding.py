@@ -43,6 +43,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_embeddings_tpu.ops.ragged import RaggedBatch
 from distributed_embeddings_tpu.parallel import mesh as mesh_lib
+from distributed_embeddings_tpu.parallel import quantization
 from distributed_embeddings_tpu.parallel.overlap import (chunk_bounds,
                                                          effective_chunks)
 from distributed_embeddings_tpu.parallel.planner import (GroupSpec,
@@ -141,6 +142,43 @@ class DistributedEmbedding:
       outputs through per-input ``psum_scatter`` slots that have no
       chunk-aligned exchange; the cached forward's row shards ride the
       slot exchange and chunk fine).
+    table_dtype: quantized table storage (docs/design.md §12): ``None``
+      | ``'int8'`` | ``'float8_e4m3'``.  Payload stores at this dtype
+      with one f32 scale per row (``scale_group_{gi}`` /
+      ``hot_scale_group_{gi}`` parameter leaves); every lookup
+      dequantizes at the gather so activations stay at
+      ``compute_dtype``, and the sparse apply requants exactly the
+      touched rows with a refreshed power-of-two scale.  Refusal matrix
+      (§12, never a silent fallback): requires ``param_dtype=float32``
+      (the scale already carries the dynamic range — a bf16 payload
+      ladder underneath it would be a different scheme); incompatible
+      with ``lookup_impl='pallas'`` (the kernel has no dequantizing
+      gather) and with the SparseCore ``custom_call`` backend (the
+      hardware binding contract is f32 tables; the EMULATION
+      dequantizes at its gather and works).  Training requires the
+      sparse trainer: dense autodiff cannot differentiate through
+      integer payloads.
+    cold_tier: host-DRAM cold tier (docs/design.md §12): keep only
+      each group's device-resident head (``GroupSpec.resident_rows``,
+      split to fit ``device_hbm_budget``) in HBM and pin the tail rows
+      in host memory (``self.cold_tier`` host arrays).  Cold-tier rows
+      ride the existing deduplicated dp<->mp exchange: the host
+      pre-pass (``build_cold_fetch``) computes each owner device's
+      deduplicated tail-row fetch for the batch, the rows transfer
+      host->device alongside the batch, the owner's gather serves them
+      like resident rows, and the sparse apply writes touched-row
+      updates back quantized.  Refusal matrix (§12): requires
+      ``dp_input=True`` AND ``hot_cache`` (the deduplicated cold
+      exchange IS the seam the tier plugs into); incompatible with
+      ``lookup_impl='sparsecore'`` (that path's custom-call feed owns
+      its own storage) and with a two-axis (DCN) mesh.
+    device_hbm_budget: per-device byte budget for table storage — see
+      ``ShardingPlan``.  With ``cold_tier=False`` an over-budget plan
+      REFUSES at construction with an OOM-shaped error.
+    cold_fetch_rows: static per-batch fetch capacity (int, or
+      ``{group_index: int}``) for the cold-tier host->device stream;
+      ``None`` calibrates from the first batch with margin
+      (``parallel/coldtier.py``).
   """
 
   def __init__(self,
@@ -160,7 +198,11 @@ class DistributedEmbedding:
                num_sc: int = 4,
                sparsecore_backend: str = 'auto',
                hot_cache=None,
-               overlap_chunks: int = 1):
+               overlap_chunks: int = 1,
+               table_dtype=None,
+               cold_tier: bool = False,
+               device_hbm_budget: Optional[int] = None,
+               cold_fetch_rows=None):
     if row_slice is not None and (isinstance(row_slice, bool)
                                   or not isinstance(row_slice,
                                                     (int, np.integer))):
@@ -239,6 +281,58 @@ class DistributedEmbedding:
           'lookup would silently run TensorCore XLA under a sparsecore '
           "label. Use lookup_impl='auto' with the cache, or disable "
           'hot_cache to measure the SparseCore path.')
+    # ---- quantized storage + cold tier refusal matrix (design §12) ----
+    table_spec = quantization.resolve_table_dtype(table_dtype)
+    if table_spec is not None and self.param_dtype != jnp.float32:
+      raise ValueError(
+          f'table_dtype={table_spec.name!r} requires param_dtype='
+          f'float32 (got {self.param_dtype}): the per-row scale '
+          'already carries the dynamic range, and the f32 dequant at '
+          'the gather is the storage contract (docs/design.md §12). '
+          'Drop param_dtype=bfloat16 or drop table_dtype.')
+    if table_spec is not None and lookup_impl == 'pallas':
+      raise ValueError(
+          f"table_dtype={table_spec.name!r} is incompatible with "
+          "lookup_impl='pallas': the Pallas lookup kernel has no "
+          'dequantizing gather, so every lookup would silently run the '
+          "XLA fallback under a pallas label. Use lookup_impl='auto' "
+          '(XLA dequantizes at the gather) with quantized tables.')
+    if cold_tier:
+      if not dp_input:
+        raise ValueError(
+            'cold_tier requires dp_input=True: the tier streams rows '
+            'through the deduplicated dp->mp cold exchange, which the '
+            'model-parallel input path does not have '
+            '(docs/design.md §12 refusal matrix)')
+      if not hot_cache:
+        raise ValueError(
+            'cold_tier requires hot_cache: the deduplicated cold-id '
+            'exchange of the hot-cache forward is exactly the stream '
+            'the tier fetch rides (docs/design.md §12). Pass hot_sets '
+            '(even a small calibrated set) to enable the tier.')
+      if lookup_impl == 'sparsecore':
+        raise ValueError(
+            "cold_tier is incompatible with lookup_impl='sparsecore': "
+            'the SparseCore custom-call path owns its own table '
+            'storage and feed (design §8); a host tier underneath it '
+            'would measure a different program under its label. Use '
+            "lookup_impl='auto' with the cold tier.")
+      if self.dcn_axis is not None:
+        raise ValueError(
+            'cold_tier on a two-axis (ICI x DCN) mesh is not '
+            'supported: the host tier is per-device state and the '
+            'cross-slice update-stream gather has no tier writeback '
+            'channel yet. Use a flat mesh with the cold tier.')
+      if self.param_dtype != jnp.float32:
+        raise ValueError(
+            f'cold_tier requires param_dtype=float32 (got '
+            f'{self.param_dtype}): the host tier stores f32 tails and '
+            'the tiered apply concatenates them with the resident '
+            'head, which would silently promote a bfloat16 table leaf '
+            'to f32 after the first step and skip the per-step bf16 '
+            'rounding the untiered program applies (docs/design.md '
+            '§12 refusal matrix). Quantize instead: '
+            "table_dtype='int8' halves storage twice as hard as bf16.")
     self.plan = ShardingPlan(self.table_configs,
                              world_size=self.world_size,
                              strategy=strategy,
@@ -249,9 +343,32 @@ class DistributedEmbedding:
                              mod_sharding=mod_sharding,
                              num_sc=num_sc,
                              hot_sets=hot_cache,
-                             overlap_chunks=overlap_chunks)
+                             overlap_chunks=overlap_chunks,
+                             table_dtype=table_spec,
+                             cold_tier=cold_tier,
+                             device_hbm_budget=device_hbm_budget,
+                             param_itemsize=self.param_dtype.itemsize)
     self.hot_enabled = bool(self.plan.hot_sets)
     self.overlap_chunks = self.plan.overlap_chunks
+    # quantized storage: the payload dtype tables (and hot buffers)
+    # physically store at; scales live in scale_group_{gi} leaves
+    self.quant = self.plan.table_spec
+    self.table_dtype = (jnp.dtype(self.quant.dtype) if self.quant
+                        else self.param_dtype)
+    # host-DRAM cold tier: per-(group, device) host arrays for the tail
+    # rows (created empty here; init()/set_weights fill them)
+    self.cold_tier = None
+    if self.plan.cold_tier_groups:
+      from distributed_embeddings_tpu.parallel.coldtier import HostTier
+      self.cold_tier = HostTier(self.plan, self.quant)
+    self._cold_fetch_caps: Dict[int, int] = {}
+    if cold_fetch_rows is not None:
+      if isinstance(cold_fetch_rows, dict):
+        self._cold_fetch_caps = {int(k): int(v)
+                                 for k, v in cold_fetch_rows.items()}
+      else:
+        self._cold_fetch_caps = {gi: int(cold_fetch_rows)
+                                 for gi in self.plan.cold_tier_groups}
     if overlap_chunks > 1 and any(self.plan.row_sliced) \
         and not self.hot_enabled:
       raise ValueError(
@@ -282,7 +399,8 @@ class DistributedEmbedding:
     self._fn_cache: Dict[Any, Any] = {}
 
   def _lookup(self, table: jax.Array, routed: jax.Array,
-              combiner: Optional[str], pack: int = 1) -> jax.Array:
+              combiner: Optional[str], pack: int = 1,
+              scale: Optional[jax.Array] = None) -> jax.Array:
     """Fused lookup+combine for one subgroup, XLA or Pallas.
 
     'auto' currently always takes the XLA gather+segment-sum path: on
@@ -325,6 +443,15 @@ class DistributedEmbedding:
       if pack == 1 and sparsecore.group_supported(nat, combiner, hotness):
         backend = self._resolve_sc_backend()
         if backend == 'custom_call':
+          if scale is not None:
+            # §12 refusal: the hardware binding contract is f32 tables;
+            # a dequantizing custom call does not exist, and running
+            # the emulation here would mislabel the measurement
+            raise ValueError(
+                "table_dtype-quantized groups cannot take the "
+                "SparseCore custom_call backend (the binding's table "
+                "contract is f32). Use sparsecore_backend='emulate' "
+                '(its gather dequantizes) or an unquantized plan.')
           csr = sparsecore.csr_from_routed(routed, table.shape[0],
                                            self.plan.num_sc, combiner)
           return sparsecore.custom_call_lookup(table, csr, combiner,
@@ -332,7 +459,7 @@ class DistributedEmbedding:
                                                self.plan.num_sc)
         return sparsecore.emulated_lookup(table, routed, combiner,
                                           self.compute_dtype,
-                                          self.plan.num_sc)
+                                          self.plan.num_sc, scale=scale)
       impl = 'xla'
     ok = pallas_lookup.supported(nat, combiner, hotness)
     if impl == 'auto':
@@ -348,7 +475,8 @@ class DistributedEmbedding:
     if pack > 1:
       return _fused_lookup_packed(table, routed, pack, combiner,
                                   self.compute_dtype)
-    return _fused_lookup(table, routed, combiner, self.compute_dtype)
+    return _fused_lookup(table, routed, combiner, self.compute_dtype,
+                         scale=scale)
 
   def _resolve_sc_backend(self) -> str:
     """Resolve (once) the requested SparseCore backend against the
@@ -473,9 +601,13 @@ class DistributedEmbedding:
       full = (chunks[0] if len(chunks) == 1 else
               jnp.concatenate(chunks, axis=0))
       # fail at build time on a wrong-shaped custom initializer (the old
-      # whole-group reshape validated this implicitly)
-      assert full.shape == (g.param_rows, g.param_width), (
-          full.shape, g.param_rows, g.param_width)
+      # whole-group reshape validated this implicitly).  Init always
+      # builds the FULL fused shard (rows_cap) — cold-tier plans split
+      # the tail off afterwards (_split_cold_tier), so the assert is
+      # against the full shape, not the resident param_rows.
+      assert full.shape == (g.rows_cap // g.storage_pack,
+                            g.param_width), (
+          full.shape, g.rows_cap, g.storage_pack, g.param_width)
       return full[None]
 
     def build_all(key):
@@ -492,7 +624,16 @@ class DistributedEmbedding:
             (lambda k, dev=dev, g=g: make_shard(k, dev, g))
             for dev in range(self.world_size)
         ]
-        out[f'group_{gi}'] = jax.lax.switch(me, branches, key)
+        shard = jax.lax.switch(me, branches, key)
+        if self.quant is not None:
+          # quantized storage (design §12): the f32 draw quantizes
+          # per-row at init — tables never exist at f32 on device
+          # beyond this one shard-local temporary
+          payload, scale = quantization.quantize_jnp(shard[0], self.quant)
+          out[f'group_{gi}'] = payload[None]
+          out[f'scale_group_{gi}'] = scale[None]
+        else:
+          out[f'group_{gi}'] = shard
       return out
 
     n_groups = len(self.plan.groups)
@@ -500,15 +641,52 @@ class DistributedEmbedding:
         f'group_{gi}': P(self.axis_name, None, None)
         for gi in range(n_groups)
     }
+    if self.quant is not None:
+      out_specs.update({
+          f'scale_group_{gi}': P(self.axis_name, None, None)
+          for gi in range(n_groups)
+      })
     fn = jax.jit(
         jax.shard_map(build_all,
                       mesh=self.mesh,
                       in_specs=P(),
                       out_specs=out_specs,
                       check_vma=False))
+    # tiered plans build FULL-size shards first (the hot-buffer init
+    # below gathers owner rows wherever they live), then split the tail
+    # off to the host tier.  At real beyond-HBM scale the split would
+    # stream per row-chunk instead of materialising the full shard
+    # once; documented honestly in docs/perf_notes.md §12.
     params = fn(rng)
     if self.hot_enabled:
       params.update(self._init_hot(params))
+    if self.cold_tier is not None:
+      params = self._split_cold_tier(params)
+    return params
+
+  def _split_cold_tier(self, params: Dict[str, jax.Array]):
+    """Move each tiered group's tail rows ``[resident_rows, rows_cap)``
+    from the full-size device shards into the host tier, leaving the
+    resident head on device (docs/design.md §12 tier membership
+    contract: the split is by fused local row index, nothing else)."""
+    params = dict(params)
+    for gi in self.plan.cold_tier_groups:
+      g = self.plan.groups[gi]
+      res = g.device_rows
+      for key, leaf in ((f'group_{gi}', 'payload'),
+                        (f'scale_group_{gi}', 'scale')):
+        if key not in params:
+          continue
+        arr = params[key]
+        if arr.shape[1] == res:
+          continue  # already split (set_weights builds split directly)
+        self.cold_tier.set_tail(gi, leaf,
+                                np.asarray(jax.device_get(arr[:, res:])))
+        slicer = jax.jit(
+            lambda a, res=res: a[:, :res],
+            out_shardings=NamedSharding(self.mesh,
+                                        P(self.axis_name, None, None)))
+        params[key] = slicer(arr)
     return params
 
   def _init_hot(self, params) -> Dict[str, jax.Array]:
@@ -529,32 +707,46 @@ class DistributedEmbedding:
       for gi in hot_gis:
         g = plan.groups[gi]
         table = params[f'group_{gi}'][0]
+        tscale = self._scale_of(params, gi)
 
-        def one_dev(table, dev, g=g):
+        def one_dev(operand, dev, g=g):
+          table, tscale = operand
           rows = g.hot_owner_rows[dev]
           dst = g.hot_owner_dst[dev]
-          buf = jnp.zeros((g.hot_rows_cap, g.width), self.param_dtype)
+          dt = jnp.float32 if self.quant else self.param_dtype
+          buf = jnp.zeros((g.hot_rows_cap, g.width), dt)
           if rows.size == 0:
             return buf
           vals = _gather_natural_rows(table, jnp.asarray(rows),
                                       g.storage_pack)
-          return buf.at[jnp.asarray(dst)].set(
-              vals.astype(self.param_dtype))
+          if tscale is not None:
+            # quantized shard: dequantize the owned rows (exact) so
+            # the psum below moves f32 values, then requantize the
+            # replicated union identically on every device
+            vals = vals.astype(jnp.float32) * tscale[jnp.asarray(rows)]
+          return buf.at[jnp.asarray(dst)].set(vals.astype(dt))
 
         branches = [
             (lambda t, dev=dev, g=g: one_dev(t, dev, g))
             for dev in range(self.world_size)
         ]
-        buf = jax.lax.switch(me, branches, table)
-        out[f'hot_group_{gi}'] = (jax.lax.psum(buf, self.axis_name)
-                                  if self.world_size > 1 else buf)
+        buf = jax.lax.switch(me, branches, (table, tscale))
+        if self.world_size > 1:
+          buf = jax.lax.psum(buf, self.axis_name)
+        if self.quant is not None:
+          payload, scale = quantization.quantize_jnp(buf, self.quant)
+          out[f'hot_group_{gi}'] = payload
+          out[f'hot_scale_group_{gi}'] = scale
+        else:
+          out[f'hot_group_{gi}'] = buf
       return out
 
-    in_specs = ({
-        f'group_{gi}': P(self.axis_name, None, None)
-        for gi in range(len(plan.groups))
-    },)
+    in_specs = ({k: v for k, v in self._param_specs().items()
+                 if not k.startswith('hot_')},)
     out_specs = {f'hot_group_{gi}': P(None, None) for gi in hot_gis}
+    if self.quant is not None:
+      out_specs.update(
+          {f'hot_scale_group_{gi}': P(None, None) for gi in hot_gis})
     fn = jax.jit(
         jax.shard_map(local_fn,
                       mesh=self.mesh,
@@ -584,7 +776,8 @@ class DistributedEmbedding:
             f'input {i}: combiner=None supports only hotness 1 in the '
             f'distributed path, got hotness {h}')
 
-  def apply(self, params: Dict[str, jax.Array], inputs) -> List[jax.Array]:
+  def apply(self, params: Dict[str, jax.Array], inputs,
+            cold_fetch=None) -> List[jax.Array]:
     """Forward pass (reference ``_call_base`` + ``call``,
     dist_model_parallel.py:382-450,670-674).
 
@@ -596,14 +789,21 @@ class DistributedEmbedding:
         trace time).  With ``dp_input=False`` a list in *worker order* (the
         flattened ``plan.input_ids_list``) of ``[global_batch(, hot)]``
         arrays holding model-parallel inputs at global batch size.
+      cold_fetch: cold-tier layers only — the per-batch host->device
+        fetch (``build_cold_fetch``); computed internally from concrete
+        inputs when omitted (a traced call without it raises: the host
+        pre-pass cannot run on tracers).
 
     Returns:
       List of ``[global_batch, output_dim]`` arrays in input order, batch-
       sharded over the mesh.
     """
     inputs, batch, hotness = self._prepare_inputs(inputs)
+    cold_fetch = self._resolve_cold_fetch(inputs, cold_fetch)
     if self.hot_enabled:
-      fwd = self._build_dp_forward_hot(batch, hotness)
+      fwd = self._build_dp_forward_hot(
+          batch, hotness, fetch_caps=_fetch_caps_sig(cold_fetch))
+      return list(fwd(params, _forward_fetch(cold_fetch), *inputs))
     elif self.dp_input:
       fwd = self._build_dp_forward(batch, hotness)
     else:
@@ -611,6 +811,44 @@ class DistributedEmbedding:
     return list(fwd(params, *inputs))
 
   __call__ = apply
+
+  def _resolve_cold_fetch(self, inputs, cold_fetch):
+    """Cold-tier layers: ensure a per-batch fetch exists — compute it
+    from concrete inputs when the caller did not supply one, refuse on
+    tracers (the host pre-pass reads id values)."""
+    if self.cold_tier is None:
+      return None
+    if cold_fetch is not None:
+      # accept either the ColdFetch wrapper or its device pytree
+      return getattr(cold_fetch, 'device', cold_fetch)
+    if any(isinstance(x, jax.core.Tracer) for x in inputs):
+      raise ValueError(
+          'cold-tier forward reached a traced (jit) context without a '
+          'cold_fetch: the host pre-pass that gathers tail rows from '
+          'the host tier cannot read traced ids. Build the fetch '
+          'outside the jit boundary (dist.build_cold_fetch(cats)) and '
+          'pass it through — make_hybrid_train_step does this '
+          'automatically.')
+    from distributed_embeddings_tpu.parallel import coldtier
+    return coldtier.build_fetch(self, inputs).device
+
+  def build_cold_fetch(self, cats, rows=None):
+    """Host pre-pass of the cold tier (design §12): the per-device
+    DEDUPLICATED tail rows this batch needs, gathered from the host
+    tier into padded device-ready buffers (``parallel/coldtier.py``).
+    ``rows``: optional precomputed row lists (the pipelined prefetch
+    path — rows compute ahead, payload gathers after the previous
+    step's writeback)."""
+    from distributed_embeddings_tpu.parallel import coldtier
+    inputs, _, _ = self._prepare_inputs(cats)
+    return coldtier.build_fetch(self, inputs, rows=rows)
+
+  def cold_write_back(self, fetch, writeback):
+    """Write one step's touched-tail-row updates (payload + scale +
+    optimizer rows, already quantized device-side) back into the host
+    tier arrays."""
+    from distributed_embeddings_tpu.parallel import coldtier
+    coldtier.write_back(self, fetch, writeback)
 
   def _prepare_inputs(self, inputs):
     """Shared input validation/densification for both forward entry points.
@@ -920,6 +1158,7 @@ class DistributedEmbedding:
           # construction, so every slot rides the a2a buffer here).
           assert not sub.merge_inputs and not sub.mean_row_sliced
           table = params[f'group_{sub.gi}'][0]
+          tscale = self._scale_of(params, sub.gi)
           rows_cap = self.plan.groups[sub.gi].rows_cap
           spack = self.plan.groups[sub.gi].storage_pack
           w = sub.group.width
@@ -932,6 +1171,7 @@ class DistributedEmbedding:
           routed_parts, back_parts = [], []
 
           def process(lo, hi, recv_c, sub=sub, h=h, table=table,
+                      tscale=tscale,
                       rows_cap=rows_cap, spack=spack, w=w, offs=offs,
                       voc=voc, rlo=rlo, rhi=rhi, rst=rst,
                       routed_parts=routed_parts, back_parts=back_parts):
@@ -941,7 +1181,7 @@ class DistributedEmbedding:
                                   rows_cap, rlo[lo:hi], rhi[lo:hi],
                                   rst[lo:hi] if rst is not None else None)
             out_c = self._lookup(table, routed_c, sub.lookup_combiner,
-                                 pack=spack)
+                                 pack=spack, scale=tscale)
             routed_parts.append(routed_c)
             back_c = out_c.reshape(hi - lo, D, local_batch,
                                    w).transpose(1, 0, 2, 3)
@@ -977,7 +1217,8 @@ class DistributedEmbedding:
                              if sub.has_mod_windows else None))
         out = self._lookup(params[f'group_{sub.gi}'][0], routed,
                            sub.lookup_combiner,
-                           pack=self.plan.groups[sub.gi].storage_pack)
+                           pack=self.plan.groups[sub.gi].storage_pack,
+                           scale=self._scale_of(params, sub.gi))
         if sub.mean_row_sliced:
           # mean row shards look up with 'sum'; divide by the TRUE
           # per-sample id count HERE, where the full raw ids are in hand
@@ -995,10 +1236,8 @@ class DistributedEmbedding:
       return outs
 
     bax = self._batch_axes
-    in_specs = (
-        {f'group_{gi}': P(self.axis_name, None, None)
-         for gi in range(len(self.plan.groups))},
-    ) + tuple(P(bax) if h == 1 else P(bax, None) for h in hotness)
+    in_specs = (self._param_specs(),) + tuple(
+        P(bax) if h == 1 else P(bax, None) for h in hotness)
     out_specs = tuple(P(bax, None) for _ in range(self.num_inputs))
     if with_residuals:
       # residuals [D, n_cap, GB, h]: dim 0 is the table shard (inner
@@ -1071,7 +1310,8 @@ class DistributedEmbedding:
                              if sub.has_mod_windows else None))
         out = self._lookup(params[f'group_{sub.gi}'][0], routed,
                            sub.lookup_combiner,
-                           pack=self.plan.groups[sub.gi].storage_pack)
+                           pack=self.plan.groups[sub.gi].storage_pack,
+                           scale=self._scale_of(params, sub.gi))
         if sub.mean_row_sliced:
           # owner-side division by the true count (see the dp path)
           out = out / _valid_count(ids)[..., None].astype(out.dtype)
@@ -1091,10 +1331,7 @@ class DistributedEmbedding:
     sharded = jax.shard_map(
         local_fn,
         mesh=self.mesh,
-        in_specs=(
-            {f'group_{gi}': P(self.axis_name, None, None)
-             for gi in range(len(self.plan.groups))},
-        ) + tuple(
+        in_specs=(self._param_specs(),) + tuple(
             P(self.axis_name, None, self.dcn_axis, None) for _ in subs),
         out_specs=out_specs,
         check_vma=False)
@@ -1109,7 +1346,7 @@ class DistributedEmbedding:
 
   # ------------------------------------------------- sparse training hooks
 
-  def forward_with_residuals(self, params, inputs):
+  def forward_with_residuals(self, params, inputs, cold_fetch=None):
     """Forward that also returns the routed lookup ids, for the sparse
     (O(nnz)) training path (parallel/sparse.py).
 
@@ -1123,12 +1360,17 @@ class DistributedEmbedding:
     """
     inputs, batch, hotness = self._prepare_inputs(inputs)
     if self.hot_enabled:
-      fwd = self._build_dp_forward_hot(batch, hotness, with_residuals=True)
+      cold_fetch = self._resolve_cold_fetch(inputs, cold_fetch)
+      fwd = self._build_dp_forward_hot(
+          batch, hotness, with_residuals=True,
+          fetch_caps=_fetch_caps_sig(cold_fetch))
+      flat = fwd(params, _forward_fetch(cold_fetch), *inputs)
     elif self.dp_input:
       fwd = self._build_dp_forward(batch, hotness, with_residuals=True)
+      flat = fwd(params, *inputs)
     else:
       fwd = self._build_mp_forward(batch, hotness, with_residuals=True)
-    flat = fwd(params, *inputs)
+      flat = fwd(params, *inputs)
     outs = list(flat[:self.num_inputs])
     residuals = tuple(flat[self.num_inputs:])
     return outs, residuals, (batch, hotness)
@@ -1370,7 +1612,8 @@ class DistributedEmbedding:
     return out
 
   def _build_dp_forward_hot(self, global_batch: int, hotness: tuple,
-                            with_residuals: bool = False):
+                            with_residuals: bool = False,
+                            fetch_caps: tuple = ()):
     """The hot-cache dp forward (docs/design.md §10).
 
     Per subgroup: hot ids are served LOCALLY from the replicated
@@ -1393,8 +1636,15 @@ class DistributedEmbedding:
     routed unique ids ``[D, n_cap, D*U, 1]`` (``U = local_batch * h``;
     sentinel ``rows_cap`` padding) — already-deduplicated update
     streams for the sparse backward.
+
+    COLD-TIER groups (design §12) serve their owner-side gather from
+    two sources: resident rows from the device shard, tail rows from
+    the per-batch host->device fetch buffers (``fetch_caps`` keys the
+    static fetch shapes; ``build_cold_fetch`` supplies the buffers).
+    Either way the gather dequantizes, so downstream is unchanged.
     """
-    key = ('dp_fwd_hot', global_batch, hotness, with_residuals)
+    key = ('dp_fwd_hot', global_batch, hotness, with_residuals,
+           fetch_caps)
     if key in self._fn_cache:
       return self._fn_cache[key]
     D = self.world_size
@@ -1404,7 +1654,7 @@ class DistributedEmbedding:
     meta = self._hot_meta()
     plan = self.plan
 
-    def local_fn(params, *inputs):
+    def local_fn(params, fetch, *inputs):
       me = jax.lax.axis_index(self.axis_name)
       mem = self._hot_membership(inputs, hotness)
       piece: Dict[tuple, Any] = {}
@@ -1414,6 +1664,7 @@ class DistributedEmbedding:
         U = local_batch * h
         w = sub.group.width
         rows_cap = plan.groups[sub.gi].rows_cap
+        cold_gather = self._make_cold_gather(params, fetch, sub.gi)
 
         def _cold(k, h=h):
           if k == -1:
@@ -1446,12 +1697,10 @@ class DistributedEmbedding:
           rst = (jnp.asarray(sub.row_stride)[me]
                  if sub.has_mod_windows else None)
           inv3 = inv.reshape(D, sub.n_cap, U)
-          table = params[f'group_{sub.gi}'][0]
-          spack = plan.groups[sub.gi].storage_pack
           routed_parts, comb_parts = [], []
 
           def process(lo, hi, recv_c, sub=sub, h=h, U=U, w=w,
-                      rows_cap=rows_cap, table=table, spack=spack,
+                      rows_cap=rows_cap, cold_gather=cold_gather,
                       offs=offs, voc=voc, rlo=rlo, rhi=rhi, rst=rst,
                       inv3=inv3, routed_parts=routed_parts,
                       comb_parts=comb_parts):
@@ -1460,7 +1709,7 @@ class DistributedEmbedding:
                                   voc[lo:hi], rows_cap, rlo[lo:hi],
                                   rhi[lo:hi],
                                   rst[lo:hi] if rst is not None else None)
-            rows_c = self._lookup(table, routed_c, None, pack=spack)
+            rows_c = cold_gather(routed_c)
             routed_parts.append(routed_c)
             back_c = rows_c.reshape(hi - lo, D, U,
                                     w).transpose(1, 0, 2, 3)
@@ -1502,9 +1751,9 @@ class DistributedEmbedding:
                                if sub.has_mod_windows else None))
           # one row gather per distinct id (combiner=None == masked
           # row fetch); out-of-window ids of row shards return zero, so
-          # slot partials sum to the whole at the source
-          rows = self._lookup(params[f'group_{sub.gi}'][0], routed, None,
-                              pack=plan.groups[sub.gi].storage_pack)
+          # slot partials sum to the whole at the source.  Tiered
+          # groups serve tail rows from the fetch buffers (design §12).
+          rows = cold_gather(routed)
           if with_residuals:
             residuals.append(routed[None])
           back = rows.reshape(sub.n_cap, D, U, w).transpose(1, 0, 2, 3)
@@ -1533,7 +1782,14 @@ class DistributedEmbedding:
           ext = jnp.concatenate(
               [buf, jnp.zeros((1, buf.shape[1]), buf.dtype)])
           idx = jnp.where(hotm >= 0, off + hotm, buf.shape[0])
-          hp = jnp.sum(ext[idx].astype(jnp.float32), axis=1)
+          rows_h = ext[idx].astype(jnp.float32)
+          if self.quant is not None:
+            # quantized hot buffer: dequantize at the gather (§12)
+            hs = params[f'hot_scale_group_{gi}']
+            hs_ext = jnp.concatenate(
+                [hs, jnp.ones((1, 1), jnp.float32)])
+            rows_h = rows_h * hs_ext[idx]
+          hp = jnp.sum(rows_h, axis=1)
           k = (i, cs, ce)
           piece[k] = hp if k not in piece else piece[k] + hp
 
@@ -1553,7 +1809,7 @@ class DistributedEmbedding:
       return tuple(outs)
 
     bax = self._batch_axes
-    in_specs = (self._param_specs(),) + tuple(
+    in_specs = (self._param_specs(), self._fetch_specs()) + tuple(
         P(bax) if h == 1 else P(bax, None) for h in hotness)
     out_specs = tuple(P(bax, None) for _ in range(self.num_inputs))
     if with_residuals:
@@ -1568,16 +1824,62 @@ class DistributedEmbedding:
     self._fn_cache[key] = fn
     return fn
 
+  def _fetch_specs(self):
+    """shard_map in_specs for the cold-tier fetch pytree ({} when the
+    plan has no tier): per tiered group, sorted fused tail rows,
+    payload rows, and (quantized plans) per-row scales, all sharded on
+    the device axis."""
+    specs = {}
+    for gi in self.plan.cold_tier_groups:
+      e = {
+          'rows': P(self.axis_name, None),
+          'payload': P(self.axis_name, None, None),
+      }
+      if self.quant is not None:
+        e['scale'] = P(self.axis_name, None, None)
+      specs[gi] = e
+    return specs
+
+  def _make_cold_gather(self, params, fetch, gi):
+    """Owner-side cold-row gather for group ``gi``: the plain
+    (dequantizing) shard lookup for fully resident groups, the
+    two-source tiered gather (device head + fetch buffers) for
+    cold-tier groups (design §12)."""
+    g = self.plan.groups[gi]
+    table = params[f'group_{gi}'][0]
+    scale = self._scale_of(params, gi)
+    if g.tier_rows == 0:
+      return lambda routed: self._lookup(table, routed, None,
+                                         pack=g.storage_pack, scale=scale)
+    f = fetch[gi]
+    return lambda routed: _tiered_gather(
+        table, scale, routed, f['rows'][0], f['payload'][0],
+        f['scale'][0] if 'scale' in f else None, g.rows_cap,
+        self.compute_dtype)
+
   def _param_specs(self):
     """shard_map in_specs for the params pytree: fused group shards on
-    the mesh axis, hot-cache buffers replicated."""
+    the mesh axis, hot-cache buffers replicated, per-row scale leaves
+    (quantized storage, design §12) following their tables."""
     specs = {
         f'group_{gi}': P(self.axis_name, None, None)
         for gi in range(len(self.plan.groups))
     }
+    if self.quant is not None:
+      for gi in range(len(self.plan.groups)):
+        specs[f'scale_group_{gi}'] = P(self.axis_name, None, None)
     for gi in self.plan.hot_groups:
       specs[f'hot_group_{gi}'] = P(None, None)
+      if self.quant is not None:
+        specs[f'hot_scale_group_{gi}'] = P(None, None)
     return specs
+
+  def _scale_of(self, params, gi):
+    """Per-device ``[device_rows, 1]`` scale shard of group ``gi``
+    inside a shard_map'd local fn; None for unquantized plans."""
+    if self.quant is None:
+      return None
+    return params[f'scale_group_{gi}'][0]
 
   def _build_backward_hot(self, global_batch: int, hotness: tuple,
                           with_sq: bool = False,
@@ -1973,8 +2275,72 @@ def _gather_natural_rows(table: jax.Array, idx: jax.Array,
   return jnp.sum(contrib.reshape(idx.shape[0], pack, w), axis=1)
 
 
+def _fetch_caps_sig(cold_fetch) -> tuple:
+  """Static shape signature of a cold-tier fetch (part of the traced
+  function cache key): ``((group_index, fetch_cap), ...)``."""
+  if not cold_fetch:
+    return ()
+  return tuple(sorted(
+      (gi, int(f['rows'].shape[1])) for gi, f in cold_fetch.items()))
+
+
+def _forward_fetch(cold_fetch):
+  """The forward's slice of a fetch pytree (rows/payload/scale only —
+  optimizer rows ride the same fetch but only the apply consumes
+  them)."""
+  if not cold_fetch:
+    return {}
+  return {
+      gi: {k: v for k, v in f.items() if k in ('rows', 'payload', 'scale')}
+      for gi, f in cold_fetch.items()
+  }
+
+
+def _tiered_gather(table: jax.Array, scale: Optional[jax.Array],
+                   routed: jax.Array, fetch_rows: jax.Array,
+                   fetch_payload: jax.Array,
+                   fetch_scale: Optional[jax.Array], rows_cap: int,
+                   compute_dtype) -> jax.Array:
+  """Owner-side row gather of a COLD-TIER group (design §12).
+
+  ``table``: the device-resident head ``[resident_rows, w]`` (quantized
+  payload when ``scale`` is given); ``routed``: ``[n_cap, N, 1]``
+  fused-local unique ids (sentinel ``rows_cap``); ``fetch_rows`` /
+  ``fetch_payload`` / ``fetch_scale``: this batch's host->device fetch —
+  the deduplicated tail rows the host pre-pass guaranteed to cover
+  every id ``>= resident_rows`` the batch routes here, sorted ascending
+  with ``rows_cap`` padding.  Resident ids gather from the head, tail
+  ids searchsorted into the fetch buffers; both sides dequantize, so
+  the output is exactly what the fully-resident gather would produce
+  (pinned bit-exact by tests/test_quantized_storage.py
+  ``test_cold_tier_is_pure_layout`` and the fuzzed
+  ``test_fuzz_quantized_tier_parity``).  An id absent from the
+  fetch (impossible by the pre-pass contract; the host raises on
+  overflow before the step launches) reads as a zero row.
+  """
+  res = table.shape[0]
+  cap_f = fetch_rows.shape[0]
+  r = routed[..., 0]
+  valid = r < rows_cap
+  is_res = r < res
+  safe_res = jnp.where(is_res, r, 0)
+  rows_res = jnp.take(table, safe_res, axis=0).astype(jnp.float32)
+  if scale is not None:
+    rows_res = rows_res * jnp.take(scale, safe_res, axis=0)
+  pos = jnp.searchsorted(fetch_rows, r).astype(jnp.int32)
+  safe_pos = jnp.minimum(pos, cap_f - 1)
+  hit = (~is_res) & valid & (fetch_rows[safe_pos] == r)
+  rows_t = jnp.take(fetch_payload, safe_pos, axis=0).astype(jnp.float32)
+  if fetch_scale is not None:
+    rows_t = rows_t * jnp.take(fetch_scale, safe_pos, axis=0)
+  rows = jnp.where(is_res[..., None], rows_res, rows_t)
+  keep = (valid & (is_res | hit))[..., None]
+  return jnp.where(keep, rows, 0.0).astype(compute_dtype)
+
+
 def _fused_lookup(table: jax.Array, routed: jax.Array,
-                  combiner: Optional[str], compute_dtype) -> jax.Array:
+                  combiner: Optional[str], compute_dtype,
+                  scale: Optional[jax.Array] = None) -> jax.Array:
   """Lookup+combine all slots of one subgroup on one device.
 
   ``table``: [rows_cap, w] fused local table; ``routed``: [n_cap, GB, h]
@@ -1982,11 +2348,19 @@ def _fused_lookup(table: jax.Array, routed: jax.Array,
   XLA-fallback equivalent of the reference CUDA fused kernel (SURVEY.md C2);
   sees the same data layout the Pallas kernel consumes
   (ops/pallas_lookup.py).
+
+  ``scale`` (quantized storage, design §12): ``[rows_cap, 1]`` f32
+  per-row scales — the gather dequantizes (``payload * scale``, exact:
+  power-of-two scales only shift exponents) so the combine and
+  everything downstream stays f32.
   """
   rows_cap = table.shape[0]
   mask = routed < rows_cap
   safe = jnp.where(mask, routed, 0)
   rows = jnp.take(table, safe, axis=0)  # [n_cap, GB, h, w]
+  if scale is not None:
+    rows = rows.astype(jnp.float32) * jnp.take(scale, safe, axis=0)
+    return _combine_rows(rows, mask, combiner, jnp.float32, compute_dtype)
   return _combine_rows(rows, mask, combiner, table.dtype, compute_dtype)
 
 

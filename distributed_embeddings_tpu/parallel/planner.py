@@ -49,6 +49,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from distributed_embeddings_tpu.parallel.hotcache import HotSet
+from distributed_embeddings_tpu.parallel.quantization import (
+    SCALE_BYTES, resolve_table_dtype)
 
 
 @dataclasses.dataclass
@@ -214,11 +216,31 @@ class GroupSpec:
   # requested chunk count runs at its slot count (n_cap == 1 groups are
   # monolithic by construction).  1 = the monolithic program.
   overlap_chunks: int = 1
+  # ---- host-DRAM cold tier (docs/design.md §12) ----
+  # device-resident head of the fused shard: local rows [0, resident_rows)
+  # live in HBM, rows [resident_rows, rows_cap) pin in host memory and
+  # stream through the deduplicated cold exchange per batch.  None (the
+  # default) means fully resident (the pre-tier program).  Tier
+  # membership is purely this row-index split — deterministic, recorded
+  # in the plan, and invisible to checkpoints (which stay global
+  # canonical like the hot-cache contract).
+  resident_rows: Optional[int] = None
+
+  @property
+  def device_rows(self) -> int:
+    """HBM-resident natural rows of the per-device fused shard."""
+    return self.rows_cap if self.resident_rows is None else self.resident_rows
+
+  @property
+  def tier_rows(self) -> int:
+    """Host-DRAM tail rows per device (0 when fully resident)."""
+    return self.rows_cap - self.device_rows
 
   @property
   def param_rows(self) -> int:
-    """Physical per-device parameter rows (``rows_cap`` when natural)."""
-    return self.rows_cap // self.storage_pack
+    """Physical per-device parameter rows (``device_rows`` when
+    natural; tiered groups always store natural, planner contract)."""
+    return self.device_rows // self.storage_pack
 
   @property
   def param_width(self) -> int:
@@ -437,6 +459,31 @@ class ShardingPlan:
       the physical fingerprint covers it — chunking changes the
       compiled program, never the math.  1 (default) IS the monolithic
       program.
+    table_dtype: quantized table storage (docs/design.md §12): ``None``
+      (store at ``param_dtype``, the pre-quantization behaviour),
+      ``'int8'`` or ``'float8_e4m3'``.  Quantized groups store the
+      payload at this dtype plus one f32 scale per NATURAL row
+      (``scale_group_{gi}`` parameter leaves); every lookup dequantizes
+      at the gather and the sparse apply requants exactly the touched
+      rows with a refreshed power-of-two scale
+      (parallel/quantization.py).  Quantized plans always store natural
+      width (``storage_pack == 1``) so scale rows stay aligned.
+    cold_tier: keep only each group's device-resident head
+      (``GroupSpec.resident_rows``) in HBM and pin the tail rows in
+      host DRAM (docs/design.md §12).  Requires ``device_hbm_budget``;
+      the split gives each group HBM rows proportional to its share of
+      total table bytes (8-row aligned), after funding the replicated
+      hot buffers.  Tier membership is a layout detail — checkpoints
+      stay global canonical and restore under any other tier split.
+    device_hbm_budget: per-device byte budget for TABLE storage
+      (payload + per-row scales + replicated hot buffers; optimizer
+      accumulators ride their own ``accum_dtype`` ladder and are not
+      counted).  With ``cold_tier=False`` this is a hard gate: a plan
+      whose resident tables exceed it REFUSES at construction with an
+      OOM-shaped error instead of dying at allocation.  ``None``
+      disables the check.
+    param_itemsize: itemsize of unquantized storage (4 for f32, 2 for
+      bf16) — only used for the byte accounting above.
   """
 
   def __init__(self,
@@ -450,7 +497,11 @@ class ShardingPlan:
                mod_sharding: bool = False,
                num_sc: int = 4,
                hot_sets=None,
-               overlap_chunks: int = 1):
+               overlap_chunks: int = 1,
+               table_dtype=None,
+               cold_tier: bool = False,
+               device_hbm_budget: Optional[int] = None,
+               param_itemsize: int = 4):
     if strategy not in ('basic', 'memory_balanced', 'memory_optimized'):
       raise ValueError(f'Unsupported shard strategy {strategy}')
     # Single-process case may skip collectives; mirror the reference's
@@ -481,10 +532,41 @@ class ShardingPlan:
       raise ValueError(
           f'overlap_chunks must be an int >= 1, got {overlap_chunks!r}')
     self.overlap_chunks = int(overlap_chunks)
+    # quantized table storage (docs/design.md §12)
+    self.table_spec = resolve_table_dtype(table_dtype)
+    self.table_dtype = self.table_spec.name if self.table_spec else None
+    self.cold_tier = bool(cold_tier)
+    if device_hbm_budget is not None and (
+        isinstance(device_hbm_budget, bool)
+        or not isinstance(device_hbm_budget, (int, np.integer))
+        or device_hbm_budget <= 0):
+      raise ValueError(
+          f'device_hbm_budget must be a positive byte count or None, '
+          f'got {device_hbm_budget!r}')
+    self.device_hbm_budget = (None if device_hbm_budget is None
+                              else int(device_hbm_budget))
+    self.param_itemsize = int(param_itemsize)
+    if self.cold_tier and self.device_hbm_budget is None:
+      raise ValueError(
+          'cold_tier=True needs device_hbm_budget: the tier exists to '
+          'fit a stated per-device HBM budget — pass the byte budget '
+          'the resident head must fit')
+    if self.cold_tier and self.mod_sharding:
+      raise ValueError(
+          'cold_tier is incompatible with mod_sharding: the tier '
+          'membership contract is a contiguous head/tail split of the '
+          'fused local rows (docs/design.md §12), which mod residue '
+          'windows do not have. Use contiguous row slicing with the '
+          'cold tier.')
     # mod plans never lane-pack: SC padding granularity is 8, and the
     # natural layout is what both the emulation backend and the hardware
-    # binding consume
-    self.packed_storage = bool(packed_storage) and not self.mod_sharding
+    # binding consume.  Quantized and tiered plans store natural too:
+    # the per-row scale (and the head/tail row split) are NATURAL-row
+    # quantities — lane packing would interleave rows with distinct
+    # scales inside one physical row.
+    self.packed_storage = (bool(packed_storage) and not self.mod_sharding
+                           and self.table_spec is None
+                           and not self.cold_tier)
     # frequency-aware hot sets: normalise to {table_id: HotSet} and
     # validate against the table set (empty sets dropped — a table
     # without hot rows simply takes the plain cold path)
@@ -744,6 +826,9 @@ class ShardingPlan:
     if self.hot_sets:
       self._attach_hot_layout()
 
+    if self.device_hbm_budget is not None:
+      self._apply_hbm_budget()
+
     # Output slices of each input arrive in device order.  Distinct column
     # ranges must tile [0, output_dim) exactly; requests SHARING a column
     # range are row shards whose outputs sum at assembly, and their row
@@ -838,6 +923,88 @@ class ShardingPlan:
       g.hot_owner_rows = owner_rows
       g.hot_owner_dst = owner_dst
 
+  # ---- quantized storage + host-DRAM cold tier (docs/design.md §12) ----
+
+  def row_bytes(self, width: int) -> int:
+    """Stored bytes of ONE natural row at this plan's table dtype:
+    payload plus (for quantized plans) the per-row f32 scale."""
+    if self.table_spec is not None:
+      return width * self.table_spec.itemsize + SCALE_BYTES
+    return width * self.param_itemsize
+
+  def hot_buffer_bytes(self) -> int:
+    """Per-device bytes of the replicated hot buffers (payload + scale
+    for quantized plans) — the fixed cost the cold-tier budget funds
+    before splitting table rows."""
+    return sum(g.hot_rows_cap * self.row_bytes(g.width)
+               for g in self.groups if g.hot_rows_cap)
+
+  def resident_table_bytes(self) -> int:
+    """Per-device HBM bytes of the RESIDENT table storage: padded
+    device rows of every group at ``row_bytes`` plus the hot buffers
+    (what an allocation would actually claim for tables)."""
+    return self.hot_buffer_bytes() + sum(
+        g.device_rows * self.row_bytes(g.width) for g in self.groups)
+
+  def _apply_hbm_budget(self):
+    """Enforce ``device_hbm_budget``: refuse (OOM-shaped) without the
+    cold tier, or split each group into a device-resident head and a
+    host-DRAM tail with it (``GroupSpec.resident_rows``)."""
+    budget = self.device_hbm_budget
+    hot_bytes = self.hot_buffer_bytes()
+    total = sum(g.rows_cap * self.row_bytes(g.width) for g in self.groups)
+    need = hot_bytes + total
+    if not self.cold_tier:
+      if need > budget:
+        raise ValueError(
+            f'embedding tables need {need} bytes/device '
+            f'({total} table rows + {hot_bytes} replicated hot-buffer '
+            f'bytes at table_dtype={self.table_dtype or "param_dtype"}) '
+            f'but device_hbm_budget is {budget} — this plan would OOM '
+            f'at allocation. Enable cold_tier=True to pin the tail '
+            f'rows in host DRAM (docs/design.md §12), quantize with '
+            f"table_dtype='int8', or raise the budget.")
+      return
+    table_budget = budget - hot_bytes
+    if table_budget <= 0:
+      raise ValueError(
+          f'device_hbm_budget {budget} does not even fund the '
+          f'replicated hot buffers ({hot_bytes} bytes/device): shrink '
+          f'the hot sets or raise the budget')
+    if total <= table_budget:
+      return  # everything fits resident: the tier is inert by design
+    frac = table_budget / total
+    spent = 0
+    for g in self.groups:
+      res = min(g.rows_cap, max(8, (int(g.rows_cap * frac) // 8) * 8))
+      g.resident_rows = res
+      spent += res * self.row_bytes(g.width)
+    # the 8-row floors of small groups can overshoot the proportional
+    # split; trim the biggest heads in 8-row steps, deterministically
+    order = sorted(range(len(self.groups)),
+                   key=lambda gi: (-self.groups[gi].device_rows, gi))
+    while spent > table_budget:
+      trimmed = False
+      for gi in order:
+        g = self.groups[gi]
+        if g.device_rows > 8:
+          step = min(8, g.device_rows - 8)
+          g.resident_rows = g.device_rows - step
+          spent -= step * self.row_bytes(g.width)
+          trimmed = True
+          if spent <= table_budget:
+            break
+      if not trimmed:
+        raise ValueError(
+            f'device_hbm_budget {budget} is too small for even the '
+            f'minimum 8-row resident heads ({spent + hot_bytes} '
+            f'bytes/device at the floor): raise the budget')
+
+  @property
+  def cold_tier_groups(self) -> List[int]:
+    """Indices of fusion groups with a non-empty host-DRAM tail."""
+    return [gi for gi, g in enumerate(self.groups) if g.tier_rows > 0]
+
   @property
   def hot_groups(self) -> List[int]:
     """Indices of fusion groups carrying a non-empty hot buffer."""
@@ -864,6 +1031,11 @@ class ShardingPlan:
         # changes the math, but it changes the compiled program and the
         # per-chunk buffer sizes capacity calibration describes
         self.overlap_chunks,
+        # quantized storage + cold tier (design §12): the dtype changes
+        # the payload leaves, the budget/tier split changes the
+        # resident shapes — all physical, all program-visible
+        self.table_dtype, self.cold_tier, self.device_hbm_budget,
+        [g.resident_rows for g in self.groups],
     ])
     return hashlib.sha256(material.encode()).hexdigest()[:16]
 

@@ -1,0 +1,244 @@
+"""Quantized table storage: per-row scales, int8 / float8_e4m3 payloads.
+
+The storage side of docs/design.md §12.  Embedding rows tolerate far
+lower storage precision than their f32 updates ("Tensor Casting",
+PAPERS.md): each table row stores as a narrow payload (int8 or
+ml_dtypes float8_e4m3) plus ONE f32 scale per row, and every lookup
+path dequantizes at the gather (``payload.astype(f32) * scale``) so the
+combine and everything downstream stays f32.  Optimizer applies become
+dequant -> f32 update -> requant-with-refreshed-scale on exactly the
+touched rows (parallel/sparse.py ``_QuantizedTableOptimizer``).
+
+Scale-refresh rule (load-bearing, pinned by
+tests/test_quantized_storage.py): the per-row scale is the smallest
+POWER OF TWO ``s`` with ``max|row| / s <= qmax`` (``s = 2**ceil(log2(
+max|row| / qmax))``; all-zero rows take ``s = 1``).  Power-of-two
+scales make the whole scheme exactly self-consistent in f32:
+
+- ``payload * scale`` is EXACT (the multiply only shifts exponents), so
+  a quantized table's lookup values are exactly representable — an f32
+  plan restored from a quantized checkpoint computes bit-identical
+  forwards;
+- quant -> dequant -> requant is the IDENTITY on already-quantized rows
+  (``max|q| * s`` is exact and lands in ``(qmax/2, qmax] * s``, so the
+  refreshed exponent reproduces ``s`` bit-for-bit and every payload
+  value round-trips) — untouched rows are bit-preserved through any
+  number of dense hot applies, and a dequantized (f32) checkpoint
+  restores back into the SAME payload+scale bits;
+- the NumPy and jax implementations below agree bitwise (frexp/ldexp
+  exponent arithmetic + shared round-to-nearest-even), so host-side
+  checkpoint requantization matches the traced apply exactly.
+
+The cost is at most one extra bit of quantization error versus an
+optimal real-valued scale (s < 2 * max|row| / qmax), i.e. int8 behaves
+no worse than a 7-bit optimal-scale code — bounded and pinned by the
+forward-parity fuzz tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+try:  # the fp8 payload dtype rides ml_dtypes (bundled with jax)
+  import ml_dtypes
+  _FP8 = np.dtype(ml_dtypes.float8_e4m3fn)
+  _FP8_MAX = float(ml_dtypes.finfo(_FP8).max)  # 448.0
+except Exception:  # pragma: no cover - ml_dtypes ships with this image
+  ml_dtypes = None
+  _FP8 = None
+  _FP8_MAX = 448.0
+
+# table_dtype registry: name -> (numpy dtype, qmax, integer?)
+_SPECS = {}
+_SPECS['int8'] = (np.dtype(np.int8), 127.0, True)
+if _FP8 is not None:
+  _SPECS['float8_e4m3'] = (_FP8, _FP8_MAX, False)
+
+SCALE_BYTES = 4  # one f32 scale per row, stored alongside the payload
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantSpec:
+  """Resolved quantized-storage dtype."""
+  name: str
+  dtype: np.dtype
+  qmax: float
+  integer: bool
+
+  @property
+  def itemsize(self) -> int:
+    return self.dtype.itemsize
+
+
+def resolve_table_dtype(table_dtype) -> Optional[QuantSpec]:
+  """Normalise a ``ShardingPlan(table_dtype=)`` value.
+
+  Accepts ``None`` (f32/bf16 storage per ``param_dtype`` — the
+  pre-quantization behaviour), the strings ``'int8'`` /
+  ``'float8_e4m3'``, or equivalent numpy/ml_dtypes dtype objects.
+  """
+  if table_dtype is None:
+    return None
+  if isinstance(table_dtype, QuantSpec):
+    return table_dtype
+  name = None
+  if isinstance(table_dtype, str):
+    name = {'float8_e4m3fn': 'float8_e4m3'}.get(table_dtype, table_dtype)
+  else:
+    dt = np.dtype(table_dtype)
+    if dt == np.int8:
+      name = 'int8'
+    elif _FP8 is not None and dt == _FP8:
+      name = 'float8_e4m3'
+  if name not in _SPECS:
+    raise ValueError(
+        f'Unsupported table_dtype {table_dtype!r}: expected None, '
+        f"'int8' or 'float8_e4m3' (per-row-scaled quantized storage, "
+        'docs/design.md §12)')
+  dt, qmax, integer = _SPECS[name]
+  return QuantSpec(name=name, dtype=dt, qmax=qmax, integer=integer)
+
+
+def row_scale_np(rows: np.ndarray, qmax: float) -> np.ndarray:
+  """Per-row power-of-two scale, NumPy side: smallest ``2**e`` with
+  ``max|row| <= qmax * 2**e``; all-zero (or non-finite-free zero) rows
+  take 1.0.  Returns ``[rows, 1]`` f32."""
+  amax = np.max(np.abs(rows.astype(np.float32)), axis=-1, keepdims=True)
+  v = (amax / np.float32(qmax)).astype(np.float32)
+  m, e = np.frexp(v)  # v = m * 2**e, m in [0.5, 1)
+  # ceil(log2 v): e unless v is an exact power of two (m == 0.5)
+  e = np.where(m == np.float32(0.5), e - 1, e)
+  s = np.ldexp(np.float32(1.0), e).astype(np.float32)
+  return np.where(amax > 0, s, np.float32(1.0))
+
+
+def quantize_np(rows: np.ndarray,
+                spec: QuantSpec) -> Tuple[np.ndarray, np.ndarray]:
+  """Quantize ``[..., w]`` f32 rows -> ``(payload [..., w], scale
+  [..., 1] f32)`` on the host.  Bitwise-identical to ``quantize_jnp``
+  (pinned by tests/test_quantized_storage.py)."""
+  rows = np.asarray(rows, np.float32)
+  scale = row_scale_np(rows, spec.qmax)
+  x = rows / scale  # exact: power-of-two divisor
+  if spec.integer:
+    # rint lands max|payload| in (qmax/2, qmax] by the smallest-po2
+    # property, so the scale is already the requant fixed point
+    return np.clip(np.rint(x), -spec.qmax,
+                   spec.qmax).astype(spec.dtype), scale
+  g = _fp8_grid_round_np(x)
+  # fp8 fixed-point refresh: rounding to the grid can land a row max
+  # EXACTLY on qmax/2 — requant would then halve the scale.  Refresh
+  # the scale against the rounded payload and rescale (a pure exponent
+  # shift, exact on fp8 values) so the stored (payload, scale) pair is
+  # its own requant fixed point.
+  amax_q = np.max(np.abs(g), axis=-1, keepdims=True) * scale
+  scale2 = row_scale_np(amax_q, spec.qmax)
+  return (g * (scale / scale2)).astype(spec.dtype), scale2
+
+
+def _fp8_grid_round_np(x: np.ndarray) -> np.ndarray:
+  """Round f32 values (|x| <= 448) onto the float8_e4m3fn grid with
+  round-to-nearest-even, in f32.  Backend casts disagree on ties (XLA's
+  CPU convert double-rounds through f16), so both sides round onto the
+  grid with the SAME exponent arithmetic first and the final dtype cast
+  only ever sees exactly-representable values — bitwise agreement by
+  construction."""
+  ax = np.abs(x).astype(np.float32)
+  _, e = np.frexp(ax)  # ax = m * 2**e, m in [0.5, 1)
+  # normal grid step 2**(e-4) (3 mantissa bits); subnormal floor 2**-9
+  step = np.ldexp(np.float32(1.0), np.maximum(e - 4, -9))
+  r = np.minimum(np.rint(ax / step) * step, np.float32(448.0))
+  return np.copysign(r, x).astype(np.float32)
+
+
+def dequantize_np(payload: np.ndarray, scale: np.ndarray) -> np.ndarray:
+  """Exact inverse gather value: ``payload * scale`` in f32."""
+  return payload.astype(np.float32) * np.asarray(scale, np.float32)
+
+
+def row_scale_jnp(rows, qmax: float):
+  """``row_scale_np`` traced: same frexp/ldexp exponent arithmetic."""
+  import jax.numpy as jnp
+  amax = jnp.max(jnp.abs(rows.astype(jnp.float32)), axis=-1, keepdims=True)
+  v = (amax / jnp.float32(qmax)).astype(jnp.float32)
+  m, e = jnp.frexp(v)
+  e = jnp.where(m == jnp.float32(0.5), e - 1, e)
+  s = jnp.ldexp(jnp.float32(1.0), e).astype(jnp.float32)
+  return jnp.where(amax > 0, s, jnp.float32(1.0))
+
+
+def quantize_jnp(rows, spec: QuantSpec):
+  """``quantize_np`` traced (the requant of the sparse apply) — same
+  arithmetic, bitwise-identical results."""
+  import jax.numpy as jnp
+  rows = rows.astype(jnp.float32)
+  scale = row_scale_jnp(rows, spec.qmax)
+  x = rows / scale
+  if spec.integer:
+    payload = jnp.clip(jnp.rint(x), -spec.qmax, spec.qmax).astype(
+        jnp.dtype(spec.dtype))
+    return payload, scale
+  g = _fp8_grid_round_jnp(x)
+  # fp8 fixed-point refresh (see quantize_np)
+  amax_q = jnp.max(jnp.abs(g), axis=-1, keepdims=True) * scale
+  scale2 = row_scale_jnp(amax_q, spec.qmax)
+  return (g * (scale / scale2)).astype(jnp.dtype(spec.dtype)), scale2
+
+
+def _fp8_grid_round_jnp(x):
+  """``_fp8_grid_round_np`` traced — identical exponent arithmetic."""
+  import jax.numpy as jnp
+  ax = jnp.abs(x).astype(jnp.float32)
+  _, e = jnp.frexp(ax)
+  step = jnp.ldexp(jnp.float32(1.0), jnp.maximum(e - 4, -9))
+  r = jnp.minimum(jnp.rint(ax / step) * step, jnp.float32(448.0))
+  return jnp.copysign(r, x).astype(jnp.float32)
+
+
+def dequantize_jnp(payload, scale):
+  import jax.numpy as jnp
+  return payload.astype(jnp.float32) * scale.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# bytes accounting (the journaled counters; docs/design.md §12)
+# ---------------------------------------------------------------------------
+
+
+def payload_bytes_per_row(width: int, spec: Optional[QuantSpec],
+                          param_itemsize: int = 4) -> int:
+  """Payload bytes of ONE stored row — the journaled
+  ``table_bytes_per_row`` quantity ("quantized row bytes"; the per-row
+  scale is accounted separately, ``SCALE_BYTES``, so the artifact's
+  ratio states the payload compression and the scale overhead each by
+  name instead of folding them)."""
+  return width * (spec.itemsize if spec is not None else param_itemsize)
+
+
+def table_bytes_stats(plan, param_itemsize: int = 4) -> dict:
+  """Aggregate storage accounting over a plan's fusion groups, weighted
+  by un-padded resident rows: the journaled block bench.py folds into
+  the artifact.  ``table_bytes_per_row`` is payload-only;
+  ``table_total_bytes_per_row`` adds the per-row scale so the honest
+  all-in ratio is one line away."""
+  spec = getattr(plan, 'table_spec', None)
+  rows = 0
+  payload = 0
+  for g in plan.groups:
+    r = sum(g.rows)
+    rows += r
+    payload += r * payload_bytes_per_row(g.width, spec, param_itemsize)
+  scale = rows * SCALE_BYTES if spec is not None else 0
+  return {
+      'table_dtype': spec.name if spec is not None else None,
+      'table_rows': int(rows),
+      'table_bytes_per_row': round(payload / max(rows, 1), 4),
+      'table_scale_bytes_per_row': (SCALE_BYTES if spec is not None else 0),
+      'table_total_bytes_per_row': round(
+          (payload + scale) / max(rows, 1), 4),
+      'table_payload_bytes': int(payload),
+      'table_scale_bytes': int(scale),
+  }

@@ -234,15 +234,29 @@ class SparseSGD:
       out[f'hot_group_{gi}'] = {}
     return out
 
+  def row_updates(self, state, uids, sum_g, sum_sq, lr, limit):
+    """Per-row f32 deltas at the compacted unique rows, plus the new
+    optimizer state — the arithmetic core ``apply_unique`` scatters and
+    the quantized adapter (``_QuantizedTableOptimizer``) requants.  ONE
+    definition per optimizer so the two paths can never drift."""
+    del sum_sq, limit
+    return -lr * sum_g, state
+
+  def tier_leaf_specs(self):
+    """Optimizer-state leaves the host cold tier must carry per tail
+    row (design §12): SGD is stateless."""
+    return {}
+
   def apply_unique(self, table, state, uids, sum_g, sum_sq, lr):
     """Apply one step at COMPACTED unique rows (``compact_segments``)."""
-    del sum_sq
-    update = (-lr * sum_g).astype(table.dtype)
+    delta, state = self.row_updates(state, uids, sum_g, sum_sq, lr,
+                                    table.shape[0])
     # compacted ids are ascending; _distinct_oob makes them strictly
     # unique so the hints let XLA vectorise the scatter instead of
     # serialising for duplicates
     uids = _distinct_oob(uids, table.shape[0])
-    return table.at[uids].add(update, mode='drop', unique_indices=True,
+    return table.at[uids].add(delta.astype(table.dtype), mode='drop',
+                              unique_indices=True,
                               indices_are_sorted=True), state
 
   def apply_hot(self, hot, state, sum_g, sum_sq, lr, count=None):
@@ -314,6 +328,12 @@ class SparseAdagrad:
 
   def init(self, dist: DistributedEmbedding, params) -> Dict:
     adt = jnp.dtype(self.accum_dtype)
+    if getattr(dist, 'cold_tier', None) is not None:
+      # the accumulator of host-tier tail rows lives in the tier
+      # (design §12); created here so a fresh train state and a
+      # checkpoint restore see the same leaf set
+      dist.cold_tier.ensure_opt('acc', self.initial_accumulator_value,
+                                adt)
     out = {
         f'group_{gi}': {
             'acc':
@@ -334,8 +354,14 @@ class SparseAdagrad:
       }
     return out
 
-  def apply_unique(self, table, state, uids, sum_g, sum_sq, lr):
-    """One step at COMPACTED unique rows.
+  def tier_leaf_specs(self):
+    """The host cold tier carries the accumulator per tail row (design
+    §12; the ``accum_dtype`` ladder applies there too)."""
+    return {'acc': (self.accum_dtype, self.initial_accumulator_value)}
+
+  def row_updates(self, state, uids, sum_g, sum_sq, lr, limit):
+    """Per-row f32 deltas + new state at COMPACTED unique rows (the
+    shared arithmetic core — see ``SparseSGD.row_updates``).
 
     Matches the uncompacted semantics exactly: with duplicates, every
     occurrence reads the accumulator AFTER the full batch's additions,
@@ -350,26 +376,33 @@ class SparseAdagrad:
     docs/perf_notes.md).
     """
     add = sum_g * sum_g if self.dedup else sum_sq
-    safe = jnp.clip(uids, 0, table.shape[0] - 1)
+    safe = jnp.clip(uids, 0, limit - 1)
     # compacted ids are ascending; _distinct_oob makes them strictly
     # unique (clipped sentinel gathers may duplicate the last row, hence
     # unique_indices=False there): the hints let XLA vectorise the
     # gather/scatters instead of serialising for duplicates
-    uids = _distinct_oob(uids, table.shape[0])
+    dids = _distinct_oob(uids, limit)
     # low-precision accumulators: gather up-casts, arithmetic (add +
     # rsqrt) stays f32, only the store rounds to accum_dtype — the
     # update this step uses the EXACT f32 running value
     acc_rows = state['acc'].at[safe].get(
         unique_indices=False,
         indices_are_sorted=True).astype(jnp.float32) + add
-    acc = state['acc'].at[uids].set(acc_rows.astype(state['acc'].dtype),
+    acc = state['acc'].at[dids].set(acc_rows.astype(state['acc'].dtype),
                                     mode='drop',
                                     unique_indices=True,
                                     indices_are_sorted=True)
-    update = (-lr * sum_g * jax.lax.rsqrt(acc_rows + self.epsilon)).astype(
-        table.dtype)
-    return table.at[uids].add(update, mode='drop', unique_indices=True,
-                              indices_are_sorted=True), {'acc': acc}
+    delta = -lr * sum_g * jax.lax.rsqrt(acc_rows + self.epsilon)
+    return delta, {'acc': acc}
+
+  def apply_unique(self, table, state, uids, sum_g, sum_sq, lr):
+    """One step at COMPACTED unique rows (see ``row_updates``)."""
+    delta, state = self.row_updates(state, uids, sum_g, sum_sq, lr,
+                                    table.shape[0])
+    uids = _distinct_oob(uids, table.shape[0])
+    return table.at[uids].add(delta.astype(table.dtype), mode='drop',
+                              unique_indices=True,
+                              indices_are_sorted=True), state
 
   def apply_hot(self, hot, state, sum_g, sum_sq, lr, count=None):
     """DENSE Adagrad step on a replicated hot-cache buffer: the same
@@ -416,6 +449,16 @@ class SparseAdam:
   supports_lane_packing = False
 
   def init(self, dist: DistributedEmbedding, params) -> Dict:
+    if getattr(dist, 'cold_tier', None) is not None:
+      # §12 refusal matrix: lazy Adam's per-row step counter 't' is not
+      # an elementwise [rows, w] leaf, so the tier's fetch/writeback
+      # row channels cannot carry it — refuse actionably rather than
+      # silently degrading the lazy semantics
+      raise ValueError(
+          'SparseAdam does not support cold-tier layers: the lazy '
+          "per-row step counter 't' has no tier fetch/writeback "
+          'channel (docs/design.md §12). Train tiered tables with '
+          'SparseSGD or SparseAdagrad, or disable the cold tier.')
     out = {}
     for gi in getattr(dist.plan, 'hot_groups', []):
       # replicated split state for hot rows (design §10): moments plus
@@ -453,15 +496,16 @@ class SparseAdam:
       }
     return out
 
-  def apply_unique(self, table, state, uids, sum_g, sum_sq, lr):
-    """One lazy-Adam step at COMPACTED unique rows (duplicates were
-    segment-summed by ``compact_segments`` — the same dedup the old path
-    did internally)."""
+  def row_updates(self, state, uids, sum_g, sum_sq, lr, limit):
+    """Per-row f32 deltas + new state at COMPACTED unique rows (the
+    shared arithmetic core — see ``SparseSGD.row_updates``); duplicates
+    were segment-summed by ``compact_segments``, the same dedup the old
+    path did internally."""
     del sum_sq
-    safe = jnp.clip(uids, 0, table.shape[0] - 1)
-    valid = (uids < table.shape[0])[:, None]
-    ids, g = _distinct_oob(uids, table.shape[0]), sum_g
-    # strictly unique ascending ids; see SparseAdagrad.apply_unique
+    safe = jnp.clip(uids, 0, limit - 1)
+    valid = (uids < limit)[:, None]
+    ids, g = _distinct_oob(uids, limit), sum_g
+    # strictly unique ascending ids; see SparseAdagrad.row_updates
     hints = dict(unique_indices=True, indices_are_sorted=True)
     ghints = dict(unique_indices=False, indices_are_sorted=True)
     t = state['t'].at[ids].add(1, mode='drop', **hints)
@@ -475,9 +519,17 @@ class SparseAdam:
     t_rows = t.at[safe].get(**ghints).astype(jnp.float32)[:, None]
     mhat = m_rows / (1 - self.b1**t_rows)
     vhat = v_rows / (1 - self.b2**t_rows)
-    update = (-lr * mhat / (jnp.sqrt(vhat) + self.epsilon)).astype(table.dtype)
-    return table.at[ids].add(update, mode='drop', **hints), {'m': m, 'v': v,
-                                                             't': t}
+    delta = -lr * mhat / (jnp.sqrt(vhat) + self.epsilon)
+    return delta, {'m': m, 'v': v, 't': t}
+
+  def apply_unique(self, table, state, uids, sum_g, sum_sq, lr):
+    """One lazy-Adam step at COMPACTED unique rows (``row_updates``)."""
+    delta, state = self.row_updates(state, uids, sum_g, sum_sq, lr,
+                                    table.shape[0])
+    ids = _distinct_oob(uids, table.shape[0])
+    return table.at[ids].add(delta.astype(table.dtype), mode='drop',
+                             unique_indices=True,
+                             indices_are_sorted=True), state
 
   def apply_hot(self, hot, state, sum_g, sum_sq, lr, count=None):
     """DENSE lazy-Adam step on a replicated hot-cache buffer.
@@ -512,6 +564,63 @@ class SparseAdam:
         'v': jnp.where(mask, v_rows, state['v']),
         't': t,
     })
+
+
+class _QuantizedTableOptimizer:
+  """Dequant -> f32 update -> requant adapter (docs/design.md §12).
+
+  Wraps a row-wise optimizer so the audited compact/apply pipeline
+  (``_dedup_and_apply`` / ``_apply_unique_chunked`` / the correction
+  wave) runs unchanged against QUANTIZED tables: the "table" operand
+  becomes the ``(payload, scale)`` pair, the update arithmetic runs
+  through the inner optimizer's ``row_updates`` (ONE definition of the
+  math, shared with the unquantized scatter path), and exactly the
+  touched rows requantize with a refreshed power-of-two scale
+  (``quantization.quantize_jnp`` — the scale-refresh rule that makes
+  untouched-row round-trips bit-exact).  Optimizer STATE (Adagrad
+  accumulators, Adam moments) is untouched: it keeps its own
+  ``accum_dtype`` ladder at full row width.
+  """
+
+  supports_lane_packing = False
+
+  def __init__(self, inner, spec):
+    self.inner = inner
+    self.spec = spec
+    self.capacity_fraction = getattr(inner, 'capacity_fraction', 0.5)
+    self.needs_sq = bool(getattr(inner, 'needs_sq', False))
+    self.needs_touch = bool(getattr(inner, 'needs_touch', False))
+
+  def apply_unique(self, pt, state, uids, sum_g, sum_sq, lr):
+    from distributed_embeddings_tpu.parallel import quantization
+    payload, scale = pt
+    limit = payload.shape[0]
+    delta, state2 = self.inner.row_updates(state, uids, sum_g, sum_sq,
+                                           lr, limit)
+    ghints = dict(unique_indices=False, indices_are_sorted=True)
+    safe = jnp.clip(uids, 0, limit - 1)
+    old = (payload.at[safe].get(**ghints).astype(jnp.float32)
+           * scale.at[safe].get(**ghints))
+    npay, nscale = quantization.quantize_jnp(old + delta, self.spec)
+    hints = dict(mode='drop', unique_indices=True,
+                 indices_are_sorted=True)
+    dids = _distinct_oob(uids, limit)
+    return (payload.at[dids].set(npay, **hints),
+            scale.at[dids].set(nscale, **hints)), state2
+
+  def apply_hot(self, pt, state, sum_g, sum_sq, lr, count=None):
+    """Dense step on a quantized replicated hot buffer: dequantize the
+    whole (small) buffer, run the inner dense apply, requantize every
+    row — untouched rows see a zero update, and the power-of-two
+    scale-refresh rule makes their dequant->requant round trip the
+    bitwise identity (pinned in tests/test_quantized_storage.py)."""
+    from distributed_embeddings_tpu.parallel import quantization
+    payload, scale = pt
+    hot = payload.astype(jnp.float32) * scale
+    new_hot, state2 = self.inner.apply_hot(hot, state, sum_g, sum_sq,
+                                           lr, count=count)
+    npay, nscale = quantization.quantize_jnp(new_hot, self.spec)
+    return (npay, nscale), state2
 
 
 def _lane_pack(uids, sum_g, sum_sq, pack: int, rows_cap: int):
@@ -889,7 +998,8 @@ def _segwalk_apply(optimizer, table, state, flat_ids, flat_g, lr,
 
 
 def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
-                        global_batch: int, hotness: tuple):
+                        global_batch: int, hotness: tuple,
+                        fetch_caps: tuple = ()):
   """shard_map'd per-device sparse update over all fusion groups.
 
   Hot-cache layers (``dist.hot_enabled``): the per-subgroup streams
@@ -897,8 +1007,22 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
   compact/apply pipeline runs over far fewer rows — and the trailing
   args carry one replicated ``[hot_rows_cap, w]`` (``2w`` with
   per-occurrence squares) gradient buffer per hot group, applied as a
-  DENSE elementwise optimizer step (``apply_hot``) with no scatter."""
-  key = ('sparse_apply', optimizer, global_batch, hotness)
+  DENSE elementwise optimizer step (``apply_hot``) with no scatter.
+
+  QUANTIZED plans (design §12) route every group through the
+  ``_QuantizedTableOptimizer`` adapter: the table operand is the
+  ``(payload, scale)`` pair and exactly the touched rows requantize
+  with a refreshed scale.  The segwalk/SparseCore streaming kernels do
+  not serve quantized groups (their table contract is f32; per-group
+  fallback like every other kernel seam).
+
+  COLD-TIER groups additionally concatenate the batch's fetched tail
+  rows (payload/scale/optimizer rows) onto the resident operand, remap
+  tail ids into the concatenated space, run the SAME compact/apply,
+  and return the updated fetch rows as a per-group WRITEBACK output
+  the host stores into the tier.
+  """
+  key = ('sparse_apply', optimizer, global_batch, hotness, fetch_caps)
   if key in dist._fn_cache:
     return dist._fn_cache[key]
   subs = dist._subgroups(hotness)
@@ -911,13 +1035,18 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
   # apply_unique/apply_hot per chunk; the segwalk/SparseCore kernels
   # are single-pass streaming applies and consume the full stream
   n_chunks = getattr(dist.plan, 'overlap_chunks', 1)
+  quant = getattr(dist, 'quant', None)
+  tiered = set(getattr(dist.plan, 'cold_tier_groups', []))
+  opt_q = (_QuantizedTableOptimizer(optimizer, quant)
+           if quant is not None else optimizer)
 
-  def local_fn(params, opt_state, lr, *res_and_g):
+  def local_fn(params, opt_state, lr, fetch, *res_and_g):
     residuals = res_and_g[:len(subs)]
     gs = res_and_g[len(subs):2 * len(subs)]
     hot_gs = res_and_g[2 * len(subs):]
     new_params = dict(params)
     new_state = dict(opt_state)
+    writeback = {}
     fence = lr  # serialisation token threaded through the group applies
     for gi, group in enumerate(dist.plan.groups):
       ids_list, grad_list, gidx_list = [], [], []
@@ -1039,6 +1168,72 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
         flat_g = g_rows[:, :w]
         flat_sq = g_rows[:, w:]
       spack = getattr(group, 'storage_pack', 1)
+      if quant is not None or gi in tiered:
+        # quantized and/or tiered group (design §12): the table operand
+        # is the (payload, scale) pair; cold-tier groups concatenate
+        # the batch's fetched tail rows and return the updated rows as
+        # writeback.  Streaming kernels (segwalk/SparseCore apply) do
+        # not serve these groups — XLA adapter path only.
+        table_op = params[key][0]
+        scale_op = (params[f'scale_group_{gi}'][0]
+                    if quant is not None else None)
+        rows_eff = rows_cap
+        res = group.device_rows
+        if gi in tiered:
+          f = fetch[gi]
+          frows = f['rows'][0]
+          cap_f = frows.shape[0]
+          # remap tail ids into the concatenated [res + cap_f] space:
+          # resident ids pass through, fetched tail ids land at
+          # res + fetch position, everything else (sentinel; a tail id
+          # the pre-pass missed, impossible by contract) drops at the
+          # new sentinel res + cap_f
+          pos = jnp.searchsorted(frows, flat_ids).astype(jnp.int32)
+          safe_pos = jnp.minimum(pos, cap_f - 1)
+          hit = ((flat_ids >= res) & (flat_ids < rows_cap)
+                 & (frows[safe_pos] == flat_ids))
+          flat_ids = jnp.where(
+              flat_ids < res, flat_ids,
+              jnp.where(hit, res + safe_pos, res + cap_f))
+          rows_eff = res + cap_f
+          table_op = jnp.concatenate([table_op, f['payload'][0]])
+          if scale_op is not None:
+            scale_op = jnp.concatenate([scale_op, f['scale'][0]])
+          state_g = {
+              k: jnp.concatenate([v, f['opt'][k][0]])
+              for k, v in state_g.items()
+          }
+        operand = ((table_op, scale_op) if quant is not None
+                   else table_op)
+        if flat_g is None:
+          t2, state2 = _dedup_and_apply(opt_q, operand, state_g,
+                                        flat_ids, g_rows, lr, rows_eff,
+                                        cap_rows=cap_rows,
+                                        g_index=g_idx,
+                                        n_chunks=n_chunks)
+        else:
+          t2, state2 = _dedup_and_apply(opt_q, operand, state_g,
+                                        flat_ids, flat_g, lr, rows_eff,
+                                        cap_rows=cap_rows,
+                                        flat_sq=flat_sq,
+                                        n_chunks=n_chunks)
+        pay2, sc2 = t2 if quant is not None else (t2, None)
+        if gi in tiered:
+          wb = {'payload': pay2[res:][None]}
+          if sc2 is not None:
+            wb['scale'] = sc2[res:][None]
+          wb['opt'] = {k: v[res:][None] for k, v in state2.items()}
+          writeback[gi] = wb
+          pay2 = pay2[:res]
+          if sc2 is not None:
+            sc2 = sc2[:res]
+          state2 = {k: v[:res] for k, v in state2.items()}
+        new_params[key] = pay2[None]
+        if sc2 is not None:
+          new_params[f'scale_group_{gi}'] = sc2[None]
+        new_state[key] = {k: v[None] for k, v in state2.items()}
+        fence = pay2[0, 0]
+        continue
       if flat_sq is None and _use_sparsecore(optimizer, dist,
                                              params[key][0], spack):
         # SparseCore grad+optimizer path (docs/design.md §8): the
@@ -1105,39 +1300,60 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
       count = hg[:, cnt_off:cnt_off + 1] if needs_touch else None
       K = hg.shape[0]
       kch = effective_chunks(n_chunks, K)
+      hsk = f'hot_scale_group_{gi}'
+      hot_op = ((params[hk], params[hsk]) if quant is not None
+                else params[hk])
+
+      def slice_op(op, lo, hi):
+        return ((op[0][lo:hi], op[1][lo:hi]) if quant is not None
+                else op[lo:hi])
+
       if kch == 1:
-        hot_new, hstate = optimizer.apply_hot(params[hk], opt_state[hk],
-                                              sum_g, sum_sq, lr,
-                                              count=count)
+        hot_new, hstate = opt_q.apply_hot(hot_op, opt_state[hk],
+                                          sum_g, sum_sq, lr,
+                                          count=count)
       else:
         # chunked dense hot apply (design §11): apply_hot is
         # elementwise per row, so row-range chunks are bit-exact — and
         # chunk k's step can execute while chunk k+1's psummed
         # gradient slice is still in flight (the backward psums the
-        # hot grads in the same row chunks)
+        # hot grads in the same row chunks).  Quantized buffers chunk
+        # identically: the per-row requant is row-local.
         pieces, spieces = [], []
         for lo, hi in chunk_bounds(K, kch):
-          hp, hs = optimizer.apply_hot(
-              params[hk][lo:hi],
+          hp, hs = opt_q.apply_hot(
+              slice_op(hot_op, lo, hi),
               {kk: vv[lo:hi] for kk, vv in opt_state[hk].items()},
               sum_g[lo:hi],
               None if sum_sq is None else sum_sq[lo:hi], lr,
               count=None if count is None else count[lo:hi])
           pieces.append(hp)
           spieces.append(hs)
-        hot_new = jnp.concatenate(pieces, axis=0)
+        if quant is not None:
+          hot_new = (jnp.concatenate([p[0] for p in pieces], axis=0),
+                     jnp.concatenate([p[1] for p in pieces], axis=0))
+        else:
+          hot_new = jnp.concatenate(pieces, axis=0)
         hstate = ({} if not spieces[0] else {
             kk: jnp.concatenate([s[kk] for s in spieces], axis=0)
             for kk in spieces[0]
         })
-      new_params[hk] = hot_new
+      if quant is not None:
+        new_params[hk], new_params[hsk] = hot_new
+      else:
+        new_params[hk] = hot_new
       new_state[hk] = hstate
-    return new_params, new_state
+    return new_params, new_state, writeback
 
   n_groups = len(dist.plan.groups)
   param_specs = {f'group_{gi}': P(ax, None, None) for gi in range(n_groups)}
+  if quant is not None:
+    for gi in range(n_groups):
+      param_specs[f'scale_group_{gi}'] = P(ax, None, None)
   for gi in hot_gis:
     param_specs[f'hot_group_{gi}'] = P(None, None)
+    if quant is not None:
+      param_specs[f'hot_scale_group_{gi}'] = P(None, None)
 
   def _state_spec(opt_state):
     # sharded group leaves are [D, ...] on axis 0; hot-cache leaves are
@@ -1152,20 +1368,35 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
             lambda x: P(ax, *([None] * (x.ndim - 1))), leaves)
     return out
 
-  def apply(params, opt_state, lr, *res_and_g):
+  def _fetch_spec(fetch):
+    # the cold-tier fetch buffers are per-device data on axis 0
+    return jax.tree.map(lambda x: P(ax, *([None] * (x.ndim - 1))),
+                        fetch)
+
+  def apply(params, opt_state, lr, fetch, *res_and_g):
     # every sharded optimizer-state leaf is [D, ...] on axis 0 (and,
     # on a two-axis mesh, replicated over the slice axis)
     state_spec = _state_spec(opt_state)
+    wb_spec = {
+        gi: {
+            'payload': P(ax, None, None),
+            **({'scale': P(ax, None, None)} if quant is not None else {}),
+            'opt': {k: P(ax, None, None)
+                    for k in opt_state.get(f'group_{gi}', {})},
+        }
+        for gi in tiered
+    }
     fn = jax.shard_map(
         local_fn,
         mesh=dist.mesh,
-        in_specs=(param_specs, state_spec, P()) + tuple(
+        in_specs=(param_specs, state_spec, P(), _fetch_spec(fetch)) +
+        tuple(
             P(ax, None, dist.dcn_axis, None)
             for _ in range(2 * len(subs))) + tuple(
                 P(None, None) for _ in hot_gis),
-        out_specs=(param_specs, state_spec),
+        out_specs=(param_specs, state_spec, wb_spec),
         check_vma=False)
-    return fn(params, opt_state, lr, *res_and_g)
+    return fn(params, opt_state, lr, fetch, *res_and_g)
 
   dist._fn_cache[key] = apply
   return apply
@@ -1174,12 +1405,29 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
 def sparse_apply_updates(dist: DistributedEmbedding, optimizer, params,
                          opt_state, residuals, gsubs, lr,
                          global_batch: int, hotness: tuple,
-                         hot_grads=None):
+                         hot_grads=None, cold_fetch=None):
   """Apply one sparse optimizer step to the embedding params.
 
   ``hot_grads``: for hot-cache layers, the ``{group_index: [K, w]}``
-  replicated hot-row gradient buffers from ``backward_to_mp``."""
-  fn = _build_sparse_apply(dist, optimizer, global_batch, hotness)
+  replicated hot-row gradient buffers from ``backward_to_mp``.
+
+  ``cold_fetch``: for cold-tier layers (design §12), the batch's fetch
+  pytree (``DistributedEmbedding.build_cold_fetch``) — the SAME buffers
+  the forward consumed.  The return value then gains a third element:
+  the per-group writeback (updated tail payload/scale/optimizer rows)
+  the caller must store with ``dist.cold_write_back``.
+  """
+  from distributed_embeddings_tpu.parallel.dist_embedding import (
+      _fetch_caps_sig)
+  tier_on = bool(getattr(dist.plan, 'cold_tier_groups', []))
+  if tier_on and cold_fetch is None:
+    raise ValueError(
+        'sparse_apply_updates on a cold-tier layer requires '
+        'cold_fetch= (the batch fetch the forward consumed): the tier '
+        'rows it updates live in those buffers (docs/design.md §12)')
+  fetch = getattr(cold_fetch, 'device', cold_fetch) if cold_fetch else {}
+  fn = _build_sparse_apply(dist, optimizer, global_batch, hotness,
+                           fetch_caps=_fetch_caps_sig(fetch))
   hot_list = []
   if hot_grads:
     hot_list = [hot_grads[gi] for gi in dist.plan.hot_groups]
@@ -1188,8 +1436,12 @@ def sparse_apply_updates(dist: DistributedEmbedding, optimizer, params,
         'sparse_apply_updates on a hot-cache layer requires hot_grads= '
         '(the {group_index: [K, w]} replicated hot-row gradient buffers '
         'that backward_to_mp returns alongside gsubs)')
-  return fn(params, opt_state, jnp.asarray(lr, jnp.float32),
-            *residuals, *gsubs, *hot_list)
+  new_params, new_state, writeback = fn(
+      params, opt_state, jnp.asarray(lr, jnp.float32), fetch,
+      *residuals, *gsubs, *hot_list)
+  if tier_on:
+    return new_params, new_state, writeback
+  return new_params, new_state
 
 
 def make_hybrid_train_step(dist: DistributedEmbedding,
@@ -1226,7 +1478,21 @@ def make_hybrid_train_step(dist: DistributedEmbedding,
     ``head_loss_fn``.
   """
 
-  def step(state: TrainState, cats, batch):
+  tier_on = bool(getattr(dist.plan, 'cold_tier_groups', []))
+  if tier_on:
+    # cold-tier refusal + host-state setup (design §12): the optimizer
+    # must expose its per-tail-row state leaves so the tier can carry
+    # them (SparseAdam has none and refuses in its init)
+    specs_fn = getattr(emb_optimizer, 'tier_leaf_specs', None)
+    if specs_fn is None:
+      raise ValueError(
+          f'{type(emb_optimizer).__name__} does not support cold-tier '
+          'layers (no tier_leaf_specs): train tiered tables with '
+          'SparseSGD or SparseAdagrad (docs/design.md §12)')
+    for leaf, (ldtype, fill) in specs_fn().items():
+      dist.cold_tier.ensure_opt(leaf, fill, ldtype)
+
+  def step(state: TrainState, cats, batch, cold_fetch=None):
     emb_params = state.params['embedding']
     dense_params = {
         k: v for k, v in state.params.items() if k != 'embedding'
@@ -1234,7 +1500,8 @@ def make_hybrid_train_step(dist: DistributedEmbedding,
     dense_opt_state, emb_opt_state = state.opt_state
 
     emb_outs, residuals, (global_batch, hotness) = (
-        dist.forward_with_residuals(emb_params, cats))
+        dist.forward_with_residuals(emb_params, cats,
+                                    cold_fetch=cold_fetch))
 
     loss, pull = jax.vjp(
         lambda dp, eo: head_loss_fn(dp, eo, batch), dense_params,
@@ -1261,6 +1528,14 @@ def make_hybrid_train_step(dist: DistributedEmbedding,
           with_touch=bool(getattr(emb_optimizer, 'needs_touch', False)))
       lr = (lr_schedule(state.step) if lr_schedule is not None
             else emb_optimizer.learning_rate)
+      if tier_on:
+        new_emb, emb_opt_state, writeback = sparse_apply_updates(
+            dist, emb_optimizer, emb_params, emb_opt_state, residuals,
+            gsubs, lr, global_batch, hotness, hot_grads=hot_grads,
+            cold_fetch=cold_fetch)
+        params = {**new_dense, 'embedding': new_emb}
+        return TrainState(params, (dense_opt_state, emb_opt_state),
+                          state.step + 1), loss, writeback
       new_emb, emb_opt_state = sparse_apply_updates(
           dist, emb_optimizer, emb_params, emb_opt_state, residuals,
           gsubs, lr, global_batch, hotness, hot_grads=hot_grads)
@@ -1307,7 +1582,7 @@ def make_hybrid_train_step(dist: DistributedEmbedding,
     return step  # composable form (e.g. as a lax.scan body)
   jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
 
-  def run(state, cats, batch):
+  def run(state, cats, batch, cold_fetch=None):
     # densify RaggedBatch inputs HERE, outside the jit boundary, where
     # the true max row length is readable — inside jit the lengths are
     # tracers and a batch without a static hot_cap raises (see
@@ -1316,7 +1591,18 @@ def make_hybrid_train_step(dist: DistributedEmbedding,
         x.to_padded_dense(dist._ragged_cap(x))
         if isinstance(x, RaggedBatch) else x for x in cats
     ]
-    return jitted(state, cats, batch)
+    if not tier_on:
+      return jitted(state, cats, batch)
+    # cold tier (design §12): the host pre-pass runs OUTSIDE the jit
+    # boundary (it reads id values and the host tier), the fetch rides
+    # into the step as data, and the step's writeback output lands
+    # back in the tier before the loss returns.  ``cold_fetch`` lets a
+    # pipeline (coldtier.ColdFetchPipeline) hand in a prefetched one.
+    fetch = (cold_fetch if cold_fetch is not None
+             else dist.build_cold_fetch(cats))
+    state, loss, writeback = jitted(state, cats, batch, fetch.device)
+    dist.cold_write_back(fetch, writeback)
+    return state, loss
 
   return run
 

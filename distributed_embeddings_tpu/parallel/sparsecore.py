@@ -116,8 +116,20 @@ def group_supported(table_aval, combiner: Optional[str],
   if table_aval.shape[1] > SC_WIDTH_LIMIT:
     return False  # very-wide rows stay on TensorCore
   # SC accumulates f32; bf16 tables would need the pair-fetch layout the
-  # hardware does not expose through this surface
-  return jnp.dtype(table_aval.dtype) == jnp.float32
+  # hardware does not expose through this surface.  Per-row-scaled
+  # QUANTIZED payloads (int8 / float8_e4m3, design §12) qualify for the
+  # EMULATION: its gather dequantizes to f32 before the combine (the
+  # custom_call backend refuses them at the dispatch — the hardware
+  # binding's table contract is f32).
+  dt = jnp.dtype(table_aval.dtype)
+  if dt == jnp.float32:
+    return True
+  try:
+    from distributed_embeddings_tpu.parallel.quantization import (
+        resolve_table_dtype)
+    return resolve_table_dtype(dt) is not None
+  except ValueError:
+    return False
 
 
 def engaged_groups(plan, param_dtype) -> List[int]:
@@ -125,8 +137,11 @@ def engaged_groups(plan, param_dtype) -> List[int]:
   ``param_dtype`` — the ONE definition of "engaged" shared by the
   layer's zero-engagement guard (``DistributedEmbedding.__init__``) and
   the bench artifact label, so the two can never disagree about which
-  groups actually take the SC path."""
-  dt = jnp.dtype(param_dtype)
+  groups actually take the SC path.  Quantized plans (design §12) are
+  judged at their STORAGE dtype: the emulation dequantizes at the
+  gather, so int8/fp8 groups stay engaged."""
+  spec = getattr(plan, 'table_spec', None)
+  dt = jnp.dtype(spec.dtype) if spec is not None else jnp.dtype(param_dtype)
   return [
       gi for gi, g in enumerate(plan.groups)
       if g.storage_pack == 1 and group_supported(
@@ -388,7 +403,7 @@ def _worker_pool(num_workers: Optional[int] = None) -> ThreadPoolExecutor:
 
 def emulated_lookup(table: jax.Array, routed: jax.Array,
                     combiner: Optional[str], compute_dtype,
-                    num_sc: int) -> jax.Array:
+                    num_sc: int, scale=None) -> jax.Array:
   """Executable TensorCore emulation of ``tpu_sparse_dense_matmul``.
 
   ``table``: ``[rows_cap, w]`` natural fused shard; ``routed``:
@@ -411,7 +426,15 @@ def emulated_lookup(table: jax.Array, routed: jax.Array,
   csr = csr_from_routed(routed, rows_cap, num_sc, combiner)
   fused = jnp.where(csr.sample_ids < samples,
                     csr.embedding_ids * num_sc + csr.partition_ids, rows_cap)
-  rows = jnp.take(table, jnp.minimum(fused, rows_cap - 1), axis=0)  # [N, w]
+  safe = jnp.minimum(fused, rows_cap - 1)
+  rows = jnp.take(table, safe, axis=0)  # [N, w]
+  table_dtype = table.dtype
+  if scale is not None:
+    # quantized storage (design §12): dequantize at the gather — the
+    # scatter/combine below then moves f32 values exactly like the
+    # TensorCore path, preserving the bit-exactness contract
+    rows = rows.astype(jnp.float32) * jnp.take(scale, safe, axis=0)
+    table_dtype = jnp.float32
   # padding entries scatter out of bounds (dropped) at DISTINCT indices
   # (samples*h + entry position): several padding entries sharing one
   # index would break the unique_indices promise, which XLA documents
@@ -420,12 +443,12 @@ def emulated_lookup(table: jax.Array, routed: jax.Array,
   idx = jnp.where(csr.sample_ids < samples,
                   csr.sample_ids * h + csr.hot_ids,
                   samples * h + jnp.arange(n_entries, dtype=jnp.int32))
-  dense = jnp.zeros((samples * h, w), table.dtype).at[idx].set(
+  dense = jnp.zeros((samples * h, w), table_dtype).at[idx].set(
       rows, mode='drop', unique_indices=True)
   mask = jnp.zeros((samples * h,), bool).at[idx].set(
       True, mode='drop', unique_indices=True)
   return _combine_rows(dense.reshape(n_cap, gb, h, w),
-                       mask.reshape(n_cap, gb, h), combiner, table.dtype,
+                       mask.reshape(n_cap, gb, h), combiner, table_dtype,
                        compute_dtype)
 
 

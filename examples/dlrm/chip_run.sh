@@ -72,6 +72,34 @@ if [ "$BUDGET" = 1 ]; then
     --fast_compile \
     --overlap_chunks 4 \
     --max_steps 40
+
+  # cheap quantized-storage A/B (design §12): int8 rows + per-row f32
+  # scales, 4x less table HBM — the plain --max_steps 40 row above is
+  # the f32 off arm; compare steady-state samples/s AND the printed
+  # table-bytes line
+  python examples/dlrm/main.py \
+    --dataset_path "$DATA" \
+    --batch_size "$BATCH" \
+    --dp_input \
+    --fast_compile \
+    --table_dtype int8 \
+    --max_steps 40
+
+  # cheap cold-tier row (design §12): int8 + hot cache + a per-device
+  # HBM budget tight enough to force tail rows into host DRAM — proves
+  # the beyond-HBM path trains on this chip and prints the measured
+  # fetch-overlap pct (the int8 row above is the untiered arm).  NO
+  # --fast_compile here: the tier step owns its own jit boundary and
+  # main.py refuses the combination, so this row compiles at full
+  # effort (still bounded by --max_steps 40).
+  python examples/dlrm/main.py \
+    --dataset_path "$DATA" \
+    --batch_size "$BATCH" \
+    --dp_input \
+    --hot_cache \
+    --table_dtype int8 \
+    --cold_tier_budget_mb 1024 \
+    --max_steps 40
   exit 0
 fi
 
@@ -110,6 +138,29 @@ python examples/dlrm/main.py \
   --batch_size "$BATCH" \
   --dp_input \
   --overlap_chunks 4 \
+  --max_steps 40
+
+# quantized-storage A/B (design §12): int8 rows + per-row f32 scales
+# cut table HBM 4x (the scaling model's binding resource); the plain
+# --max_steps 40 row above is the f32 off arm
+python examples/dlrm/main.py \
+  --dataset_path "$DATA" \
+  --batch_size "$BATCH" \
+  --dp_input \
+  --table_dtype int8 \
+  --max_steps 40
+
+# cold-tier row (design §12): int8 + hot cache + a per-device HBM
+# budget tight enough to force tail rows into host DRAM — the
+# beyond-HBM regime on one chip, with the fetch pre-pass overlap pct
+# printed (the int8 row above is the untiered arm)
+python examples/dlrm/main.py \
+  --dataset_path "$DATA" \
+  --batch_size "$BATCH" \
+  --dp_input \
+  --hot_cache \
+  --table_dtype int8 \
+  --cold_tier_budget_mb 1024 \
   --max_steps 40
 
 # AMP-analog variant (reference examples/dlrm/README.md:8, 10.4M

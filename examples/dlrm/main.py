@@ -66,6 +66,23 @@ def parse_args():
   parser.add_argument('--hot_budget_mb', type=float, default=None,
                       help='per-device replication budget for hot rows + '
                       'optimizer state (None = coverage-sized)')
+  parser.add_argument('--table_dtype', default='none',
+                      choices=['none', 'int8', 'float8_e4m3'],
+                      help='quantized table storage (design §12): rows '
+                      'store as int8/fp8 payloads with one f32 scale '
+                      'per row, dequantized at the gather; the sparse '
+                      'apply requants exactly the touched rows.  int8 '
+                      'is 4x fewer table bytes/row than f32.  Requires '
+                      '--trainer sparse and --param_dtype float32')
+  parser.add_argument('--cold_tier_budget_mb', type=float, default=None,
+                      help='host-DRAM cold tier (design §12): per-device '
+                      'HBM byte budget the resident table head must '
+                      'fit; the tail rows pin in host memory and '
+                      'stream through the deduplicated cold exchange '
+                      '(double-buffered fetch pre-pass behind device '
+                      'steps).  Requires --dp_input, --hot_cache and '
+                      '--trainer sparse; prints the fetch/overlap '
+                      'stats at the end')
   parser.add_argument('--param_dtype', default='float32',
                       choices=['float32', 'bfloat16'],
                       help='table + MLP storage dtype (bfloat16 halves '
@@ -176,6 +193,35 @@ def main():
       raise SystemExit('--overlap_chunks > 1 pairs with --trainer '
                        'sparse (the chunked gradient exchange/apply '
                        'lives in the sparse row-wise path)')
+  if args.table_dtype != 'none':
+    if args.trainer != 'sparse':
+      raise SystemExit('--table_dtype requires --trainer sparse (dense '
+                       'autodiff cannot differentiate through integer '
+                       'payloads; design §12 refusal matrix)')
+    if args.param_dtype != 'float32':
+      raise SystemExit('--table_dtype requires --param_dtype float32 '
+                       '(the per-row scale carries the dynamic range; '
+                       'design §12 refusal matrix)')
+  if args.cold_tier_budget_mb is not None:
+    if not args.dp_input or not args.hot_cache:
+      raise SystemExit('--cold_tier_budget_mb requires --dp_input and '
+                       '--hot_cache: the tier streams tail rows '
+                       'through the deduplicated cold exchange of the '
+                       'hot-cache forward (design §12 refusal matrix)')
+    if args.trainer != 'sparse':
+      raise SystemExit('--cold_tier_budget_mb requires --trainer sparse '
+                       '(tier writeback rides the sparse apply)')
+    if args.fast_compile:
+      raise SystemExit('--cold_tier_budget_mb is incompatible with '
+                       '--fast_compile: the tier step owns its own jit '
+                       'boundary (host fetch outside, writeback after) '
+                       'and cannot be re-wrapped by the low-effort '
+                       'compile path')
+    if args.csr_feed:
+      raise SystemExit('--cold_tier_budget_mb is incompatible with '
+                       '--csr_feed: each pipelines the host pre-pass '
+                       'over the same data iterator — use the cold '
+                       'tier\'s own fetch pipeline')
   hot_sets = None
   if args.hot_cache:
     if not args.dp_input:
@@ -234,8 +280,34 @@ def main():
                compute_dtype=jnp.dtype(args.compute_dtype
                                        or args.param_dtype),
                hot_cache=hot_sets,
-               overlap_chunks=args.overlap_chunks)
+               overlap_chunks=args.overlap_chunks,
+               table_dtype=(None if args.table_dtype == 'none'
+                            else args.table_dtype),
+               cold_tier=args.cold_tier_budget_mb is not None,
+               device_hbm_budget=(int(args.cold_tier_budget_mb * 2**20)
+                                  if args.cold_tier_budget_mb is not None
+                                  else None))
   params = model.init(0)
+  if args.table_dtype != 'none':
+    from distributed_embeddings_tpu.parallel import quantization
+    tb = quantization.table_bytes_stats(model.dist_embedding.plan)
+    print(f"table_dtype: {tb['table_dtype']} — "
+          f"{tb['table_bytes_per_row']:.1f} payload B/row + "
+          f"{tb['table_scale_bytes_per_row']} scale B/row over "
+          f"{tb['table_rows']:,} rows "
+          f"({tb['table_payload_bytes'] + tb['table_scale_bytes']:,} "
+          f"bytes total vs {tb['table_payload_bytes'] * 4:,} at f32)")
+  if args.cold_tier_budget_mb is not None:
+    tiers = model.dist_embedding.plan.cold_tier_groups
+    if model.dist_embedding.cold_tier is None:
+      print(f'cold_tier: everything fits the '
+            f'{args.cold_tier_budget_mb} MB/device budget — 0 tiered '
+            'groups, no host tail')
+    else:
+      print(f'cold_tier: {len(tiers)} tiered group(s); resident/tail rows '
+            f'per group: '
+            f'{[(model.dist_embedding.plan.groups[gi].device_rows, model.dist_embedding.plan.groups[gi].tier_rows) for gi in tiers]}; '
+            f'host bytes {model.dist_embedding.cold_tier.host_bytes():,}')
 
   if args.dp_input:
     table_ids = list(range(len(table_sizes)))
@@ -402,14 +474,48 @@ def main():
           f'({feed.builder} builder, caps calibrated from batch 0, '
           f'on_batch_error={args.on_batch_error})')
     data_iter = (fed.item for fed in feed)
-  for i, (numerical, cats, labels) in enumerate(data_iter):
+  tier_pipe = None
+  if args.cold_tier_budget_mb is not None:
+    # cold-tier fetch pipeline (design §12): the host pre-pass (route +
+    # dedup the batch's tail rows) for batch N+1 runs on a worker
+    # thread while the device executes batch N; the payload gather
+    # stays consumer-side, after the previous step's writeback landed.
+    # Batches queue through a deque so numerical/labels stay aligned
+    # with the (ordered) pipeline output.
+    import collections
+    from distributed_embeddings_tpu.parallel import ColdFetchPipeline
+    _tier_q = collections.deque()
+
+    def _tier_cats(it):
+      for b in it:
+        _tier_q.append(b)
+        yield [np.asarray(c) for c in b[1]]
+
+    tier_pipe = ColdFetchPipeline(dist, _tier_cats(data_iter))
+
+    def _tier_batches():
+      for cats_b, fetch in tier_pipe:
+        numerical_b, _, labels_b = _tier_q.popleft()
+        yield numerical_b, cats_b, labels_b, fetch
+
+    batch_iter = _tier_batches()
+  else:
+    batch_iter = ((n, c, l, None) for n, c, l in data_iter)
+  for i, (numerical, cats, labels, fetch) in enumerate(batch_iter):
     numerical = jnp.asarray(numerical)
     cats = tuple(jnp.asarray(c) for c in cats)
     labels = jnp.asarray(labels)
     if args.trainer == 'sparse':
-      state, loss = step(state, list(cats), (numerical, labels))
+      if tier_pipe is not None:
+        state, loss = step(state, list(cats), (numerical, labels),
+                           cold_fetch=fetch)
+      else:
+        state, loss = step(state, list(cats), (numerical, labels))
     else:
       state, loss = step(state, (numerical, cats, labels))
+    if tier_pipe is not None and i == 0:
+      jax.block_until_ready(loss)
+      tier_pipe.reset_stats()  # batch 0 has no prior step to hide behind
     samples += args.batch_size
     if feed is not None:
       # per-step sync: this blocking window is the device time the
@@ -445,6 +551,13 @@ def main():
             f"batch(es) skipped, {fstats['io_retries']} I/O retries, "
             f"{fstats['respawns']} producer respawn(s); details in the "
             'fault journal')
+  if tier_pipe is not None:
+    tstats = tier_pipe.stats()
+    print(f"cold_tier: fetch pre-pass built {tstats['batches']} "
+          f"batch(es) in {tstats['build_ms']:.1f} ms on the worker; "
+          f"consumer blocked {tstats['blocked_ms']:.1f} ms -> "
+          f"{tstats['overlap_pct'] * 100:.1f}% of the host pre-pass "
+          'hidden behind the device step')
   if loss is None:
     print('no batches to train on (resume skipped the whole dataset)')
     return
@@ -474,7 +587,10 @@ def main():
 
   weights = None
   if args.save_weights or args.save_state:
-    weights = get_weights(dist, state.params['embedding'])
+    # quantized plans export payload+scale pairs (design §12): the
+    # resumable file carries quantized table bytes; save_npz's
+    # positional arr_i format dequantizes exactly (value-lossless)
+    weights = export_tables(dist, state.params['embedding'])
 
   if args.save_weights:
     save_npz(args.save_weights, weights)

@@ -178,8 +178,18 @@ def _train(dist, opt, weights, kernel, labels, steps=10, batch=8):
   return state
 
 
-@pytest.mark.parametrize('optname', ['sgd', 'adagrad', 'adagrad_sq',
-                                     'adagrad_bf16', 'adam'])
+# Each param compiles two full 10-step hybrid train programs (~18 s on
+# the 2-core CI host).  Tier-1 keeps the flagship optimizers (sgd,
+# adagrad, adam); the accumulator variants — same program shape, only
+# the accumulator channel differs, and that channel has its own direct
+# tier-1 coverage in test_sparse_train — ride -m slow with the other
+# over-budget suites (the 870 s tier-1 ceiling, see pyproject).
+@pytest.mark.parametrize('optname', [
+    'sgd', 'adagrad',
+    pytest.param('adagrad_sq', marks=pytest.mark.slow),
+    pytest.param('adagrad_bf16', marks=pytest.mark.slow),
+    'adam',
+])
 def test_train_parity_10_steps(optname):
   """Canonical weights + optimizer state match the baseline after 10
   steps — the split hot/cold state is semantically invisible (lazy

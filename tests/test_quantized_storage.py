@@ -1,0 +1,553 @@
+"""Quantized table storage + host-DRAM cold tier (design §12).
+
+The load-bearing claims pinned here:
+
+- the NumPy and traced quantizers agree BITWISE (payload and scale) —
+  host-side checkpoint requantization matches the traced apply exactly;
+- per-row scales are powers of two, so quant -> dequant -> requant is
+  the IDENTITY on already-quantized rows (untouched rows are
+  bit-preserved through any number of applies/saves);
+- the quantized forward matches f32 within the pinned per-dtype bound
+  (int8: one quantization step ``amax_row / 127`` per looked-up
+  element; fp8 e4m3: 3-mantissa-bit relative grid, ``amax / 16``);
+- 10 training steps drift from the f32 run by at most one quantization
+  step per step;
+- the cold tier is pure LAYOUT: tiered vs untiered runs are bit-exact
+  in forward, trained weights and optimizer state, and the refusal
+  matrix rejects every unsupported combination actionably;
+- checkpoints carry payload+scale members only and round-trip
+  bit-exactly across differing table_dtype / tier plans, and a legacy
+  all-f32 file restores into a quantized plan within the forward bound.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from distributed_embeddings_tpu.parallel import (DistributedEmbedding,
+                                                 SparseAdagrad, SparseSGD,
+                                                 TableConfig, create_mesh,
+                                                 get_optimizer_state,
+                                                 get_weights,
+                                                 init_hybrid_train_state,
+                                                 make_hybrid_train_step,
+                                                 set_optimizer_state,
+                                                 set_weights)
+from distributed_embeddings_tpu.parallel import coldtier, quantization
+from distributed_embeddings_tpu.parallel.checkpoint import (QuantizedWeight,
+                                                            export_tables,
+                                                            load_train_npz,
+                                                            save_train_npz)
+from distributed_embeddings_tpu.parallel.hotcache import HotSet
+
+DTYPES = list(quantization._SPECS)  # int8 (+ float8_e4m3 when available)
+
+CONFIGS = [
+    TableConfig(96, 8, 'sum'),
+    TableConfig(64, 8, 'sum'),
+    TableConfig(200, 16, 'mean'),
+    TableConfig(48, 4, None),
+]
+HOT = {
+    0: HotSet(0, np.array([0, 1, 7])),
+    2: HotSet(2, np.arange(10)),
+    3: HotSet(3, np.array([5])),
+}
+
+
+def _mesh(n=4):
+  return create_mesh(jax.devices()[:n])
+
+
+def _weights(rng, configs=CONFIGS):
+  return [(rng.normal(size=(c.input_dim, c.output_dim)) * 0.1).astype(
+      np.float32) for c in configs]
+
+
+def _ids(rng, batch, configs=CONFIGS):
+  ids = []
+  for c in configs:
+    if c.combiner is None:
+      ids.append(rng.integers(0, c.input_dim, size=(batch,)).astype(
+          np.int32))
+    else:
+      ids.append(rng.integers(0, c.input_dim, size=(batch, 3)).astype(
+          np.int32))
+  return ids
+
+
+def _bound(spec, amax, hotness=1):
+  """The pinned per-dtype forward-parity bound for one looked-up
+  element: one quantization step (int8 ``amax / qmax``; fp8's 3
+  mantissa bits give a relative grid of 2**-4)."""
+  if spec.integer:
+    return hotness * amax / spec.qmax
+  return hotness * amax * 2.0**-4
+
+
+def _build(**kw):
+  return DistributedEmbedding(CONFIGS, mesh=_mesh(), dp_input=True, **kw)
+
+
+def _tiered(dtype='int8', frac=0.6, **kw):
+  probe = _build(hot_cache=HOT, table_dtype=dtype)
+  budget = int(probe.plan.resident_table_bytes() * frac)
+  d = _build(hot_cache=HOT, table_dtype=dtype, cold_tier=True,
+             device_hbm_budget=budget, **kw)
+  assert d.plan.cold_tier_groups, 'budget did not trigger the tier'
+  return d
+
+
+# ---------------------------------------------------------------------------
+# quantizer unit contract
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_table_dtype():
+  assert quantization.resolve_table_dtype(None) is None
+  spec = quantization.resolve_table_dtype('int8')
+  assert (spec.name, spec.qmax, spec.integer) == ('int8', 127.0, True)
+  assert quantization.resolve_table_dtype(np.int8).name == 'int8'
+  assert quantization.resolve_table_dtype(spec) is spec
+  with pytest.raises(ValueError, match='Unsupported table_dtype'):
+    quantization.resolve_table_dtype('int4')
+  with pytest.raises(ValueError, match='Unsupported table_dtype'):
+    quantization.resolve_table_dtype(np.float16)
+
+
+@pytest.mark.parametrize('dtype', DTYPES)
+def test_np_jnp_quantizers_agree_bitwise(dtype):
+  """Host requant (checkpoint side) and traced requant (apply side)
+  must produce IDENTICAL payload and scale bits, or saved state would
+  diverge from live state one save later."""
+  spec = quantization.resolve_table_dtype(dtype)
+  rng = np.random.default_rng(7)
+  rows = np.concatenate([
+      rng.normal(size=(40, 16)).astype(np.float32) * 0.07,
+      rng.normal(size=(8, 16)).astype(np.float32) * 300.0,  # big range
+      rng.normal(size=(8, 16)).astype(np.float32) * 1e-6,   # tiny range
+      np.zeros((4, 16), np.float32),                        # all-zero rows
+  ])
+  pn, sn = quantization.quantize_np(rows, spec)
+  pj, sj = quantization.quantize_jnp(jnp.asarray(rows), spec)
+  np.testing.assert_array_equal(pn.view(np.uint8),
+                                np.asarray(pj).view(np.uint8))
+  np.testing.assert_array_equal(sn, np.asarray(sj))
+  # scales are powers of two (mantissa of frexp == 0.5), zero rows -> 1
+  m, _ = np.frexp(sn)
+  assert np.all(m == 0.5)
+  assert np.all(sn[np.all(rows == 0, axis=-1)] == 1.0)
+  # payload respects the dtype's representable range
+  assert np.all(np.abs(pn.astype(np.float32)) <= spec.qmax)
+
+
+@pytest.mark.parametrize('dtype', DTYPES)
+def test_quant_dequant_requant_idempotent(dtype):
+  """The po2 fixed-point property: requantizing already-quantized
+  values reproduces payload AND scale bit-for-bit — untouched rows are
+  bit-preserved through saves and dense applies."""
+  spec = quantization.resolve_table_dtype(dtype)
+  rng = np.random.default_rng(11)
+  rows = rng.normal(size=(64, 8)).astype(np.float32) * \
+      np.exp(rng.normal(size=(64, 1))).astype(np.float32)
+  p1, s1 = quantization.quantize_np(rows, spec)
+  v1 = quantization.dequantize_np(p1, s1)
+  p2, s2 = quantization.quantize_np(v1, spec)
+  np.testing.assert_array_equal(p1.view(np.uint8), p2.view(np.uint8))
+  np.testing.assert_array_equal(s1, s2)
+  # and through the traced side too
+  p3, s3 = quantization.quantize_jnp(jnp.asarray(v1), spec)
+  np.testing.assert_array_equal(p1.view(np.uint8),
+                                np.asarray(p3).view(np.uint8))
+  np.testing.assert_array_equal(s1, np.asarray(s3))
+
+
+@pytest.mark.parametrize('dtype', DTYPES)
+def test_quantization_error_within_one_step(dtype):
+  spec = quantization.resolve_table_dtype(dtype)
+  rng = np.random.default_rng(13)
+  rows = rng.normal(size=(128, 32)).astype(np.float32) * 5.0
+  p, s = quantization.quantize_np(rows, spec)
+  err = np.abs(quantization.dequantize_np(p, s) - rows)
+  amax = np.abs(rows).max(axis=-1, keepdims=True)
+  bound = _bound(spec, amax)
+  assert np.all(err <= bound + 1e-12), (err.max(), bound.min())
+
+
+def test_table_bytes_stats():
+  d_f32 = _build()
+  d_q = _build(table_dtype='int8')
+  off = quantization.table_bytes_stats(d_f32.plan)
+  on = quantization.table_bytes_stats(d_q.plan)
+  assert off['table_dtype'] is None and on['table_dtype'] == 'int8'
+  assert off['table_bytes_per_row'] == pytest.approx(
+      4 * on['table_bytes_per_row'], rel=1e-3)
+  assert on['table_scale_bytes_per_row'] == quantization.SCALE_BYTES
+  assert on['table_total_bytes_per_row'] > on['table_bytes_per_row']
+  assert on['table_payload_bytes'] * 4 == off['table_payload_bytes']
+
+
+# ---------------------------------------------------------------------------
+# runtime parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize('dtype', DTYPES)
+def test_forward_parity_vs_f32(dtype):
+  """Quantized lookup == f32 lookup within one quantization step per
+  looked-up element, on the plain and hot-cache paths both."""
+  spec = quantization.resolve_table_dtype(dtype)
+  rng = np.random.default_rng(17)
+  w = _weights(rng)
+  ids = _ids(rng, 8)
+  jids = [jnp.asarray(x) for x in ids]
+  for cache in (None, HOT):
+    d_f = _build(hot_cache=cache)
+    d_q = _build(hot_cache=cache, table_dtype=dtype)
+    o_f = d_f.apply(set_weights(d_f, w), jids)
+    o_q = d_q.apply(set_weights(d_q, w), jids)
+    for t, (a, b) in enumerate(zip(o_f, o_q)):
+      hot = 1 if CONFIGS[t].combiner is None else ids[t].shape[1]
+      atol = _bound(spec, float(np.abs(w[t]).max()), hot) + 1e-7
+      np.testing.assert_allclose(
+          np.asarray(a), np.asarray(b), rtol=0, atol=atol,
+          err_msg=f'{dtype} input {t} cache={cache is not None}')
+
+
+def test_quantized_vs_f32_training_drift_bound():
+  """10 SparseAdagrad steps: the quantized run tracks the f32 run
+  within one quantization step PER STEP (requant after each touched-row
+  update injects at most one step of rounding)."""
+  rng = np.random.default_rng(19)
+  w = _weights(rng)
+  ids = _ids(rng, 8)
+  jids = [jnp.asarray(x) for x in ids]
+  labels = jnp.asarray(rng.integers(0, 2, (8, 1)).astype(np.float32))
+  kernel = jnp.asarray(rng.standard_normal(
+      (sum(c.output_dim for c in CONFIGS), 1)).astype(np.float32) * 0.1)
+
+  def head_loss(dp, outs, b):
+    h = jnp.concatenate(list(outs), axis=-1)
+    return jnp.mean((h @ dp['kernel'] - b) ** 2)
+
+  res = {}
+  for name, d in (('f32', _build(hot_cache=HOT)),
+                  ('q', _build(hot_cache=HOT, table_dtype='int8'))):
+    opt = SparseAdagrad(learning_rate=0.05)
+    st = init_hybrid_train_state(
+        d, {'embedding': set_weights(d, w), 'kernel': kernel},
+        optax.sgd(0.05), opt)
+    step = make_hybrid_train_step(d, head_loss, optax.sgd(0.05), opt,
+                                  donate=False)
+    for _ in range(10):
+      st, loss = step(st, jids, labels)
+    assert np.isfinite(float(loss))
+    res[name] = get_weights(d, st.params['embedding'])
+  for t in range(len(CONFIGS)):
+    amax = float(np.abs(res['f32'][t]).max())
+    drift = np.abs(res['q'][t] - res['f32'][t]).max()
+    assert drift <= 10 * amax / 127.0, (t, drift, amax)
+
+
+@pytest.mark.parametrize('dtype', DTYPES)
+def test_cold_tier_is_pure_layout(dtype):
+  """Tiered vs untiered (same table_dtype): BIT-EXACT forward, trained
+  weights and optimizer state — tier membership is never semantic."""
+  rng = np.random.default_rng(23)
+  w = _weights(rng)
+  ids = _ids(rng, 8)
+  jids = [jnp.asarray(x) for x in ids]
+  d_q = _build(hot_cache=HOT, table_dtype=dtype)
+  d_t = _tiered(dtype)
+  o_q = d_q.apply(set_weights(d_q, w), jids)
+  o_t = d_t.apply(set_weights(d_t, w), jids)
+  for t, (a, b) in enumerate(zip(o_q, o_t)):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                  err_msg=f'{dtype} forward input {t}')
+  labels = jnp.asarray(rng.integers(0, 2, (8, 1)).astype(np.float32))
+  kernel = jnp.asarray(rng.standard_normal(
+      (sum(c.output_dim for c in CONFIGS), 1)).astype(np.float32) * 0.1)
+
+  def head_loss(dp, outs, b):
+    h = jnp.concatenate(list(outs), axis=-1)
+    return jnp.mean((h @ dp['kernel'] - b) ** 2)
+
+  res = {}
+  for name, d in (('q', d_q), ('t', d_t)):
+    opt = SparseAdagrad(learning_rate=0.05)
+    st = init_hybrid_train_state(
+        d, {'embedding': set_weights(d, w), 'kernel': kernel},
+        optax.sgd(0.05), opt)
+    step = make_hybrid_train_step(d, head_loss, optax.sgd(0.05), opt,
+                                  donate=False)
+    for _ in range(10):
+      st, loss = step(st, jids, labels)
+    res[name] = (get_weights(d, st.params['embedding']),
+                 get_optimizer_state(d, st.opt_state[1]))
+  for t in range(len(CONFIGS)):
+    np.testing.assert_array_equal(res['q'][0][t], res['t'][0][t],
+                                  err_msg=f'{dtype} weights table {t}')
+    for k in res['q'][1][t]:
+      np.testing.assert_array_equal(
+          np.asarray(res['q'][1][t][k], np.float32),
+          np.asarray(res['t'][1][t][k], np.float32),
+          err_msg=f'{dtype} state {k} table {t}')
+  # the tier actually holds tail state (not an inert no-op)
+  assert d_t.cold_tier is not None
+  assert d_t.cold_tier.host_bytes() > 0
+
+
+def test_training_touches_the_host_tier():
+  """Touched tail rows must land back in host DRAM (write_back), and
+  untouched tail rows must stay bit-identical."""
+  rng = np.random.default_rng(29)
+  w = _weights(rng)
+  ids = _ids(rng, 8)
+  jids = [jnp.asarray(x) for x in ids]
+  d = _tiered('int8')
+  opt = SparseSGD(learning_rate=0.5)
+  labels = jnp.asarray(rng.integers(0, 2, (8, 1)).astype(np.float32))
+  kernel = jnp.asarray(rng.standard_normal(
+      (sum(c.output_dim for c in CONFIGS), 1)).astype(np.float32) * 0.1)
+
+  def head_loss(dp, outs, b):
+    h = jnp.concatenate(list(outs), axis=-1)
+    return jnp.mean((h @ dp['kernel'] - b) ** 2)
+
+  st = init_hybrid_train_state(
+      d, {'embedding': set_weights(d, w), 'kernel': kernel},
+      optax.sgd(0.5), opt)
+  before = {gi: d.cold_tier.payload[gi].copy()
+            for gi in d.plan.cold_tier_groups}
+  # which tail rows CAN change: the batch's fetch lists
+  fetch = d.build_cold_fetch(jids)
+  step = make_hybrid_train_step(d, head_loss, optax.sgd(0.5), opt,
+                                donate=False)
+  st, _ = step(st, jids, labels)
+  changed = 0
+  for gi in d.plan.cold_tier_groups:
+    g = d.plan.groups[gi]
+    touched = np.zeros((d.world_size, g.tier_rows), bool)
+    for dev in range(d.world_size):
+      n = fetch.counts[gi][dev]
+      if n:
+        touched[dev, fetch.rows_np[gi][dev][:n] - g.device_rows] = True
+    after = d.cold_tier.payload[gi]
+    changed += int((before[gi] != after).any(axis=-1)[touched].sum())
+    # untouched rows: bit-identical
+    np.testing.assert_array_equal(before[gi][~touched], after[~touched])
+  assert changed > 0, 'no tail row changed under lr=0.5 SGD'
+
+
+def test_cold_fetch_stats_crosscheck():
+  """The journaled byte counters are EXACT: fetched bytes == sum over
+  groups of fetched rows x that group's quantized payload row bytes,
+  scale bytes counted by name alongside."""
+  rng = np.random.default_rng(31)
+  d = _tiered('int8')
+  set_weights(d, _weights(rng))
+  ids = _ids(rng, 16)
+  fetch = d.build_cold_fetch([jnp.asarray(x) for x in ids])
+  fs = coldtier.fetch_stats(d, fetch)
+  assert fs['cold_tier_fetch_rows'] > 0
+  want_bytes = sum(
+      n * rb for n, rb in zip(fs['cold_tier_fetch_rows_per_group'],
+                              fs['cold_tier_row_bytes_per_group']))
+  assert fs['cold_tier_fetch_bytes'] == want_bytes
+  assert fs['cold_tier_fetch_rows'] == \
+      sum(fs['cold_tier_fetch_rows_per_group'])
+  assert fs['cold_tier_fetch_scale_bytes'] == \
+      fs['cold_tier_fetch_rows'] * quantization.SCALE_BYTES
+  for gi, rb in zip(d.plan.cold_tier_groups,
+                    fs['cold_tier_row_bytes_per_group']):
+    assert rb == d.plan.groups[gi].width  # int8: 1 byte/element
+  ts = coldtier.tier_stats(d)
+  assert ts['cold_tier_host_bytes'] == d.cold_tier.host_bytes()
+  assert ts['cold_tier_groups'] == list(d.plan.cold_tier_groups)
+
+
+def test_cold_fetch_cap_overflow_refuses():
+  """A batch needing more tail rows than the static fetch capacity
+  refuses with the sizing hint — silent dropping is never an option."""
+  rng = np.random.default_rng(37)
+  d = _tiered('int8', cold_fetch_rows=1)
+  set_weights(d, _weights(rng))
+  ids = _ids(rng, 32)
+  with pytest.raises(ValueError, match='cold_fetch_rows'):
+    d.build_cold_fetch([jnp.asarray(x) for x in ids])
+
+
+def test_cold_fetch_pipeline_ordered_and_measured():
+  """ColdFetchPipeline yields batches in order with their fetches and
+  measures overlap directly from consumer blocked time."""
+  rng = np.random.default_rng(41)
+  d = _tiered('int8')
+  set_weights(d, _weights(rng))
+  batches = [_ids(np.random.default_rng(100 + i), 8) for i in range(4)]
+  pipe = coldtier.ColdFetchPipeline(d, iter(batches))
+  seen = []
+  for cats, fetch in pipe:
+    ref = d.build_cold_fetch([jnp.asarray(x) for x in cats])
+    for gi in d.plan.cold_tier_groups:
+      for dev in range(d.world_size):
+        np.testing.assert_array_equal(fetch.rows_np[gi][dev],
+                                      ref.rows_np[gi][dev])
+    seen.append([np.asarray(c) for c in cats])
+  assert len(seen) == 4
+  for got, want in zip(seen, batches):  # order preserved
+    for a, b in zip(got, want):
+      np.testing.assert_array_equal(a, b)
+  st = pipe.stats()
+  assert st['batches'] == 4
+  assert 0.0 <= st['overlap_pct'] <= 1.0
+
+
+def test_refusal_matrix():
+  mesh = _mesh()
+  # table_dtype needs f32 params
+  with pytest.raises(ValueError, match='param_dtype'):
+    DistributedEmbedding(CONFIGS, mesh=mesh, dp_input=True,
+                         table_dtype='int8', param_dtype=jnp.bfloat16)
+  # cold tier needs dp_input / hot_cache; never sparsecore
+  with pytest.raises(ValueError, match='dp_input'):
+    DistributedEmbedding(CONFIGS, mesh=mesh, dp_input=False,
+                         cold_tier=True, device_hbm_budget=1 << 20)
+  with pytest.raises(ValueError, match='hot_cache'):
+    DistributedEmbedding(CONFIGS, mesh=mesh, dp_input=True,
+                         cold_tier=True, device_hbm_budget=1 << 20)
+  with pytest.raises(ValueError, match='sparsecore'):
+    DistributedEmbedding(CONFIGS, mesh=mesh, dp_input=True,
+                         hot_cache=HOT, cold_tier=True,
+                         device_hbm_budget=1 << 20,
+                         lookup_impl='sparsecore')
+  # unquantized bf16 params: the f32 host tails would silently promote
+  # the leaf and skip the per-step bf16 rounding — refuse
+  with pytest.raises(ValueError, match='param_dtype=float32'):
+    DistributedEmbedding(CONFIGS, mesh=mesh, dp_input=True,
+                         hot_cache=HOT, param_dtype=jnp.bfloat16,
+                         cold_tier=True, device_hbm_budget=1 << 20)
+  # the OOM-shaped off-arm refusal: over budget without the tier
+  probe = DistributedEmbedding(CONFIGS, mesh=mesh, dp_input=True,
+                               hot_cache=HOT, table_dtype='int8')
+  budget = int(probe.plan.resident_table_bytes() * 0.6)
+  with pytest.raises(ValueError, match='OOM'):
+    DistributedEmbedding(CONFIGS, mesh=mesh, dp_input=True,
+                         hot_cache=HOT, table_dtype='int8',
+                         device_hbm_budget=budget)
+  # a budget everything fits in leaves the tier inert by design
+  d = DistributedEmbedding(CONFIGS, mesh=mesh, dp_input=True,
+                           hot_cache=HOT, table_dtype='int8',
+                           cold_tier=True, device_hbm_budget=1 << 30)
+  assert not d.plan.cold_tier_groups and d.cold_tier is None
+
+
+# ---------------------------------------------------------------------------
+# checkpoint contract
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize('dtype', DTYPES)
+def test_checkpoint_payload_scale_members_and_roundtrip(dtype, tmp_path):
+  """Saved files carry payload+scale members only (4x smaller for
+  int8), and quantized -> f32 -> quantized round-trips reproduce the
+  exact payload and scale bits under a DIFFERENT tier split."""
+  rng = np.random.default_rng(43)
+  w = _weights(rng)
+  d = _tiered(dtype)
+  p = set_weights(d, w)
+  tables = export_tables(d, p)
+  st = get_optimizer_state(d, SparseAdagrad(learning_rate=0.05).init(d, p))
+  npz = str(tmp_path / 'q.npz')
+  save_train_npz(npz, tables, st, plan=d)
+  with np.load(npz) as zf:
+    for i in range(len(CONFIGS)):
+      assert f'table{i}:scale' in zf and f'table{i}:dtype' in zf
+      assert str(zf[f'table{i}:dtype']) == dtype
+      if dtype == 'int8':
+        assert zf[f'table{i}'].dtype == np.int8
+      else:  # fp8 rides as a uint8 bit-view
+        assert zf[f'table{i}'].dtype == np.uint8
+  loaded, lst, _ = load_train_npz(npz)
+  # restore under f32/no-tier: exact dequantized values everywhere
+  d_f = _build()
+  p_f = set_weights(d_f, loaded)
+  set_optimizer_state(d_f, SparseAdagrad(learning_rate=0.05).init(d_f, p_f),
+                      lst)
+  for a, b in zip(loaded, get_weights(d_f, p_f)):
+    np.testing.assert_array_equal(a.values(), b)
+  # and back into a DIFFERENT tier split: payload+scale bits reproduce
+  d2 = _tiered(dtype, frac=0.45)
+  g0 = d.plan.cold_tier_groups[0]
+  assert d2.plan.groups[g0].tier_rows != d.plan.groups[g0].tier_rows
+  p2 = set_weights(d2, get_weights(d_f, p_f))
+  for a, b in zip(tables, export_tables(d2, p2)):
+    np.testing.assert_array_equal(a.payload.view(np.uint8),
+                                  b.payload.view(np.uint8))
+    np.testing.assert_array_equal(a.scale, b.scale)
+
+
+def test_legacy_f32_checkpoint_restores_into_quantized_plan(tmp_path):
+  """An all-f32 file written by an unquantized plan (the legacy format)
+  restores into a quantized+tiered plan: values requantize within the
+  forward bound, and a second save from there is bit-stable."""
+  rng = np.random.default_rng(47)
+  w = _weights(rng)
+  ids = _ids(rng, 8)
+  jids = [jnp.asarray(x) for x in ids]
+  d_f = _build()
+  p_f = set_weights(d_f, w)
+  npz = str(tmp_path / 'legacy.npz')
+  save_train_npz(npz, get_weights(d_f, p_f),
+                 get_optimizer_state(
+                     d_f, SparseAdagrad(learning_rate=0.05).init(d_f, p_f)),
+                 plan=d_f)
+  with np.load(npz) as zf:  # genuinely a legacy f32 file
+    assert zf['table0'].dtype == np.float32
+    assert 'table0:scale' not in zf.files
+  loaded, lst, _ = load_train_npz(npz)
+  d_q = _tiered('int8')
+  p_q = set_weights(d_q, loaded)
+  set_optimizer_state(d_q, SparseAdagrad(learning_rate=0.05).init(d_q, p_q),
+                      lst)
+  o_f = d_f.apply(p_f, jids)
+  o_q = d_q.apply(p_q, jids)
+  spec = quantization.resolve_table_dtype('int8')
+  for t, (a, b) in enumerate(zip(o_f, o_q)):
+    hot = 1 if CONFIGS[t].combiner is None else ids[t].shape[1]
+    atol = _bound(spec, float(np.abs(w[t]).max()), hot) + 1e-7
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=0,
+                               atol=atol, err_msg=f'input {t}')
+  # second save from the quantized plan: bit-stable thereafter
+  t1 = export_tables(d_q, p_q)
+  npz2 = str(tmp_path / 'requant.npz')
+  save_train_npz(npz2, t1, lst, plan=d_q)
+  l2, _, _ = load_train_npz(npz2)
+  p_q2 = set_weights(d_q, l2)
+  for a, b in zip(t1, export_tables(d_q, p_q2)):
+    np.testing.assert_array_equal(a.payload.view(np.uint8),
+                                  b.payload.view(np.uint8))
+    np.testing.assert_array_equal(a.scale, b.scale)
+
+
+def test_portable_carries_quantized_pairs_losslessly():
+  """checkpoint._portable: QuantizedWeight falls back to its EXACT f32
+  values (positional arr_i format has no sidecar slot); ml_dtypes
+  arrays still up-cast; plain arrays pass through untouched."""
+  from distributed_embeddings_tpu.parallel.checkpoint import _portable
+  spec = quantization.resolve_table_dtype('int8')
+  rng = np.random.default_rng(53)
+  vals = rng.normal(size=(16, 8)).astype(np.float32)
+  qw = QuantizedWeight.from_values(vals, spec)
+  out = _portable(qw)
+  assert out.dtype == np.float32
+  np.testing.assert_array_equal(out, qw.values())
+  import ml_dtypes
+  bf = np.arange(6, dtype=np.float32).astype(ml_dtypes.bfloat16)
+  assert _portable(bf).dtype == np.float32
+  i64 = np.arange(4)
+  assert _portable(i64).dtype == i64.dtype
