@@ -26,9 +26,16 @@ from distributed_embeddings_tpu.parallel.checkpoint import (
     load_latest_valid,
     plan_fingerprint,
     prune_checkpoints,
+    quarantine_checkpoint,
     read_manifest,
     restore_train_state,
     verify_npz,
+)
+from distributed_embeddings_tpu.parallel.audit import (
+    AuditError,
+    AuditFinding,
+    LossSpikeGate,
+    StateAuditor,
 )
 from distributed_embeddings_tpu.parallel.grad import (broadcast_variables,
                                                       DistributedGradientTape,
@@ -73,6 +80,7 @@ from distributed_embeddings_tpu.parallel.csr_feed import CsrFeed, FedBatch
 from distributed_embeddings_tpu.parallel.coldtier import (
     ColdFetchPipeline,
     HostTier,
+    TierIntegrityError,
 )
 from distributed_embeddings_tpu.parallel.quantization import (
     QuantSpec,

@@ -47,7 +47,14 @@ class CheckpointCallback:
     keep_last: retention for ``{step}``-templated paths — after each
       save, checkpoints beyond the newest ``keep_last`` are pruned
       (``None`` keeps everything; ignored for the overwrite-in-place
-      spelling, which holds one file by construction).
+      spelling, which holds one file by construction).  Pruning is
+      anchored to last-known-good, not bare step order
+      (``prune_checkpoints``, design §13): the newest checkpoint that
+      VERIFIES survives even beyond the keep window (so a run whose
+      newest files are corrupt always keeps a rollback target), any
+      file an in-flight rollback is restoring from is spared, and
+      quarantined ``*.corrupt`` files neither count toward
+      ``keep_last`` nor get deleted.
   """
 
   def __init__(self, dist, path: str, every: int = 1000,

@@ -25,6 +25,8 @@ import glob as glob_lib
 import hashlib
 import json
 import os
+import re
+import threading
 
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
@@ -631,7 +633,9 @@ def set_optimizer_state(dist: DistributedEmbedding,
           heads.append(full[:res])
           tails.append(full[res:])
         if getattr(dist, 'cold_tier', None) is not None:
-          dist.cold_tier.opt[gi][k] = np.stack(tails)
+          # routed through set_opt_tail (not a raw dict store) so the
+          # tier's write-back digests re-certify the restored bytes
+          dist.cold_tier.set_opt_tail(gi, k, np.stack(tails))
         new_state[gkey][k] = jax.make_array_from_callback(
             tmpl.shape, sharding,
             lambda index, hs=heads: hs[index[0].start or 0][None])
@@ -871,7 +875,6 @@ def _step_hint(path: str) -> int:
   ``ckpt_1000.npz`` -> 1000), -1 when absent — the mtime tie-breaker.
   A lexical tie-break would rank ckpt_999 above ckpt_1000 on
   filesystems with coarse mtime granularity (NFS, FAT)."""
-  import re
   groups = re.findall(r'\d+', os.path.basename(path))
   return int(groups[-1]) if groups else -1
 
@@ -883,20 +886,117 @@ def _is_atomic_tmp(name: str) -> bool:
   return name.startswith('.') and '.tmp.' in name
 
 
+QUARANTINE_SUFFIX = '.corrupt'
+
+
+_QUARANTINE_RE = re.compile(r'\.corrupt(\.\d+)?$')
+
+
+def _is_quarantined(name: str) -> bool:
+  """Matches exactly ``quarantine_checkpoint``'s naming
+  (``*.corrupt`` / ``*.corrupt.N``) — a user checkpoint merely
+  CONTAINING '.corrupt' mid-name must stay visible to
+  resume/retention (same rule as ``_is_atomic_tmp``)."""
+  return _QUARANTINE_RE.search(name) is not None
+
+
 def _candidates(directory: str, pattern: str) -> List[str]:
   """Checkpoint files under ``directory`` newest-first (mtime, then the
-  numeric step in the name, then the name), in-flight atomic tmp files
-  excluded."""
+  numeric step in the name, then the name); in-flight atomic tmp files
+  AND quarantined ``*.corrupt`` files excluded — a quarantined file
+  must never re-enter resume candidate ordering or retention counting
+  (it would either resume known-bad state or push a good file out of
+  the keep window)."""
   paths = [p for p in glob_lib.glob(os.path.join(directory, pattern))
-           if not _is_atomic_tmp(os.path.basename(p))]
+           if not _is_atomic_tmp(os.path.basename(p))
+           and not _is_quarantined(os.path.basename(p))]
   return sorted(paths,
                 key=lambda p: (os.path.getmtime(p), _step_hint(p), p),
                 reverse=True)
 
 
+# files currently targeted by an in-flight rollback/restore: retention
+# must never delete them mid-read (the self-healing fit rolls back while
+# its own CheckpointCallback keeps pruning).  Guarded registry, not a
+# lock around the whole restore: prune just skips these paths.
+_PROTECTED_LOCK = threading.Lock()
+_PROTECTED: set = set()
+
+
+class _protect_path:
+  """Context manager marking ``path`` as in-flight (prune-exempt)."""
+
+  def __init__(self, path: str):
+    self.path = os.path.abspath(path)
+
+  def __enter__(self):
+    with _PROTECTED_LOCK:
+      _PROTECTED.add(self.path)
+    return self.path
+
+  def __exit__(self, *exc):
+    with _PROTECTED_LOCK:
+      _PROTECTED.discard(self.path)
+
+
+def protected_paths() -> List[str]:
+  with _PROTECTED_LOCK:
+    return sorted(_PROTECTED)
+
+
+# verification results for the RETENTION ANCHOR only, keyed by
+# (path, mtime_ns, size): the anchor search runs after EVERY periodic
+# save, and re-reading + re-checksumming the multi-GB file it verified
+# one save ago would double steady-state checkpoint I/O.  An unchanged
+# (mtime, size) pair re-uses the last verdict; any rewrite (atomic
+# os.replace updates both) re-verifies.  Resume-time verification
+# (``load_latest_valid`` / ``restore_train_state``) NEVER consults
+# this cache — a file that bit-rotted without an mtime change can at
+# worst be over-protected from pruning, never loaded unverified.
+# Bounded: stale entries evict FIFO.
+_VERIFY_CACHE: Dict[str, Tuple[Tuple[int, int], bool]] = {}
+_VERIFY_CACHE_CAP = 64
+
+
+def _verified_cached(path: str) -> bool:
+  try:
+    st = os.stat(path)
+  except OSError:
+    return False
+  key = (st.st_mtime_ns, st.st_size)
+  hit = _VERIFY_CACHE.get(os.path.abspath(path))
+  if hit is not None and hit[0] == key:
+    return hit[1]
+  ok, _, _ = verify_npz(path)
+  if len(_VERIFY_CACHE) >= _VERIFY_CACHE_CAP:
+    _VERIFY_CACHE.pop(next(iter(_VERIFY_CACHE)))
+  _VERIFY_CACHE[os.path.abspath(path)] = (key, ok)
+  return ok
+
+
+def quarantine_checkpoint(path: str) -> str:
+  """Rename a checkpoint that failed verification to
+  ``{path}.corrupt`` (``.corrupt.2``, ... if taken) — NEVER delete:
+  the damaged bytes are the forensic evidence for the corruption
+  (which offsets flipped, whether the writer or the medium is at
+  fault), and deletion would destroy it.  Quarantined files are
+  excluded from resume candidate ordering and retention counting
+  (``_candidates``).  Journaled (``checkpoint_quarantined``); returns
+  the new path."""
+  target = path + QUARANTINE_SUFFIX
+  n = 1
+  while os.path.exists(target):
+    n += 1
+    target = f'{path}{QUARANTINE_SUFFIX}.{n}'
+  os.replace(path, target)
+  resilience.journal('checkpoint_quarantined', path=path, target=target)
+  return target
+
+
 def load_latest_valid(directory: str,
                       expect_plan=None,
-                      pattern: str = '*.npz'):
+                      pattern: str = '*.npz',
+                      quarantine: bool = False):
   """Scan ``directory`` newest-first and load the first VALID resumable
   checkpoint: ``(path, (weights, table_states, extras))``.
 
@@ -904,27 +1004,48 @@ def load_latest_valid(directory: str,
   plan-mismatched, or structurally not a ``save_train_npz`` file) is
   journaled with its reason (``checkpoint_rejected``) and skipped — the
   auto-resume path falls back to the previous valid file instead of
-  dying on the artifact a crash corrupted.  Raises ``FileNotFoundError``
-  with the per-file reasons when nothing valid remains.
+  dying on the artifact a crash corrupted.  With ``quarantine=True``
+  (the self-healing rollback path, design §13), candidates failing an
+  INTEGRITY check are additionally renamed to ``*.corrupt``
+  (``quarantine_checkpoint``) so later resumes never rescan known-bad
+  bytes; plan-mismatched files are left in place — they are valid
+  checkpoints of a different model, not corruption.  Raises
+  ``FileNotFoundError`` with the per-file reasons when nothing valid
+  remains.
   """
   reasons = []
   for path in _candidates(directory, pattern):
     # single pass: each candidate's members are read + checksummed once
-    # (_load_verified), then parsed in memory — never re-read from disk
-    try:
-      arrays, _ = _load_verified(path, expect_plan=expect_plan)
-    except ValueError as e:
-      resilience.journal('checkpoint_rejected', path=path, reason=str(e))
-      reasons.append((path, str(e)))
-      continue
-    try:
-      payload = _parse_train_payload(arrays, path)
-    except Exception as e:  # valid npz but not a resumable train file
-      reason = f'not-a-train-checkpoint: {e!r}'
-      resilience.journal('checkpoint_rejected', path=path, reason=reason)
-      reasons.append((path, reason))
-      continue
-    return path, payload
+    # (_load_verified), then parsed in memory — never re-read from disk.
+    # The candidate is prune-protected while in flight.
+    with _protect_path(path):
+      try:
+        arrays, _ = _load_verified(path, expect_plan=expect_plan)
+      except ValueError as e:
+        reason = str(e)
+        resilience.journal('checkpoint_rejected', path=path,
+                           reason=reason)
+        reasons.append((path, reason))
+        # quarantine only on INTEGRITY failure: a plan-mismatched file
+        # is a valid checkpoint of a different model, not corruption
+        if quarantine and not reason.startswith('plan-mismatch'):
+          try:
+            quarantine_checkpoint(path)
+          except OSError:
+            pass
+        continue
+      try:
+        payload = _parse_train_payload(arrays, path)
+      except Exception as e:  # valid npz but not a resumable train file
+        # not quarantined either: the file is intact (checksums passed),
+        # just not in the save_train_npz key scheme (e.g. a weights-only
+        # save_npz sharing the directory)
+        reason = f'not-a-train-checkpoint: {e!r}'
+        resilience.journal('checkpoint_rejected', path=path,
+                           reason=reason)
+        reasons.append((path, reason))
+        continue
+      return path, payload
   detail = '; '.join(f'{os.path.basename(p)}: {r}' for p, r in reasons)
   raise FileNotFoundError(
       f'no valid checkpoint under {directory!r} (pattern {pattern!r})'
@@ -934,11 +1055,36 @@ def load_latest_valid(directory: str,
 def prune_checkpoints(directory: str, keep_last: int,
                       pattern: str = '*.npz') -> List[str]:
   """Retention: delete all but the newest ``keep_last`` checkpoints
-  matching ``pattern``; returns the removed paths (journaled)."""
+  matching ``pattern``; returns the removed paths (journaled).
+
+  Two files are exempt beyond the keep window (design §13 — retention
+  must never strand a rollback):
+
+  - the newest VERIFIED checkpoint (candidates verify newest-first
+    until one passes — normally one ``verify_npz`` of the file just
+    written): if every file inside the keep window is corrupt, the
+    last-known-good file beyond it survives pruning, so
+    ``load_latest_valid`` always has a fall-back;
+  - any path currently registered by an in-flight rollback/restore
+    (``_protect_path``).
+
+  Quarantined ``*.corrupt`` files neither count toward ``keep_last``
+  nor get removed here (``_candidates`` excludes them; forensics are
+  kept deliberately).
+  """
   if keep_last < 1:
     raise ValueError(f'keep_last must be >= 1, got {keep_last}')
+  cands = _candidates(directory, pattern)
+  anchor = None  # newest checkpoint that actually verifies
+  for p in cands:
+    if _verified_cached(p):
+      anchor = p
+      break
+  protected = set(protected_paths())
   removed = []
-  for path in _candidates(directory, pattern)[keep_last:]:
+  for path in cands[keep_last:]:
+    if path == anchor or os.path.abspath(path) in protected:
+      continue
     try:
       os.remove(path)
       removed.append(path)
@@ -1010,6 +1156,18 @@ def save_train_npz(path: str,
     step = int(np.asarray(extras['step']))
   payload[MANIFEST_KEY] = _build_manifest(payload, step=step, plan=plan)
   _atomic_savez(path, payload)
+  # seed the retention anchor's verify cache: this path just computed
+  # every checksum for the manifest and atomically published the file,
+  # so the prune that follows each periodic save must not re-read and
+  # re-hash the multi-GB artifact it knows to be freshly valid
+  try:
+    st = os.stat(path)
+    if len(_VERIFY_CACHE) >= _VERIFY_CACHE_CAP:
+      _VERIFY_CACHE.pop(next(iter(_VERIFY_CACHE)))
+    _VERIFY_CACHE[os.path.abspath(path)] = (
+        (st.st_mtime_ns, st.st_size), True)
+  except OSError:
+    pass
 
 
 def _parse_train_payload(arrays: Dict[str, np.ndarray], path: str):
@@ -1098,7 +1256,8 @@ def _restore_like(template, saved: Dict[str, np.ndarray], prefix: str):
   return jax.tree_util.tree_unflatten(treedef, rebuilt)
 
 
-def restore_train_state(dist: DistributedEmbedding, state, source: str):
+def restore_train_state(dist: DistributedEmbedding, state, source: str,
+                        quarantine: bool = False):
   """Restore a ``TrainState`` from a resumable checkpoint: embedding
   tables reshard through ``set_weights``, sparse-optimizer tables
   through ``set_optimizer_state``, dense params / optax state (incl.
@@ -1113,12 +1272,17 @@ def restore_train_state(dist: DistributedEmbedding, state, source: str):
   ``state`` supplies the structure to rebuild into — a fresh
   ``init_train_state`` / ``init_hybrid_train_state``.
 
+  ``quarantine``: the in-process-rollback spelling (design §13; what
+  ``fit(on_anomaly='rollback')`` uses) — candidates failing integrity
+  verification are renamed ``*.corrupt`` instead of merely skipped,
+  and the chosen file is registered prune-exempt while the restore is
+  in flight.
+
   Returns ``(state, path)`` — the restored state and the file used.
   """
-  import jax.numpy as jnp
   if os.path.isdir(source):
     path, (weights, st_tables, extras) = load_latest_valid(
-        source, expect_plan=dist)
+        source, expect_plan=dist, quarantine=quarantine)
   else:
     try:  # single pass: verified and parsed from one read
       arrays, _ = _load_verified(source, expect_plan=dist)
@@ -1128,6 +1292,13 @@ def restore_train_state(dist: DistributedEmbedding, state, source: str):
       raise ValueError(f'{source}: invalid checkpoint: {e}') from e
     path = source
     weights, st_tables, extras = _parse_train_payload(arrays, source)
+  with _protect_path(path):  # in-flight rollback target: prune-exempt
+    return _rebuild_train_state(dist, state, path, weights, st_tables,
+                                extras)
+
+
+def _rebuild_train_state(dist, state, path, weights, st_tables, extras):
+  import jax.numpy as jnp
   new_params = dict(state.params)
   new_params['embedding'] = set_weights(dist, weights)
   dense_template = {k: v for k, v in new_params.items() if k != 'embedding'}

@@ -35,14 +35,48 @@ import queue
 import threading
 import time
 
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from distributed_embeddings_tpu.parallel import quantization
+from distributed_embeddings_tpu.utils import resilience
 
 _FETCH_MARGIN = 1.5
 _FETCH_ALIGN = 64
+
+# deterministic per-byte odd multipliers for the row digests: odd, so a
+# single corrupted byte always changes the weighted sum (odd * nonzero
+# delta is never 0 mod 2**64); fixed seed, so digests are comparable
+# across processes
+_DIGEST_SEED = 0x5DC0FF5E7
+
+
+def _byte_weights(n: int) -> np.ndarray:
+  rng = np.random.default_rng(_DIGEST_SEED)
+  return (rng.integers(0, 1 << 62, size=n, dtype=np.uint64) << np.uint64(1)
+          ) | np.uint64(1)
+
+
+class TierIntegrityError(RuntimeError):
+  """A host-tier row's bytes disagree with its write-back-maintained
+  digest (design §13): silent corruption of host-DRAM state, detected
+  at fetch time before the damaged row reaches the device.  ``findings``
+  lists ``(group, device, rows)`` provenance; the event is journaled
+  (``tier_integrity_failure``) before raising, and ``fit``'s
+  ``on_anomaly`` rollback policy treats it like any other anomaly."""
+
+  def __init__(self, findings: List[Tuple[int, int, List[int]]]):
+    self.findings = findings
+    detail = '; '.join(
+        f'group {gi} device {dev} rows {rows}' for gi, dev, rows
+        in findings)
+    super().__init__(
+        f'host-tier integrity check failed: {detail}. The tier rows '
+        'were corrupted in host memory after their last write-back '
+        '(checksum mismatch) — roll back to the last valid checkpoint '
+        '(fit on_anomaly=rollback) instead of training on damaged '
+        'state (docs/design.md §13).')
 
 
 class HostTier:
@@ -58,6 +92,17 @@ class HostTier:
     self.payload: Dict[int, np.ndarray] = {}
     self.scale: Dict[int, np.ndarray] = {}
     self.opt: Dict[int, Dict[str, np.ndarray]] = {}
+    # write-back-maintained per-row digests (design §13): None until
+    # enable_digests() arms them — the default (off) path is
+    # byte-for-byte the pre-auditor program.  Bulk installs (checkpoint
+    # restore: set_tail twice + one set_opt_tail per optimizer leaf,
+    # all per group) only MARK the group dirty; the full re-hash runs
+    # ONCE, lazily, at the next digest read — a rollback restore of a
+    # beyond-HBM tier must not pay 3-4 redundant memory-bound sweeps
+    # on the recovery critical path.
+    self._digests: Optional[Dict[int, np.ndarray]] = None
+    self._dirty: set = set()
+    self._weights: Dict[int, np.ndarray] = {}
     for gi in plan.cold_tier_groups:
       g = plan.groups[gi]
       self.payload[gi] = np.zeros(
@@ -76,11 +121,14 @@ class HostTier:
       raise ValueError(f'tier tail for group {gi}/{leaf}: expected '
                        f'shape {want}, got {arr.shape}')
     target[gi] = arr.astype(target[gi].dtype) if gi in target else arr
+    if self._digests is not None:
+      self._dirty.add(gi)
 
   def ensure_opt(self, leaf: str, fill: float, dtype):
     """Create (idempotently) one optimizer-state leaf's tail arrays,
     filled with the optimizer's init value — the host half of e.g.
     Adagrad's accumulator for tier rows."""
+    created = False
     for gi in self.plan.cold_tier_groups:
       if leaf in self.opt[gi]:
         continue
@@ -88,6 +136,145 @@ class HostTier:
       self.opt[gi][leaf] = np.full(
           (self.plan.world_size, g.tier_rows, g.width), fill,
           np.dtype(dtype))
+      created = True
+    if created and self._digests is not None:
+      # a new leaf changes the per-row byte layout the digest covers
+      self._weights.clear()
+      self._dirty.update(self.plan.cold_tier_groups)
+
+  def set_opt_tail(self, gi: int, leaf: str, arr: np.ndarray):
+    """Install one group's full optimizer-state tail (the checkpoint
+    restore leg) — routed here, not assigned directly, so the row
+    digests stay in sync with the bytes they certify."""
+    self.opt[gi][leaf] = np.asarray(arr)
+    if self._digests is not None:
+      self._weights.pop(gi, None)
+      self._dirty.add(gi)
+
+  # -- row digests (design §13; the state the auditor + build_fetch
+  # verify against) ---------------------------------------------------------
+
+  @property
+  def digests_enabled(self) -> bool:
+    return self._digests is not None
+
+  def _flush_dirty(self, gi: Optional[int] = None):
+    """Run the deferred full-group re-hash for ``gi`` (or every dirty
+    group) — the ONE sweep all the bulk installs since the last digest
+    read collapse into."""
+    if self._digests is None or not self._dirty:
+      return
+    targets = (list(self._dirty) if gi is None
+               else ([gi] if gi in self._dirty else []))
+    for g in targets:
+      self._refresh_group(g)
+      self._dirty.discard(g)
+
+  def enable_digests(self):
+    """Arm the write-back-maintained per-row digests: every row's
+    payload+scale+optimizer bytes hash into ``[D, tier_rows]`` uint64
+    checksums, refreshed by ``write_back``/``set_tail``/``set_opt_tail``
+    and verified for every fetched row in ``build_fetch`` (mismatch
+    raises ``TierIntegrityError``).  Idempotent; default off — the
+    unarmed tier is program-identical to pre-§13 behaviour."""
+    if self._digests is None:
+      self._digests = {}
+      self._dirty.clear()
+      for gi in self.plan.cold_tier_groups:
+        self._refresh_group(gi)
+
+  def _row_bytes(self, gi: int, dev, idx) -> np.ndarray:
+    """``[n, B]`` uint8 view of the selected rows' full byte content
+    (payload, then scale, then optimizer leaves in sorted order)."""
+    sel = (slice(None) if idx is None else idx)
+    parts = [self.payload[gi][dev, sel]]
+    if gi in self.scale:
+      parts.append(self.scale[gi][dev, sel])
+    for k in sorted(self.opt[gi]):
+      parts.append(self.opt[gi][k][dev, sel])
+    rows = parts[0].shape[0]
+    flat = [np.ascontiguousarray(p).view(np.uint8).reshape(rows, -1)
+            for p in parts]
+    return np.concatenate(flat, axis=1)
+
+  # bound on the uint64 temporary the hash materializes (~9x the bytes
+  # it covers): a full-slice hash of a beyond-HBM tier would otherwise
+  # transiently allocate multiples of the tier itself and OOM the very
+  # process the detector protects — full-group passes chunk through
+  # this window instead
+  _DIGEST_CHUNK_BYTES = 8 << 20
+
+  def row_nbytes(self, gi: int) -> int:
+    """Bytes ONE tier row contributes to its digest (payload + scale +
+    every optimizer leaf) — what budgeted sweeps size their row
+    windows with."""
+    g = self.plan.groups[gi]
+    n = self.payload[gi].dtype.itemsize * g.width
+    if gi in self.scale:
+      n += 4
+    for k in self.opt[gi]:
+      n += self.opt[gi][k].dtype.itemsize * g.width
+    return n
+
+  def _digest_rows(self, gi: int, dev, idx=None) -> np.ndarray:
+    if idx is None:
+      # full device slice: chunk the row range so the ~9x uint64
+      # temporary stays bounded regardless of tier size
+      rows = self.payload[gi].shape[1]
+      step = max(1, self._DIGEST_CHUNK_BYTES // max(1, self.row_nbytes(gi)))
+      if rows > step:
+        return np.concatenate([
+            self._digest_rows(gi, dev, np.arange(lo, min(lo + step, rows)))
+            for lo in range(0, rows, step)
+        ])
+      idx = np.arange(rows)
+    b = self._row_bytes(gi, dev, idx)
+    w = self._weights.get(gi)
+    if w is None or w.size != b.shape[1]:
+      w = _byte_weights(b.shape[1])
+      self._weights[gi] = w
+    return (b.astype(np.uint64) * w).sum(axis=1, dtype=np.uint64)
+
+  def _refresh_group(self, gi: int):
+    self._digests[gi] = np.stack([
+        self._digest_rows(gi, dev)
+        for dev in range(self.plan.world_size)
+    ])
+
+  def refresh_rows(self, gi: int, dev: int, idx: np.ndarray):
+    if self._digests is None:
+      return
+    if gi in self._dirty:
+      self._flush_dirty(gi)  # the full re-hash covers these rows too
+      return
+    if len(idx):
+      self._digests[gi][dev, idx] = self._digest_rows(gi, dev, idx)
+
+  def verify_rows(self, gi: int, dev: int, idx: np.ndarray) -> np.ndarray:
+    """Tail-local indices among ``idx`` whose bytes disagree with the
+    stored digest (empty when healthy or digests are off)."""
+    if self._digests is None or not len(idx):
+      return np.zeros((0,), np.int64)
+    self._flush_dirty(gi)
+    got = self._digest_rows(gi, dev, idx)
+    want = self._digests[gi][dev, idx]
+    return np.asarray(idx, np.int64)[got != want]
+
+  def verify_all(self, max_rows: int = 8
+                 ) -> List[Tuple[int, int, List[int]]]:
+    """Full-tier digest sweep (the auditor's periodic ``tier`` check):
+    ``(group, device, first damaged rows)`` per failing device."""
+    out: List[Tuple[int, int, List[int]]] = []
+    if self._digests is None:
+      return out
+    self._flush_dirty()
+    for gi in self.plan.cold_tier_groups:
+      for dev in range(self.plan.world_size):
+        got = self._digest_rows(gi, dev)
+        bad = np.nonzero(got != self._digests[gi][dev])[0]
+        if bad.size:
+          out.append((gi, dev, [int(r) for r in bad[:max_rows]]))
+    return out
 
   def host_bytes(self) -> int:
     total = sum(a.nbytes for a in self.payload.values())
@@ -194,6 +381,26 @@ def build_fetch(dist, inputs, rows=None) -> ColdFetch:
   else:
     rows, counts = rows
   _ensure_caps(dist, counts)
+  if tier.digests_enabled:
+    # fetch-time integrity (design §13): every row about to be gathered
+    # is re-hashed against its write-back digest BEFORE it can reach
+    # the device — corrupted host-DRAM state fails loudly with
+    # provenance, never trains
+    bad_all = []
+    for gi in plan.cold_tier_groups:
+      res = plan.groups[gi].device_rows
+      for dev in range(plan.world_size):
+        n = counts[gi][dev]
+        if not n:
+          continue
+        bad = tier.verify_rows(gi, dev, rows[gi][dev][:n] - res)
+        if bad.size:
+          bad_all.append((gi, dev, [int(r) for r in bad[:8]]))
+    if bad_all:
+      for gi, dev, rws in bad_all:
+        resilience.journal('tier_integrity_failure', group=gi,
+                           device=dev, rows=rws)
+      raise TierIntegrityError(bad_all)
   device = {}
   for gi in plan.cold_tier_groups:
     g = plan.groups[gi]
@@ -252,6 +459,8 @@ def write_back(dist, fetch: ColdFetch, writeback):
       for k, v in host_opt.items():
         tier.opt[gi][k][dev, idx] = v[dev, :n].astype(
             tier.opt[gi][k].dtype)
+      # the digest certifies exactly the bytes this write-back landed
+      tier.refresh_rows(gi, dev, idx)
 
 
 # ---------------------------------------------------------------------------

@@ -188,6 +188,7 @@ class CsrFeed:
     self._last_seq = -1    # last ordinal the consumer returned
     self.reset_stats()
     self._skipped = 0
+    self._fast_forwarded = 0
     self._io_retry_count = 0
     self._respawns = 0
     self._thread = self._spawn()
@@ -284,6 +285,25 @@ class CsrFeed:
 
   def __iter__(self):
     return self
+
+  def skip_to(self, seq: int) -> int:
+    """Fast-forward the consumer past the window ``[next, seq)`` —
+    the self-healing skip leg (design §13): after an anomaly rollback
+    decides a window of batches is poisoned, the feed's seq fence
+    (``_last_seq``) advances so every batch below ``seq`` is discarded
+    on delivery, whether it was already built, is in flight on the
+    producer's cursor, or gets re-built after a respawn.  No producer
+    coordination is needed — delivery-side fencing is exactly the
+    mechanism that already de-duplicates respawned batches.  Journals
+    ``csr_feed_fast_forward``; returns the number of seqs fenced off
+    (0 when ``seq`` is already behind the stream)."""
+    fenced = max(0, int(seq) - 1 - self._last_seq)
+    if fenced:
+      self._last_seq = int(seq) - 1
+      self._fast_forwarded += fenced
+      resilience.journal('csr_feed_fast_forward', to_seq=int(seq),
+                         fenced=fenced)
+    return fenced
 
   def __next__(self) -> FedBatch:
     if self._closed:
@@ -392,6 +412,7 @@ class CsrFeed:
                         else None),
         'builder': self.builder,
         'skipped': self._skipped,
+        'fast_forwarded': self._fast_forwarded,
         'io_retries': self._io_retry_count,
         'respawns': self._respawns,
     }

@@ -511,6 +511,28 @@ class DistributedEmbedding:
                    on_batch_error=on_batch_error, io_retries=io_retries,
                    max_respawns=max_respawns)
 
+  def make_auditor(self, every: int = 100, checks=None, max_rows: int = 8,
+                   bytes_per_audit='default'):
+    """A ``parallel.audit.StateAuditor`` over this layer's state
+    (docs/design.md §13): cheap invariant checks — replicated hot
+    buffers bit-identical across the mesh, quantized rows on the §12
+    contract, params/optimizer finiteness, host-tier digests — run
+    every ``every`` steps when passed as ``fit(auditor=...)``; each
+    failure journals ``audit_failure`` with (device, leaf, row)
+    provenance and feeds ``fit``'s ``on_anomaly`` policy.  The
+    ``tier`` check (on cold-tier layers) also arms the host tier's
+    write-back digests, so every subsequent fetch verifies the rows
+    it gathers."""
+    from distributed_embeddings_tpu.parallel.audit import (BYTES_PER_AUDIT,
+                                                           CHECKS,
+                                                           StateAuditor)
+    return StateAuditor(self, every=every,
+                        checks=CHECKS if checks is None else checks,
+                        max_rows=max_rows,
+                        bytes_per_audit=(BYTES_PER_AUDIT
+                                         if bytes_per_audit == 'default'
+                                         else bytes_per_audit))
+
   # ------------------------------------------------------------------ init
 
   def init(self, rng: Union[int, jax.Array]) -> Dict[str, jax.Array]:

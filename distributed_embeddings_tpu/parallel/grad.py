@@ -26,7 +26,22 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_embeddings_tpu.parallel import mesh as mesh_lib
+from distributed_embeddings_tpu.parallel.coldtier import TierIntegrityError
 from distributed_embeddings_tpu.utils import resilience
+
+ANOMALY_POLICIES = (None, 'terminate', 'rollback', 'rollback_skip')
+
+
+class _Anomaly(Exception):
+  """Internal control flow of ``fit``'s anomaly policy: a detected
+  anomaly unwinds to the policy handler, which terminates or rolls
+  back in-process."""
+
+  def __init__(self, kind: str, step: int, detail: str = ''):
+    self.kind = kind
+    self.step = int(step)
+    self.detail = detail
+    super().__init__(f'{kind} at step {step}: {detail}')
 
 
 def broadcast_variables(params, root_rank: int = 0):
@@ -115,7 +130,14 @@ def fit(step_fn: Callable,
         resume_from: Optional[str] = None,
         dist=None,
         terminate_on_nan: bool = False,
-        step_timeout_s: Optional[float] = None):
+        step_timeout_s: Optional[float] = None,
+        on_anomaly: Optional[str] = None,
+        rollback_dir: Optional[str] = None,
+        rollback_budget: int = 3,
+        data_factory: Optional[Callable] = None,
+        auditor=None,
+        spike_zscore: Optional[float] = None,
+        spike_warmup: int = 10):
   """Keras-``fit``-like driver for the train steps built here.
 
   The reference's integration test trains its distributed layer through
@@ -153,12 +175,60 @@ def fit(step_fn: Callable,
       skip ``int(state.step)`` batches).  Requires ``dist``.
     dist: the model's ``DistributedEmbedding`` (needed only with
       ``resume_from`` — it defines the resharding layout).
-    terminate_on_nan: stop the run when a non-finite loss appears in a
-      log window, with a clear message and a journaled
-      ``terminate_on_nan`` event naming the offending step
-      (``history['terminated_on_nan']``).  Without this guard a NaN
-      flows through silently AND defeats ``EarlyStopping`` (NaN
+    terminate_on_nan: DEPRECATED alias for ``on_anomaly='terminate'``
+      (kept so existing callers work unchanged; the journal event name
+      ``terminate_on_nan`` is also kept).  Without any anomaly policy a
+      NaN flows through silently AND defeats ``EarlyStopping`` (NaN
       comparisons are always False, so ``patience`` never fires).
+    on_anomaly: the self-healing policy (docs/design.md §13).  An
+      ANOMALY is any of: a non-finite loss in a log window; a loss
+      spike past the EMA z-score gate (``spike_zscore``); a failed
+      state-integrity audit (``auditor``); a host-tier integrity error
+      raised by the step (``coldtier.TierIntegrityError``).  Every
+      detection journals ``anomaly_detected`` and lands in
+      ``history['anomalies']``.  Policies:
+
+      - ``None`` (default): no detection — pre-§13 behaviour.
+      - ``'terminate'``: stop the run with a journaled reason (the
+        promoted ``terminate_on_nan``; non-finite-loss terminations
+        keep that legacy event name and ``history`` key).
+      - ``'rollback'``: restore the newest VALID checkpoint under
+        ``rollback_dir`` IN-PROCESS (``restore_train_state`` with
+        quarantine: corrupt candidates are renamed ``*.corrupt``,
+        never deleted, and excluded from later scans), reposition the
+        input at the restored step via ``data_factory`` and retry the
+        same window — for transient state corruption (SDC), the replay
+        is bit-exact vs an undisturbed run.
+      - ``'rollback_skip'``: like ``'rollback'``, but the input
+        fast-forwards PAST the offending window ``(ckpt_step,
+        detect_step]`` (journaled ``skip_window``) — for poison data
+        that would re-trigger on replay (feed-driven loops fence the
+        same window with ``CsrFeed.skip_to``).
+
+      Each run takes at most ``rollback_budget`` rollbacks; the next
+      anomaly past the budget journals ``rollback_budget_exhausted``
+      and terminates — a persistent fault must page a human, not loop.
+      After a rollback the log/eval history simply continues (steps in
+      the replayed window appear twice, annotated by the journal).
+    rollback_dir: checkpoint directory the rollback policies scan
+      (normally the same directory a ``CheckpointCallback`` in
+      ``callbacks`` writes; retention never prunes the newest verified
+      file or an in-flight rollback target).
+    rollback_budget: max in-process rollbacks per ``fit`` call.
+    data_factory: ``step -> iterable`` positioned at the batch that
+      trains ``step + 1`` (deterministic sources:
+      ``lambda s: iter(batches[s:])``; feed-driven loops can combine a
+      fresh reader with ``CsrFeed.skip_to``).  Required by the
+      rollback policies — a bare iterator cannot rewind.
+    auditor: a ``parallel.audit.StateAuditor``; ``fit`` calls
+      ``auditor.check_state(state)`` every ``auditor.every`` steps
+      (before the same step's log-point callbacks, so a failing audit
+      blocks the checkpoint that would have persisted the damage) and
+      feeds any finding into the anomaly policy.
+    spike_zscore: arm the EMA z-score loss-spike gate
+      (``audit.LossSpikeGate``) at this threshold; ``spike_warmup``
+      observations train the gate before it can fire.  Spikes journal
+      through ``anomaly_detected`` with ``kind='loss_spike'``.
     step_timeout_s: hung-device-step watchdog — every step dispatch and
       every log-point device sync runs under this timeout (mirroring
       bench.py's 180 s backend-probe guard: a downed TPU backend makes
@@ -182,11 +252,38 @@ def fit(step_fn: Callable,
     instead of corrupting that series' alignment.
   """
   eval_every = eval_every or log_every
+  if on_anomaly not in ANOMALY_POLICIES:
+    raise ValueError(f'on_anomaly must be one of {ANOMALY_POLICIES}, '
+                     f'got {on_anomaly!r}')
+  if on_anomaly is None and (terminate_on_nan or auditor is not None
+                             or spike_zscore is not None):
+    # terminate_on_nan is the deprecated alias of the policy; an armed
+    # detector (auditor / spike gate) without an explicit policy
+    # defaults to the conservative one
+    on_anomaly = 'terminate'
+  if on_anomaly in ('rollback', 'rollback_skip'):
+    if dist is None or rollback_dir is None:
+      raise ValueError(
+          f'fit(on_anomaly={on_anomaly!r}) needs rollback_dir= (the '
+          'checkpoint directory to restore from — normally where a '
+          'CheckpointCallback in callbacks= writes) and dist= (the '
+          'DistributedEmbedding defining the resharding layout)')
+    if data_factory is None:
+      raise ValueError(
+          f'fit(on_anomaly={on_anomaly!r}) needs data_factory= — a '
+          'callable step -> iterable positioned at the batch that '
+          'trains step+1 (deterministic sources: '
+          'lambda s: iter(batches[s:])); a bare iterator cannot be '
+          'rewound after a rollback')
+  gate = None
+  if spike_zscore is not None:
+    from distributed_embeddings_tpu.parallel.audit import LossSpikeGate
+    gate = LossSpikeGate(zscore=spike_zscore, warmup=spike_warmup)
   _RESERVED = ('step', 'loss', 'eval_step')
   history: dict = {'step': [], 'loss': [], 'eval_step': []}
   window = []  # on-device losses since the last sync
-  it = iter(data)
   i = 0
+  it = iter(data) if data is not None else None
   if resume_from is not None:
     if dist is None:
       raise ValueError('fit(resume_from=...) needs dist= (the '
@@ -198,6 +295,10 @@ def fit(step_fn: Callable,
     i = int(state.step)
     if verbose:
       print_fn(f'resumed from {ckpt_path} at step {i}')
+  if it is None:
+    if data_factory is None:
+      raise ValueError('fit() needs data= or data_factory=')
+    it = iter(data_factory(i))
   last_eval_at = None  # step of the last eval: the exit flush must not
   #                      re-eval a state already evaluated at this step
 
@@ -221,16 +322,21 @@ def fit(step_fn: Callable,
     if window:
       n_window = len(window)
       host = sync_window(i)
-      if terminate_on_nan and not np.isfinite(host).all():
-        bad = int(np.argmax(~np.isfinite(host)))
-        bad_step = i - n_window + bad + 1
-        resilience.journal('terminate_on_nan', step=bad_step,
-                           loss=repr(host[bad]))
-        history['terminated_on_nan'] = bad_step
-        print_fn(f'terminate_on_nan: non-finite loss at step {bad_step}; '
-                 'stopping (event journaled to '
-                 f'{resilience.journal_path()})')
-        raise StopIteration
+      if on_anomaly is not None:
+        # scan the window in step order: the FIRST anomalous value
+        # names the offending step (non-finite beats spike; a healthy
+        # value trains the spike gate's EMA)
+        for j, v in enumerate(host):
+          step_j = i - n_window + j + 1
+          if not np.isfinite(v):
+            raise _Anomaly('non_finite_loss', step_j, repr(v))
+          if gate is not None:
+            z = gate.observe(float(v))
+            if z is not None:
+              raise _Anomaly(
+                  'loss_spike', step_j,
+                  f'loss={float(v):.6g} zscore={z:.2f} '
+                  f'(gate {gate.zscore:g})')
       mean = float(host.mean())
       logs['loss'] = mean
       history['step'].append(i)
@@ -256,23 +362,119 @@ def fit(step_fn: Callable,
       cb(i, state, logs)
     return logs
 
-  try:
-    while steps is None or i < steps:
-      try:
-        args = next(it)
-      except StopIteration:
-        break
-      if step_timeout_s is not None:
-        state, loss = resilience.call_with_timeout(
-            lambda s=state, a=args: step_fn(s, *a),
-            step_timeout_s, what=f'train step dispatch at step {i}')
+  rollbacks = 0
+
+  def handle_anomaly(a: _Anomaly) -> bool:
+    """Apply the on_anomaly policy to one detection.  Returns True
+    after an in-process rollback (training continues), False when the
+    run must terminate (reason printed + journaled)."""
+    nonlocal state, i, it, rollbacks, last_eval_at
+    resilience.journal('anomaly_detected', anomaly=a.kind,
+                       step=a.step, policy=on_anomaly, detail=a.detail)
+    history.setdefault('anomalies', []).append(
+        {'kind': a.kind, 'step': a.step})
+    if on_anomaly == 'terminate':
+      if a.kind == 'non_finite_loss':
+        # the promoted legacy guard: same journal event name and
+        # history key, so pre-§13 callers/tests see identical behaviour
+        resilience.journal('terminate_on_nan', step=a.step,
+                           loss=a.detail)
+        history['terminated_on_nan'] = a.step
+        print_fn(f'terminate_on_nan: non-finite loss at step {a.step}; '
+                 'stopping (event journaled to '
+                 f'{resilience.journal_path()})')
       else:
-        state, loss = step_fn(state, *args)
-      window.append(loss)
-      i += 1
-      if i % log_every == 0:
-        flush(i, final=(steps == i))
-    flush(i, final=True)
+        history['terminated_on_anomaly'] = a.step
+        print_fn(f'on_anomaly=terminate: {a.kind} at step {a.step}; '
+                 f'stopping ({a.detail})')
+      return False
+    if rollbacks >= rollback_budget:
+      resilience.journal('rollback_budget_exhausted',
+                         budget=rollback_budget, step=a.step,
+                         anomaly=a.kind)
+      history['terminated_on_anomaly'] = a.step
+      history['rollback_budget_exhausted'] = True
+      print_fn(f'on_anomaly={on_anomaly}: {a.kind} at step {a.step} '
+               f'but the rollback budget ({rollback_budget}) is '
+               'exhausted; escalating to termination — a persistent '
+               'fault needs a human, not a retry loop')
+      return False
+    from distributed_embeddings_tpu.parallel.checkpoint import (
+        restore_train_state)
+    try:
+      state, path = restore_train_state(dist, state, rollback_dir,
+                                        quarantine=True)
+    except (FileNotFoundError, ValueError) as e:
+      resilience.journal('rollback_failed', step=a.step,
+                         anomaly=a.kind, error=str(e))
+      history['terminated_on_anomaly'] = a.step
+      print_fn(f'on_anomaly={on_anomaly}: {a.kind} at step {a.step} '
+               f'and no valid checkpoint to roll back to ({e}); '
+               'terminating')
+      return False
+    rollbacks += 1
+    to_step = int(state.step)
+    detect_at = i
+    window.clear()
+    last_eval_at = None  # replayed steps re-evaluate
+    resilience.journal('rollback', anomaly=a.kind, detect_step=a.step,
+                       at_step=detect_at, to_step=to_step, path=path,
+                       attempt=rollbacks, policy=on_anomaly)
+    if on_anomaly == 'rollback_skip' and detect_at > to_step:
+      # fast-forward past the offending window: batches (to_step,
+      # detect_at] never replay (poison data would re-trigger)
+      resilience.journal('skip_window', from_step=to_step,
+                         to_step=detect_at,
+                         batches=detect_at - to_step)
+      it = iter(data_factory(detect_at))
+    else:
+      it = iter(data_factory(to_step))
+    i = to_step
+    if verbose:
+      print_fn(f'rollback: {a.kind} at step {a.step} -> restored '
+               f'{path} at step {to_step} (attempt '
+               f'{rollbacks}/{rollback_budget}'
+               + (', input fast-forwarded past the offending window'
+                  if on_anomaly == 'rollback_skip' else '') + ')')
+    return True
+
+  try:
+    while True:
+      try:
+        while steps is None or i < steps:
+          try:
+            args = next(it)
+          except StopIteration:
+            break
+          if step_timeout_s is not None:
+            state, loss = resilience.call_with_timeout(
+                lambda s=state, a=args: step_fn(s, *a),
+                step_timeout_s, what=f'train step dispatch at step {i}')
+          else:
+            state, loss = step_fn(state, *args)
+          window.append(loss)
+          i += 1
+          if auditor is not None and i % auditor.every == 0:
+            # audit BEFORE this step's log point, so a failing state
+            # never reaches the checkpoint callback that would have
+            # persisted the damage
+            findings = auditor.check_state(state, step=i)
+            if findings:
+              raise _Anomaly(
+                  'audit_failure', i,
+                  '; '.join(f.brief() for f in findings[:3]))
+          if i % log_every == 0:
+            flush(i, final=(steps == i))
+        flush(i, final=True)
+        break
+      except _Anomaly as a:
+        if not handle_anomaly(a):
+          break
+      except TierIntegrityError as e:
+        if on_anomaly is None:
+          raise
+        if not handle_anomaly(_Anomaly('tier_integrity', i, str(e))):
+          break
   except StopIteration:  # raised by a callback: early stop
     pass
   return state, history

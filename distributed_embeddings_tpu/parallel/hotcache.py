@@ -398,3 +398,19 @@ def measure_exchange_counters(dist, cats,
       'scatter_rows_per_step_off': scatter_rows(routed_off),
       'scatter_rows_per_step': scatter_rows(routed_on),
   }
+
+
+def replicated_leaf_names(plan) -> list:
+  """Parameter leaves that are FULLY REPLICATED across the mesh under
+  ``plan`` — the §10 hot-row buffers plus, on quantized plans (§12),
+  their per-row scale twins.  These are exactly the leaves whose
+  per-device copies must stay bit-identical, i.e. what the §13
+  replicated-consistency audit digests (their optimizer slots,
+  ``hot_group_{gi}/{leaf}``, replicate too and are audited alongside).
+  """
+  names = []
+  for gi in getattr(plan, 'hot_groups', []) or []:
+    names.append(f'hot_group_{gi}')
+    if getattr(plan, 'table_spec', None) is not None:
+      names.append(f'hot_scale_group_{gi}')
+  return names

@@ -159,6 +159,34 @@ def dequantize_np(payload: np.ndarray, scale: np.ndarray) -> np.ndarray:
   return payload.astype(np.float32) * np.asarray(scale, np.float32)
 
 
+# ---------------------------------------------------------------------------
+# row-contract invariants (design §13): what a VALID stored row looks
+# like, checkable without any reference data — the auditor
+# (parallel/audit.py) and the offline verifier (tools/verify_checkpoint)
+# both test against exactly these masks.
+# ---------------------------------------------------------------------------
+
+
+def scale_bad_mask_np(scale: np.ndarray) -> np.ndarray:
+  """True where a per-row scale violates the §12 contract: every scale
+  this module ever writes is a finite, positive, EXACT power of two
+  (``row_scale_np``), so any other bit pattern is corruption."""
+  s = np.asarray(scale, np.float32)
+  with np.errstate(invalid='ignore'):
+    m, _ = np.frexp(s)
+    return ~(np.isfinite(s) & (s > 0) & (m == np.float32(0.5)))
+
+
+def payload_bad_mask_np(payload: np.ndarray, spec: QuantSpec) -> np.ndarray:
+  """True where a payload element is off its dtype's quantized grid:
+  int8 payloads are clipped to ``[-qmax, qmax]`` so -128 never occurs;
+  every fp8_e4m3fn bit pattern except NaN is a grid value."""
+  p = np.asarray(payload)
+  if spec.integer:
+    return p == np.asarray(-128, p.dtype)
+  return np.isnan(p.astype(np.float32))
+
+
 def row_scale_jnp(rows, qmax: float):
   """``row_scale_np`` traced: same frexp/ldexp exponent arithmetic."""
   import jax.numpy as jnp

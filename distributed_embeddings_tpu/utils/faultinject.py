@@ -17,6 +17,16 @@ runtime promises:
   producer) — the feed must respawn it and continue the stream.
 - ``DelayedStep``: stall one train step past the watchdog timeout —
   ``fit(step_timeout_s=...)`` must dump diagnostics and fail fast.
+- ``flip_device_bit`` / ``corrupt_state_leaf``: XOR one bit inside ONE
+  device's physical copy of a live param/optimizer leaf (a replicated
+  hot buffer diverges; a sharded quantized row goes off-contract) —
+  the SDC model the design-§13 auditor must catch.
+- ``corrupt_tier_row``: flip a byte in a host-DRAM cold-tier row
+  WITHOUT refreshing its write-back digest — the host-memory SDC the
+  tier integrity check must catch at fetch/audit time.
+- ``CorruptingStep`` / ``LossSpikeStep``: wrap a train step so one
+  chosen step's output state is corrupted / its loss spikes — drives
+  the ``fit(on_anomaly=...)`` rollback and skip-window policies.
 
 These are test/ops tools, not production paths; nothing here is
 imported by the runtime modules.
@@ -166,3 +176,116 @@ class DelayedStep:
     if i == self._at:
       time.sleep(self._delay)
     return self._fn(*args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# live device-state corruption (the SDC model for the design-§13 auditor)
+# ---------------------------------------------------------------------------
+
+
+def flip_device_bit(arr, shard_index: int = 0, byte_offset: int = 0,
+                    bit: int = 0):
+  """Return a copy of a live ``jax.Array`` with ONE bit flipped inside
+  ONE device's physical shard — the deterministic stand-in for an
+  HBM/SEU bit flip on a single chip.
+
+  For a REPLICATED leaf (e.g. a design-§10 ``hot_group_{gi}`` buffer)
+  this produces an array whose sharding still claims replication while
+  the chosen device's copy has silently diverged — exactly the
+  condition the auditor's replicated-consistency digest must detect.
+  For a sharded ``[D, ...]`` leaf it damages that device's resident
+  rows.  ``shard_index`` indexes ``arr.addressable_shards`` (wrapped),
+  ``byte_offset`` the flat byte inside that shard (wrapped), so any
+  (index, offset, bit) triple is valid and reproducible.
+  """
+  import jax
+  import numpy as np
+  shards = list(arr.addressable_shards)
+  bufs = []
+  for i, s in enumerate(shards):
+    host = np.array(s.data)  # copy: never mutate the live buffer
+    if i == shard_index % len(shards):
+      flat = host.view(np.uint8).reshape(-1)
+      flat[byte_offset % flat.size] ^= np.uint8(1 << (bit % 8))
+    bufs.append(jax.device_put(host, s.device))
+  return jax.make_array_from_single_device_arrays(arr.shape, arr.sharding,
+                                                  bufs)
+
+
+def corrupt_state_leaf(state, leaf: str, shard_index: int = 0,
+                       byte_offset: int = 0, bit: int = 0,
+                       where: str = 'params'):
+  """``flip_device_bit`` applied to one embedding leaf of a hybrid
+  ``TrainState`` (``state.params['embedding'][leaf]``, or the sparse
+  optimizer table ``where='opt'`` → ``state.opt_state[1][leaf][k]``
+  with ``leaf`` spelled ``'{group}/{k}'``).  Returns the new state;
+  the input is untouched."""
+  if where == 'params':
+    emb = dict(state.params['embedding'])
+    emb[leaf] = flip_device_bit(emb[leaf], shard_index, byte_offset, bit)
+    params = dict(state.params)
+    params['embedding'] = emb
+    return state._replace(params=params)
+  if where != 'opt':
+    raise ValueError(f"where must be 'params' or 'opt', got {where!r}")
+  group, _, k = leaf.partition('/')
+  emb_opt = {g: dict(d) for g, d in state.opt_state[1].items()}
+  emb_opt[group][k] = flip_device_bit(emb_opt[group][k], shard_index,
+                                      byte_offset, bit)
+  return state._replace(opt_state=(state.opt_state[0], emb_opt))
+
+
+def corrupt_tier_row(tier, gi: int, device: int, row: int,
+                     byte_offset: int = 0, bit: int = 0):
+  """Flip one bit of a host-DRAM cold-tier payload row IN PLACE without
+  refreshing its write-back digest — host-memory rot.  The tier's
+  integrity check (``HostTier.verify_rows`` at fetch time, or the
+  auditor's ``tier`` sweep) must flag exactly this row."""
+  import numpy as np
+  rowbuf = tier.payload[gi][device, row]
+  flat = rowbuf.view(np.uint8).reshape(-1)
+  flat[byte_offset % flat.size] ^= np.uint8(1 << (bit % 8))
+
+
+class CorruptingStep:
+  """Wrap a train step so the OUTPUT state of call ``at_step`` (0-based)
+  is passed through ``mutate(state) -> state`` exactly once — e.g. a
+  ``corrupt_state_leaf`` injection landing between two healthy steps,
+  the way real SDC does."""
+
+  def __init__(self, step_fn: Callable, at_step: int, mutate: Callable):
+    self._fn = step_fn
+    self._at = int(at_step)
+    self._mutate = mutate
+    self.calls = 0
+    self.injected = 0
+
+  def __call__(self, state, *args, **kwargs):
+    i = self.calls
+    self.calls += 1
+    out = self._fn(state, *args, **kwargs)
+    if i == self._at:
+      self.injected += 1
+      out = (self._mutate(out[0]),) + tuple(out[1:])
+    return out
+
+
+class LossSpikeStep:
+  """Wrap a train step so call ``at_step``'s reported loss is offset by
+  ``magnitude`` (state untouched) — drives the EMA z-score loss-spike
+  gate without perturbing training math."""
+
+  def __init__(self, step_fn: Callable, at_step: int,
+               magnitude: float = 1e6):
+    self._fn = step_fn
+    self._at = int(at_step)
+    self._magnitude = float(magnitude)
+    self.calls = 0
+
+  def __call__(self, state, *args, **kwargs):
+    i = self.calls
+    self.calls += 1
+    state, loss = self._fn(state, *args, **kwargs)
+    if i == self._at:
+      loss = loss + self._magnitude
+    return state, loss

@@ -40,6 +40,32 @@ _JOURNAL_ENV = 'DET_FT_JOURNAL'
 _DEFAULT_JOURNAL = '/tmp/det_ft_journal.jsonl'
 _RING_CAP = 256
 
+# The complete journal-event schema.  Every ``journal(...)`` call site in
+# the runtime must use one of these names (pinned by
+# tests/test_fault_tolerance.py test_journal_event_names_registered):
+# stringly-typed scattered names caused two classes of bug before this
+# registry — a dashboard filtering on a misspelled kind silently shows
+# nothing, and a renamed event orphans every consumer.  Add the name
+# HERE in the same change that introduces the call site.
+REGISTERED_EVENTS = frozenset({
+    # transient-I/O retry (retry_io)
+    'io_retry', 'io_retry_exhausted',
+    # step watchdog (call_with_timeout)
+    'watchdog_fired',
+    # input pipeline (parallel/csr_feed.py)
+    'csr_feed_skipped_batch', 'csr_feed_respawn', 'csr_feed_fast_forward',
+    # native-builder degradation (parallel/sparsecore.py)
+    'csr_native_fallback',
+    # checkpoint integrity + retention (parallel/checkpoint.py)
+    'checkpoint_rejected', 'checkpoint_pruned', 'checkpoint_quarantined',
+    'resume',
+    # anomaly policy (parallel/grad.py fit on_anomaly; design §13)
+    'terminate_on_nan', 'anomaly_detected', 'rollback', 'rollback_failed',
+    'rollback_budget_exhausted', 'skip_window',
+    # state-integrity auditor (parallel/audit.py + coldtier.py)
+    'audit_failure', 'tier_integrity_failure',
+})
+
 _lock = threading.Lock()
 _ring: collections.deque = collections.deque(maxlen=_RING_CAP)
 
@@ -51,7 +77,12 @@ def journal_path() -> str:
 def journal(kind: str, **fields) -> Dict[str, Any]:
   """Record one fault-tolerance event: append a jsonl line to
   ``journal_path()`` (best-effort — the journal must never take the
-  run down with it) and to the in-memory ring.  Returns the event."""
+  run down with it) and to the in-memory ring.  Returns the event.
+
+  Runtime call sites must use a name from ``REGISTERED_EVENTS`` (the
+  schema consumers filter on; enforced by a source-scan test) — the
+  function itself stays permissive so a user extension can journal its
+  own kinds without touching this module."""
   event = {'kind': kind, 'ts': time.time(), **fields}
   with _lock:
     _ring.append(event)

@@ -85,6 +85,18 @@ if [ "$BUDGET" = 1 ]; then
     --table_dtype int8 \
     --max_steps 40
 
+  # cheap audit off/on A/B (design §13): the plain --max_steps 40 row
+  # above is the audit-off arm (byte-identical program); this arm runs
+  # the state-integrity auditor every 10 steps — compare the two
+  # steady-state samples/s lines to price leaving SDC detection armed
+  python examples/dlrm/main.py \
+    --dataset_path "$DATA" \
+    --batch_size "$BATCH" \
+    --dp_input \
+    --fast_compile \
+    --audit_every 10 \
+    --max_steps 40
+
   # cheap cold-tier row (design §12): int8 + hot cache + a per-device
   # HBM budget tight enough to force tail rows into host DRAM — proves
   # the beyond-HBM path trains on this chip and prints the measured
@@ -148,6 +160,18 @@ python examples/dlrm/main.py \
   --batch_size "$BATCH" \
   --dp_input \
   --table_dtype int8 \
+  --max_steps 40
+
+# audit off/on A/B (design §13): the plain --max_steps 40 row above is
+# the audit-off arm (byte-identical program); the on arm checks the
+# live state every 10 steps (replicated digests, quantized row
+# contract, finiteness) — the steady-state samples/s pair prices
+# leaving SDC detection armed on an unattended run
+python examples/dlrm/main.py \
+  --dataset_path "$DATA" \
+  --batch_size "$BATCH" \
+  --dp_input \
+  --audit_every 10 \
   --max_steps 40
 
 # cold-tier row (design §12): int8 + hot cache + a per-device HBM

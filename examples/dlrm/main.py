@@ -144,6 +144,32 @@ def parse_args():
                       "build errors (after transient-I/O retries); 'skip' "
                       'drops it, counts it in the feed stats and journals '
                       'it — never silent')
+  parser.add_argument('--audit_every', type=int, default=0,
+                      help='state-integrity audit cadence (parallel/'
+                      'audit.py, design §13): every N steps the live '
+                      'state is checked for diverged replicated hot '
+                      'buffers, quantized-row contract violations, '
+                      'non-finite params/optimizer slots and host-tier '
+                      'digest mismatches; failures journal with '
+                      '(device, leaf, row) provenance and trigger '
+                      '--on_anomaly.  0 (default) disables — the '
+                      'audited-off program is byte-identical')
+  parser.add_argument('--on_anomaly', default='terminate',
+                      choices=['terminate', 'rollback'],
+                      help="response to an audit failure or non-finite "
+                      "loss: 'terminate' exits nonzero with the reason "
+                      "journaled; 'rollback' restores the newest VALID "
+                      'checkpoint from --resume_dir IN-PROCESS '
+                      '(quarantining corrupt files as *.corrupt) and '
+                      'continues with the CURRENT input position — '
+                      'skip-window semantics, the right default for a '
+                      'sequential reader (design §13).  rollback '
+                      'requires --resume_dir')
+  parser.add_argument('--rollback_budget', type=int, default=2,
+                      help='max in-process rollbacks per run under '
+                      '--on_anomaly rollback; the next anomaly past the '
+                      'budget terminates (journaled '
+                      'rollback_budget_exhausted)')
   return parser.parse_args()
 
 
@@ -438,6 +464,79 @@ def main():
     print(f'step: {step_no}  eval AUC: {auc:.5f}', flush=True)
     return auc
 
+  # self-healing (design §13): periodic state-integrity audits over the
+  # live train state, with terminate-or-rollback response.  The example
+  # loop's rollback keeps the CURRENT input position (skip-window
+  # semantics: a sequential reader cannot rewind mid-epoch; the window
+  # between the restored step and the detection is skipped, journaled).
+  auditor = None
+  if args.audit_every > 0:
+    if args.trainer != 'sparse':
+      raise SystemExit('--audit_every requires --trainer sparse (the '
+                       'auditor checks the hybrid embedding state)')
+    from distributed_embeddings_tpu.parallel import StateAuditor
+    auditor = StateAuditor(dist, every=args.audit_every)
+    print(f'audit: state-integrity checks every {args.audit_every} '
+          f'step(s), on_anomaly={args.on_anomaly}')
+  if args.on_anomaly == 'rollback' and not args.resume_dir:
+    raise SystemExit('--on_anomaly rollback needs --resume_dir (the '
+                     'checkpoint directory to restore from)')
+  rollbacks = 0
+
+  def handle_anomaly(step_no, why):
+    """terminate (exit 3) or roll back in-process; returns after a
+    successful rollback.
+
+    Deliberately a SIBLING of fit()'s policy handler (grad.py), not a
+    call into it: this loop terminates with a process exit code and
+    cannot reposition its sequential reader, so only the skip leg
+    applies.  The JOURNAL SCHEMA is the shared contract — both
+    implementations emit the same registered event names/fields
+    (resilience.REGISTERED_EVENTS + the source-scan test pin them), so
+    consumers never see two shapes."""
+    nonlocal state, rollbacks
+    from distributed_embeddings_tpu.utils import resilience
+    # ONE policy label per incident: this loop's rollback keeps the
+    # current input position, i.e. rollback_skip semantics — every
+    # event of the incident journals that same label
+    policy = ('rollback_skip' if args.on_anomaly == 'rollback'
+              else args.on_anomaly)
+    resilience.journal('anomaly_detected', anomaly=why, step=step_no,
+                       policy=policy)
+    if args.on_anomaly == 'rollback' and rollbacks < args.rollback_budget:
+      try:
+        state, pth = restore_train_state(dist, state, args.resume_dir,
+                                         quarantine=True)
+      except (FileNotFoundError, ValueError) as e:
+        resilience.journal('rollback_failed', step=step_no, anomaly=why,
+                           error=str(e))
+        print(f'on_anomaly=rollback: {why} at step {step_no} and no '
+              f'valid checkpoint to roll back to ({e}); terminating')
+        sys.exit(3)
+      rollbacks += 1
+      resilience.journal('rollback', anomaly=why, detect_step=step_no,
+                         at_step=step_no, to_step=int(state.step),
+                         path=pth, attempt=rollbacks, policy=policy)
+      resilience.journal('skip_window', from_step=int(state.step),
+                         to_step=step_no,
+                         batches=step_no - int(state.step))
+      print(f'on_anomaly=rollback: {why} at step {step_no} -> restored '
+            f'{pth} at step {int(state.step)} (attempt {rollbacks}/'
+            f'{args.rollback_budget}); input continues at the current '
+            'batch (offending window skipped)')
+      return
+    if args.on_anomaly == 'rollback':
+      resilience.journal('rollback_budget_exhausted',
+                         budget=args.rollback_budget, step=step_no,
+                         anomaly=why)
+      print(f'on_anomaly=rollback: {why} at step {step_no} but the '
+            f'rollback budget ({args.rollback_budget}) is exhausted; '
+            'terminating')
+    else:
+      print(f'on_anomaly=terminate: {why} at step {step_no}; '
+            'terminating (journaled)')
+    sys.exit(3)
+
   start = time.perf_counter()
   steady_start = None  # set after warmup so samples/s excludes compiles
   samples = 0
@@ -524,6 +623,19 @@ def main():
       jax.block_until_ready(loss)
       if i == 0:
         feed.reset_stats()  # batch 0 has no prior step to hide behind
+    if auditor is not None and (i + 1) % args.audit_every == 0:
+      step_no = resume_step + i + 1
+      findings = auditor.check_state(state, step=step_no)
+      if findings:
+        handle_anomaly(step_no, 'audit_failure: '
+                       + '; '.join(f.brief() for f in findings[:3]))
+      elif not np.isfinite(float(loss)):  # sync already paid by audit
+        handle_anomaly(step_no, 'non_finite_loss')
+    elif i % 1000 == 0 and not np.isfinite(float(loss)):
+      # the non-finite-loss response is INDEPENDENT of the auditor:
+      # --on_anomaly promises it, and this print-cadence sync point
+      # already pays the float(loss) host pull
+      handle_anomaly(resume_step + i + 1, 'non_finite_loss')
     if i == 2:
       # steps 0-2 pay the compile + donation-relayout recompile; the
       # steady-state rate starts here (sync first so queued dispatches

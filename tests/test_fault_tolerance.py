@@ -599,3 +599,677 @@ def test_flip_bytes_is_deterministic(tmp_path):
     f.write(bytes(range(256)) * 8)
   b = faultinject.flip_bytes(p, count=4, seed=9)
   assert a == b
+
+
+# --------------------------------------------------------------------------
+# self-healing (ISSUE 8, design §13): state auditor + anomaly policy
+# --------------------------------------------------------------------------
+
+SH_WORLD = 4
+# one table per device: no column slicing, so the quantized save/restore
+# round trip is bit-stable (the column-sliced per-slice-scale re-round is
+# a documented §12 contract, not what this suite measures)
+SH_CONFIGS = [TableConfig(40, 8, 'sum'), TableConfig(30, 8, 'mean'),
+              TableConfig(24, 8, 'sum'), TableConfig(36, 8, 'mean')]
+
+
+@pytest.fixture(scope='module')
+def selfheal():
+  """Hot-cache + int8 trainer for the rollback acceptance proofs: ONE
+  dist/step compile shared by every arm (state is rebuilt per run;
+  nothing leaks across arms on a tier-less layer), plus the cached
+  20-step undisturbed reference leaves."""
+  import optax
+  from distributed_embeddings_tpu.parallel import SparseAdagrad
+  from distributed_embeddings_tpu.parallel.hotcache import HotSet
+  mesh = create_mesh(jax.devices()[:SH_WORLD])
+  hs = {0: HotSet(0, np.array([0, 1, 5])), 1: HotSet(1, np.array([2, 3]))}
+  dist = DistributedEmbedding(SH_CONFIGS, mesh=mesh, dp_input=True,
+                              hot_cache=hs, table_dtype='int8')
+  rng = np.random.default_rng(0)
+  weights = [(rng.normal(size=(c.input_dim, c.output_dim)) * 0.1
+              ).astype(np.float32) for c in SH_CONFIGS]
+  kernel = jnp.asarray(rng.normal(size=(32, 1)).astype(np.float32))
+
+  def head_loss_fn(dense, emb_outs, y):
+    x = jnp.concatenate(list(emb_outs), axis=1)
+    return jnp.mean((x @ dense['kernel'] - y) ** 2)
+
+  r = np.random.default_rng(7)
+  data = []
+  for _ in range(20):
+    cats = [jnp.asarray(r.integers(0, c.input_dim, (8, 2)), jnp.int32)
+            for c in SH_CONFIGS]
+    y = jnp.asarray(r.normal(size=(8, 1)).astype(np.float32))
+    data.append((cats, y))
+
+  emb_opt = SparseAdagrad(learning_rate=0.05)
+  step = make_hybrid_train_step(dist, head_loss_fn, optax.sgd(0.05),
+                                emb_opt, donate=False)
+
+  def fresh_state():
+    from distributed_embeddings_tpu.parallel import set_weights as _sw
+    params = {'embedding': _sw(dist, weights), 'kernel': kernel}
+    return init_hybrid_train_state(dist, params, optax.sgd(0.05), emb_opt)
+
+  def leaves(state):
+    out = list(_logical_leaves(dist, state))
+    return out
+
+  ref, _ = fit(step, fresh_state(), iter(data), steps=20, log_every=5,
+               verbose=False)
+  ref_leaves = leaves(ref)
+  return dist, step, fresh_state, data, leaves, ref_leaves
+
+
+def _assert_bit_exact(ref_leaves, got_leaves):
+  assert len(ref_leaves) == len(got_leaves)
+  for idx, (a, b) in enumerate(zip(ref_leaves, got_leaves)):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                  err_msg=f'leaf {idx}')
+
+
+def test_rollback_hot_bitflip_bit_exact(selfheal, tmp_path):
+  """Acceptance proof: a bit flip injected into ONE device's copy of a
+  replicated hot buffer is caught by the auditor's replicated-
+  consistency digest within K steps, rolled back in-process to the
+  last valid checkpoint, and the continued run is BIT-EXACT vs the
+  undisturbed reference."""
+  from distributed_embeddings_tpu.parallel import StateAuditor
+  dist, step, fresh_state, data, leaves, ref_leaves = selfheal
+  cb = CheckpointCallback(dist, str(tmp_path / 'ckpt_{step}.npz'), every=5)
+  corrupt = lambda st: faultinject.corrupt_state_leaf(
+      st, 'hot_group_0', shard_index=2, byte_offset=7, bit=5)
+  bad = faultinject.CorruptingStep(step, at_step=10, mutate=corrupt)
+  aud = StateAuditor(dist, every=2)
+  final, hist = fit(bad, fresh_state(), iter(data), steps=20, log_every=5,
+                    callbacks=[cb], verbose=False,
+                    on_anomaly='rollback', rollback_dir=str(tmp_path),
+                    dist=dist, data_factory=lambda s: iter(data[s:]),
+                    auditor=aud)
+  assert [a['kind'] for a in hist['anomalies']] == ['audit_failure']
+  assert hist['anomalies'][0]['step'] == 12  # within K=2 of the step-11 flip
+  assert bad.injected == 1
+  assert int(final.step) == 20
+  _assert_bit_exact(ref_leaves, leaves(final))
+  fails = resilience.recent('audit_failure')
+  assert fails and fails[0]['check'] == 'replicated'
+  assert fails[0]['leaf'] == 'hot_group_0'
+  assert fails[0]['devices'] and fails[0]['rows']  # provenance, not just a flag
+  rb = resilience.recent('rollback')
+  assert rb and rb[0]['to_step'] == 10 and rb[0]['path'].endswith(
+      'ckpt_10.npz')
+
+
+def test_rollback_quantized_scale_flip_bit_exact(selfheal, tmp_path):
+  """A flipped mantissa bit in a sharded per-row scale breaks the §12
+  power-of-two contract — the quantized well-formedness check names
+  the device and row, and recovery is bit-exact."""
+  from distributed_embeddings_tpu.parallel import StateAuditor
+  dist, step, fresh_state, data, leaves, ref_leaves = selfheal
+  cb = CheckpointCallback(dist, str(tmp_path / 'ckpt_{step}.npz'), every=5)
+  corrupt = lambda st: faultinject.corrupt_state_leaf(
+      st, 'scale_group_0', shard_index=1, byte_offset=6, bit=3)
+  # inject into the output of call 11 (= state at step 12): the audit
+  # at step 12 then sees the broken scale AT REST — one train step
+  # later the row could requant to a valid (but wrong-valued) scale,
+  # which is exactly why the cadence bounds the detection window
+  bad = faultinject.CorruptingStep(step, at_step=11, mutate=corrupt)
+  aud = StateAuditor(dist, every=2)
+  final, hist = fit(bad, fresh_state(), iter(data), steps=20, log_every=5,
+                    callbacks=[cb], verbose=False,
+                    on_anomaly='rollback', rollback_dir=str(tmp_path),
+                    dist=dist, data_factory=lambda s: iter(data[s:]),
+                    auditor=aud)
+  assert [a['kind'] for a in hist['anomalies']] == ['audit_failure']
+  assert int(final.step) == 20
+  _assert_bit_exact(ref_leaves, leaves(final))
+  fails = resilience.recent('audit_failure')
+  assert any(e['check'] == 'quantized' and 'scale_group_0' in e['leaf']
+             and e['rows'] for e in fails)
+
+
+def test_audit_healthy_state_no_findings(selfheal):
+  """One-sidedness: a healthy trained state produces ZERO findings
+  (false positives would make every rollback policy unusable)."""
+  from distributed_embeddings_tpu.parallel import StateAuditor
+  dist, step, fresh_state, data, _, _ = selfheal
+  state = fresh_state()
+  for k in range(3):
+    state, _ = step(state, *data[k])
+  aud = StateAuditor(dist, every=1)
+  assert aud.check_state(state, step=3) == []
+  assert aud.audits == 1 and aud.findings_total == 0
+  aud.assert_healthy(state, step=3)  # and the raising spelling agrees
+
+
+def test_audit_finds_nonfinite_optimizer_slot(selfheal):
+  """A NaN planted in a sharded optimizer accumulator is caught by the
+  finiteness check with (device, leaf, row) provenance."""
+  from distributed_embeddings_tpu.parallel import AuditError, StateAuditor
+  dist, step, fresh_state, data, _, _ = selfheal
+  state = fresh_state()
+  state, _ = step(state, *data[0])
+  acc = np.array(jax.device_get(state.opt_state[1]['group_0']['acc']))
+  acc[1, 3, 2] = np.nan
+  emb_opt = {g: dict(d) for g, d in state.opt_state[1].items()}
+  from jax.sharding import NamedSharding, PartitionSpec as P
+  emb_opt['group_0']['acc'] = jax.device_put(
+      acc, NamedSharding(dist.mesh, P(dist.axis_name, None, None)))
+  bad_state = state._replace(opt_state=(state.opt_state[0], emb_opt))
+  aud = StateAuditor(dist, every=1)
+  findings = aud.check_state(bad_state, step=1)
+  hit = [f for f in findings if f.leaf == 'group_0/acc']
+  assert hit and hit[0].check == 'finite'
+  assert hit[0].devices == (1,) and 3 in hit[0].rows
+  with pytest.raises(AuditError, match='group_0/acc'):
+    aud.assert_healthy(bad_state)
+
+
+def test_loss_spike_rollback_skip_window(hybrid, tmp_path):
+  """A loss spike past the EMA z-score gate under on_anomaly=
+  'rollback_skip' rolls back AND fast-forwards the input past the
+  offending window — the spiking batch is never retrained."""
+  dist, step, fresh_state, data = hybrid
+  cb = CheckpointCallback(dist, str(tmp_path / 'c_{step}.npz'), every=5)
+  spike = faultinject.LossSpikeStep(step, at_step=11, magnitude=1e7)
+  final, hist = fit(spike, fresh_state(), iter(data), steps=20,
+                    log_every=5, callbacks=[cb], verbose=False,
+                    on_anomaly='rollback_skip', rollback_dir=str(tmp_path),
+                    dist=dist, data_factory=lambda s: iter(data[s:]),
+                    spike_zscore=6.0)
+  assert [a['kind'] for a in hist['anomalies']] == ['loss_spike']
+  assert hist['anomalies'][0]['step'] == 12
+  sk = resilience.recent('skip_window')
+  assert sk and sk[-1]['from_step'] == 10 and sk[-1]['to_step'] == 15
+  # window (10, 15] skipped: the stream resumes at batch 15 with the
+  # step counter back at 10, so the 20-batch stream drains at step 15
+  assert int(final.step) == 15
+  assert resilience.recent('anomaly_detected')
+  assert resilience.recent('rollback')
+
+
+def test_rollback_budget_exhaustion_terminates(hybrid, tmp_path):
+  """A PERSISTENT anomaly (poison batch replayed by on_anomaly=
+  'rollback') burns the budget and then terminates cleanly — a fault
+  that survives N rollbacks needs a human, not an infinite loop."""
+  dist, step, fresh_state, data = hybrid
+  data = list(data)
+  cats12, y12 = data[12]
+  data[12] = (cats12, jnp.asarray(np.full_like(np.asarray(y12), np.inf)))
+  cb = CheckpointCallback(dist, str(tmp_path / 'c_{step}.npz'), every=5)
+  msgs = []
+  final, hist = fit(step, fresh_state(), iter(data), steps=20,
+                    log_every=5, callbacks=[cb], verbose=False,
+                    print_fn=msgs.append,
+                    on_anomaly='rollback', rollback_dir=str(tmp_path),
+                    dist=dist, data_factory=lambda s: iter(data[s:]),
+                    rollback_budget=2)
+  assert len(resilience.recent('rollback')) == 2
+  assert resilience.recent('rollback_budget_exhausted')
+  assert hist['rollback_budget_exhausted'] is True
+  assert [a['kind'] for a in hist['anomalies']] == ['non_finite_loss'] * 3
+  assert hist['terminated_on_anomaly'] == 13
+  assert any('budget' in m for m in msgs)
+
+
+def test_rollback_without_checkpoint_terminates(hybrid, tmp_path):
+  """An anomaly before the first checkpoint exists cannot roll back:
+  journaled rollback_failed + clean termination, never a crash."""
+  dist, step, fresh_state, data = hybrid
+  data = list(data)
+  cats2, y2 = data[2]
+  data[2] = (cats2, jnp.asarray(np.full_like(np.asarray(y2), np.nan)))
+  final, hist = fit(step, fresh_state(), iter(data), steps=20,
+                    log_every=5, verbose=False, print_fn=lambda m: None,
+                    on_anomaly='rollback', rollback_dir=str(tmp_path),
+                    dist=dist, data_factory=lambda s: iter(data[s:]))
+  assert resilience.recent('rollback_failed')
+  assert hist['terminated_on_anomaly'] == 3
+  assert not resilience.recent('rollback')
+
+
+def test_on_anomaly_terminate_is_promoted_nan_guard():
+  """on_anomaly='terminate' reproduces the legacy terminate_on_nan
+  behaviour exactly (same journal event name, same history key) — the
+  old kwarg is now an alias."""
+  step, state = _scalar_trainer()
+  data = [(jnp.asarray(1.0),)] * 20
+  data[6] = (jnp.asarray(-1.0),)
+  msgs = []
+  _, hist = fit(step, state, iter(data), steps=20, log_every=5,
+                on_anomaly='terminate', verbose=False,
+                print_fn=msgs.append)
+  assert hist['terminated_on_nan'] == 7
+  assert hist['step'] == [5]
+  events = resilience.recent('terminate_on_nan')
+  assert events and events[-1]['step'] == 7
+  assert resilience.recent('anomaly_detected')
+  assert any('terminate_on_nan' in m and 'step 7' in m for m in msgs)
+
+
+def test_fit_rollback_requires_dir_and_factory(hybrid):
+  dist, step, fresh_state, data = hybrid
+  with pytest.raises(ValueError, match='rollback_dir'):
+    fit(step, fresh_state(), iter(data), steps=1, on_anomaly='rollback',
+        dist=dist, verbose=False)
+  with pytest.raises(ValueError, match='data_factory'):
+    fit(step, fresh_state(), iter(data), steps=1, on_anomaly='rollback',
+        dist=dist, rollback_dir='/tmp/x', verbose=False)
+  with pytest.raises(ValueError, match='on_anomaly'):
+    fit(step, fresh_state(), iter(data), steps=1, on_anomaly='explode',
+        verbose=False)
+
+
+def test_loss_spike_gate_unit():
+  from distributed_embeddings_tpu.parallel import LossSpikeGate
+  gate = LossSpikeGate(zscore=6.0, warmup=5, decay=0.9)
+  for v in (1.0, 1.1, 0.9, 1.05, 0.95):
+    assert gate.observe(v) is None  # warmup absorbs everything
+  assert gate.observe(1.0) is None  # in-family value passes
+  z = gate.observe(100.0)
+  assert z is not None and z > 6.0
+  # the spike was NOT absorbed: the next healthy value still passes
+  assert gate.observe(1.02) is None
+  with pytest.raises(ValueError, match='zscore'):
+    LossSpikeGate(zscore=0)
+
+
+def test_quantized_invariant_masks_unit():
+  from distributed_embeddings_tpu.parallel import quantization
+  spec = quantization.resolve_table_dtype('int8')
+  scales = np.array([1.0, 0.5, 2.0 ** -9, 3.0, 0.0, -2.0, np.inf, np.nan],
+                    np.float32)
+  np.testing.assert_array_equal(
+      quantization.scale_bad_mask_np(scales),
+      [False, False, False, True, True, True, True, True])
+  pay = np.array([-128, -127, 0, 127], np.int8)
+  np.testing.assert_array_equal(
+      quantization.payload_bad_mask_np(pay, spec),
+      [True, False, False, False])
+
+
+# --------------------------------------------------------------------------
+# checkpoint quarantine + retention anchoring (design §13 satellites)
+# --------------------------------------------------------------------------
+
+
+def test_quarantine_renames_and_excludes(hybrid, tmp_path):
+  """Corrupt candidates under quarantine=True rename to *.corrupt
+  (never deleted), journal the move, and stay excluded from later
+  candidate scans and retention counting."""
+  dist = hybrid[0]
+  rng = np.random.default_rng(11)
+  weights = [rng.normal(size=(c.input_dim, c.output_dim)).astype(np.float32)
+             for c in CONFIGS]
+  p10, p20, p30 = _save_three(dist, tmp_path, weights)
+  faultinject.flip_bytes(p30, count=8, seed=0)
+  faultinject.truncate_file(p20, nbytes=512)
+  path, (_, _, extras) = ckpt_lib.load_latest_valid(
+      str(tmp_path), expect_plan=dist, quarantine=True)
+  assert path == p10 and int(extras['step']) == 10
+  names = sorted(os.listdir(tmp_path))
+  assert 'ckpt_30.npz.corrupt' in names and 'ckpt_20.npz.corrupt' in names
+  assert 'ckpt_30.npz' not in names  # renamed, not copied
+  q = resilience.recent('checkpoint_quarantined')
+  assert {os.path.basename(e['path']) for e in q} == {'ckpt_20.npz',
+                                                      'ckpt_30.npz'}
+  # quarantined files are invisible to candidate ordering AND retention
+  path2, _ = ckpt_lib.load_latest_valid(str(tmp_path), expect_plan=dist)
+  assert path2 == p10
+  removed = ckpt_lib.prune_checkpoints(str(tmp_path), keep_last=1)
+  assert removed == []  # only one live candidate left; .corrupt not counted
+  assert 'ckpt_30.npz.corrupt' in os.listdir(tmp_path)  # forensics kept
+
+
+def test_plan_mismatch_not_quarantined(hybrid, tmp_path):
+  """A plan-mismatched file is a VALID checkpoint of another model:
+  rejected for resume but never renamed."""
+  dist = hybrid[0]
+  rng = np.random.default_rng(12)
+  weights = [rng.normal(size=(c.input_dim, c.output_dim)).astype(np.float32)
+             for c in CONFIGS]
+  p = str(tmp_path / 'other_5.npz')
+  save_train_npz(p, weights, extras={'step': np.int64(5)}, plan=dist)
+  other = [TableConfig(41, 8, 'sum'), TableConfig(30, 8, 'mean')]
+  with pytest.raises(FileNotFoundError):
+    ckpt_lib.load_latest_valid(str(tmp_path), expect_plan=other,
+                               quarantine=True)
+  assert os.path.exists(p)  # untouched
+  assert not resilience.recent('checkpoint_quarantined')
+
+
+def test_prune_anchors_to_newest_verified(hybrid, tmp_path):
+  """Retention must never delete the last-known-good file: with every
+  file inside the keep window corrupt, the newest VERIFIED checkpoint
+  beyond it survives pruning."""
+  dist = hybrid[0]
+  rng = np.random.default_rng(13)
+  weights = [rng.normal(size=(c.input_dim, c.output_dim)).astype(np.float32)
+             for c in CONFIGS]
+  paths = []
+  for step_no in (10, 20, 30, 40):
+    p = str(tmp_path / f'ckpt_{step_no}.npz')
+    save_train_npz(p, weights, extras={'step': np.int64(step_no)},
+                   plan=dist)
+    os.utime(p, (step_no, step_no))
+    paths.append(p)
+  faultinject.flip_bytes(paths[2], count=8, seed=1)  # ckpt_30
+  faultinject.flip_bytes(paths[3], count=8, seed=2)  # ckpt_40
+  removed = ckpt_lib.prune_checkpoints(str(tmp_path), keep_last=2)
+  # keep window = {40, 30} (both corrupt); anchor = ckpt_20 (newest that
+  # verifies) survives; only ckpt_10 is prunable
+  assert [os.path.basename(r) for r in removed] == ['ckpt_10.npz']
+  assert os.path.exists(paths[1])
+
+
+def test_prune_spares_in_flight_rollback_target(hybrid, tmp_path):
+  dist = hybrid[0]
+  rng = np.random.default_rng(14)
+  weights = [rng.normal(size=(c.input_dim, c.output_dim)).astype(np.float32)
+             for c in CONFIGS]
+  p10, p20, p30 = _save_three(dist, tmp_path, weights)
+  with ckpt_lib._protect_path(p10):
+    removed = ckpt_lib.prune_checkpoints(str(tmp_path), keep_last=1)
+    assert [os.path.basename(r) for r in removed] == ['ckpt_20.npz']
+    assert os.path.exists(p10)  # in-flight rollback target spared
+  removed = ckpt_lib.prune_checkpoints(str(tmp_path), keep_last=1)
+  assert [os.path.basename(r) for r in removed] == ['ckpt_10.npz']
+
+
+def test_csr_feed_skip_to_fast_forward(feed_dist):
+  """The seq-fenced consumer fast-forward behind on_anomaly=
+  'rollback_skip' for feed-driven loops: already-built, in-flight and
+  respawn-rebuilt batches below the fence are all discarded."""
+  feed = CsrFeed(feed_dist, _feed_batches(6), cats_fn=lambda it: it[1])
+  assert next(feed).item[0] == 0
+  assert next(feed).item[0] == 1
+  fenced = feed.skip_to(4)
+  assert fenced == 2  # seqs 2 and 3 fenced off
+  got = [fed.item[0] for fed in feed]
+  assert got == [4, 5]
+  assert feed.stats()['fast_forwarded'] == 2
+  ev = resilience.recent('csr_feed_fast_forward')
+  assert ev and ev[-1]['to_seq'] == 4
+
+
+def test_journal_event_names_registered():
+  """Schema hardening: every journal() call site in the runtime uses a
+  name registered in resilience.REGISTERED_EVENTS — a misspelled or
+  unregistered kind is invisible to every journal consumer."""
+  import pathlib
+  import re
+  root = pathlib.Path(__file__).resolve().parents[1]
+  pat = re.compile(r"""journal\(\s*(['"])([A-Za-z0-9_]+)\1""")
+  sources = [p for p in (root / 'distributed_embeddings_tpu').rglob('*.py')]
+  sources += [root / 'bench.py', root / '__graft_entry__.py']
+  sources += list((root / 'tools').glob('*.py'))
+  sources += list((root / 'examples').rglob('*.py'))
+  found = {}
+  for f in sources:
+    for m in pat.finditer(f.read_text()):
+      found.setdefault(m.group(2), []).append(f.name)
+  assert found, 'source scan found no journal() call sites — scan broken?'
+  unregistered = {k: v for k, v in found.items()
+                  if k not in resilience.REGISTERED_EVENTS}
+  assert not unregistered, (
+      f'journal() call sites with unregistered event names: '
+      f'{unregistered} — add them to resilience.REGISTERED_EVENTS')
+
+
+# --------------------------------------------------------------------------
+# host-tier integrity (design §13): write-back digests + recovery drill
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(scope='module')
+def tiered():
+  """int8 + hot-cache + cold-tier trainer (the full PR 7 stack) for the
+  host-DRAM corruption drills.  Fresh dist per call: the tier's host
+  arrays are per-dist state, so arms must not share them."""
+  import optax
+  from distributed_embeddings_tpu.parallel import SparseAdagrad
+  from distributed_embeddings_tpu.parallel.hotcache import HotSet
+  mesh = create_mesh(jax.devices()[:SH_WORLD])
+  configs = [TableConfig(64 * SH_WORLD, 8, 'sum')] + [
+      TableConfig(40 + 4 * i, 8, 'sum') for i in range(SH_WORLD)]
+  hs = {0: HotSet(0, np.array([0, 1, 3])), 1: HotSet(1, np.array([2, 5]))}
+  rng = np.random.default_rng(0)
+  weights = [(rng.normal(size=(c.input_dim, c.output_dim)) * 0.1
+              ).astype(np.float32) for c in configs]
+  kernel = jnp.asarray(
+      rng.normal(size=(8 * len(configs), 1)).astype(np.float32) * 0.1)
+  probe = DistributedEmbedding(configs, mesh=mesh, dp_input=True,
+                               hot_cache=hs, table_dtype='int8')
+  budget = int(probe.plan.resident_table_bytes() * 0.6)
+
+  def head_loss_fn(dense, emb_outs, y):
+    x = jnp.concatenate(list(emb_outs), axis=1)
+    return jnp.mean((x @ dense['kernel'] - y) ** 2)
+
+  r = np.random.default_rng(7)
+  data = []
+  for _ in range(16):
+    cats = [jnp.asarray(r.integers(0, c.input_dim, (8,)), jnp.int32)
+            for c in configs]
+    y = jnp.asarray(r.normal(size=(8, 1)).astype(np.float32))
+    data.append((cats, y))
+
+  def build():
+    import optax
+    from distributed_embeddings_tpu.parallel import (SparseAdagrad,
+                                                     set_weights)
+    dist = DistributedEmbedding(configs, mesh=mesh, dp_input=True,
+                                hot_cache=hs, table_dtype='int8',
+                                cold_tier=True, device_hbm_budget=budget)
+    assert dist.plan.cold_tier_groups
+    opt = SparseAdagrad(learning_rate=0.05)
+    state = init_hybrid_train_state(
+        dist, {'embedding': set_weights(dist, weights), 'kernel': kernel},
+        optax.sgd(0.05), opt)
+    step = make_hybrid_train_step(dist, head_loss_fn, optax.sgd(0.05),
+                                  opt, donate=False)
+    return dist, state, step
+
+  return build, data, weights
+
+
+def test_tier_fetch_time_verification(tiered):
+  """build_fetch re-hashes every row it is about to gather: a tier row
+  corrupted in host DRAM raises TierIntegrityError (journaled, with
+  provenance) BEFORE the damaged bytes can reach the device."""
+  from distributed_embeddings_tpu.parallel import TierIntegrityError
+  build, data, _ = tiered
+  dist, state, step = build()
+  dist.cold_tier.enable_digests()
+  state, _ = step(state, *data[0])  # calibrates the fetch caps
+  fetch = dist.build_cold_fetch(data[1][0])
+  gi = dist.plan.cold_tier_groups[0]
+  res = dist.plan.groups[gi].device_rows
+  dev = next(d for d in range(SH_WORLD) if fetch.counts[gi][d])
+  row = int(fetch.rows_np[gi][dev][0]) - res  # a row this batch fetches
+  faultinject.corrupt_tier_row(dist.cold_tier, gi, dev, row,
+                               byte_offset=2, bit=6)
+  with pytest.raises(TierIntegrityError, match='checksum mismatch'):
+    dist.build_cold_fetch(data[1][0])
+  ev = resilience.recent('tier_integrity_failure')
+  assert ev and ev[-1]['group'] == gi and ev[-1]['device'] == dev
+  assert row in ev[-1]['rows']
+  # write-back of fresh rows re-certifies: after restoring the byte the
+  # digests agree again
+  faultinject.corrupt_tier_row(dist.cold_tier, gi, dev, row,
+                               byte_offset=2, bit=6)  # flip back
+  assert dist.cold_tier.verify_all() == []
+
+
+def test_tier_corruption_rollback_bit_exact(tiered, tmp_path):
+  """Acceptance proof (host-tier leg): a bit flipped in a host-DRAM
+  tier row is caught by the auditor's digest sweep within K steps and
+  recovered via in-process rollback, bit-exact vs the undisturbed
+  run (set_weights/set_optimizer_state re-install AND re-certify the
+  tier tails on restore)."""
+  from distributed_embeddings_tpu.parallel import StateAuditor
+  build, data, _ = tiered
+  dist_a, state_a, step_a = build()
+  ref, _ = fit(step_a, state_a, iter(data), steps=16, log_every=4,
+               verbose=False)
+  dist_b, state_b, step_b = build()
+  cb = CheckpointCallback(dist_b, str(tmp_path / 'ckpt_{step}.npz'),
+                          every=4)
+  aud = StateAuditor(dist_b, every=3)
+  assert dist_b.cold_tier.digests_enabled  # the tier check armed them
+  gi = dist_b.plan.cold_tier_groups[0]
+
+  def corrupt(st):
+    faultinject.corrupt_tier_row(dist_b.cold_tier, gi, device=1, row=2,
+                                 byte_offset=1, bit=3)
+    return st
+
+  bad = faultinject.CorruptingStep(step_b, at_step=8, mutate=corrupt)
+  final, hist = fit(bad, state_b, iter(data), steps=16, log_every=4,
+                    callbacks=[cb], verbose=False,
+                    on_anomaly='rollback', rollback_dir=str(tmp_path),
+                    dist=dist_b, data_factory=lambda s: iter(data[s:]),
+                    auditor=aud)
+  assert [a['kind'] for a in hist['anomalies']] == ['audit_failure']
+  assert int(final.step) == 16
+  fails = resilience.recent('audit_failure')
+  assert any(f['check'] == 'tier' and f['leaf'] == f'tier_group_{gi}'
+             and f['devices'] == [1] and 2 in f['rows'] for f in fails)
+  for idx, (a, b) in enumerate(zip(_logical_leaves(dist_a, ref),
+                                   _logical_leaves(dist_b, final))):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                  err_msg=f'leaf {idx}')
+
+
+def test_verify_checkpoint_cli(hybrid, tmp_path, capsys):
+  """tools/verify_checkpoint.py: per-file verdicts (manifest +
+  quantized-row invariants), quarantined files informational, nonzero
+  exit on any failure."""
+  import importlib.util
+  import pathlib
+  from distributed_embeddings_tpu.parallel import QuantizedWeight
+  from distributed_embeddings_tpu.parallel import quantization
+  spec_path = (pathlib.Path(__file__).resolve().parents[1] / 'tools'
+               / 'verify_checkpoint.py')
+  mod_spec = importlib.util.spec_from_file_location('verify_checkpoint',
+                                                    spec_path)
+  vc = importlib.util.module_from_spec(mod_spec)
+  mod_spec.loader.exec_module(vc)
+
+  dist = hybrid[0]
+  rng = np.random.default_rng(21)
+  weights = [rng.normal(size=(c.input_dim, c.output_dim)).astype(np.float32)
+             for c in CONFIGS]
+  good = str(tmp_path / 'good_10.npz')
+  save_train_npz(good, weights, extras={'step': np.int64(10)}, plan=dist)
+  # a quantized file with an in-contract payload+scale pair ...
+  qspec = quantization.resolve_table_dtype('int8')
+  qw = [QuantizedWeight.from_values(w, qspec) for w in weights]
+  qgood = str(tmp_path / 'quant_20.npz')
+  save_train_npz(qgood, qw, extras={'step': np.int64(20)}, plan=dist)
+  # ... and one whose scale violates the power-of-two contract (written
+  # through plain savez so the manifest still matches the bad bytes —
+  # the QUANTIZED invariant must catch it, not the checksum)
+  qbad = str(tmp_path / 'quantbad_30.npz')
+  bad_scale = qw[0].scale.copy()
+  bad_scale[1] = 0.3
+  np.savez(qbad, **{'table0': np.asarray(qw[0].payload),
+                    'table0:scale': bad_scale,
+                    'table0:dtype': np.array('int8')})
+  flipped = str(tmp_path / 'flipped_40.npz')
+  save_train_npz(flipped, weights, extras={'step': np.int64(40)}, plan=dist)
+  faultinject.flip_bytes(flipped, count=8, seed=3)
+  quarantined = str(tmp_path / 'old_5.npz')
+  save_train_npz(quarantined, weights, extras={'step': np.int64(5)},
+                 plan=dist)
+  ckpt_lib.quarantine_checkpoint(quarantined)
+
+  rc = vc.main([str(tmp_path)])
+  out = capsys.readouterr().out
+  assert rc == 1  # failures present
+  lines = {l.split()[0]: l for l in out.strip().splitlines() if l.strip()}
+  assert 'OK' in lines['good_10.npz']
+  assert 'OK' in lines['quant_20.npz'] and 'quantized table' in \
+      lines['quant_20.npz']
+  assert 'FAIL' in lines['quantbad_30.npz'] and 'power-of-two' in \
+      lines['quantbad_30.npz']
+  assert 'FAIL' in lines['flipped_40.npz']
+  assert 'QUARANTINED' in lines['old_5.npz.corrupt']
+  assert '2 failing' in out
+  # a healthy-only walk exits 0
+  clean = tmp_path / 'clean'
+  clean.mkdir()
+  save_train_npz(str(clean / 'c_1.npz'), weights,
+                 extras={'step': np.int64(1)}, plan=dist)
+  assert vc.main([str(clean)]) == 0
+
+
+def test_audit_rotating_coverage_detects_within_bound(selfheal):
+  """Budget-capped audits read rotating row windows: a flip anywhere in
+  the state is still detected within ``full_coverage_audits`` audits —
+  the documented detection bound for states above ``bytes_per_audit``."""
+  from distributed_embeddings_tpu.parallel import StateAuditor
+  dist, step, fresh_state, data, _, _ = selfheal
+  state = fresh_state()
+  state, _ = step(state, *data[0])
+  aud = StateAuditor(dist, every=1, bytes_per_audit=4096)  # force windows
+  assert aud.check_state(state, step=0) == []  # healthy under rotation
+  assert aud.coverage_frac < 1.0 and aud.full_coverage_audits > 1
+  bad = faultinject.corrupt_state_leaf(state, 'hot_group_0',
+                                       shard_index=1, byte_offset=3, bit=2)
+  detected_at = None
+  for k in range(aud.full_coverage_audits):
+    if aud.check_state(bad, step=k + 1):
+      detected_at = k
+      break
+  assert detected_at is not None, (
+      f'flip not detected within {aud.full_coverage_audits} rotating '
+      'audits')
+  # and an UNbudgeted auditor sees it on the first audit
+  full = StateAuditor(dist, every=1, bytes_per_audit=None)
+  assert full.coverage_frac == 1.0 and full.full_coverage_audits == 1
+  assert full.check_state(bad, step=0)
+
+
+def test_corrupt_substring_mid_name_stays_visible(hybrid, tmp_path):
+  """Only the exact quarantine naming (*.corrupt / *.corrupt.N) is
+  excluded from scans — a user checkpoint merely CONTAINING '.corrupt'
+  mid-name must stay visible to resume and retention (the same rule
+  _is_atomic_tmp applies to '.tmp')."""
+  dist = hybrid[0]
+  rng = np.random.default_rng(31)
+  weights = [rng.normal(size=(c.input_dim, c.output_dim)).astype(np.float32)
+             for c in CONFIGS]
+  odd = str(tmp_path / 'sdc.corrupt_drill_10.npz')
+  save_train_npz(odd, weights, extras={'step': np.int64(10)}, plan=dist)
+  path, _ = load_latest_valid(str(tmp_path), expect_plan=dist)
+  assert path == odd  # visible despite the substring
+  assert ckpt_lib._is_quarantined('x.npz.corrupt')
+  assert ckpt_lib._is_quarantined('x.npz.corrupt.3')
+  assert not ckpt_lib._is_quarantined('sdc.corrupt_drill_10.npz')
+
+
+def test_loss_spike_gate_flat_series_no_false_positive():
+  """A loss that plateaus to float-identical values must not turn every
+  later healthy wiggle into a several-sigma spike: the std floor
+  scales with the loss magnitude (rel_floor)."""
+  from distributed_embeddings_tpu.parallel import LossSpikeGate
+  gate = LossSpikeGate(zscore=8.0, warmup=5)
+  for _ in range(10):
+    assert gate.observe(0.25) is None  # perfectly flat series
+  assert gate.observe(0.2500005) is None  # healthy wiggle: no spike
+  assert gate.observe(250.0) is not None  # a real spike still fires
+
+
+def test_audit_dense_scalar_nan_no_crash(selfheal):
+  """A 0-d dense leaf (scalar temperature / injected hyperparameter)
+  going NaN must report a finding, never crash the never-raises
+  run() contract (a crash here would escape fit's anomaly policy)."""
+  from distributed_embeddings_tpu.parallel import StateAuditor
+  dist = selfheal[0]
+  aud = StateAuditor(dist, every=1)
+  findings = aud.run(dense={'temp': jnp.asarray(np.nan, jnp.float32),
+                            'ok': jnp.asarray(1.0, jnp.float32)})
+  assert len(findings) == 1 and findings[0].check == 'finite'
+  assert 'temp' in findings[0].leaf

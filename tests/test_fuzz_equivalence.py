@@ -642,3 +642,94 @@ def test_fuzz_hot_cache_parity(seed):
           np.asarray(results['on'][1][t][k], np.float32),
           rtol=5e-3, atol=5e-4,
           err_msg=f'seed {seed} table {t} state {k}')
+
+
+# Seed 1 draws a second (plan, dtype, tier, chunk) point; one seed is
+# the tier-1 flagship, the deeper draw rides the slow lane (budget
+# discipline, PR 7 precedent).
+@pytest.mark.parametrize('seed', [
+    0,
+    pytest.param(1, marks=pytest.mark.slow),
+])
+def test_fuzz_audit_no_false_positive(seed):
+  """The design-§13 auditor is ONE-SIDED: healthy runs across fuzzed
+  (plan, hot-set, table_dtype, tier-split, overlap_chunks) draws
+  produce ZERO findings — at init, mid-training, and after training —
+  including the armed cold-tier fetch digests.  A false positive here
+  would make every on_anomaly rollback policy unusable (it would
+  quarantine healthy checkpoints and burn the rollback budget on
+  phantom corruption)."""
+  import optax
+  from distributed_embeddings_tpu.parallel import (SparseAdagrad, SparseSGD,
+                                                   StateAuditor,
+                                                   init_hybrid_train_state,
+                                                   make_hybrid_train_step,
+                                                   quantization)
+  from distributed_embeddings_tpu.parallel.hotcache import HotSet
+  rng = np.random.default_rng(6000 + seed)
+  world = int(rng.choice([2, 4]))
+  mesh = create_mesh(jax.devices()[:world])  # tier refuses two-axis meshes
+  n_tables = world + 1 + int(rng.integers(0, 3))
+  configs = []
+  for _ in range(n_tables):
+    rows = int(rng.integers(24, 160))
+    width = int(rng.choice([4, 8]))
+    configs.append(TableConfig(rows, width, rng.choice(['sum', 'mean'])))
+  dtypes = [None] + list(quantization._SPECS)
+  dtype = dtypes[seed % len(dtypes)] if rng.random() < 0.8 else None
+  hot_sets = {}
+  for tid, c in enumerate(configs):
+    if rng.random() < 0.7:
+      k = int(rng.integers(1, max(2, c.input_dim // 3)))
+      hids = np.sort(rng.choice(c.input_dim, size=k, replace=False))
+      hot_sets[tid] = HotSet(tid, hids.astype(np.int64))
+  if not hot_sets:
+    hot_sets[0] = HotSet(0, np.array([0]))
+  chunks = int(rng.choice([1, 2]))
+  kw = dict(dp_input=True, hot_cache=hot_sets, table_dtype=dtype,
+            overlap_chunks=chunks)
+  if rng.random() < 0.6:
+    probe = DistributedEmbedding(configs, mesh=mesh, **kw)
+    kw.update(cold_tier=True,
+              device_hbm_budget=int(probe.plan.resident_table_bytes()
+                                    * float(rng.uniform(0.5, 0.8))))
+  try:
+    dist = DistributedEmbedding(configs, mesh=mesh, **kw)
+  except ValueError as e:
+    if 'Not enough table' in str(e) or 'raise the budget' in str(e):
+      pytest.skip(str(e))
+    raise
+  weights = [
+      (rng.normal(size=(c.input_dim, c.output_dim)) * 0.1).astype(
+          np.float32) for c in configs
+  ]
+  opt = (SparseSGD(learning_rate=0.02) if rng.random() < 0.5
+         else SparseAdagrad(learning_rate=0.02))
+  total_w = sum(c.output_dim for c in configs)
+  kernel = jnp.asarray(
+      rng.standard_normal((total_w, 1)).astype(np.float32) * 0.1)
+  batch = world * 2
+  ids = [jnp.asarray(rng.integers(0, c.input_dim, size=(batch,))
+                     .astype(np.int32)) for c in configs]
+  labels = jnp.asarray(rng.integers(0, 2, (batch, 1)).astype(np.float32))
+
+  def head_loss_fn(dense_params, emb_outs, b):
+    h = jnp.concatenate(list(emb_outs), axis=-1)
+    return jnp.mean((h @ dense_params['kernel'] - b)**2)
+
+  state = init_hybrid_train_state(dist, {
+      'embedding': set_weights(dist, weights), 'kernel': kernel
+  }, optax.sgd(0.02), opt)
+  step = make_hybrid_train_step(dist, head_loss_fn, optax.sgd(0.02), opt,
+                                donate=False)
+  auditor = StateAuditor(dist, every=1)
+  ctx = (f'seed {seed} world {world} dtype {dtype} chunks {chunks} '
+         f'tier {bool(getattr(dist.plan, "cold_tier_groups", []))}')
+  assert auditor.check_state(state, step=0) == [], ctx  # healthy at init
+  for k in range(3):
+    state, loss = step(state, ids, labels)
+    findings = auditor.check_state(state, step=k + 1)
+    assert findings == [], f'{ctx}: step {k + 1} false positive: ' + \
+        '; '.join(f.brief() for f in findings)
+  assert np.isfinite(float(loss)), ctx
+  assert auditor.findings_total == 0

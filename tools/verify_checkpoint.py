@@ -1,0 +1,154 @@
+#!/usr/bin/env python3
+"""Offline checkpoint verifier (design §13): walk a checkpoint
+directory (or explicit files), run the embedded-manifest verification
+plus the quantized-row invariants on saved payload/scale sidecars, and
+print a per-file verdict table.  Exit code is nonzero when ANY file
+fails — wire it into CI or run it before a serving export, so corrupt
+or contract-violating table bytes are caught at rest, before a resume
+or an inference fleet trains/serves from them.
+
+Checks per file:
+- manifest: ``checkpoint.verify_npz`` — decompression, per-array
+  sha256, no missing/stray members (legacy manifest-less files pass a
+  structural check, verdict ``LEGACY``).
+- quantized rows (files carrying ``table{i}:scale`` sidecars): every
+  scale is a finite, positive, EXACT power of two and every payload
+  value is on the int8/fp8 grid (``quantization.scale_bad_mask_np`` /
+  ``payload_bad_mask_np`` — the same invariant masks the online
+  auditor uses), and payload/scale row counts agree.
+
+Quarantined ``*.corrupt`` files are listed informationally (verdict
+``QUARANTINED``) and do not fail the run — they are already out of
+every resume path.
+
+Usage::
+
+    python tools/verify_checkpoint.py CKPT_DIR [more dirs/files ...]
+    python tools/verify_checkpoint.py --pattern 'ckpt_*.npz' CKPT_DIR
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as glob_lib
+import os
+import sys
+
+# invocable as `python tools/verify_checkpoint.py ...` from anywhere:
+# the repo root (one level up) carries the package
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+  sys.path.insert(0, _REPO)
+
+import numpy as np
+
+
+def _quantized_row_verdict(path):
+  """(ok, reason) for the §12 row contract over every quantized table
+  in the file; ok=True with reason 'f32' when the file carries no
+  quantized sidecars."""
+  from distributed_embeddings_tpu.parallel import quantization
+  problems = []
+  quantized = 0
+  with np.load(path, allow_pickle=False) as data:
+    scales = [k for k in data.files if k.endswith(':scale')]
+    for sk in scales:
+      name = sk[:-len(':scale')]
+      if name not in data.files:
+        problems.append(f'{sk} without {name} payload')
+        continue
+      quantized += 1
+      dk = f'{name}:dtype'
+      dtype_name = (str(data[dk][()]) if dk in data.files else 'int8')
+      try:
+        spec = quantization.resolve_table_dtype(dtype_name)
+      except ValueError as e:
+        problems.append(f'{name}: {e}')
+        continue
+      payload = data[name]
+      if payload.dtype != spec.dtype:
+        payload = payload.view(spec.dtype)  # fp8 stored as uint8 bit-view
+      scale = data[sk]
+      if payload.shape[0] != scale.reshape(-1).shape[0]:
+        problems.append(f'{name}: payload rows {payload.shape[0]} != '
+                        f'scale rows {scale.reshape(-1).shape[0]}')
+        continue
+      bad_s = quantization.scale_bad_mask_np(scale)
+      if bad_s.any():
+        rows = np.nonzero(bad_s.reshape(-1))[0][:4].tolist()
+        problems.append(f'{name}: {int(bad_s.sum())} non-power-of-two/'
+                        f'invalid scale(s), rows {rows}')
+      bad_p = quantization.payload_bad_mask_np(payload, spec)
+      if bad_p.any():
+        rows = np.nonzero(bad_p.any(axis=-1))[0][:4].tolist()
+        problems.append(f'{name}: {int(bad_p.sum())} off-grid payload '
+                        f'value(s), rows {rows}')
+  if problems:
+    return False, '; '.join(problems)
+  return True, (f'{quantized} quantized table(s) on-contract'
+                if quantized else 'f32')
+
+
+def verify_one(path):
+  """(verdict, detail) for one file: OK / LEGACY / QUARANTINED / FAIL."""
+  from distributed_embeddings_tpu.parallel import checkpoint
+  if checkpoint._is_quarantined(os.path.basename(path)):
+    return 'QUARANTINED', 'already out of the resume path'
+  ok, reason, man = checkpoint.verify_npz(path)
+  if not ok:
+    return 'FAIL', reason
+  step = man.get('step') if man else None
+  try:
+    qok, qreason = _quantized_row_verdict(path)
+  except Exception as e:  # a structurally-odd npz must still report
+    return 'FAIL', f'quantized-invariant scan failed: {e!r}'
+  if not qok:
+    return 'FAIL', qreason
+  verdict = 'OK' if man is not None else 'LEGACY'
+  detail = qreason if step is None else f'step {step}; {qreason}'
+  return verdict, detail
+
+
+def collect(paths, pattern):
+  files = []
+  for p in paths:
+    if os.path.isdir(p):
+      files.extend(sorted(glob_lib.glob(os.path.join(p, pattern))))
+      files.extend(sorted(glob_lib.glob(
+          os.path.join(p, pattern + '.corrupt*'))))
+    else:
+      files.append(p)
+  return files
+
+
+def main(argv=None) -> int:
+  parser = argparse.ArgumentParser(
+      description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+  parser.add_argument('paths', nargs='+',
+                      help='checkpoint directories and/or .npz files')
+  parser.add_argument('--pattern', default='*.npz',
+                      help='glob for directory walks (default: *.npz)')
+  parser.add_argument('--quiet', action='store_true',
+                      help='print only failing files')
+  args = parser.parse_args(argv)
+  files = collect(args.paths, args.pattern)
+  if not files:
+    print(f'no checkpoint files matched {args.pattern!r} under '
+          f'{args.paths}', file=sys.stderr)
+    return 2
+  width = max(len(os.path.basename(f)) for f in files)
+  failures = 0
+  for f in files:
+    verdict, detail = verify_one(f)
+    if verdict == 'FAIL':
+      failures += 1
+    if args.quiet and verdict != 'FAIL':
+      continue
+    print(f'{os.path.basename(f):<{width}}  {verdict:<11}  {detail}')
+  total = len(files)
+  print(f'-- {total} file(s): {total - failures} ok, {failures} failing')
+  return 1 if failures else 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())
