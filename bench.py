@@ -314,6 +314,36 @@ def main():
                       'the headline (audit-off) arm, which stays '
                       'program-identical to pre-§13.  Default: 10 for '
                       'the sparse trainer, off otherwise; 0 disables')
+  parser.add_argument('--serve', action=argparse.BooleanOptionalAction,
+                      default=None,
+                      help='online-serving phase (serving/, design '
+                      '§14): freeze the trained tables into a '
+                      'lookup-only ServingEngine (int8 payload+scale '
+                      'unless the plan is already quantized) and '
+                      'measure the dynamic-batching off/on A/B over a '
+                      'concurrent request stream cut from the bench '
+                      'traffic — journals serve_p50_ms / serve_p99_ms '
+                      '/ serve_qps / serve_batch_fill plus the '
+                      'no-batch arm, all directly measured.  Default: '
+                      'on for the sparse trainer')
+  parser.add_argument('--serve_batch', type=int, default=256,
+                      help='the ONE compiled serving batch (rounded '
+                      'down to a device-count multiple)')
+  parser.add_argument('--serve_requests', type=int, default=192,
+                      help='request count per serving arm')
+  parser.add_argument('--serve_max_delay_ms', type=float, default=2.0,
+                      help='batcher admission deadline (oldest queued '
+                      'request waits at most this long for co-riders)')
+  parser.add_argument('--serve_concurrency', type=int, default=8,
+                      help='closed-loop in-flight requests in the '
+                      'batching arm')
+  parser.add_argument('--serve_hot_coverage', type=float, default=0.95,
+                      help='serving hot-cache coverage target (read-'
+                      'only cache, no optimizer copies to fund — '
+                      'larger than training coverage by design)')
+  parser.add_argument('--serve_hot_budget_mb', type=float, default=256.0,
+                      help='per-device replication budget for the '
+                      'serving hot rows')
   parser.add_argument('--measure_windows', type=int, default=3,
                       help='min-of-k measurement: split --steps into k '
                       'windows and report the fastest window, immunising '
@@ -1072,6 +1102,75 @@ def main():
     except Exception as e:
       tier_stats = {'cold_tier_error': f'{type(e).__name__}: {e}'}
 
+  # Online-serving phase (serving/, design §14; ISSUE 9).  The trained
+  # tables freeze into a lookup-only ServingEngine — quantized to int8
+  # payload+scale unless the plan already carries a table_dtype, the
+  # production serving shape and 4x less host/device memory for the
+  # second table copy this phase holds — with a serving-sized READ-ONLY
+  # hot cache (state_copies=0: no optimizer slots to fund).  Both arms
+  # are measured directly over the same request stream cut from the
+  # bench traffic: per-request submit->demux latencies from the batcher
+  # itself (p50/p99), sequential full-batch dispatches for the no-batch
+  # arm.  Never fatal.
+  serve_stats = None
+  use_serve = args.serve
+  if use_serve is None:
+    use_serve = args.trainer == 'sparse'
+  if use_serve:
+    try:
+      from distributed_embeddings_tpu import serving as serving_lib
+      from distributed_embeddings_tpu.parallel import (
+          hotcache as hotcache_lib, quantization as serve_quant)
+      from distributed_embeddings_tpu.parallel.checkpoint import (
+          QuantizedWeight, export_tables)
+      from distributed_embeddings_tpu.models.synthetic import (
+          expand_tables as serve_expand)
+      dist0 = model.dist_embedding
+      int8 = serve_quant.resolve_table_dtype('int8')
+      bundle_tables = []
+      for t in export_tables(dist0, state.params['embedding']):
+        # quantize f32 exports table-by-table so only one full f32
+        # table is ever live beyond the export itself
+        bundle_tables.append(
+            t if isinstance(t, QuantizedWeight)
+            else QuantizedWeight.from_values(np.asarray(t), int8))
+      denom = dist0.world_size * dist0.num_slices
+      sv_batch = max(denom, (int(args.serve_batch) // denom) * denom)
+      serve_hot = None
+      if args.alpha > 0:
+        serve_cfgs, _, _ = serve_expand(config)
+        serve_hot = hotcache_lib.analytic_power_law_hot_sets(
+            serve_cfgs, args.alpha, coverage=args.serve_hot_coverage,
+            budget_bytes=int(args.serve_hot_budget_mb * 2**20),
+            state_copies=0)
+      requests = serving_lib.split_requests(
+          [np.asarray(c) for c in cats0], sizes=(1, 2, 4, 8),
+          limit=args.serve_requests)
+      engine = serving_lib.ServingEngine(
+          dist0.table_configs, bundle_tables, batch_size=sv_batch,
+          mesh=mesh, input_table_map=list(dist0.plan.input_table_map),
+          hotness=[1 if np.asarray(c).ndim == 1 else
+                   np.asarray(c).shape[1] for c in cats0],
+          hot_sets=serve_hot)
+      serve_stats = serving_lib.measure_serving(
+          engine, requests, max_delay_ms=args.serve_max_delay_ms,
+          concurrency=args.serve_concurrency)
+      serve_stats.update({
+          'serve_table_dtype': (engine.dist.quant.name
+                                if engine.dist.quant else None),
+          'serve_hot_rows_replicated': (
+              int(sum(h.size for h in serve_hot.values()))
+              if serve_hot else 0),
+          'serve_hot_hit_rate': (
+              serving_lib.hot_hit_rate(
+                  serve_hot, dist0.table_configs,
+                  list(dist0.plan.input_table_map), requests)
+              if serve_hot else None),
+      })
+      del engine, bundle_tables
+    except Exception as e:
+      serve_stats = {'serving_error': f'{type(e).__name__}: {e}'}
+
   n_dev = len(devices)
   backend = devices[0].platform
   # the baselines are AT global batch 65536: a reduced-batch chip run
@@ -1153,6 +1252,8 @@ def main():
     result.update(tier_stats)
   if audit_stats:
     result.update(audit_stats)
+  if serve_stats:
+    result.update(serve_stats)
   if on_cpu:
     # a sweep window may have landed an on-chip line earlier this round;
     # carry it (labelled, with its own sha/timestamp) so the artifact is

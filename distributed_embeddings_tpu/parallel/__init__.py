@@ -66,6 +66,7 @@ from distributed_embeddings_tpu.parallel.hotcache import (
     measure_exchange_counters,
     power_law_hot_k,
     select_hot_rows,
+    serving_hot_sets,
 )
 from distributed_embeddings_tpu.parallel.sparsecore import (
     StaticCsr,
@@ -76,7 +77,8 @@ from distributed_embeddings_tpu.parallel.sparsecore import (
     measure_preprocess_ms,
     preprocess_batch_host,
 )
-from distributed_embeddings_tpu.parallel.csr_feed import CsrFeed, FedBatch
+from distributed_embeddings_tpu.parallel.csr_feed import (CsrFeed, FedBatch,
+                                                          QueueSource)
 from distributed_embeddings_tpu.parallel.coldtier import (
     ColdFetchPipeline,
     HostTier,

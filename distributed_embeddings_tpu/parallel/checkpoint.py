@@ -229,23 +229,42 @@ def set_weights(dist: DistributedEmbedding,
     ValueError: on length or shape mismatch.
   """
   plan = dist.plan
+  weights = list(weights)
   if len(weights) != len(plan.table_configs):
     raise ValueError(
         f'You called set_weights with a weight list of length '
         f'{len(weights)}, but the layer was expecting '
         f'{len(plan.table_configs)} weights.')
-  # canonical VALUES: QuantizedWeight entries dequantize exactly here,
-  # then the live plan re-quantizes / re-tiers below into WHATEVER
-  # table_dtype / tier split it carries (design §12 — mirrors the hot-set
-  # canonicalization: storage layout never leaks into saved state)
-  loaded = [_canonical_values(w) for w in weights]
-  for tid, (w, cfg) in enumerate(zip(loaded, plan.table_configs)):
-    if tuple(w.shape) != (cfg.input_dim, cfg.output_dim):
+  for tid, (w, cfg) in enumerate(zip(weights, plan.table_configs)):
+    shape = tuple(w.shape if isinstance(w, QuantizedWeight)
+                  else _load(w).shape)
+    if shape != (cfg.input_dim, cfg.output_dim):
       raise ValueError(
           f'table {tid}: expected shape {(cfg.input_dim, cfg.output_dim)}, '
-          f'got {tuple(w.shape)}')
+          f'got {shape}')
 
   quant = getattr(dist, 'quant', None)
+
+  # canonical VALUES, materialised LAZILY per table: a QuantizedWeight
+  # entry restoring into a plan of the SAME table dtype never takes
+  # this path at all — its payload+scale slice straight into the
+  # shards (quant -> dequant -> requant is the IDENTITY on po2-scaled
+  # rows, design §12), so a serving host restoring a beyond-HBM
+  # quantized bundle never holds the 4x-wider f32 table.  Everything
+  # else (f32 entries, dtype-mismatched quantized entries, unquantized
+  # plans) re-quantizes / re-tiers from the exact canonical values as
+  # before — storage layout never leaks into saved state.
+  _vals: Dict[int, np.ndarray] = {}
+
+  def table_values(tid):
+    if tid not in _vals:
+      _vals[tid] = _canonical_values(weights[tid])
+    return _vals[tid]
+
+  def direct_quant(tid):
+    w = weights[tid]
+    return (quant is not None and isinstance(w, QuantizedWeight)
+            and w.dtype_name == quant.name)
   params = {}
   for gi, g in enumerate(plan.groups):
     sharding = NamedSharding(dist.mesh, P(dist.axis_name, None, None))
@@ -258,8 +277,9 @@ def set_weights(dist: DistributedEmbedding,
         # strided slice extracts exactly the shard's resident rows
         chunks.append(
             np.asarray(
-                loaded[lt.table_id][lt.row_start:lt.row_end:lt.row_stride,
-                                    lt.col_start:lt.col_end],
+                table_values(lt.table_id)
+                [lt.row_start:lt.row_end:lt.row_stride,
+                 lt.col_start:lt.col_end],
                 dtype=dtype))
       pad_rows = g.rows_cap - g.rows[dev]
       if pad_rows or not chunks:
@@ -292,10 +312,18 @@ def set_weights(dist: DistributedEmbedding,
     def quant_rows(dev, g=g):
       pays, scales = [], []
       for lt in g.member_tables[dev]:
-        rows = np.asarray(
-            loaded[lt.table_id][lt.row_start:lt.row_end:lt.row_stride],
-            np.float32)
-        fp, fs = quantization.quantize_np(rows, quant)
+        sl = slice(lt.row_start, lt.row_end, lt.row_stride)
+        if direct_quant(lt.table_id):
+          # same-dtype QuantizedWeight: the stored pair IS the requant
+          # fixed point (§12 identity), so payload+scale slice straight
+          # into the shard — no f32 table ever materialises on the
+          # restore host (the serving-mesh memory contract, §14)
+          w = weights[lt.table_id]
+          fp = np.asarray(w.payload)[sl]
+          fs = np.asarray(w.scale, np.float32).reshape(-1, 1)[sl]
+        else:
+          rows = np.asarray(table_values(lt.table_id)[sl], np.float32)
+          fp, fs = quantization.quantize_np(rows, quant)
         pays.append(fp[:, lt.col_start:lt.col_end])
         scales.append(fs)
       pad_rows = g.rows_cap - g.rows[dev]
@@ -328,17 +356,32 @@ def set_weights(dist: DistributedEmbedding,
       params[f'scale_group_{gi}'] = jax.make_array_from_callback(
           (dist.world_size, res, 1), sharding,
           lambda index, ss=head_scales: ss[index[0].start or 0][None])
-  params.update(_hot_leaves_from_tables(dist, loaded, dist.param_dtype))
+  params.update(_hot_leaves_from_tables(dist, weights, dist.param_dtype))
   return params
+
+
+def _weight_rows(w, ids) -> np.ndarray:
+  """Exact VALUE rows ``w[ids]`` of one weight entry without
+  materialising the full table: QuantizedWeight entries dequantize only
+  the gathered rows (the same narrow-restore contract ``set_weights``
+  keeps for the sharded leaves)."""
+  ids = np.asarray(ids)
+  if isinstance(w, QuantizedWeight):
+    return quantization.dequantize_np(
+        np.asarray(w.payload)[ids],
+        np.asarray(w.scale, np.float32).reshape(-1, 1)[ids])
+  return np.asarray(_load(w)[ids])
 
 
 def _hot_leaves_from_tables(dist, tables, dtype, leaf_prefix='hot_group_'):
   """Replicated hot-cache buffers built from GLOBAL canonical per-table
-  arrays (the ``set_weights``/``set_optimizer_state`` leg of the
+  entries (the ``set_weights``/``set_optimizer_state`` leg of the
   design-§10 canonicalization contract: hot membership is a layout
   detail, so a checkpoint restores into ANY hot set by re-slicing the
   canonical rows).  Quantized plans (design §12) quantize the
-  replicated buffer per row exactly like the device init, emitting the
+  replicated buffer per row exactly like the device init — and a
+  same-dtype ``QuantizedWeight`` entry's stored payload+scale rows copy
+  straight in (the §12 identity; no full-table widening), emitting the
   ``hot_scale_group_{gi}`` leaf alongside.  Returns ``{}`` for
   cache-less layers."""
   plan = dist.plan
@@ -355,8 +398,13 @@ def _hot_leaves_from_tables(dist, tables, dtype, leaf_prefix='hot_group_'):
       scale = np.ones((g.hot_rows_cap, 1), np.float32)
       for tid, cs, ce, off, k in g.hot_chunks:
         ids = plan.hot_sets[tid].ids
-        fp, fs = quantization.quantize_np(
-            np.asarray(np.asarray(tables[tid])[ids], np.float32), quant)
+        w = tables[tid]
+        if isinstance(w, QuantizedWeight) and w.dtype_name == quant.name:
+          fp = np.asarray(w.payload)[ids]
+          fs = np.asarray(w.scale, np.float32).reshape(-1, 1)[ids]
+        else:
+          fp, fs = quantization.quantize_np(
+              np.asarray(_weight_rows(w, ids), np.float32), quant)
         payload[off:off + k] = fp[:, cs:ce]
         scale[off:off + k] = fs
       out[f'{leaf_prefix}{gi}'] = jax.make_array_from_callback(
@@ -368,7 +416,7 @@ def _hot_leaves_from_tables(dist, tables, dtype, leaf_prefix='hot_group_'):
       for tid, cs, ce, off, k in g.hot_chunks:
         ids = plan.hot_sets[tid].ids
         buf[off:off + k] = np.asarray(
-            np.asarray(tables[tid])[ids, cs:ce], dtype=dtype)
+            _weight_rows(tables[tid], ids)[:, cs:ce], dtype=dtype)
       out[f'{leaf_prefix}{gi}'] = jax.make_array_from_callback(
           buf.shape, sharding, lambda index, buf=buf: buf[index])
   return out

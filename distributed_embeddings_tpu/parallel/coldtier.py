@@ -88,6 +88,7 @@ class HostTier:
   def __init__(self, plan, quant):
     self.plan = plan
     self.quant = quant
+    self.frozen = False
     dt = np.dtype(quant.dtype) if quant is not None else np.float32
     self.payload: Dict[int, np.ndarray] = {}
     self.scale: Dict[int, np.ndarray] = {}
@@ -112,8 +113,25 @@ class HostTier:
             (plan.world_size, g.tier_rows, 1), np.float32)
       self.opt[gi] = {}
 
+  def freeze(self):
+    """Mark the tier READ-ONLY (the §14 serving contract): every later
+    ``set_tail`` / ``set_opt_tail`` / ``ensure_opt`` / ``write_back``
+    refuses.  Fetches (``build_fetch``) keep working — and keep
+    digest-verifying every gathered row when digests are armed.
+    Irreversible by design: a serving tier that could quietly thaw
+    would void the read-only guarantee the engine states."""
+    self.frozen = True
+
+  def _check_writable(self, what: str):
+    if self.frozen:
+      raise RuntimeError(
+          f'HostTier is frozen (read-only serving tier, docs/design.md '
+          f'§14): {what} refused. Serving engines never write table '
+          'state; rebuild the tier from a checkpoint to change it.')
+
   def set_tail(self, gi: int, leaf: str, arr: np.ndarray):
     """Install one group's full tail (``[D, tier_rows, ...]``)."""
+    self._check_writable(f'set_tail(group {gi}, {leaf!r})')
     target = self.payload if leaf == 'payload' else self.scale
     want = target[gi].shape if gi in target else None
     arr = np.asarray(arr)
@@ -128,6 +146,7 @@ class HostTier:
     """Create (idempotently) one optimizer-state leaf's tail arrays,
     filled with the optimizer's init value — the host half of e.g.
     Adagrad's accumulator for tier rows."""
+    self._check_writable(f'ensure_opt({leaf!r})')
     created = False
     for gi in self.plan.cold_tier_groups:
       if leaf in self.opt[gi]:
@@ -146,6 +165,7 @@ class HostTier:
     """Install one group's full optimizer-state tail (the checkpoint
     restore leg) — routed here, not assigned directly, so the row
     digests stay in sync with the bytes they certify."""
+    self._check_writable(f'set_opt_tail(group {gi}, {leaf!r})')
     self.opt[gi][leaf] = np.asarray(arr)
     if self._digests is not None:
       self._weights.pop(gi, None)
@@ -440,6 +460,8 @@ def write_back(dist, fetch: ColdFetch, writeback):
   the fetch's row lists."""
   import jax
   tier = dist.cold_tier
+  if getattr(tier, 'frozen', False):
+    tier._check_writable('write_back')
   for gi, wb in writeback.items():
     g = dist.plan.groups[gi]
     res = g.device_rows

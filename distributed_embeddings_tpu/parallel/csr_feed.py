@@ -89,6 +89,78 @@ class _Item(NamedTuple):
 _NO_ITEM = object()  # cursor sentinel: no source item pulled yet
 
 
+class QueueSource:
+  """Bounded IN-MEMORY batch source for a ``CsrFeed`` — the producer
+  side of the serving batcher (docs/design.md §14), where merged
+  request batches exist only in RAM and must reach the feed without a
+  reader/file detour.
+
+  ``put(item)`` enqueues one batch (blocking while the bound is full —
+  backpressure toward the submitter; ``block=False`` instead DROPS the
+  batch and counts it, for callers that prefer shedding to stalling).
+  ``close()`` ends the stream: the feed's producer drains what is
+  queued, then sees ``StopIteration`` and shuts down cleanly — ALWAYS
+  close the source before (or instead of) closing the feed, otherwise
+  the feed's producer blocks inside the source pull until the feed's
+  own join times out.
+
+  A ``CsrFeed`` constructed over a ``QueueSource`` reports the queue's
+  live depth and drop count in its ``stats()``
+  (``queue_depth`` / ``queue_dropped``).
+  """
+
+  def __init__(self, maxsize: int = 8):
+    self._q: queue.Queue = queue.Queue(maxsize=max(1, int(maxsize)))
+    self._closed = threading.Event()
+    self._dropped = 0
+
+  def put(self, item, block: bool = True,
+          timeout: Optional[float] = None) -> bool:
+    """Enqueue one batch; returns False when the queue stays full.  A
+    NON-blocking put against a full queue is a shed — counted in
+    ``dropped``; a timed blocking put that runs out is merely "not yet
+    enqueued" (the caller retries) and counts nothing.  Raises on a
+    closed source — feeding a finished stream is a caller bug, never
+    silent."""
+    if self._closed.is_set():
+      raise RuntimeError('QueueSource is closed')
+    try:
+      self._q.put(item, block=block, timeout=timeout)
+      return True
+    except queue.Full:
+      if not block:
+        self._dropped += 1
+      return False
+
+  def close(self):
+    """End the stream (idempotent): queued items still drain, then the
+    consumer sees ``StopIteration``."""
+    self._closed.set()
+
+  @property
+  def closed(self) -> bool:
+    return self._closed.is_set()
+
+  @property
+  def dropped(self) -> int:
+    """Batches shed by non-blocking ``put`` against a full queue."""
+    return self._dropped
+
+  def qsize(self) -> int:
+    return self._q.qsize()
+
+  def __iter__(self):
+    return self
+
+  def __next__(self):
+    while True:
+      try:
+        return self._q.get(timeout=0.05)
+      except queue.Empty:
+        if self._closed.is_set():
+          raise StopIteration from None
+
+
 def _producer_main(ref: 'weakref.ref'):
   """Producer thread body: a trampoline over bounded work units that
   holds the feed only WEAKLY between units (the ``_ReadAhead`` pattern,
@@ -166,6 +238,8 @@ class CsrFeed:
       raise ValueError(
           f"on_batch_error must be 'raise' or 'skip', got {on_batch_error!r}")
     self._dist = dist
+    # queue-backed sources surface their depth/drop counters in stats()
+    self._queue_source = source if isinstance(source, QueueSource) else None
     self._source = iter(source)
     self._cats_fn = cats_fn if cats_fn is not None else (lambda item: item)
     self._caps = max_ids_per_partition
@@ -404,7 +478,7 @@ class CsrFeed:
     threads respawned after a worker death."""
     build = self._build_ms
     hidden = max(0.0, build - self._blocked_ms)
-    return {
+    out = {
         'batches': self._batches,
         'build_ms': round(build, 3),
         'blocked_ms': round(self._blocked_ms, 3),
@@ -416,3 +490,9 @@ class CsrFeed:
         'io_retries': self._io_retry_count,
         'respawns': self._respawns,
     }
+    if self._queue_source is not None:
+      # in-memory queue source (serving batcher): live depth + batches
+      # shed by non-blocking puts against the full bound
+      out['queue_depth'] = self._queue_source.qsize()
+      out['queue_dropped'] = self._queue_source.dropped
+    return out

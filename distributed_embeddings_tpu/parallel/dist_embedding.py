@@ -511,6 +511,49 @@ class DistributedEmbedding:
                    on_batch_error=on_batch_error, io_retries=io_retries,
                    max_respawns=max_respawns)
 
+  def compile_lookup(self, global_batch: int, hotness=None):
+    """The LOOKUP-ONLY jitted forward for one ``(batch, hotness)``
+    signature — the serving entry point (docs/design.md §14).
+
+    Returns the exact cached program ``apply`` dispatches to for that
+    signature: ``fn(params, *inputs)`` for plain layers,
+    ``fn(params, fetch, *inputs)`` for hot-cache layers (``fetch`` is
+    ``{}`` for fully resident plans).  The traced program contains the
+    forward alone — no backward, no optimizer leaves, no donation — so
+    a serving process never compiles (or holds) anything but the
+    lookup.  Cold-tier plans need their static fetch capacities fixed
+    first (``cold_fetch_rows=`` at construction, or one concrete
+    ``apply`` on representative traffic — ``ServingEngine.warmup``);
+    compiling before that would bake an arbitrary fetch shape into the
+    one program.
+    """
+    hotness = tuple(int(h) for h in (hotness if hotness is not None
+                                     else (1,) * self.num_inputs))
+    if len(hotness) != self.num_inputs:
+      raise ValueError(f'hotness has {len(hotness)} entries for '
+                       f'{self.num_inputs} inputs')
+    self._check_combiner_hotness(list(hotness))
+    if self.hot_enabled:
+      caps = ()
+      if self.cold_tier is not None:
+        missing = [gi for gi in self.plan.cold_tier_groups
+                   if gi not in self._cold_fetch_caps]
+        if missing:
+          raise ValueError(
+              f'cold-tier groups {missing} have no static fetch '
+              'capacity yet: pass cold_fetch_rows= at construction or '
+              'run one concrete forward on representative traffic '
+              '(ServingEngine.warmup) before compile_lookup '
+              '(docs/design.md §14)')
+        caps = tuple(sorted(
+            (gi, self._cold_fetch_caps[gi])
+            for gi in self.plan.cold_tier_groups))
+      return self._build_dp_forward_hot(global_batch, hotness,
+                                        fetch_caps=caps)
+    if self.dp_input:
+      return self._build_dp_forward(global_batch, hotness)
+    return self._build_mp_forward(global_batch, hotness)
+
   def make_auditor(self, every: int = 100, checks=None, max_rows: int = 8,
                    bytes_per_audit='default'):
     """A ``parallel.audit.StateAuditor`` over this layer's state

@@ -186,6 +186,32 @@ def calibrate_hot_sets(table_configs,
   return out
 
 
+def serving_hot_sets(table_configs,
+                     input_table_map: Sequence[int],
+                     batches: Sequence[Sequence[np.ndarray]],
+                     coverage: float = 0.99,
+                     budget_bytes: Optional[int] = None,
+                     min_rows_per_table: int = 0) -> Dict[int, HotSet]:
+  """Hot sets sized for a READ-ONLY serving cache (docs/design.md §14).
+
+  Same counting calibration as ``calibrate_hot_sets``, with the two
+  serving-side differences baked in: ``state_copies=0`` (an inference
+  replica funds no optimizer-state copies, so each replicated row costs
+  exactly ``width * 4`` bytes — the HBM that training spent on
+  accumulators buys coverage instead) and a much larger default
+  coverage target (0.99 vs training's 0.8: the cache is the whole
+  latency story when there is no backward to amortise the exchange
+  against — "Dissecting Embedding Bag Performance in DLRM Inference",
+  PAPERS.md).  Feed it representative request traffic; the batcher's
+  merged batches are exactly that.
+  """
+  return calibrate_hot_sets(table_configs, input_table_map, batches,
+                            coverage=coverage,
+                            budget_bytes=budget_bytes,
+                            state_copies=0,
+                            min_rows_per_table=min_rows_per_table)
+
+
 def power_law_hot_k(num_rows: int, alpha: float, coverage: float) -> int:
   """Closed-form K for the synthetic generator's power law: ids come
   from ``power_law(1, rows + 1, alpha, U[0,1)) - 1``

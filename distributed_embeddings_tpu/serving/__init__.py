@@ -1,0 +1,31 @@
+"""Online inference serving: exported lookup-only runtime (design §14).
+
+The serving half of the train/serve split ("Scalable Machine Learning
+Training Infrastructure for Online Ads Recommendation ... at Google",
+PAPERS.md): a training checkpoint freezes into a read-only bundle
+(``export.py`` — optimizer slots stripped, quantized payload+scale kept
+narrow, manifest-verified), the bundle restores into a ``ServingEngine``
+(``engine.py`` — ONE compiled lookup-only forward over the existing
+dispatch paths, serving-sized read-only hot cache, fetch-only cold
+tier), and a ``DynamicBatcher`` (``batcher.py``) merges many small
+concurrent user requests into that one padded static device batch with
+per-request demux and p50/p99 latency accounting (``bench.py`` — the
+block bench.py journals in the standard artifact).
+"""
+
+from distributed_embeddings_tpu.serving.export import (
+    SERVING_FORMAT,
+    export_bundle_from_checkpoint,
+    export_serving_bundle,
+    load_serving_bundle,
+)
+from distributed_embeddings_tpu.serving.engine import ServingEngine
+from distributed_embeddings_tpu.serving.batcher import (
+    DynamicBatcher,
+    ServeFuture,
+)
+from distributed_embeddings_tpu.serving.bench import (
+    hot_hit_rate,
+    measure_serving,
+    split_requests,
+)

@@ -1,0 +1,432 @@
+"""Dynamic request batcher: many small requests -> one static device batch.
+
+The admission half of serving (docs/design.md §14 "Batcher admission
+policy").  Concurrent user requests (each a per-input list of id arrays
+for ``n`` samples) enqueue through ``submit``; a dispatcher thread
+merges them — launching as soon as the batch is FULL (``max_batch``
+samples) or the OLDEST queued request has waited ``max_delay_ms``,
+whichever comes first — into one ``-1``-padded batch at the engine's
+single compiled signature, runs the lookup, and demuxes each request's
+``[n, output_dim]`` slice back to its ``ServeFuture``.
+
+Admission rules (all pinned in tests/test_serving.py):
+
+- an EMPTY request (0 samples) resolves immediately with empty outputs
+  — it never occupies batch space;
+- a request larger than ``max_batch`` REFUSES at ``submit`` with an
+  actionable error (split it, or build a bigger engine batch) — silent
+  splitting would break the one-request-one-result contract;
+- a request that does not fit the in-flight batch's remaining space
+  rides the NEXT batch (requests are never split);
+- demux is BIT-EXACT vs running the same request through
+  ``engine.lookup_padded`` alone (hotness-1; multi-hot within the
+  pinned 1e-6 fold-order bound): per-sample lookup+combine is
+  independent of batch composition, so batching is pure scheduling.
+
+With ``csr_feed=True`` merged batches additionally flow through a
+``CsrFeed`` over a bounded in-memory ``QueueSource`` (no disk touch):
+batch N+1's padded static-CSR host buffers build on worker threads
+while the device runs batch N, and the feed's build/parity/queue
+counters fold into ``stats()``.  Same contract as the training
+pipeline (see ``csr_feed.py``): on SparseCore hardware the custom-call
+binding consumes the buffers directly; on the XLA/emulation backends
+they are the measured host-side feed cost the overlap exists to hide,
+while the jitted lookup recomputes the same content via the traced
+twin.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class ServeFuture:
+  """Resolution handle of one submitted request."""
+
+  def __init__(self):
+    self._ev = threading.Event()
+    self._out: Optional[List[np.ndarray]] = None
+    self._err: Optional[BaseException] = None
+    self.latency_ms: Optional[float] = None
+
+  def _resolve(self, out=None, err=None, latency_ms=None):
+    self._out = out
+    self._err = err
+    self.latency_ms = latency_ms
+    self._ev.set()
+
+  def done(self) -> bool:
+    return self._ev.is_set()
+
+  def result(self, timeout: Optional[float] = None) -> List[np.ndarray]:
+    """Per-input ``[n, output_dim]`` activations; raises the serving
+    error (or ``TimeoutError``) instead of returning partial data."""
+    if not self._ev.wait(timeout):
+      raise TimeoutError('serving request not resolved within '
+                         f'{timeout}s')
+    if self._err is not None:
+      raise self._err
+    return self._out
+
+
+class _Slot:
+  __slots__ = ('cats', 'n', 'future', 't0')
+
+  def __init__(self, cats, n, t0):
+    self.cats = cats
+    self.n = n
+    self.future = ServeFuture()
+    self.t0 = t0
+
+
+_CLOSE = object()
+
+
+class DynamicBatcher:
+  """Merge concurrent requests into the engine's one compiled batch.
+
+  Args:
+    engine: a warmed (or warm-on-first-batch) ``ServingEngine``.
+    max_delay_ms: admission deadline — the longest the OLDEST queued
+      request waits for co-riders before its batch launches anyway.
+      The knob trades tail latency against batch fill (the off/on A/B
+      bench journals).
+    max_batch: samples per launched batch (default and upper bound: the
+      engine's ``batch_size`` — the padded remainder is sentinel rows).
+    queue_depth: bound on queued requests (backpressure: ``submit``
+      blocks when full).
+    csr_feed: also build each merged batch's static-CSR host buffers
+      through a ``CsrFeed`` over a bounded in-memory ``QueueSource``
+      (see module docstring).
+  """
+
+  def __init__(self, engine, max_delay_ms: float = 2.0,
+               max_batch: Optional[int] = None, queue_depth: int = 256,
+               csr_feed: bool = False,
+               csr_feed_kwargs: Optional[dict] = None):
+    self.engine = engine
+    self.max_batch = int(max_batch if max_batch is not None
+                         else engine.batch_size)
+    if not 1 <= self.max_batch <= engine.batch_size:
+      raise ValueError(
+          f'max_batch {self.max_batch} must be in [1, engine.batch_size'
+          f' = {engine.batch_size}]')
+    self.max_delay_ms = float(max_delay_ms)
+    self._q: queue.Queue = queue.Queue(maxsize=max(1, int(queue_depth)))
+    self._closed = threading.Event()
+    self._lock = threading.Lock()
+    # admission lock: makes submit's {closed-check, enqueue} atomic
+    # against close's {set-closed} — a put racing past the flag would
+    # land after close's final sweep and strand its future forever.
+    # Separate from self._lock (the stats lock the dispatcher takes
+    # mid-batch), so a submit blocked on a full queue can never
+    # deadlock the dispatcher that must drain it.
+    self._submit_lock = threading.Lock()
+    self._submitted = 0
+    self._completed = 0
+    self._batches = 0
+    self._fill_sum = 0.0
+    self._latencies: List[float] = []
+    self._feed = None
+    self._queue_source = None
+    self._consumer = None
+    self._inflight: List[_Slot] = []  # pushed to the feed, not yet run
+    if csr_feed:
+      from distributed_embeddings_tpu.parallel.csr_feed import QueueSource
+      self._queue_source = QueueSource(maxsize=4)
+      self._feed = engine.dist.make_csr_feed(
+          self._queue_source,
+          cats_fn=lambda item: [np.asarray(c) for c in item[0]],
+          **(csr_feed_kwargs or {}))
+      self._consumer = threading.Thread(target=self._consume_feed,
+                                        name='serve-feed-consumer',
+                                        daemon=True)
+      self._consumer.start()
+    self._dispatcher = threading.Thread(target=self._dispatch_loop,
+                                        name='serve-batcher',
+                                        daemon=True)
+    self._dispatcher.start()
+
+  # ----------------------------------------------------------- submission
+
+  def submit(self, cats) -> ServeFuture:
+    """Enqueue one request (per-input id arrays for ``n`` samples);
+    returns its ``ServeFuture``.  Admission-policy refusals raise HERE,
+    synchronously, so the caller can repair the request."""
+    if self._closed.is_set():
+      raise RuntimeError('batcher is closed')
+    cats = [np.asarray(x) for x in cats]
+    if len(cats) != self.engine.dist.num_inputs:
+      raise ValueError(f'expected {self.engine.dist.num_inputs} inputs, '
+                       f'got {len(cats)}')
+    n = int(cats[0].shape[0]) if cats else 0
+    for i, x in enumerate(cats):
+      if x.ndim not in (1, 2):
+        raise ValueError(
+            f'input {i}: expected 1-D or 2-D ids, got shape {x.shape}')
+      if int(x.shape[0]) != n:
+        raise ValueError(
+            f'input {i} has {x.shape[0]} samples, input 0 has {n}')
+      h = x.shape[1] if x.ndim == 2 else 1
+      if h > self.engine.hotness[i]:
+        raise ValueError(
+            f'input {i}: request hotness {h} exceeds the compiled hot '
+            f'cap {self.engine.hotness[i]}')
+    if n > self.max_batch:
+      raise ValueError(
+          f'request of {n} samples exceeds max_batch {self.max_batch}: '
+          'split the request, or build the batcher/engine with a '
+          'larger batch (requests are never silently split)')
+    t0 = time.monotonic()
+    slot = _Slot(cats, n, t0)
+    with self._lock:
+      self._submitted += 1
+    if n == 0:
+      # empty request: resolves immediately, occupies no batch space
+      slot.future._resolve(
+          out=[np.zeros((0, d), np.float32)
+               for d in self.engine.output_dims],
+          latency_ms=0.0)
+      with self._lock:
+        self._completed += 1
+      return slot.future
+    # atomic with close()'s flag-set (see _submit_lock): every slot
+    # that enqueues here is guaranteed a consumer — the live
+    # dispatcher, its exit drain, or close()'s final sweep
+    with self._submit_lock:
+      if self._closed.is_set():
+        raise RuntimeError('batcher is closed')
+      self._q.put(slot)
+    return slot.future
+
+  # ------------------------------------------------------------- dispatch
+
+  def _dispatch_loop(self):
+    pending: Optional[_Slot] = None
+    while True:
+      first = pending
+      pending = None
+      if first is None:
+        try:
+          first = self._q.get(timeout=0.05)
+        except queue.Empty:
+          if self._closed.is_set():
+            break
+          continue
+        if first is _CLOSE:
+          break
+      batch = [first]
+      n = first.n
+      deadline = first.t0 + self.max_delay_ms / 1000.0
+      while n < self.max_batch:
+        wait = deadline - time.monotonic()
+        try:
+          # past the deadline the batch must not WAIT any longer — but
+          # requests already queued (a backlog built while the previous
+          # batch executed) still merge in, non-blockingly: under load
+          # the batch fills from the backlog instead of launching
+          # singletons
+          nxt = (self._q.get(timeout=wait) if wait > 0
+                 else self._q.get_nowait())
+        except queue.Empty:
+          break
+        if nxt is _CLOSE:
+          self._closed.set()
+          break
+        if n + nxt.n > self.max_batch:
+          pending = nxt  # does not fit: rides the NEXT batch, unsplit
+          break
+        batch.append(nxt)
+        n += nxt.n
+      try:
+        self._launch(batch, n)
+      except BaseException as e:
+        # a failed merge/launch fails THIS batch's futures — the
+        # dispatcher itself must survive, or every later request
+        # would hang unresolved against a silently dead thread
+        for slot in batch:
+          if not slot.future.done():
+            slot.future._resolve(err=e)
+    # drain: fail anything still queued after close
+    leftovers = [pending] if pending is not None else []
+    while True:
+      try:
+        s = self._q.get_nowait()
+      except queue.Empty:
+        break
+      if s is not _CLOSE:
+        leftovers.append(s)
+    for s in leftovers:
+      s.future._resolve(err=RuntimeError('batcher closed before the '
+                                         'request was served'))
+    if self._queue_source is not None:
+      self._queue_source.close()
+
+  def _merge(self, batch) -> List[np.ndarray]:
+    """One ``-1``-padded batch at the engine signature from the
+    requests' per-input arrays (request r's samples occupy rows
+    ``[off_r, off_r + n_r)`` of every input)."""
+    eng = self.engine
+    merged = []
+    for i in range(eng.dist.num_inputs):
+      h = eng.hotness[i]
+      buf = np.full((eng.batch_size, h), -1, np.int32)
+      off = 0
+      for slot in batch:
+        x = slot.cats[i]
+        x2 = x[:, None] if x.ndim == 1 else x
+        buf[off:off + slot.n, :x2.shape[1]] = x2
+        off += slot.n
+      merged.append(buf[:, 0] if h == 1 else buf)
+    return merged
+
+  def _launch(self, batch, n):
+    merged = self._merge(batch)
+    if self._queue_source is not None:
+      # csr_feed mode: the merged batch rides the in-memory queue into
+      # the CsrFeed; the consumer thread executes + demuxes in feed
+      # order (the CSR host build overlaps the previous device lookup).
+      # TIMED puts with a consumer-liveness check: a dead feed pipeline
+      # must fail this batch's futures fast, never wedge the
+      # dispatcher (and with it every later request) on a full queue
+      # nothing will ever drain.
+      with self._lock:
+        self._inflight.extend(batch)
+      err = None
+      while err is None:
+        if self._consumer is None or not self._consumer.is_alive():
+          err = RuntimeError(
+              'serving feed pipeline is dead (CsrFeed consumer '
+              'exited); request not served')
+          break
+        try:
+          if self._queue_source.put((merged, batch, n), timeout=0.2):
+            return
+        except RuntimeError as e:  # source closed under us
+          err = e
+      with self._lock:
+        self._inflight = [s for s in self._inflight if s not in batch]
+      for slot in batch:
+        if not slot.future.done():
+          slot.future._resolve(err=err)
+      return
+    self._execute(merged, batch, n)
+
+  def _consume_feed(self):
+    try:
+      for fed in self._feed:
+        merged, batch, n = fed.item
+        with self._lock:
+          self._inflight = [s for s in self._inflight
+                            if s not in batch]
+        self._execute(merged, batch, n)
+      stranded = []
+    except BaseException as e:
+      with self._lock:
+        stranded, self._inflight = self._inflight, []
+      for slot in stranded:
+        slot.future._resolve(err=e)
+      return
+    # clean feed shutdown (close()): fail whatever never ran
+    with self._lock:
+      stranded, self._inflight = self._inflight, []
+    for slot in stranded:
+      slot.future._resolve(err=RuntimeError(
+          'batcher closed before the request was served'))
+
+  def _execute(self, merged, batch, n):
+    try:
+      outs = self.engine.lookup(merged)
+      host = [np.asarray(o) for o in outs]
+    except BaseException as e:
+      for slot in batch:
+        slot.future._resolve(err=e)
+      return
+    now = time.monotonic()
+    lats = [(now - slot.t0) * 1000.0 for slot in batch]
+    # stats update BEFORE the futures resolve: a caller reading
+    # stats() the moment result() returns must already see this batch
+    # counted (measure_serving journals straight off that read)
+    with self._lock:
+      self._batches += 1
+      self._fill_sum += n / self.max_batch
+      self._completed += len(batch)
+      self._latencies.extend(lats)
+      if len(self._latencies) > 65536:
+        del self._latencies[:-32768]
+    off = 0
+    for slot, lat in zip(batch, lats):
+      out = [h[off:off + slot.n] for h in host]
+      off += slot.n
+      slot.future._resolve(out=out, latency_ms=lat)
+
+  # ----------------------------------------------------------- lifecycle
+
+  def close(self):
+    """Stop the dispatcher; pending requests fail with a clear error.
+    Idempotent."""
+    with self._submit_lock:
+      if self._closed.is_set():
+        return
+      self._closed.set()
+    try:
+      self._q.put_nowait(_CLOSE)
+    except queue.Full:
+      pass
+    self._dispatcher.join(timeout=30.0)
+    # nothing can enqueue past this point (the _submit_lock pairing in
+    # submit re-checks the flag before its put): one final sweep and
+    # no future is ever stranded unresolved
+    while True:
+      try:
+        s = self._q.get_nowait()
+      except queue.Empty:
+        break
+      if s is not _CLOSE:
+        s.future._resolve(err=RuntimeError(
+            'batcher closed before the request was served'))
+    if self._queue_source is not None:
+      self._queue_source.close()
+    if self._consumer is not None:
+      self._consumer.join(timeout=30.0)
+    if self._feed is not None:
+      self._feed.close()
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()
+    return False
+
+  # --------------------------------------------------------------- stats
+
+  def stats(self) -> dict:
+    """Latency / fill accounting: ``p50_ms``/``p99_ms`` over resolved
+    request latencies (submit -> demux), mean ``batch_fill`` (samples /
+    ``max_batch``), and the feed's build/queue counters in csr_feed
+    mode."""
+    with self._lock:
+      lat = np.asarray(self._latencies, np.float64)
+      out = {
+          'submitted': self._submitted,
+          'completed': self._completed,
+          'batches': self._batches,
+          'max_batch': self.max_batch,
+          'max_delay_ms': self.max_delay_ms,
+          'batch_fill': (round(self._fill_sum / self._batches, 4)
+                         if self._batches else None),
+          'p50_ms': (round(float(np.percentile(lat, 50)), 3)
+                     if lat.size else None),
+          'p99_ms': (round(float(np.percentile(lat, 99)), 3)
+                     if lat.size else None),
+      }
+    if self._feed is not None:
+      out['csr_feed'] = self._feed.stats()
+    return out

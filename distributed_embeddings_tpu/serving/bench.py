@@ -1,0 +1,149 @@
+"""Serving measurement: the p50/p99/QPS block bench.py journals.
+
+Two directly-measured arms over the SAME request set and the SAME
+compiled engine program (docs/design.md §14):
+
+- ``serve_nobatch_*``: each request runs alone through the full-batch
+  program (``lookup_padded`` — the honest cost of serving without a
+  batcher: one device dispatch per request, batch fill = n/batch);
+- ``serve_*``: the same requests submitted concurrently through the
+  ``DynamicBatcher`` under a closed-loop load of ``concurrency``
+  in-flight requests; latencies are per-request submit->demux walls
+  recorded by the batcher itself, never a wall-clock subtraction.
+
+Percentiles are computed over the full per-request latency list, QPS
+over the arm's wall; ``serve_batch_fill`` is the mean fill of launched
+batches — together the off/on A/B states what dynamic batching bought
+(throughput) and cost (added queueing delay, bounded by
+``max_delay_ms``) on this host.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from distributed_embeddings_tpu.parallel import hotcache
+from distributed_embeddings_tpu.serving.batcher import DynamicBatcher
+
+
+def split_requests(cats, sizes: Sequence[int] = (1, 2, 4, 8),
+                   limit: Optional[int] = None) -> List[List[np.ndarray]]:
+  """Cut one batch of per-input id arrays into many small requests
+  (consecutive sample windows whose sizes cycle through ``sizes``) —
+  the standard way bench derives a request stream from its generated
+  pool, so the served traffic is exactly the measured training
+  traffic."""
+  cats = [np.asarray(c) for c in cats]
+  n = int(cats[0].shape[0])
+  out: List[List[np.ndarray]] = []
+  off = 0
+  k = 0
+  while off < n and (limit is None or len(out) < limit):
+    s = min(int(sizes[k % len(sizes)]), n - off)
+    k += 1
+    out.append([c[off:off + s] for c in cats])
+    off += s
+  return out
+
+
+def hot_hit_rate(hot_sets, table_configs, input_table_map,
+                 requests) -> float:
+  """Exact hot fraction of the request stream's valid id occurrences
+  (the serving twin of ``measure_exchange_counters``'s hit rate —
+  host-side, hardware-independent)."""
+  total = 0
+  hot = 0
+  for r in requests:
+    for i, ids in enumerate(r):
+      tid = input_table_map[i]
+      v = hotcache._clip_valid(ids, table_configs[tid].input_dim)
+      total += v.size
+      hs = hot_sets.get(tid) if hot_sets else None
+      if hs is not None and hs.ids.size:
+        hot += int(np.isin(v, hs.ids).sum())
+  return round(hot / total, 4) if total else 0.0
+
+
+def _pct(lat, q) -> Optional[float]:
+  lat = np.asarray(lat, np.float64)
+  return round(float(np.percentile(lat, q)), 3) if lat.size else None
+
+
+def measure_serving(engine, requests, *, max_delay_ms: float = 2.0,
+                    concurrency: int = 8,
+                    max_batch: Optional[int] = None) -> Dict:
+  """The off/on batching A/B over ``requests``; returns the artifact
+  block (``serve_p50_ms`` / ``serve_p99_ms`` / ``serve_qps`` + the
+  no-batch arm and fill counters).  ``engine`` warms (compiles) before
+  any timed work."""
+  requests = list(requests)
+  if not requests:
+    raise ValueError('measure_serving needs at least one request')
+  # no sample: a cold engine warms on uniform-random FULL-batch ids,
+  # which over-provisions a tiered engine's static fetch capacity by
+  # construction — warming on requests[0] (typically one sample) would
+  # calibrate near-empty caps and refuse on the first real batch
+  engine.warmup()
+
+  # ---- off arm: one full-batch dispatch per request, sequential ------
+  lat_off = []
+  t0 = time.monotonic()
+  for r in requests:
+    ta = time.monotonic()
+    engine.lookup_padded(r)  # returns host arrays: the demuxed answer
+    lat_off.append((time.monotonic() - ta) * 1000.0)
+  wall_off = time.monotonic() - t0
+
+  # ---- on arm: closed-loop concurrent submission through the batcher -
+  batcher = DynamicBatcher(engine, max_delay_ms=max_delay_ms,
+                           max_batch=max_batch)
+  idx_lock = threading.Lock()
+  cursor = [0]
+  errors: List[BaseException] = []
+
+  def worker():
+    while True:
+      with idx_lock:
+        i = cursor[0]
+        if i >= len(requests):
+          return
+        cursor[0] = i + 1
+      try:
+        batcher.submit(requests[i]).result(timeout=60.0)
+      except BaseException as e:  # surfaced after the join
+        errors.append(e)
+        return
+
+  threads = [threading.Thread(target=worker, daemon=True)
+             for _ in range(max(1, int(concurrency)))]
+  t0 = time.monotonic()
+  for t in threads:
+    t.start()
+  for t in threads:
+    t.join()
+  wall_on = time.monotonic() - t0
+  st = batcher.stats()
+  batcher.close()
+  if errors:
+    raise errors[0]
+
+  return {
+      'serve_requests': len(requests),
+      'serve_batch': engine.batch_size,
+      'serve_max_batch': st['max_batch'],
+      'serve_max_delay_ms': max_delay_ms,
+      'serve_concurrency': int(concurrency),
+      'serve_p50_ms': st['p50_ms'],
+      'serve_p99_ms': st['p99_ms'],
+      'serve_qps': round(len(requests) / max(wall_on, 1e-9), 2),
+      'serve_batches': st['batches'],
+      'serve_batch_fill': st['batch_fill'],
+      'serve_nobatch_p50_ms': _pct(lat_off, 50),
+      'serve_nobatch_p99_ms': _pct(lat_off, 99),
+      'serve_nobatch_qps': round(len(requests) / max(wall_off, 1e-9), 2),
+  }

@@ -1,0 +1,292 @@
+"""ServingEngine: ONE compiled lookup-only forward over a frozen state.
+
+The device half of serving (docs/design.md §14).  The engine owns a
+``DistributedEmbedding`` built for the SERVING mesh (which is routinely
+smaller than the training mesh — the canonical checkpoint layout
+reshards on restore), a frozen parameter pytree holding table leaves
+only (no optimizer state anywhere in the compiled program), and exactly
+ONE jitted forward signature ``(batch_size, hotness)``:
+
+- the read-only hot cache reuses the §10 replicated-buffer forward with
+  a serving-sized hot set (``hotcache.serving_hot_sets`` — no optimizer
+  copies to fund, so the same HBM budget buys far more coverage);
+- the read-only cold tier reuses the §12 host tier fetch-ONLY: row
+  digests are armed at load and verified for every fetched row, the
+  tier is frozen (any write_back refuses), and the fetch carries no
+  optimizer rows because none exist;
+- quantized bundles keep their payload narrow end to end: the bundle's
+  payload+scale slices straight into the serving shards
+  (``checkpoint.set_weights``'s §12 identity fast path) and every
+  lookup dequantizes at the gather exactly as in training — so serving
+  output is bit-exact vs the training forward (hotness-1; multi-hot
+  within the pinned 1e-6 fold-order bound).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from distributed_embeddings_tpu.parallel import checkpoint
+from distributed_embeddings_tpu.parallel import mesh as mesh_lib
+from distributed_embeddings_tpu.parallel.dist_embedding import (
+    DistributedEmbedding)
+
+
+def _resolve_bundle_dtype(weights) -> Optional[str]:
+  """'auto' table_dtype: serve a uniformly quantized bundle at its own
+  narrow dtype (rows never widen on device); anything else — plain f32
+  entries or mixed dtypes — serves as f32 (dequantization is exact,
+  §12), which is the safe default, never a silent narrowing."""
+  if not weights:
+    return None
+  names = set()
+  for w in weights:
+    if not isinstance(w, checkpoint.QuantizedWeight):
+      return None
+    names.add(w.dtype_name)
+  return names.pop() if len(names) == 1 else None
+
+
+class ServingEngine:
+  """Lookup-only inference runtime over a frozen table set.
+
+  Args:
+    table_configs: the model's ``TableConfig`` list (bundle-embedded
+      configs via ``from_bundle``).
+    weights: global canonical per-table entries (arrays, ``.npy`` paths
+      or ``QuantizedWeight`` pairs) — what ``load_serving_bundle``
+      returns.
+    batch_size: the ONE static device batch every lookup runs at; must
+      be a multiple of the serving mesh's device count.  The dynamic
+      batcher fills it from concurrent requests; smaller direct calls
+      pad (``lookup_padded``).
+    mesh / axis_name: serving mesh (default: all local devices).
+    input_table_map: as in ``DistributedEmbedding``.
+    hotness: per-input static hot caps (default 1 per input) — the one
+      compiled signature's trailing dims; requests with fewer ids pad
+      with ``-1``, more refuse.
+    hot_sets: serving-sized read-only hot sets
+      (``hotcache.serving_hot_sets``); hot rows replicate per device
+      and are served with zero exchange.
+    table_dtype: ``'auto'`` (default) serves a uniformly quantized
+      bundle at its own narrow dtype; ``None``/'int8'/'float8_e4m3'
+      force a storage dtype.
+    cold_tier / device_hbm_budget / cold_fetch_rows: §12 tiering for
+      tables beyond serving HBM — fetch-only here: digests are armed
+      (``verify_tier_digests``) and the tier is frozen, so damaged
+      host rows refuse before reaching the device and nothing can
+      write back.
+    compute_dtype / lookup_impl / strategy / column_slice_threshold /
+      row_slice: as in ``DistributedEmbedding``.
+
+  ``warmup()`` compiles the one program (and, for tiered plans without
+  explicit ``cold_fetch_rows``, calibrates the static fetch capacity
+  from a representative — or uniform-random, which over-provisions —
+  sample batch).
+  """
+
+  def __init__(self, table_configs, weights, *, batch_size: int,
+               mesh=None, axis_name: str = mesh_lib.DEFAULT_AXIS,
+               input_table_map: Optional[Sequence[int]] = None,
+               hotness: Optional[Sequence[int]] = None,
+               hot_sets=None,
+               table_dtype='auto',
+               compute_dtype=None,
+               lookup_impl: str = 'auto',
+               strategy: str = 'basic',
+               column_slice_threshold: Optional[int] = None,
+               row_slice=None,
+               cold_tier: bool = False,
+               device_hbm_budget: Optional[int] = None,
+               cold_fetch_rows=None,
+               verify_tier_digests: bool = True,
+               bundle_meta: Optional[dict] = None):
+    weights = list(weights)
+    if table_dtype == 'auto':
+      table_dtype = _resolve_bundle_dtype(weights)
+    self.dist = DistributedEmbedding(
+        list(table_configs),
+        strategy=strategy,
+        column_slice_threshold=column_slice_threshold,
+        row_slice=row_slice,
+        dp_input=True,
+        input_table_map=input_table_map,
+        mesh=mesh,
+        axis_name=axis_name,
+        lookup_impl=lookup_impl,
+        compute_dtype=compute_dtype,
+        hot_cache=hot_sets,
+        table_dtype=table_dtype,
+        cold_tier=cold_tier,
+        device_hbm_budget=device_hbm_budget,
+        cold_fetch_rows=cold_fetch_rows)
+    denom = self.dist.world_size * self.dist.num_slices
+    batch_size = int(batch_size)
+    if batch_size < 1 or batch_size % denom:
+      raise ValueError(
+          f'batch_size {batch_size} must be a positive multiple of the '
+          f'serving mesh device count {denom} (the one compiled '
+          'signature is a static device batch)')
+    self.batch_size = batch_size
+    self.hotness = tuple(
+        int(h) for h in (hotness if hotness is not None
+                         else (1,) * self.dist.num_inputs))
+    if len(self.hotness) != self.dist.num_inputs:
+      raise ValueError(
+          f'hotness has {len(self.hotness)} entries for '
+          f'{self.dist.num_inputs} inputs')
+    self.params = checkpoint.set_weights(self.dist, weights)
+    if self.dist.cold_tier is not None:
+      # read-only tier contract (design §14): every fetched row is
+      # digest-verified, and nothing may write back
+      if verify_tier_digests:
+        self.dist.cold_tier.enable_digests()
+      self.dist.cold_tier.freeze()
+    self.output_dims = [
+        self.dist.table_configs[tid].output_dim
+        for tid in self.dist.plan.input_table_map
+    ]
+    self.bundle_meta = bundle_meta
+    self._warm = False
+    self._lock = threading.Lock()
+    self._batches_served = 0
+    self._samples_served = 0
+
+  @classmethod
+  def from_bundle(cls, path: str, *, table_configs=None, **kwargs
+                  ) -> 'ServingEngine':
+    """Build an engine from an exported bundle.  ``table_configs``
+    overrides (or supplies, for bundles exported without embedded
+    configs) the per-table meta."""
+    from distributed_embeddings_tpu.serving.export import (
+        load_serving_bundle)
+    weights, meta = load_serving_bundle(path)
+    configs = table_configs if table_configs is not None \
+        else meta['table_configs']
+    if configs is None:
+      raise ValueError(
+          f'{path}: bundle carries no embedded table configs (exported '
+          'without table_configs) — pass table_configs= explicitly.')
+    return cls(configs, weights, bundle_meta=meta, **kwargs)
+
+  # ---------------------------------------------------------------- lookup
+
+  def _pad_input(self, i: int, x) -> np.ndarray:
+    """One input padded to the compiled ``[batch_size(, hot_cap)]``
+    signature (``-1`` sentinel = no id, dropped by every lookup path)."""
+    x = np.asarray(x)
+    h = self.hotness[i]
+    # already at the compiled signature (the batcher's merged buffers,
+    # or lookup_padded's own padding): no second alloc+copy on the
+    # per-batch hot path
+    if (x.dtype == np.int32
+        and ((h == 1 and x.shape == (self.batch_size,))
+             or (h > 1 and x.shape == (self.batch_size, h)))):
+      return x
+    x2 = x[:, None] if x.ndim == 1 else x
+    if x2.ndim != 2:
+      raise ValueError(f'input {i}: expected 1-D or 2-D ids, '
+                       f'got shape {x.shape}')
+    if x2.shape[1] > h:
+      raise ValueError(
+          f'input {i}: request hotness {x2.shape[1]} exceeds the '
+          f'compiled hot cap {h} — build the engine with '
+          f'hotness[{i}] >= {x2.shape[1]}')
+    n = x2.shape[0]
+    if n > self.batch_size:
+      raise ValueError(
+          f'input {i}: {n} samples exceed the engine batch '
+          f'{self.batch_size}')
+    buf = np.full((self.batch_size, h), -1, np.int32)
+    buf[:n, :x2.shape[1]] = x2
+    return buf[:, 0] if h == 1 else buf
+
+  def lookup(self, cats) -> List:
+    """Full-batch lookup at the ONE compiled signature.
+
+    ``cats``: per-input ``[batch_size]`` / ``[batch_size, h<=cap]`` id
+    arrays (``-1`` padding).  Returns the per-input
+    ``[batch_size, output_dim]`` activations (jax arrays — callers
+    demuxing to hosts ``np.asarray`` them once per batch)."""
+    cats = list(cats)
+    if len(cats) != self.dist.num_inputs:
+      raise ValueError(f'expected {self.dist.num_inputs} inputs, '
+                       f'got {len(cats)}')
+    for x in cats:
+      if np.asarray(x).shape[0] != self.batch_size:
+        raise ValueError(
+            f'engine compiled for batch {self.batch_size}, got '
+            f'{np.asarray(x).shape[0]} — pad smaller requests '
+            '(lookup_padded) or batch them (DynamicBatcher)')
+    padded = [self._pad_input(i, x) for i, x in enumerate(cats)]
+    outs = self.dist.apply(self.params, padded)
+    with self._lock:
+      self._batches_served += 1
+      self._samples_served += self.batch_size
+    self._warm = True
+    return list(outs)
+
+  def lookup_padded(self, cats) -> List[np.ndarray]:
+    """One request (``n <= batch_size`` samples) through the full-batch
+    program: pad with ``-1`` sentinel samples, run, slice ``[:n]``.
+    The no-batching serving arm — and the per-request reference the
+    batcher's demux is pinned bit-exact against."""
+    cats = list(cats)
+    n = int(np.asarray(cats[0]).shape[0]) if cats else 0
+    if n == 0:
+      return [np.zeros((0, d), np.float32) for d in self.output_dims]
+    if n > self.batch_size:
+      raise ValueError(
+          f'request of {n} samples exceeds the engine batch '
+          f'{self.batch_size}: split the request or build the engine '
+          'with a larger batch_size')
+    padded = [self._pad_input(i, x) for i, x in enumerate(cats)]
+    outs = self.lookup(padded)
+    return [np.asarray(o)[:n] for o in outs]
+
+  def warmup(self, sample_cats=None, seed: int = 0) -> 'ServingEngine':
+    """Compile the one lookup program (idempotent).
+
+    ``sample_cats`` (a representative batch) drives the compile — and,
+    on cold-tier plans without explicit ``cold_fetch_rows``, calibrates
+    the static fetch capacity, so pass REAL traffic there when you can.
+    Without a sample, uniform-random ids over each full vocabulary are
+    used: they touch MORE distinct tail rows than any skewed real
+    stream, so the calibrated capacity over-provisions rather than
+    under- (a too-small cap would refuse mid-serve)."""
+    if self._warm:
+      return self
+    if sample_cats is None:
+      rng = np.random.default_rng(seed)
+      sample_cats = []
+      for i, tid in enumerate(self.dist.plan.input_table_map):
+        vocab = self.dist.table_configs[tid].input_dim
+        h = self.hotness[i]
+        shape = (self.batch_size,) if h == 1 else (self.batch_size, h)
+        sample_cats.append(
+            rng.integers(0, vocab, size=shape).astype(np.int32))
+    self.lookup_padded(sample_cats)
+    return self
+
+  def compiled(self):
+    """The underlying cached jitted forward for the engine's signature
+    (``DistributedEmbedding.compile_lookup``) — introspection/AOT hook;
+    plain serving goes through ``lookup``."""
+    return self.dist.compile_lookup(self.batch_size, self.hotness)
+
+  def stats(self) -> dict:
+    with self._lock:
+      return {
+          'batches_served': self._batches_served,
+          'samples_served': self._samples_served,
+          'batch_size': self.batch_size,
+          'world_size': self.dist.world_size,
+          'hot_cache': bool(self.dist.hot_enabled),
+          'cold_tier': self.dist.cold_tier is not None,
+          'table_dtype': (self.dist.quant.name
+                          if self.dist.quant else None),
+      }

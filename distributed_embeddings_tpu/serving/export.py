@@ -1,0 +1,181 @@
+"""Serving-bundle export: freeze a training checkpoint for lookup-only use.
+
+The bundle is a ``save_train_npz``-format npz restricted to what serving
+needs (docs/design.md §14 "Export bundle format"):
+
+- per-table WEIGHTS only — every ``table{i}/{leaf}`` optimizer slot of
+  the source checkpoint is stripped (a serving replica funds coverage,
+  not accumulators);
+- quantized tables stay NARROW on disk and through the restore:
+  ``table{i}`` int8 payload (fp8 as its uint8 bit-view) +
+  ``table{i}:scale`` / ``table{i}:dtype`` sidecars, exactly the §12
+  train-checkpoint members — and ``checkpoint.set_weights`` slices a
+  matching payload+scale pair straight into any plan (different device
+  count, different tier split) without ever materialising the f32
+  table;
+- an embedded integrity manifest (per-array sha256 + the logical plan
+  fingerprint) — a bundle that fails verification refuses to load;
+- ``extra/serving_format`` marks the file as a bundle (a raw training
+  checkpoint refuses in ``load_serving_bundle`` with a pointer at the
+  export CLI), ``extra/step`` records the source training step, and
+  ``extra/tables`` (when the exporter knows the configs) embeds the
+  per-table ``[rows, width, combiner]`` list so
+  ``ServingEngine.from_bundle`` needs zero model code.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from distributed_embeddings_tpu.parallel import checkpoint
+from distributed_embeddings_tpu.parallel.planner import TableConfig
+
+SERVING_FORMAT = 1
+
+
+def _write_bundle(path: str, weights, *, plan=None, step=None,
+                  table_configs=None, source=None) -> str:
+  extras = {'serving_format': np.int64(SERVING_FORMAT)}
+  if step is not None:
+    extras['step'] = np.int64(step)
+  if table_configs:
+    extras['tables'] = np.array(json.dumps(
+        [[int(c.input_dim), int(c.output_dim), c.combiner]
+         for c in table_configs]))
+  if source:
+    extras['source'] = np.array(str(source))
+  checkpoint.save_train_npz(path, weights, table_states=None,
+                            extras=extras, plan=plan)
+  return path
+
+
+def export_serving_bundle(dist, params, path: str,
+                          step: Optional[int] = None) -> str:
+  """Freeze a LIVE training state into a serving bundle.
+
+  ``checkpoint.export_tables`` gathers the canonical per-table entries
+  for this plan — plain f32 arrays for unquantized plans,
+  ``QuantizedWeight`` payload+scale pairs (narrow on disk) for
+  quantized ones, hot-cache and cold-tier layouts canonicalised away —
+  and the bundle carries them plus the table configs, with no optimizer
+  state.  Returns ``path``."""
+  tables = checkpoint.export_tables(dist, params)
+  return _write_bundle(path, tables, plan=dist, step=step,
+                       table_configs=dist.table_configs, source='live')
+
+
+def export_bundle_from_checkpoint(source: str, path: str,
+                                  table_configs=None,
+                                  combiner: str = 'unset') -> dict:
+  """Freeze an on-disk training checkpoint into a serving bundle.
+
+  ``source`` is one ``save_train_npz`` file or a checkpoint directory
+  (newest VALID file wins, rejects journaled — ``load_latest_valid``).
+  The source is integrity-verified before anything is written; its
+  optimizer-state members are stripped; quantized tables pass through
+  as their stored payload+scale bits (never widened).  ``table_configs``
+  (optional — the checkpoint itself does not record combiners) embeds
+  the per-table meta so ``ServingEngine.from_bundle`` needs no model
+  code; ``combiner`` instead applies ONE combiner (``None``/'sum'/
+  'mean') to every table, with shapes taken from the verified
+  checkpoint itself (the CLI's ``--combiner``).  Returns a summary
+  dict (``path``, ``source``, ``step``, ``tables``,
+  ``stripped_state_leaves``, ``quantized``)."""
+  if os.path.isdir(source):
+    src_path, (weights, states, extras) = checkpoint.load_latest_valid(
+        source)
+  else:
+    arrays, _ = checkpoint._load_verified(source)
+    weights, states, extras = checkpoint._parse_train_payload(
+        arrays, source)
+    src_path = source
+  if table_configs is None and combiner != 'unset':
+    table_configs = [
+        TableConfig(int(w.shape[0]), int(w.shape[1]), combiner)
+        for w in weights
+    ]
+  if table_configs is not None:
+    if len(table_configs) != len(weights):
+      raise ValueError(
+          f'{src_path}: checkpoint has {len(weights)} tables but '
+          f'{len(table_configs)} table_configs were given')
+    for tid, (c, w) in enumerate(zip(table_configs, weights)):
+      shape = tuple(w.shape if isinstance(w, checkpoint.QuantizedWeight)
+                    else np.asarray(w).shape)
+      if shape != (c.input_dim, c.output_dim):
+        raise ValueError(
+            f'{src_path}: table {tid} is {shape} but table_configs[{tid}]'
+            f' says {(c.input_dim, c.output_dim)}')
+  step = (int(np.asarray(extras['step'])) if 'step' in extras else None)
+  man = checkpoint.read_manifest(src_path)
+  plan_fp = man.get('plan') if man else None
+  _write_bundle(path, weights, plan=plan_fp, step=step,
+                table_configs=table_configs,
+                source=os.path.basename(src_path))
+  return {
+      'path': path,
+      'source': src_path,
+      'step': step,
+      'tables': len(weights),
+      'stripped_state_leaves': int(sum(len(s) for s in states)),
+      'quantized': sorted({
+          w.dtype_name for w in weights
+          if isinstance(w, checkpoint.QuantizedWeight)
+      }),
+  }
+
+
+def load_serving_bundle(path: str) -> Tuple[List, dict]:
+  """Verified load of a serving bundle: ``(weights, meta)``.
+
+  Every member is sha256-checked against the embedded manifest in one
+  pass (``checkpoint._load_verified``); a manifest-less file, a file
+  without the ``serving_format`` marker, or a file still carrying
+  optimizer slots all refuse actionably — a training checkpoint must go
+  through ``export_bundle_from_checkpoint`` (or
+  ``tools/export_serving.py``) first, so the slot-stripping contract is
+  never silently skipped.  ``meta`` carries ``format``, ``step``,
+  ``plan`` (the logical fingerprint), ``source``, and
+  ``table_configs`` (``None`` for bundles exported without configs).
+  """
+  try:
+    arrays, man = checkpoint._load_verified(path)
+  except ValueError as e:
+    raise ValueError(f'{path}: invalid serving bundle: {e}') from e
+  if man is None:
+    raise ValueError(
+        f'{path}: not a serving bundle (no integrity manifest). Export '
+        'one from a training checkpoint: python tools/export_serving.py '
+        f'<checkpoint> --out {os.path.basename(path)}')
+  weights, states, extras = checkpoint._parse_train_payload(arrays, path)
+  if 'serving_format' not in extras:
+    raise ValueError(
+        f'{path}: not a serving bundle (missing the serving_format '
+        'marker) — this looks like a raw training checkpoint. Export '
+        'it first (tools/export_serving.py strips optimizer slots and '
+        'stamps the bundle format).')
+  if any(states):
+    raise ValueError(
+        f'{path}: bundle carries optimizer-state members '
+        '(corrupt export?). Re-export from the training checkpoint.')
+  configs = None
+  if 'tables' in extras:
+    configs = [
+        TableConfig(int(r), int(w), c)
+        for r, w, c in json.loads(str(np.asarray(extras['tables'])[()]))
+    ]
+  meta = {
+      'format': int(np.asarray(extras['serving_format'])),
+      'step': (int(np.asarray(extras['step'])) if 'step' in extras
+               else None),
+      'plan': man.get('plan'),
+      'source': (str(np.asarray(extras['source'])[()])
+                 if 'source' in extras else None),
+      'table_configs': configs,
+  }
+  return weights, meta

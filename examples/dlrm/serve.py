@@ -1,0 +1,132 @@
+"""Serve a trained DLRM checkpoint: export -> engine -> dynamic batcher.
+
+The serving leg of the DLRM example (docs/design.md §14).  Point it at
+a training checkpoint written by ``main.py --save_state`` (or a
+``--resume_dir`` checkpoint directory): it freezes the newest valid
+file into a read-only serving bundle (optimizer slots stripped,
+quantized tables kept narrow), restores the bundle into a
+``ServingEngine`` on this host's devices — routinely FEWER than the
+training mesh; the canonical checkpoint layout reshards on restore —
+and drives a simulated concurrent request stream through the
+``DynamicBatcher``, printing the measured p50/p99 latency, QPS and
+batch-fill for the batching off/on A/B.
+
+Example::
+
+    python examples/dlrm/main.py --synthetic --dp_input \
+        --save_state /tmp/dlrm_state.npz ...
+    python examples/dlrm/serve.py --checkpoint /tmp/dlrm_state.npz \
+        --batch 1024 --requests 512 --hot_coverage 0.98
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _REPO not in sys.path:
+  sys.path.insert(0, _REPO)
+
+import numpy as np
+
+
+def main():
+  parser = argparse.ArgumentParser(description=__doc__)
+  parser.add_argument('--checkpoint', required=True,
+                      help='save_train_npz file or checkpoint directory '
+                      '(newest valid file wins)')
+  parser.add_argument('--bundle', default=None,
+                      help='where to write the serving bundle '
+                      '(default: a temp file, deleted after the run)')
+  parser.add_argument('--embedding_dim', type=int, default=128)
+  parser.add_argument('--batch', type=int, default=1024,
+                      help='the ONE compiled serving batch')
+  parser.add_argument('--requests', type=int, default=512,
+                      help='simulated request count')
+  parser.add_argument('--request_sizes', default='1,2,4,8',
+                      help='request sample counts (cycled)')
+  parser.add_argument('--max_delay_ms', type=float, default=2.0,
+                      help='batcher admission deadline')
+  parser.add_argument('--concurrency', type=int, default=8,
+                      help='closed-loop in-flight requests')
+  parser.add_argument('--alpha', type=float, default=1.05,
+                      help='power-law exponent of the simulated ids')
+  parser.add_argument('--hot_coverage', type=float, default=0.98,
+                      help='serving hot-cache coverage target '
+                      '(0 disables the cache)')
+  parser.add_argument('--hot_budget_mb', type=float, default=512.0)
+  args = parser.parse_args()
+
+  import jax
+  from distributed_embeddings_tpu import serving
+  from distributed_embeddings_tpu.parallel import TableConfig, hotcache
+
+  bundle = args.bundle
+  tmp = None
+  if bundle is None:
+    tmp = tempfile.NamedTemporaryFile(suffix='.npz', delete=False)
+    bundle = tmp.name
+    tmp.close()
+  try:
+    summary = serving.export_bundle_from_checkpoint(args.checkpoint,
+                                                    bundle)
+    weights, _ = serving.load_serving_bundle(bundle)
+    # DLRM tables are hotness-1, combiner-free lookups (main.py's
+    # TableConfig default); shapes come from the verified bundle itself
+    configs = [TableConfig(int(w.shape[0]), int(w.shape[1]), None)
+               for w in weights]
+    print(f"bundle: {summary['tables']} table(s) from "
+          f"{os.path.basename(summary['source'])} step {summary['step']}"
+          f" [{','.join(summary['quantized']) or 'f32'}; "
+          f"{summary['stripped_state_leaves']} optimizer slot(s) "
+          'stripped]')
+
+    hot_sets = None
+    if args.hot_coverage > 0 and args.alpha > 0:
+      hot_sets = hotcache.analytic_power_law_hot_sets(
+          configs, args.alpha, coverage=args.hot_coverage,
+          budget_bytes=int(args.hot_budget_mb * 2**20), state_copies=0)
+    n_dev = len(jax.devices())
+    batch = max(n_dev, (args.batch // n_dev) * n_dev)
+    engine = serving.ServingEngine(configs, weights, batch_size=batch,
+                                   hot_sets=hot_sets)
+    print(f'engine: batch {batch} on {n_dev} device(s), '
+          f"table_dtype {engine.stats()['table_dtype']}, hot rows "
+          f'{sum(h.size for h in (hot_sets or {}).values())}')
+
+    # simulated power-law request traffic — the synthetic generators'
+    # own id law (swap in recorded production ids for a real sizing
+    # run); gen_power_law_data is the one shared definition
+    from distributed_embeddings_tpu.models.synthetic import (
+        gen_power_law_data)
+    rng = np.random.default_rng(0)
+    pool = []
+    for c in configs:
+      if args.alpha > 0:
+        ids = gen_power_law_data(rng, args.requests * 8, 1,
+                                 c.input_dim, args.alpha).reshape(-1)
+        pool.append(np.clip(ids, 0, c.input_dim - 1).astype(np.int32))
+      else:
+        pool.append(rng.integers(0, c.input_dim,
+                                 size=(args.requests * 8,)).astype(
+                                     np.int32))
+    sizes = [int(s) for s in args.request_sizes.split(',')]
+    requests = serving.split_requests(pool, sizes=sizes,
+                                      limit=args.requests)
+    stats = serving.measure_serving(engine, requests,
+                                    max_delay_ms=args.max_delay_ms,
+                                    concurrency=args.concurrency)
+    if hot_sets:
+      stats['serve_hot_hit_rate'] = serving.hot_hit_rate(
+          hot_sets, configs, list(range(len(configs))), requests)
+    print(json.dumps(stats))
+  finally:
+    if tmp is not None and os.path.exists(bundle):
+      os.remove(bundle)
+
+
+if __name__ == '__main__':
+  main()

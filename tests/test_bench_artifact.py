@@ -245,6 +245,47 @@ def test_a2a_overlap_measured_and_off_arm_counters_unchanged():
   assert 0.0 <= block['a2a_overlap_pct'] <= 1.0
 
 
+def test_serving_artifact_keys():
+  """The ISSUE-9 journaled proof: the serving off/on batching A/B block
+  bench folds into the artifact carries the pinned keys (serve_p50_ms /
+  serve_p99_ms / serve_qps + the no-batch arm and fill counters), the
+  percentiles are ordered, and both arms' QPS are real measurements —
+  so a future change that silently drops the serving measurement (or
+  renames its keys) fails tier-1 here."""
+  import jax
+  import numpy as np
+  from distributed_embeddings_tpu import serving
+  from distributed_embeddings_tpu.parallel import (TableConfig,
+                                                   create_mesh, hotcache)
+
+  cfgs = [TableConfig(64, 8, 'sum'), TableConfig(40, 8, 'sum')]
+  rng = np.random.default_rng(0)
+  weights = [(rng.normal(size=(c.input_dim, c.output_dim)) * 0.1)
+             .astype(np.float32) for c in cfgs]
+  hot = {0: hotcache.HotSet(0, np.arange(8))}
+  engine = serving.ServingEngine(
+      cfgs, weights, batch_size=16,
+      mesh=create_mesh(jax.devices()[:1]), hot_sets=hot)
+  cats = [rng.integers(0, c.input_dim, size=(32,)).astype(np.int32)
+          for c in cfgs]
+  requests = serving.split_requests(cats, sizes=(1, 2, 4))
+  st = serving.measure_serving(engine, requests, max_delay_ms=1.0,
+                               concurrency=4)
+  for key in ('serve_p50_ms', 'serve_p99_ms', 'serve_qps',
+              'serve_batches', 'serve_batch_fill', 'serve_requests',
+              'serve_batch', 'serve_max_delay_ms', 'serve_concurrency',
+              'serve_nobatch_p50_ms', 'serve_nobatch_p99_ms',
+              'serve_nobatch_qps'):
+    assert key in st, key
+  assert st['serve_requests'] == len(requests)
+  assert 0 < st['serve_p50_ms'] <= st['serve_p99_ms']
+  assert st['serve_qps'] > 0 and st['serve_nobatch_qps'] > 0
+  assert 0 < st['serve_batch_fill'] <= 1.0
+  # the hit-rate twin bench journals alongside: exact, host-side
+  rate = serving.hot_hit_rate(hot, cfgs, [0, 1], requests)
+  assert 0.0 <= rate <= 1.0
+
+
 def test_split_windows(bench):
   assert bench.split_windows(20, 3) == [7, 7, 6]
   assert bench.split_windows(2, 5) == [1, 1]   # never more windows than steps

@@ -1,0 +1,540 @@
+"""Online serving subsystem (design §14): export bundle, engine,
+dynamic batcher, read-only tier, and the satellite contracts.
+
+The load-bearing claims pinned here:
+
+- a serving bundle strips every optimizer slot, keeps quantized tables
+  NARROW on disk, embeds an integrity manifest + the table meta, and
+  refuses to load when corrupt or when handed a raw training
+  checkpoint;
+- an int8 bundle written under one device count restores into a plan
+  with a DIFFERENT device count (and tier split) WITHOUT the f32 table
+  ever materialising on the restore host, bit-exactly (satellite 1);
+- batched serving output demuxes BIT-EXACT vs running each request
+  through the forward individually (hotness-1 exact; multi-hot bags
+  within the pinned 1e-6 fold-order bound vs the training layer),
+  including under fuzzed concurrent submission;
+- the batcher admission policy: empty requests resolve immediately,
+  oversized requests refuse actionably, hotness overflow refuses;
+- ``CsrFeed`` accepts a bounded in-memory ``QueueSource`` and its
+  ``stats()`` gain queue-depth / drop counters (satellite 2);
+- the serving cold tier is fetch-only: digests verify every fetched
+  row, and any write path refuses on the frozen tier.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_embeddings_tpu.parallel import (DistributedEmbedding,
+                                                 QueueSource, TableConfig,
+                                                 create_mesh,
+                                                 export_tables,
+                                                 save_train_npz,
+                                                 set_weights)
+from distributed_embeddings_tpu.parallel import checkpoint, hotcache
+from distributed_embeddings_tpu.parallel.coldtier import TierIntegrityError
+from distributed_embeddings_tpu.parallel.hotcache import HotSet
+from distributed_embeddings_tpu import serving
+from distributed_embeddings_tpu.serving.bench import measure_serving
+
+CONFIGS = [
+    TableConfig(48, 8, 'sum'),
+    TableConfig(32, 8, 'sum'),
+    TableConfig(40, 4, None),
+]
+HOT_TRAIN = {
+    0: HotSet(0, np.array([0, 1, 2, 5])),
+    1: HotSet(1, np.arange(4)),
+}
+HOT_SERVE = {
+    0: HotSet(0, np.array([3, 7, 9])),
+    1: HotSet(1, np.array([0, 8, 20, 31])),
+}
+HOTNESS = (1, 3, 1)
+BATCH = 16
+
+
+def _ids(rng, n=BATCH):
+  out = [rng.integers(0, CONFIGS[0].input_dim, size=(n,)).astype(np.int32)]
+  multi = rng.integers(0, CONFIGS[1].input_dim, size=(n, 3)).astype(
+      np.int32)
+  if n > 2:
+    multi[1, 2] = -1                        # padding inside a bag
+    multi[2, 0] = CONFIGS[1].input_dim + 7  # out-of-vocab
+  out.append(multi)
+  out.append(rng.integers(0, CONFIGS[2].input_dim, size=(n,)).astype(
+      np.int32))
+  return out
+
+
+@pytest.fixture(scope='module')
+def served(tmp_path_factory):
+  """One trained-shape int8 source (8-device mesh), its bundle, a
+  2-device serving engine under a DIFFERENT hot set, and the training
+  forward's reference outputs."""
+  td = tmp_path_factory.mktemp('serving')
+  rng = np.random.default_rng(0)
+  weights = [(rng.normal(size=(c.input_dim, c.output_dim)) * 0.1).astype(
+      np.float32) for c in CONFIGS]
+  mesh8 = create_mesh(jax.devices()[:8])
+  train = DistributedEmbedding(CONFIGS, mesh=mesh8, dp_input=True,
+                               hot_cache=HOT_TRAIN, table_dtype='int8')
+  params = set_weights(train, weights)
+  ckpt = os.path.join(td, 'ckpt_7.npz')
+  save_train_npz(ckpt, export_tables(train, params),
+                 [{'acc': np.abs(w) + 0.1} for w in weights],
+                 extras={'step': np.int64(7)}, plan=train)
+  bundle = os.path.join(td, 'bundle.npz')
+  summary = serving.export_bundle_from_checkpoint(
+      ckpt, bundle, table_configs=CONFIGS)
+  engine = serving.ServingEngine.from_bundle(
+      bundle, mesh=create_mesh(jax.devices()[:2]), batch_size=BATCH,
+      hot_sets=HOT_SERVE, hotness=HOTNESS)
+  ids = _ids(np.random.default_rng(1))
+  ref = [np.asarray(x) for x in train.apply(params, ids)]
+  return dict(td=td, rng=rng, weights=weights, train=train,
+              params=params, ckpt=ckpt, bundle=bundle, summary=summary,
+              engine=engine, ids=ids, ref=ref)
+
+
+# ---------------------------------------------------------------- export
+
+
+class TestExportBundle:
+
+  def test_bundle_strips_state_and_stays_narrow(self, served):
+    assert served['summary']['stripped_state_leaves'] == len(CONFIGS)
+    assert served['summary']['quantized'] == ['int8']
+    assert served['summary']['step'] == 7
+    with np.load(served['bundle']) as zf:
+      # int8 payload + scale sidecars only — never widened, no slots
+      assert zf['table0'].dtype == np.int8
+      assert zf['table0:scale'].dtype == np.float32
+      assert not any(k.startswith('table') and '/' in k
+                     for k in zf.files), zf.files
+
+  def test_load_meta_and_embedded_configs(self, served):
+    weights, meta = serving.load_serving_bundle(served['bundle'])
+    assert meta['step'] == 7
+    assert meta['plan'] == checkpoint.plan_fingerprint(served['train'])
+    got = [(c.input_dim, c.output_dim, c.combiner)
+           for c in meta['table_configs']]
+    assert got == [(c.input_dim, c.output_dim, c.combiner)
+                   for c in CONFIGS]
+    assert all(isinstance(w, checkpoint.QuantizedWeight)
+               for w in weights)
+
+  def test_raw_train_checkpoint_refuses(self, served):
+    with pytest.raises(ValueError, match='serving_format'):
+      serving.load_serving_bundle(served['ckpt'])
+
+  def test_corrupt_bundle_refuses(self, served, tmp_path):
+    from distributed_embeddings_tpu.utils import faultinject
+    bad = str(tmp_path / 'bad.npz')
+    import shutil
+    shutil.copy(served['bundle'], bad)
+    faultinject.flip_bytes(bad, count=8, seed=3)
+    with pytest.raises(ValueError, match='invalid serving bundle'):
+      serving.load_serving_bundle(bad)
+
+  def test_manifest_less_file_refuses(self, served, tmp_path):
+    plain = str(tmp_path / 'plain.npz')
+    checkpoint.save_npz(plain, served['weights'])  # deliberately no manifest
+    with pytest.raises(ValueError, match='manifest'):
+      serving.load_serving_bundle(plain)
+
+  def test_live_export_matches_checkpoint_export(self, served, tmp_path):
+    live = str(tmp_path / 'live.npz')
+    serving.export_serving_bundle(served['train'], served['params'],
+                                  live, step=7)
+    a, ma = serving.load_serving_bundle(live)
+    b, mb = serving.load_serving_bundle(served['bundle'])
+    assert ma['table_configs'] is not None
+    for x, y in zip(a, b):
+      np.testing.assert_array_equal(x.payload, y.payload)
+      np.testing.assert_array_equal(x.scale, y.scale)
+
+
+# ------------------------------------------- cross-device-count restore
+
+
+class TestCrossDeviceRestore:
+
+  def test_quantized_restore_never_widens(self, served, monkeypatch):
+    """Satellite 1: an int8 bundle written under 8 devices restores
+    into a 2-device int8 plan (different hot set too) with the f32
+    canonical values NEVER materialised — and re-exports the identical
+    payload+scale bits."""
+    weights, _ = serving.load_serving_bundle(served['bundle'])
+    dist2 = DistributedEmbedding(
+        CONFIGS, mesh=create_mesh(jax.devices()[:2]), dp_input=True,
+        hot_cache={2: HotSet(2, np.array([1, 2]))}, table_dtype='int8')
+
+    def boom(w):
+      raise AssertionError(
+          'set_weights widened a matching-dtype QuantizedWeight to f32')
+
+    monkeypatch.setattr(checkpoint, '_canonical_values', boom)
+    p2 = set_weights(dist2, weights)
+    monkeypatch.undo()
+    for a, b in zip(weights, export_tables(dist2, p2)):
+      np.testing.assert_array_equal(np.asarray(a.payload),
+                                    np.asarray(b.payload))
+      np.testing.assert_array_equal(np.asarray(a.scale),
+                                    np.asarray(b.scale))
+
+  def test_engine_forward_parity_across_device_counts(self, served):
+    """The 2-device engine (different hot set, restored from the
+    bundle) reproduces the 8-device training forward: hotness-1 inputs
+    bit-exact, the multi-hot input within the pinned fold-order
+    bound."""
+    got = served['engine'].lookup_padded(served['ids'])
+    for i, (a, b) in enumerate(zip(served['ref'], got)):
+      if HOTNESS[i] == 1:
+        np.testing.assert_array_equal(a, b)
+      else:
+        np.testing.assert_allclose(a, b, rtol=0, atol=1e-6)
+
+
+# ---------------------------------------------------------------- engine
+
+
+class TestEngine:
+
+  def test_one_compiled_signature(self, served):
+    eng = served['engine']
+    eng.lookup_padded([c[:3] for c in served['ids']])
+    eng.lookup_padded([c[:1] for c in served['ids']])
+    sigs = {k for k in eng.dist._fn_cache if k[0].startswith('dp_fwd')}
+    assert len(sigs) == 1, sigs
+
+  def test_batch_size_must_divide(self):
+    with pytest.raises(ValueError, match='multiple'):
+      serving.ServingEngine(CONFIGS, [np.zeros((c.input_dim,
+                                                c.output_dim),
+                                               np.float32)
+                                      for c in CONFIGS],
+                            batch_size=9,
+                            mesh=create_mesh(jax.devices()[:2]))
+
+  def test_oversized_direct_request_refuses(self, served):
+    big = _ids(np.random.default_rng(5), n=BATCH + 4)
+    with pytest.raises(ValueError, match='exceed'):
+      served['engine'].lookup_padded(big)
+
+  def test_empty_direct_request(self, served):
+    out = served['engine'].lookup_padded([c[:0] for c in served['ids']])
+    assert [o.shape for o in out] == [(0, 8), (0, 8), (0, 4)]
+
+
+# --------------------------------------------------------------- batcher
+
+
+class TestBatcher:
+
+  def test_admission_edges(self, served):
+    with serving.DynamicBatcher(served['engine'],
+                                max_delay_ms=2.0) as bat:
+      # empty request: immediate, occupies no batch space
+      fut = bat.submit([c[:0] for c in served['ids']])
+      out = fut.result(timeout=5.0)
+      assert [o.shape for o in out] == [(0, 8), (0, 8), (0, 4)]
+      assert fut.latency_ms == 0.0
+      # oversized: refuses actionably at submit
+      big = _ids(np.random.default_rng(6), n=BATCH + 1)
+      with pytest.raises(ValueError, match='never silently split'):
+        bat.submit(big)
+      # hotness overflow: refuses at submit
+      wide = [c.copy() for c in served['ids']]
+      wide[1] = np.concatenate([wide[1], wide[1]], axis=1)
+      with pytest.raises(ValueError, match='hot cap'):
+        bat.submit(wide)
+      # single-id request: demux bit-exact vs the direct forward
+      one = [c[:1] for c in served['ids']]
+      got = bat.submit(one).result(timeout=30.0)
+      want = served['engine'].lookup_padded(one)
+      for a, b in zip(want, got):
+        np.testing.assert_array_equal(a, b)
+
+  def test_demux_bitexact_vs_direct(self, served):
+    reqs = serving.split_requests(served['ids'], sizes=(1, 3, 2, 5))
+    with serving.DynamicBatcher(served['engine'],
+                                max_delay_ms=10.0) as bat:
+      futs = [bat.submit(r) for r in reqs]
+      outs = [f.result(timeout=60.0) for f in futs]
+      st = bat.stats()
+    assert st['completed'] == len(reqs)
+    assert st['p50_ms'] is not None and st['p99_ms'] >= st['p50_ms']
+    assert 0 < st['batch_fill'] <= 1.0
+    for r, out in zip(reqs, outs):
+      want = served['engine'].lookup_padded(r)
+      for a, b in zip(want, out):
+        np.testing.assert_array_equal(a, b)
+
+  def test_fuzzed_concurrent_parity(self, served):
+    """Many concurrent requests from worker threads: every demuxed
+    result is identical to the same request run alone through the same
+    program — batching is pure scheduling (same compiled forward, so
+    even the multi-hot input compares bit-exact here)."""
+    rng = np.random.default_rng(11)
+    reqs = []
+    for _ in range(36):
+      n = int(rng.integers(1, 6))
+      r = _ids(rng, n=n)
+      mask = rng.random(size=r[1].shape) < 0.2
+      r[1] = np.where(mask, -1, r[1]).astype(np.int32)
+      reqs.append(r)
+    results = [None] * len(reqs)
+    with serving.DynamicBatcher(served['engine'],
+                                max_delay_ms=1.0) as bat:
+      def worker(lo):
+        for i in range(lo, len(reqs), 6):
+          results[i] = bat.submit(reqs[i]).result(timeout=60.0)
+
+      threads = [threading.Thread(target=worker, args=(k,))
+                 for k in range(6)]
+      for t in threads:
+        t.start()
+      for t in threads:
+        t.join()
+      assert bat.stats()['completed'] == len(reqs)
+    for r, out in zip(reqs, results):
+      want = served['engine'].lookup_padded(r)
+      for a, b in zip(want, out):
+        np.testing.assert_array_equal(a, b)
+
+  def test_bad_rank_refuses_and_dispatcher_survives(self, served):
+    """A 3-D id array refuses at submit (it would otherwise blow up
+    inside the dispatcher's merge and kill the thread), and the
+    batcher keeps serving afterwards."""
+    with serving.DynamicBatcher(served['engine'],
+                                max_delay_ms=1.0) as bat:
+      bad = [c.copy() for c in served['ids']]
+      bad[0] = bad[0].reshape(4, 2, 2)
+      with pytest.raises(ValueError, match='1-D or 2-D'):
+        bat.submit(bad)
+      one = [c[:1] for c in served['ids']]
+      got = bat.submit(one).result(timeout=30.0)
+      want = served['engine'].lookup_padded(one)
+      for a, b in zip(want, got):
+        np.testing.assert_array_equal(a, b)
+
+  def test_close_fails_pending_cleanly(self, served):
+    bat = serving.DynamicBatcher(served['engine'], max_delay_ms=1.0)
+    bat.close()
+    with pytest.raises(RuntimeError, match='closed'):
+      bat.submit([c[:1] for c in served['ids']])
+
+
+# ------------------------------------------------- QueueSource / CsrFeed
+
+
+class TestQueueSource:
+
+  def test_put_drop_close_iterate(self):
+    qs = QueueSource(maxsize=2)
+    assert qs.put('a') and qs.put('b')
+    assert not qs.put('c', block=False)   # full: dropped, counted
+    assert qs.dropped == 1
+    assert qs.qsize() == 2
+    qs.close()
+    with pytest.raises(RuntimeError, match='closed'):
+      qs.put('d')
+    assert list(qs) == ['a', 'b']         # queued items drain, then stop
+
+  def test_csr_feed_over_queue_source(self, served):
+    """Satellite 2: the feed consumes an in-memory queue (no disk) and
+    its stats() gain queue-depth and drop counters."""
+    qs = QueueSource(maxsize=4)
+    feed = served['engine'].dist.make_csr_feed(
+        qs, cats_fn=lambda item: [np.asarray(c) for c in item])
+    rng = np.random.default_rng(3)
+    batches = [_ids(rng) for _ in range(3)]
+    for b in batches:
+      qs.put(b)
+    qs.close()
+    got = list(feed)
+    assert len(got) == 3
+    assert all(fed.csrs for fed in got)
+    st = feed.stats()
+    assert st['queue_depth'] == 0
+    assert st['queue_dropped'] == 0
+    assert st['batches'] == 3
+    feed.close()
+
+  def test_batcher_csr_feed_mode_parity(self, served):
+    reqs = serving.split_requests(served['ids'], sizes=(2, 3))
+    with serving.DynamicBatcher(served['engine'], max_delay_ms=10.0,
+                                csr_feed=True) as bat:
+      futs = [bat.submit(r) for r in reqs]
+      outs = [f.result(timeout=60.0) for f in futs]
+      st = bat.stats()
+    assert 'csr_feed' in st
+    assert st['csr_feed']['batches'] >= 1
+    assert 'queue_dropped' in st['csr_feed']
+    for r, out in zip(reqs, outs):
+      want = served['engine'].lookup_padded(r)
+      for a, b in zip(want, out):
+        np.testing.assert_array_equal(a, b)
+
+
+# ------------------------------------------------------- read-only tier
+
+
+class TestReadOnlyTier:
+
+  @pytest.fixture(scope='class')
+  def tiered(self, served):
+    weights, _ = serving.load_serving_bundle(served['bundle'])
+    mesh2 = create_mesh(jax.devices()[:2])
+    probe = DistributedEmbedding(CONFIGS, mesh=mesh2, dp_input=True,
+                                 hot_cache=HOT_TRAIN,
+                                 table_dtype='int8')
+    budget = max(int(probe.plan.resident_table_bytes() * 0.6),
+                 probe.plan.hot_buffer_bytes() + 512)
+    eng = serving.ServingEngine(CONFIGS, weights, batch_size=BATCH,
+                                mesh=mesh2, hot_sets=HOT_TRAIN,
+                                hotness=HOTNESS, cold_tier=True,
+                                device_hbm_budget=budget)
+    assert eng.dist.plan.cold_tier_groups, 'budget did not engage the tier'
+    eng.warmup(sample_cats=served['ids'])
+    return eng
+
+  def test_tiered_engine_parity(self, served, tiered):
+    got = tiered.lookup_padded(served['ids'])
+    for i, (a, b) in enumerate(zip(served['ref'], got)):
+      if HOTNESS[i] == 1:
+        np.testing.assert_array_equal(a, b)
+      else:
+        np.testing.assert_allclose(a, b, rtol=0, atol=1e-6)
+
+  def test_frozen_tier_refuses_writes(self, tiered):
+    tier = tiered.dist.cold_tier
+    assert tier.frozen and tier.digests_enabled
+    gi = tiered.dist.plan.cold_tier_groups[0]
+    with pytest.raises(RuntimeError, match='read-only'):
+      tier.set_tail(gi, 'payload', tier.payload[gi])
+    with pytest.raises(RuntimeError, match='read-only'):
+      tier.set_opt_tail(gi, 'acc', tier.payload[gi])
+    with pytest.raises(RuntimeError, match='read-only'):
+      from distributed_embeddings_tpu.parallel import coldtier
+      coldtier.write_back(tiered.dist, None, {gi: {}})
+
+  def test_corrupt_tier_row_refuses_at_fetch(self, served, tiered):
+    """Fetch-time digest verification: a flipped host byte fails the
+    lookup that would gather it, with provenance, BEFORE damaged bytes
+    reach the device."""
+    tier = tiered.dist.cold_tier
+    gi = tiered.dist.plan.cold_tier_groups[0]
+    orig = tier.payload[gi][0, 0, 0]
+    tier.payload[gi][0, 0, 0] = np.int8(int(orig) ^ 1)
+    try:
+      g = tiered.dist.plan.groups[gi]
+      res = g.device_rows
+      # ids that route to device 0's first tail row for some request
+      hit = None
+      for r in g.requests[0]:
+        lo = r.row_start + (res - r.row_offset)
+        if r.row_start <= lo < r.row_end:
+          hit = (r.input_id, lo)
+          break
+      assert hit is not None
+      cats = [np.zeros((4,), np.int32) if h == 1
+              else np.zeros((4, h), np.int32)
+              for h in HOTNESS]
+      cats[hit[0]] = np.full_like(cats[hit[0]], hit[1])
+      with pytest.raises(TierIntegrityError):
+        tiered.lookup_padded(cats)
+    finally:
+      tier.payload[gi][0, 0, 0] = orig
+      tier.refresh_rows(gi, 0, np.array([0]))
+
+  def test_compile_lookup_needs_caps_first(self, served):
+    weights, _ = serving.load_serving_bundle(served['bundle'])
+    mesh2 = create_mesh(jax.devices()[:2])
+    probe = DistributedEmbedding(CONFIGS, mesh=mesh2, dp_input=True,
+                                 hot_cache=HOT_TRAIN,
+                                 table_dtype='int8')
+    budget = max(int(probe.plan.resident_table_bytes() * 0.6),
+                 probe.plan.hot_buffer_bytes() + 512)
+    cold = DistributedEmbedding(CONFIGS, mesh=mesh2, dp_input=True,
+                                hot_cache=HOT_TRAIN, table_dtype='int8',
+                                cold_tier=True,
+                                device_hbm_budget=budget)
+    with pytest.raises(ValueError, match='fetch capacity'):
+      cold.compile_lookup(BATCH, HOTNESS)
+
+
+# --------------------------------------------------- serving hot selection
+
+
+def test_serving_hot_sets_defaults():
+  """serving_hot_sets = calibrate_hot_sets with read-only economics:
+  state_copies=0 (a budget funds 2x the rows training replication
+  would) and a much larger default coverage."""
+  cfgs = [TableConfig(64, 8, 'sum')]
+  rng = np.random.default_rng(0)
+  ids = np.minimum(
+      rng.geometric(0.15, size=(512,)).astype(np.int64) - 1, 63)
+  batches = [[ids]]
+  low = hotcache.calibrate_hot_sets(cfgs, [0], batches, coverage=0.5)
+  high = hotcache.serving_hot_sets(cfgs, [0], batches)
+  assert high[0].size > low[0].size
+  assert high[0].coverage >= 0.99 or high[0].size == int(
+      (np.bincount(ids, minlength=64) > 0).sum())
+  # a byte budget buys twice the rows when no optimizer copy rides
+  budget = hotcache.hot_row_bytes(8, state_copies=0) * 4
+  srv = hotcache.serving_hot_sets(cfgs, [0], batches,
+                                  budget_bytes=budget)
+  trn = hotcache.calibrate_hot_sets(cfgs, [0], batches, coverage=0.99,
+                                    budget_bytes=budget, state_copies=1)
+  assert srv[0].size >= 2 * trn[0].size
+
+
+# --------------------------------------------------------- artifact block
+
+
+def test_measure_serving_block(served):
+  reqs = serving.split_requests(served['ids'], sizes=(1, 2))[:6]
+  st = measure_serving(served['engine'], reqs, max_delay_ms=1.0,
+                       concurrency=3)
+  for key in ('serve_p50_ms', 'serve_p99_ms', 'serve_qps',
+              'serve_batches', 'serve_batch_fill',
+              'serve_nobatch_p50_ms', 'serve_nobatch_p99_ms',
+              'serve_nobatch_qps', 'serve_requests', 'serve_batch'):
+    assert key in st, key
+  assert st['serve_requests'] == len(reqs)
+  assert st['serve_qps'] > 0 and st['serve_nobatch_qps'] > 0
+  assert st['serve_p99_ms'] >= st['serve_p50_ms'] > 0
+  assert 0 < st['serve_batch_fill'] <= 1.0
+  rate = serving.hot_hit_rate(HOT_SERVE, CONFIGS, [0, 1, 2], reqs)
+  assert 0.0 <= rate <= 1.0
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def test_export_cli_round_trip(served, tmp_path):
+  import subprocess
+  import sys
+  out = str(tmp_path / 'cli_bundle.npz')
+  repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+  proc = subprocess.run(
+      [sys.executable, os.path.join(repo, 'tools', 'export_serving.py'),
+       served['ckpt'], '--out', out, '--tables',
+       '48,8,sum;32,8,sum;40,4,none'],
+      capture_output=True, text=True, timeout=120,
+      env={**os.environ, 'JAX_PLATFORMS': 'cpu'})
+  assert proc.returncode == 0, proc.stderr
+  assert 'optimizer slot(s) stripped' in proc.stdout
+  weights, meta = serving.load_serving_bundle(out)
+  assert meta['table_configs'][0].combiner == 'sum'
+  assert meta['table_configs'][2].combiner is None
+  ref, _ = serving.load_serving_bundle(served['bundle'])
+  for a, b in zip(weights, ref):
+    np.testing.assert_array_equal(a.payload, b.payload)

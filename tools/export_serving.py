@@ -1,0 +1,99 @@
+#!/usr/bin/env python3
+"""Export a serving bundle from a training checkpoint (design §14).
+
+Freezes one ``save_train_npz`` checkpoint (or the newest VALID file of
+a checkpoint directory) into a read-only serving bundle: optimizer
+slots stripped, quantized tables kept as their stored payload+scale
+bits (never widened to f32), integrity manifest embedded, and the
+serving-format marker stamped so ``serving.load_serving_bundle`` /
+``ServingEngine.from_bundle`` accept the file.  The source checkpoint
+is sha256-verified before anything is written; corrupt inputs fail
+with the rejection reason instead of exporting damaged bytes.
+
+The checkpoint records table shapes but not combiners — pass
+``--combiner`` (applied to every table) or ``--tables r,w,comb;...``
+to embed the per-table meta, so the serving host needs zero model
+code; omit both and ``ServingEngine.from_bundle`` will require
+explicit ``table_configs=``.
+
+Usage::
+
+    python tools/export_serving.py CKPT_DIR --out bundle.npz
+    python tools/export_serving.py ckpt_000100.npz --out bundle.npz \
+        --combiner sum
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# invocable as `python tools/export_serving.py ...` from anywhere:
+# the repo root (one level up) carries the package
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+  sys.path.insert(0, _REPO)
+
+
+def _parse_tables(spec):
+  """``'rows,width,comb;rows,width,comb;...'`` -> TableConfig list
+  (``comb``: none / sum / mean)."""
+  from distributed_embeddings_tpu.parallel import TableConfig
+  out = []
+  for part in spec.split(';'):
+    r, w, c = (x.strip() for x in part.split(','))
+    out.append(TableConfig(int(r), int(w),
+                           None if c.lower() == 'none' else c.lower()))
+  return out
+
+
+def main(argv=None) -> int:
+  parser = argparse.ArgumentParser(
+      description=__doc__,
+      formatter_class=argparse.RawDescriptionHelpFormatter)
+  parser.add_argument('checkpoint',
+                      help='a save_train_npz file, or a checkpoint '
+                      'directory (newest valid file wins)')
+  parser.add_argument('--out', required=True,
+                      help='bundle output path (.npz)')
+  parser.add_argument('--combiner', default=None,
+                      choices=['none', 'sum', 'mean'],
+                      help='embed per-table meta with this combiner '
+                      'applied to every table')
+  parser.add_argument('--tables', default=None,
+                      help="explicit per-table meta: 'rows,width,comb;"
+                      "rows,width,comb;...' (overrides --combiner)")
+  args = parser.parse_args(argv)
+
+  from distributed_embeddings_tpu.serving import (
+      export_bundle_from_checkpoint)
+
+  configs = None
+  if args.tables:
+    configs = _parse_tables(args.tables)
+  comb = 'unset'
+  if configs is None and args.combiner is not None:
+    # shapes come from the verified checkpoint itself; only the
+    # combiner is user-supplied (ONE verify+export pass)
+    comb = None if args.combiner == 'none' else args.combiner
+  try:
+    summary = export_bundle_from_checkpoint(args.checkpoint, args.out,
+                                            table_configs=configs,
+                                            combiner=comb)
+  except (ValueError, FileNotFoundError) as e:
+    print(f'export failed: {e}', file=sys.stderr)
+    return 1
+  qn = ','.join(summary['quantized']) or 'f32'
+  step = summary['step'] if summary['step'] is not None else '?'
+  size = os.path.getsize(args.out)
+  print(f"exported {summary['tables']} table(s) from "
+        f"{os.path.basename(summary['source'])} (step {step}) -> "
+        f"{args.out} [{qn}; {size} bytes; "
+        f"{summary['stripped_state_leaves']} optimizer slot(s) "
+        'stripped]')
+  return 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())
