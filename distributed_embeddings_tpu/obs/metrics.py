@@ -1,0 +1,367 @@
+"""Metrics registry: one named schema over every runtime counter.
+
+The aggregation half of the observability layer (docs/design.md §15).
+Before it, runtime visibility lived in per-component ``stats()`` dicts
+(``CsrFeed``, ``ColdFetchPipeline``, ``DynamicBatcher``,
+``ServingEngine``) plus inline ``perf_counter`` timings — four
+disjoint vocabularies nobody could join.  This module holds:
+
+- the process-global registry: counters / gauges / fixed-bucket
+  histograms under the documented ``REGISTERED_METRICS`` schema,
+  updated through ``inc``/``set_gauge``/``observe`` (each a single
+  flag check when the registry is disabled — the default), snapshot
+  through ``snapshot()`` / ``prometheus_text()`` /
+  ``journal_snapshot()`` (the existing ``resilience.journal`` sink,
+  event kind ``metrics_snapshot``);
+- the shared LOCAL primitives the components' ``stats()`` are built
+  on (``OverlapStat``, ``LatencyWindow``, ``Histogram``): the three
+  hand-rolled blocked-time/overlap implementations (csr_feed,
+  coldtier, serving batcher) now share one accounting, with every
+  pre-existing ``stats()`` key bit-compatible (pinned by the existing
+  tests).  Local primitives are always live — they ARE the component
+  stats — while the global registry mirror engages only when enabled.
+
+Metric-name discipline: runtime call sites must use names from
+``REGISTERED_METRICS`` (typed in ``METRIC_TYPES``); ``inc`` & co
+raise on an unknown name so a typo fails the first test that crosses
+it, and tests/test_obs.py source-scans every literal.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from distributed_embeddings_tpu.utils import resilience
+
+# The complete metric schema: name -> instrument type.  ``*_ms`` names
+# are millisecond histograms over DEFAULT_MS_BUCKETS; counters are
+# monotone totals; gauges are last-written values.  Add a name HERE in
+# the same change that introduces the call site (docs/design.md §15).
+METRIC_TYPES: Dict[str, str] = {
+    # training driver (parallel/grad.py fit)
+    'train.steps': 'counter',
+    'train.anomalies': 'counter',
+    'train.rollbacks': 'counter',
+    'train.loss': 'gauge',
+    'train.sync_ms': 'histogram',
+    # host CSR feed (parallel/csr_feed.py)
+    'feed.batches': 'counter',
+    'feed.skipped': 'counter',
+    'feed.io_retries': 'counter',
+    'feed.respawns': 'counter',
+    'feed.queue_dropped': 'counter',
+    'feed.queue_depth': 'gauge',
+    'feed.build_ms': 'histogram',
+    'feed.blocked_ms': 'histogram',
+    # cold tier (parallel/coldtier.py)
+    'coldtier.batches': 'counter',
+    'coldtier.fetch_rows': 'counter',
+    'coldtier.prepass_ms': 'histogram',
+    'coldtier.blocked_ms': 'histogram',
+    # state-integrity auditor (parallel/audit.py)
+    'audit.calls': 'counter',
+    'audit.findings': 'counter',
+    'audit.call_ms': 'histogram',
+    # checkpoints (parallel/checkpoint.py)
+    'ckpt.saves': 'counter',
+    'ckpt.restores': 'counter',
+    'ckpt.save_ms': 'histogram',
+    'ckpt.restore_ms': 'histogram',
+    # serving (serving/batcher.py + serving/engine.py)
+    'serve.submitted': 'counter',
+    'serve.completed': 'counter',
+    'serve.batches': 'counter',
+    'serve.batch_fill': 'gauge',
+    'serve.latency_ms': 'histogram',
+    'engine.lookups': 'counter',
+    'engine.samples': 'counter',
+    'engine.lookup_ms': 'histogram',
+}
+
+REGISTERED_METRICS = frozenset(METRIC_TYPES)
+
+# ~x2-2.5 geometric ladder, 10 us .. 60 s: percentile estimates from
+# bucket counts are bounded by one bucket's width (the resolution
+# contract tests/test_obs.py pins against exact NumPy percentiles).
+DEFAULT_MS_BUCKETS: Tuple[float, ...] = (
+    0.01, 0.02, 0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0,
+    100.0, 200.0, 500.0, 1000.0, 2000.0, 5000.0, 10000.0, 30000.0,
+    60000.0)
+
+
+class Histogram:
+  """Fixed-bucket histogram: ``buckets`` are ascending upper bounds
+  (one overflow bucket rides implicitly).  Percentiles resolve to the
+  containing bucket under the inverted-CDF rank convention, so the
+  exact sample percentile always lies inside ``percentile_bounds``."""
+
+  __slots__ = ('buckets', 'counts', 'count', 'sum', '_min', '_max')
+
+  def __init__(self, buckets: Iterable[float] = DEFAULT_MS_BUCKETS):
+    self.buckets = tuple(float(b) for b in buckets)
+    if list(self.buckets) != sorted(set(self.buckets)):
+      raise ValueError('histogram buckets must be strictly ascending')
+    self.counts = [0] * (len(self.buckets) + 1)
+    self.count = 0
+    self.sum = 0.0
+    self._min = None
+    self._max = None
+
+  def observe(self, value: float):
+    v = float(value)
+    i = int(np.searchsorted(self.buckets, v, side='left'))
+    self.counts[i] += 1
+    self.count += 1
+    self.sum += v
+    self._min = v if self._min is None else min(self._min, v)
+    self._max = v if self._max is None else max(self._max, v)
+
+  def percentile_bounds(self, p: float) -> Optional[Tuple[float, float]]:
+    """(lo, hi) of the bucket holding the p-th percentile (inverted-CDF
+    rank), tightened by the observed min/max; None when empty."""
+    if not self.count:
+      return None
+    rank = min(self.count, max(1, int(np.ceil(p / 100.0 * self.count))))
+    cum = 0
+    for i, c in enumerate(self.counts):
+      cum += c
+      if cum >= rank:
+        lo = self.buckets[i - 1] if i > 0 else 0.0
+        hi = self.buckets[i] if i < len(self.buckets) else self._max
+        return (max(lo, self._min), min(hi, self._max))
+    return (self._min, self._max)  # unreachable; defensive
+
+  def percentile(self, p: float) -> Optional[float]:
+    """Point estimate: the containing bucket's upper bound (clamped to
+    observed extremes) — error bounded by that bucket's width."""
+    b = self.percentile_bounds(p)
+    return None if b is None else b[1]
+
+  def to_dict(self) -> Dict[str, Any]:
+    return {
+        'count': self.count,
+        'sum': round(self.sum, 6),
+        'min': self._min,
+        'max': self._max,
+        'p50': self.percentile(50),
+        'p99': self.percentile(99),
+        'buckets': [[le, c] for le, c in zip(self.buckets, self.counts)
+                    if c] + ([['+Inf', self.counts[-1]]]
+                             if self.counts[-1] else []),
+    }
+
+  def reset(self):
+    self.counts = [0] * (len(self.buckets) + 1)
+    self.count = 0
+    self.sum = 0.0
+    self._min = None
+    self._max = None
+
+
+class OverlapStat:
+  """The ONE blocked-time/overlap accounting (previously hand-rolled
+  three times): ``build_ms`` is producer work wall, ``blocked_ms`` the
+  consumer's wait for it — i.e. producer time NOT hidden behind the
+  consumer's own work; ``overlap_frac`` is the hidden share."""
+
+  __slots__ = ('batches', 'build_ms', 'blocked_ms')
+
+  def __init__(self):
+    self.reset()
+
+  def reset(self):
+    self.batches = 0
+    self.build_ms = 0.0
+    self.blocked_ms = 0.0
+
+  def add_build(self, ms: float):
+    self.build_ms += ms
+
+  def add_blocked(self, ms: float):
+    self.blocked_ms += ms
+
+  def count_batch(self, n: int = 1):
+    self.batches += n
+
+  def overlap_frac(self) -> float:
+    """Hidden share in [0, 1]; 0.0 with no recorded build."""
+    if self.build_ms <= 0:
+      return 0.0
+    return min(1.0, max(0.0, 1.0 - self.blocked_ms / self.build_ms))
+
+  def overlap_pct(self) -> Optional[float]:
+    """Hidden share as a percentage; None with no recorded build (the
+    ``CsrFeed.stats()`` convention)."""
+    if self.build_ms <= 0:
+      return None
+    return 100.0 * max(0.0, self.build_ms - self.blocked_ms) \
+        / self.build_ms
+
+
+class LatencyWindow:
+  """Bounded exact-latency recorder (the serving batcher's accounting):
+  keeps the most recent latencies, trimming ``cap`` down to ``keep``,
+  and answers percentiles with exact ``np.percentile`` over the
+  window."""
+
+  __slots__ = ('cap', 'keep', '_values')
+
+  def __init__(self, cap: int = 65536, keep: int = 32768):
+    self.cap = int(cap)
+    self.keep = int(keep)
+    self._values: List[float] = []
+
+  def extend(self, values: Iterable[float]):
+    self._values.extend(values)
+    if len(self._values) > self.cap:
+      del self._values[:-self.keep]
+
+  def record(self, value: float):
+    self.extend((value,))
+
+  def __len__(self):
+    return len(self._values)
+
+  def values(self) -> np.ndarray:
+    return np.asarray(self._values, np.float64)
+
+  def percentile(self, p: float) -> Optional[float]:
+    if not self._values:
+      return None
+    return float(np.percentile(self.values(), p))
+
+
+# --------------------------------------------------------------------------
+# process-global registry
+# --------------------------------------------------------------------------
+
+_enabled = False
+_lock = threading.Lock()
+_counters: Dict[str, float] = {}
+_gauges: Dict[str, float] = {}
+_histograms: Dict[str, Histogram] = {}
+
+
+def _check(name: str, kind: str):
+  t = METRIC_TYPES.get(name)
+  if t is None:
+    raise KeyError(
+        f'unregistered metric {name!r}: add it to '
+        'obs.metrics.METRIC_TYPES in the same change that introduces '
+        'the call site (docs/design.md §15)')
+  if t != kind:
+    raise TypeError(f'metric {name!r} is a {t}, not a {kind}')
+
+
+def enabled() -> bool:
+  return _enabled
+
+
+def enable():
+  global _enabled
+  _enabled = True
+
+
+def disable():
+  global _enabled
+  _enabled = False
+
+
+def reset():
+  """Drop every instrument's state (flag untouched)."""
+  with _lock:
+    _counters.clear()
+    _gauges.clear()
+    _histograms.clear()
+
+
+def inc(name: str, value: float = 1.0):
+  if not _enabled:
+    return
+  _check(name, 'counter')
+  with _lock:
+    _counters[name] = _counters.get(name, 0.0) + value
+
+
+def set_gauge(name: str, value: float):
+  if not _enabled:
+    return
+  _check(name, 'gauge')
+  with _lock:
+    _gauges[name] = float(value)
+
+
+def observe(name: str, value: float):
+  if not _enabled:
+    return
+  _check(name, 'histogram')
+  with _lock:
+    h = _histograms.get(name)
+    if h is None:
+      h = _histograms[name] = Histogram()
+    h.observe(value)
+
+
+def snapshot() -> Dict[str, Any]:
+  """One JSON-ready dict of everything recorded: counters/gauges map to
+  their value, histograms to their summary dict."""
+  with _lock:
+    out: Dict[str, Any] = {}
+    out.update({k: v for k, v in _counters.items()})
+    out.update({k: v for k, v in _gauges.items()})
+    out.update({k: h.to_dict() for k, h in _histograms.items()})
+  return {k: out[k] for k in sorted(out)}
+
+
+def snapshot_digest() -> str:
+  """sha256 over the canonical-JSON snapshot — the artifact-sized
+  fingerprint bench journals (two runs recording identical values
+  digest identically)."""
+  blob = json.dumps(snapshot(), sort_keys=True,
+                    separators=(',', ':')).encode()
+  return hashlib.sha256(blob).hexdigest()
+
+
+def journal_snapshot(step: Optional[int] = None, **fields):
+  """Journal one ``metrics_snapshot`` event through the existing
+  resilience sink; a no-op (ZERO journal writes) when the registry is
+  disabled."""
+  if not _enabled:
+    return None
+  return resilience.journal('metrics_snapshot', step=step,
+                            metrics=snapshot(), **fields)
+
+
+def _prom_name(name: str) -> str:
+  return 'det_' + name.replace('.', '_').replace('/', '_')
+
+
+def prometheus_text() -> str:
+  """The registry in Prometheus text exposition format (counters,
+  gauges, and cumulative-bucket histograms)."""
+  lines: List[str] = []
+  with _lock:
+    for k in sorted(_counters):
+      n = _prom_name(k)
+      lines += [f'# TYPE {n} counter', f'{n} {_counters[k]:g}']
+    for k in sorted(_gauges):
+      n = _prom_name(k)
+      lines += [f'# TYPE {n} gauge', f'{n} {_gauges[k]:g}']
+    for k in sorted(_histograms):
+      h = _histograms[k]
+      n = _prom_name(k)
+      lines.append(f'# TYPE {n} histogram')
+      cum = 0
+      for le, c in zip(h.buckets, h.counts):
+        cum += c
+        lines.append(f'{n}_bucket{{le="{le:g}"}} {cum}')
+      lines.append(f'{n}_bucket{{le="+Inf"}} {h.count}')
+      lines.append(f'{n}_sum {h.sum:g}')
+      lines.append(f'{n}_count {h.count}')
+  return '\n'.join(lines) + ('\n' if lines else '')

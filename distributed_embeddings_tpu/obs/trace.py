@@ -1,0 +1,357 @@
+"""Span tracer: named step phases -> Chrome-trace-event JSON.
+
+The capture half of the observability layer (docs/design.md §15).  Call
+sites wrap host-side phases in ``with span('feed/build'): ...`` (or the
+``begin``/``end`` token pair where a ``with`` block would force a
+re-indent of traced jax code); each completed span becomes one
+complete-duration event (``ph='X'``) in an in-memory buffer, and
+``save()`` writes the standard wrapper object
+
+    {"traceEvents": [...], "displayTimeUnit": "ms", "otherData": {...}}
+
+that Perfetto (https://ui.perfetto.dev) and ``chrome://tracing`` open
+directly, and ``tools/trace_report.py`` parses for the stall
+attribution tables.
+
+Disabled (the default) every entry point is ONE module-flag check
+returning a shared no-op object — no allocation, no lock, no event.
+Spans placed inside jit-traced code run at Python trace time in either
+mode and never insert operations into the program, so the disabled path
+is program-identical (the bench's off/on A/B journals the measured
+overhead of the enabled path).
+
+Span-name discipline: every runtime call site must use a name from
+``REGISTERED_SPANS`` (source-scanned by tests/test_obs.py, mirroring
+``resilience.REGISTERED_EVENTS``).  The emit functions themselves stay
+permissive so a user extension can trace its own phases; unregistered
+names surface in ``tools/trace_report.py --strict``.
+
+Three event shapes:
+
+- ``span``/``begin``+``end``/``complete``: a synchronous phase on one
+  thread (``ph='X'``).  Same-thread spans follow ``with``-statement
+  stack discipline, so per-track events are always properly nested.
+- ``async_span``: a logical interval not owned by any one thread — a
+  serving request's queue residency (``serve/enqueue``) overlaps its
+  neighbours arbitrarily — emitted as a ``ph='b'``/``'e'`` pair keyed
+  by ``id`` (Perfetto renders each id on its own async track).
+- ``instant``: a point marker (``ph='i'``).
+
+Timestamps are microseconds on the ``time.perf_counter`` clock,
+re-based to ``enable()``; producers that measure an interval themselves
+(a queue wait already being timed for ``stats()``) emit it with
+``complete(name, start_s, dur_s)`` using ``now()`` for the start so the
+trace and the stats agree on the SAME measurement instead of timing the
+phase twice.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from typing import Any, Dict, List, Optional
+
+# The complete span taxonomy (docs/design.md §15).  Add a name HERE in
+# the same change that introduces the call site — tests/test_obs.py
+# source-scans every span()/begin()/complete()/async_span() literal.
+REGISTERED_SPANS = frozenset({
+    # training driver (parallel/grad.py fit)
+    'train/step', 'train/sync',
+    # host CSR feed (parallel/csr_feed.py)
+    'feed/build', 'feed/wait',
+    # cold tier (parallel/coldtier.py)
+    'coldtier/prepass', 'coldtier/wait', 'coldtier/fetch',
+    'coldtier/writeback',
+    # trace-time phases of the compiled step
+    # (parallel/dist_embedding.py / parallel/sparse.py): emitted while
+    # python traces the jitted program — they attribute TRACE/compile
+    # wall time and mark program structure, not per-step device time
+    'fwd/exchange', 'fwd/lookup_combine', 'bwd/exchange', 'apply/update',
+    # state-integrity auditor (parallel/audit.py)
+    'audit/check',
+    # checkpoints (parallel/checkpoint.py)
+    'ckpt/save', 'ckpt/restore',
+    # serving request path (serving/batcher.py + serving/engine.py)
+    'serve/submit', 'serve/enqueue', 'serve/dispatch', 'serve/lookup',
+    'serve/execute', 'serve/demux',
+})
+
+# Report classification (tools/trace_report.py): 'wait' spans are
+# blocked time (the stall-attribution numerator), 'trace' spans are
+# trace-time program phases, everything else is measured host work.
+SPAN_CATEGORIES: Dict[str, str] = {
+    'feed/wait': 'wait', 'coldtier/wait': 'wait', 'train/sync': 'wait',
+    'serve/enqueue': 'wait',
+    'fwd/exchange': 'trace', 'fwd/lookup_combine': 'trace',
+    'bwd/exchange': 'trace', 'apply/update': 'trace',
+}
+
+
+def span_category(name: str) -> str:
+  return SPAN_CATEGORIES.get(name, 'host')
+
+
+class _NoopSpan:
+  """Shared do-nothing context manager: the whole disabled path."""
+  __slots__ = ()
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    return False
+
+
+_NOOP = _NoopSpan()
+
+_DEFAULT_MAX_EVENTS = 1_000_000
+
+_enabled = False
+_lock = threading.Lock()
+_events: List[Dict[str, Any]] = []
+_dropped = 0
+_t0 = 0.0
+_path: Optional[str] = None
+_max_events = _DEFAULT_MAX_EVENTS
+_tids: Dict[Any, int] = {}
+_pid = os.getpid()
+
+
+def enabled() -> bool:
+  return _enabled
+
+
+def now() -> float:
+  """The tracer's clock (seconds) — use for ``complete()`` starts so a
+  self-timed interval lands on the same timeline as live spans."""
+  return time.perf_counter()
+
+
+def enable(path: Optional[str] = None, max_events: Optional[int] = None):
+  """Arm the tracer (idempotent; re-arming keeps buffered events).
+  ``path`` is remembered as the default ``save()`` target;
+  ``max_events`` bounds the buffer — past it events are counted as
+  dropped instead of growing host memory without bound.  Both are
+  sticky: a re-arm without them (another component calling
+  ``enable()``) keeps the previously configured values instead of
+  silently lifting a user-set memory bound."""
+  global _enabled, _t0, _path, _max_events, _pid
+  with _lock:
+    if not _enabled and not _events:
+      _t0 = time.perf_counter()
+    _pid = os.getpid()
+    if path is not None:
+      _path = path
+    if max_events is not None:
+      _max_events = int(max_events)
+    _enabled = True
+
+
+def disable():
+  global _enabled
+  _enabled = False
+
+
+def clear():
+  """Drop buffered events and restore the default buffer bound/path
+  (keeps the enabled flag untouched) — a fresh capture starts from the
+  defaults, while a mid-capture ``enable()`` re-arm keeps whatever the
+  user configured (see ``enable``)."""
+  global _dropped, _t0, _max_events, _path
+  with _lock:
+    _events.clear()
+    _tids.clear()
+    _dropped = 0
+    _max_events = _DEFAULT_MAX_EVENTS
+    _path = None
+    _t0 = time.perf_counter()
+
+
+def _tid() -> int:
+  """Small stable per-thread track id + a thread_name metadata event on
+  first sight (Perfetto labels the track).  Keyed by (ident, name): the
+  OS reuses thread idents after a thread exits (a respawned feed
+  producer can inherit a dead dispatcher's ident), and a bare-ident
+  cache would silently put the new thread's spans on the dead thread's
+  labelled track."""
+  name = threading.current_thread().name
+  key = (threading.get_ident(), name)
+  tid = _tids.get(key)
+  if tid is None:
+    tid = len(_tids) + 1
+    _tids[key] = tid
+    _events.append({
+        'name': 'thread_name', 'ph': 'M', 'pid': _pid, 'tid': tid,
+        'args': {'name': name},
+    })
+  return tid
+
+
+def _emit(event: Dict[str, Any]):
+  global _dropped
+  with _lock:
+    if len(_events) >= _max_events:
+      _dropped += 1
+      return
+    event.setdefault('tid', _tid())
+    _events.append(event)
+
+
+class _Span:
+  __slots__ = ('name', 'args', 't0')
+
+  def __init__(self, name: str, args: Optional[Dict[str, Any]]):
+    self.name = name
+    self.args = args
+    self.t0 = time.perf_counter()
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    end(self)
+    return False
+
+
+def span(name: str, **args):
+  """Context manager timing one phase on the current thread; the shared
+  no-op when tracing is disabled."""
+  if not _enabled:
+    return _NOOP
+  return _Span(name, args or None)
+
+
+def begin(name: str, **args):
+  """Token form of ``span`` for blocks where a ``with`` would force a
+  re-indent (the traced-forward sections).  Returns None disabled —
+  ``end(None)`` is a no-op, so call sites never branch."""
+  if not _enabled:
+    return None
+  return _Span(name, args or None)
+
+
+def end(tok):
+  if tok is None or not _enabled:
+    return
+  t1 = time.perf_counter()
+  ev = {
+      'name': tok.name, 'cat': span_category(tok.name), 'ph': 'X',
+      'ts': (tok.t0 - _t0) * 1e6, 'dur': (t1 - tok.t0) * 1e6,
+      'pid': _pid,
+  }
+  if tok.args:
+    ev['args'] = tok.args
+  _emit(ev)
+
+
+def complete(name: str, start_s: float, dur_s: float,
+             tid: Optional[int] = None, **args):
+  """Emit an already-measured interval (``start_s`` from ``now()``) —
+  the single-measurement contract: stats counters and the trace report
+  the same number."""
+  if not _enabled:
+    return
+  ev = {
+      'name': name, 'cat': span_category(name), 'ph': 'X',
+      'ts': (start_s - _t0) * 1e6, 'dur': max(0.0, dur_s) * 1e6,
+      'pid': _pid,
+  }
+  if tid is not None:
+    ev['tid'] = tid
+  if args:
+    ev['args'] = args
+  _emit(ev)
+
+
+def async_span(name: str, span_id, start_s: float, end_s: float, **args):
+  """Emit one logical (cross-thread) interval as a ``ph='b'``/``'e'``
+  pair keyed by ``span_id`` — queue residency and other phases whose
+  neighbours overlap arbitrarily and therefore cannot keep X-event
+  stack discipline on any one track."""
+  if not _enabled:
+    return
+  base = {'name': name, 'cat': span_category(name), 'pid': _pid,
+          'id': str(span_id)}
+  b = dict(base, ph='b', ts=(start_s - _t0) * 1e6)
+  if args:
+    b['args'] = args
+  e = dict(base, ph='e', ts=(max(start_s, end_s) - _t0) * 1e6)
+  with _lock:
+    tid = _tid()
+    b['tid'] = tid
+    e['tid'] = tid
+    global _dropped
+    if len(_events) + 2 > _max_events:
+      _dropped += 2
+      return
+    _events.extend((b, e))
+
+
+def instant(name: str, **args):
+  if not _enabled:
+    return
+  ev = {'name': name, 'cat': span_category(name), 'ph': 'i', 's': 't',
+        'ts': (time.perf_counter() - _t0) * 1e6, 'pid': _pid}
+  if args:
+    ev['args'] = args
+  _emit(ev)
+
+
+def events() -> List[Dict[str, Any]]:
+  """Snapshot of the buffered events (metadata included)."""
+  with _lock:
+    return list(_events)
+
+
+def dropped() -> int:
+  with _lock:
+    return _dropped
+
+
+def event_count() -> int:
+  with _lock:
+    return len(_events)
+
+
+def truncate(count: int, dropped_to: Optional[int] = None):
+  """Drop events past index ``count`` — the overhead microbench
+  (``obs.measure_overhead``) measures real emission cost, then removes
+  its own scaffolding events so they never pollute a saved trace.
+  ``thread_name`` metadata events in the removed range are KEPT (the
+  thread registry still holds those tids — deleting the label would
+  leave every later span on an unnamed track).  ``dropped_to``
+  restores the dropped-event counter to its pre-scaffolding value, so
+  a full buffer never misreports the scaffolding as lost real spans."""
+  global _dropped
+  with _lock:
+    meta = [e for e in _events[int(count):] if e.get('ph') == 'M']
+    del _events[int(count):]
+    _events.extend(meta)
+    if dropped_to is not None:
+      _dropped = int(dropped_to)
+
+
+def save(path: Optional[str] = None) -> str:
+  """Write the buffered trace as one Perfetto-loadable JSON object;
+  returns the path written.  Raises ``ValueError`` without a path (no
+  silent default location)."""
+  path = path or _path
+  if not path:
+    raise ValueError('trace.save() needs a path (or enable(path=...))')
+  with _lock:
+    payload = {
+        'traceEvents': list(_events),
+        'displayTimeUnit': 'ms',
+        'otherData': {
+            'producer': 'distributed_embeddings_tpu.obs.trace',
+            'dropped_events': _dropped,
+        },
+    }
+  tmp = f'{path}.tmp.{os.getpid()}'
+  with open(tmp, 'w', encoding='utf-8') as f:
+    json.dump(payload, f)
+  os.replace(tmp, path)
+  return path

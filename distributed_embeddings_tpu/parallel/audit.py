@@ -40,11 +40,14 @@ the measured ``audit_overhead_pct`` off/on A/B.
 from __future__ import annotations
 
 import dataclasses
+import time
 
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from distributed_embeddings_tpu.obs import metrics as obs_metrics
+from distributed_embeddings_tpu.obs import trace as obs_trace
 from distributed_embeddings_tpu.parallel.quantization import (
     payload_bad_mask_np, scale_bad_mask_np)
 from distributed_embeddings_tpu.utils import resilience
@@ -552,6 +555,7 @@ class StateAuditor:
     tier its digest sweep.  Journals and returns the findings."""
     import jax
     self.audits += 1
+    t0 = time.perf_counter()
     findings: List[AuditFinding] = []
     leaves = self._collect_leaves(params, opt_state)
     if leaves:
@@ -598,6 +602,14 @@ class StateAuditor:
     for f in findings:
       f.journal(step=step)
     self.findings_total += len(findings)
+    # ONE measurement feeds both the span and the histogram (the
+    # trace-vs-stats agreement contract, obs/trace.py)
+    call_ms = (time.perf_counter() - t0) * 1000.0
+    obs_trace.complete('audit/check', t0, call_ms / 1000.0, step=step)
+    obs_metrics.inc('audit.calls')
+    obs_metrics.observe('audit.call_ms', call_ms)
+    if findings:
+      obs_metrics.inc('audit.findings', len(findings))
     return findings
 
   def check_state(self, state, step: Optional[int] = None

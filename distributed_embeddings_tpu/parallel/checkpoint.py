@@ -36,6 +36,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 import dataclasses
 
+from distributed_embeddings_tpu.obs import metrics as obs_metrics
+from distributed_embeddings_tpu.obs import trace as obs_trace
 from distributed_embeddings_tpu.parallel import quantization
 from distributed_embeddings_tpu.parallel.dist_embedding import DistributedEmbedding
 from distributed_embeddings_tpu.utils import resilience
@@ -1185,6 +1187,20 @@ def save_train_npz(path: str,
   carry ~4x fewer table bytes than f32 and restore bit-exactly into
   any plan.
   """
+  # ONE measurement feeds both the span and the histogram (the
+  # trace-vs-stats agreement contract, obs/trace.py)
+  t0 = obs_trace.now()
+  try:
+    _save_train_npz(path, weights, table_states, extras, plan)
+  finally:
+    save_ms = (obs_trace.now() - t0) * 1000.0
+    obs_trace.complete('ckpt/save', t0, save_ms / 1000.0,
+                       path=os.path.basename(path))
+  obs_metrics.inc('ckpt.saves')
+  obs_metrics.observe('ckpt.save_ms', save_ms)
+
+
+def _save_train_npz(path, weights, table_states, extras, plan):
   if table_states is not None and len(table_states) != len(weights):
     raise ValueError(f'got {len(table_states)} per-table states for '
                      f'{len(weights)} weight tables')
@@ -1328,6 +1344,19 @@ def restore_train_state(dist: DistributedEmbedding, state, source: str,
 
   Returns ``(state, path)`` — the restored state and the file used.
   """
+  t0 = obs_trace.now()
+  try:
+    out = _restore_train_state(dist, state, source, quarantine)
+  finally:
+    restore_ms = (obs_trace.now() - t0) * 1000.0
+    obs_trace.complete('ckpt/restore', t0, restore_ms / 1000.0,
+                       source=os.path.basename(source))
+  obs_metrics.inc('ckpt.restores')
+  obs_metrics.observe('ckpt.restore_ms', restore_ms)
+  return out
+
+
+def _restore_train_state(dist, state, source, quarantine):
   if os.path.isdir(source):
     path, (weights, st_tables, extras) = load_latest_valid(
         source, expect_plan=dist, quarantine=quarantine)

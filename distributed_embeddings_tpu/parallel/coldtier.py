@@ -39,6 +39,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from distributed_embeddings_tpu.obs import metrics as obs_metrics
+from distributed_embeddings_tpu.obs import trace as obs_trace
 from distributed_embeddings_tpu.parallel import quantization
 from distributed_embeddings_tpu.utils import resilience
 
@@ -391,6 +393,11 @@ def build_fetch(dist, inputs, rows=None) -> ColdFetch:
   ``rows``: optional precomputed ``(rows, counts)`` from
   ``compute_fetch_rows`` (the pipelined path — the payload gather
   below must still run AFTER the previous step's writeback)."""
+  with obs_trace.span('coldtier/fetch'):
+    return _build_fetch(dist, inputs, rows)
+
+
+def _build_fetch(dist, inputs, rows=None) -> ColdFetch:
   import jax.numpy as jnp
   plan = dist.plan
   tier = dist.cold_tier
@@ -401,6 +408,8 @@ def build_fetch(dist, inputs, rows=None) -> ColdFetch:
   else:
     rows, counts = rows
   _ensure_caps(dist, counts)
+  obs_metrics.inc('coldtier.fetch_rows',
+                  sum(sum(per) for per in counts.values()))
   if tier.digests_enabled:
     # fetch-time integrity (design §13): every row about to be gathered
     # is re-hashed against its write-back digest BEFORE it can reach
@@ -458,6 +467,11 @@ def write_back(dist, fetch: ColdFetch, writeback):
   """Store one step's updated tail rows (payload/scale/optimizer rows,
   already re-quantized device-side) into the host tier, aligned with
   the fetch's row lists."""
+  with obs_trace.span('coldtier/writeback'):
+    _write_back(dist, fetch, writeback)
+
+
+def _write_back(dist, fetch: ColdFetch, writeback):
   import jax
   tier = dist.cold_tier
   if getattr(tier, 'frozen', False):
@@ -561,18 +575,22 @@ class ColdFetchPipeline:
   def __init__(self, dist, cats_iter, depth: int = 2):
     self.dist = dist
     self._q: queue.Queue = queue.Queue(maxsize=max(1, int(depth)))
-    self._build_ms = 0.0
-    self._blocked_ms = 0.0
-    self._batches = 0
+    # shared blocked-time primitive (obs/metrics.py OverlapStat) —
+    # stats() keys unchanged
+    self._overlap = obs_metrics.OverlapStat()
     self._err = None
 
     def producer():
       try:
         for cats in cats_iter:
           t0 = time.perf_counter()
+          tok = obs_trace.begin('coldtier/prepass')
           prepped, _, _ = dist._prepare_inputs(list(cats))
           rows = compute_fetch_rows(dist, prepped)
-          self._build_ms += (time.perf_counter() - t0) * 1000.0
+          obs_trace.end(tok)
+          prepass_ms = (time.perf_counter() - t0) * 1000.0
+          self._overlap.add_build(prepass_ms)
+          obs_metrics.observe('coldtier.prepass_ms', prepass_ms)
           self._q.put((cats, prepped, rows))
       except BaseException as e:  # surfaced on the consumer side
         self._err = e
@@ -589,28 +607,28 @@ class ColdFetchPipeline:
   def __next__(self):
     t0 = time.perf_counter()
     item = self._q.get()
-    self._blocked_ms += (time.perf_counter() - t0) * 1000.0
+    blocked_ms = (time.perf_counter() - t0) * 1000.0
+    self._overlap.add_blocked(blocked_ms)
+    obs_trace.complete('coldtier/wait', t0, blocked_ms / 1000.0)
+    obs_metrics.observe('coldtier.blocked_ms', blocked_ms)
     if item is None:
       if self._err is not None:
         raise self._err
       raise StopIteration
     cats, prepped, rows = item
     fetch = build_fetch(self.dist, prepped, rows=rows)
-    self._batches += 1
+    self._overlap.count_batch()
+    obs_metrics.inc('coldtier.batches')
     return cats, fetch
 
   def reset_stats(self):
-    self._build_ms = 0.0
-    self._blocked_ms = 0.0
-    self._batches = 0
+    self._overlap = obs_metrics.OverlapStat()
 
   def stats(self) -> dict:
-    build = self._build_ms
-    blocked = self._blocked_ms
-    pct = 0.0 if build <= 0 else min(1.0, max(0.0, 1.0 - blocked / build))
+    ov = self._overlap
     return {
-        'batches': self._batches,
-        'build_ms': round(build, 3),
-        'blocked_ms': round(blocked, 3),
-        'overlap_pct': round(pct, 4),
+        'batches': ov.batches,
+        'build_ms': round(ov.build_ms, 3),
+        'blocked_ms': round(ov.blocked_ms, 3),
+        'overlap_pct': round(ov.overlap_frac(), 4),
     }

@@ -55,6 +55,8 @@ from typing import Any, Callable, Dict, Iterable, List, NamedTuple, Optional, Tu
 
 import numpy as np
 
+from distributed_embeddings_tpu.obs import metrics as obs_metrics
+from distributed_embeddings_tpu.obs import trace as obs_trace
 from distributed_embeddings_tpu.parallel import sparsecore
 from distributed_embeddings_tpu.utils import resilience
 
@@ -130,6 +132,7 @@ class QueueSource:
     except queue.Full:
       if not block:
         self._dropped += 1
+        obs_metrics.inc('feed.queue_dropped')
       return False
 
   def close(self):
@@ -281,6 +284,7 @@ class CsrFeed:
 
     def counting_sleep(d):
       self._io_retry_count += 1
+      obs_metrics.inc('feed.io_retries')
       time.sleep(d)
 
     return resilience.retry_io(fn, retries=self._io_retries,
@@ -331,16 +335,25 @@ class CsrFeed:
         self._cursor = (seq, item)
       try:
         t0 = time.perf_counter()
-        csrs = self._retry(
-            lambda: sparsecore.preprocess_batch_host(
-                self._dist, self._cats_fn(item),
-                max_ids_per_partition=self._caps, native=self.builder,
-                num_workers=self._num_workers),
-            'csr-feed batch build')
+        tok = obs_trace.begin('feed/build', seq=seq)
+        try:
+          csrs = self._retry(
+              lambda: sparsecore.preprocess_batch_host(
+                  self._dist, self._cats_fn(item),
+                  max_ids_per_partition=self._caps, native=self.builder,
+                  num_workers=self._num_workers),
+              'csr-feed batch build')
+        finally:
+          # a FAILED build still emits its span: the retry-inclusive
+          # wall of a poison batch is exactly what stall attribution
+          # must not lose when the feed misbehaves
+          obs_trace.end(tok)
         build_ms = (time.perf_counter() - t0) * 1000.0
+        obs_metrics.observe('feed.build_ms', build_ms)
       except Exception as e:  # poison batch (or exhausted retries)
         if self._on_batch_error == 'skip':
           self._skipped += 1
+          obs_metrics.inc('feed.skipped')
           resilience.journal('csr_feed_skipped_batch', seq=seq,
                              error=repr(e))
           self._cursor = (seq + 1, _NO_ITEM)
@@ -395,6 +408,7 @@ class CsrFeed:
         if not self._thread.is_alive():
           if self._respawns < self._max_respawns:
             self._respawns += 1
+            obs_metrics.inc('feed.respawns')
             resilience.journal('csr_feed_respawn', count=self._respawns,
                                next_seq=self._cursor[0])
             self._thread = self._spawn()
@@ -415,10 +429,15 @@ class CsrFeed:
         continue  # duplicate re-built after a respawn: already delivered
       break
     blocked_ms = (time.perf_counter() - t0) * 1000.0
+    obs_trace.complete('feed/wait', t0, blocked_ms / 1000.0, seq=msg.seq)
+    obs_metrics.observe('feed.blocked_ms', blocked_ms)
+    obs_metrics.inc('feed.batches')
+    if self._queue_source is not None:
+      obs_metrics.set_gauge('feed.queue_depth', self._queue_source.qsize())
     self._last_seq = msg.seq
-    self._batches += 1
-    self._build_ms += msg.fed.build_ms
-    self._blocked_ms += blocked_ms
+    self._overlap.count_batch()
+    self._overlap.add_build(msg.fed.build_ms)
+    self._overlap.add_blocked(blocked_ms)
     return msg.fed
 
   def __enter__(self):
@@ -459,9 +478,10 @@ class CsrFeed:
     """Zero the overlap accounting — e.g. after the first batch, whose
     build has no prior device step to hide behind, so steady-state
     overlap is reported."""
-    self._batches = 0
-    self._build_ms = 0.0
-    self._blocked_ms = 0.0
+    # the shared blocked-time primitive (obs/metrics.py OverlapStat):
+    # one accounting for CsrFeed, ColdFetchPipeline, and the serving
+    # batcher, with this class's pre-existing stats() keys unchanged
+    self._overlap = obs_metrics.OverlapStat()
 
   def stats(self) -> Dict[str, Any]:
     """Overlap accounting since the last ``reset_stats()``.
@@ -476,14 +496,13 @@ class CsrFeed:
     ``skipped`` poison batches dropped under ``on_batch_error='skip'``,
     ``io_retries`` transient-I/O retries taken, ``respawns`` producer
     threads respawned after a worker death."""
-    build = self._build_ms
-    hidden = max(0.0, build - self._blocked_ms)
+    ov = self._overlap
+    pct = ov.overlap_pct()
     out = {
-        'batches': self._batches,
-        'build_ms': round(build, 3),
-        'blocked_ms': round(self._blocked_ms, 3),
-        'overlap_pct': (round(100.0 * hidden / build, 1) if build > 0
-                        else None),
+        'batches': ov.batches,
+        'build_ms': round(ov.build_ms, 3),
+        'blocked_ms': round(ov.blocked_ms, 3),
+        'overlap_pct': (round(pct, 1) if pct is not None else None),
         'builder': self.builder,
         'skipped': self._skipped,
         'fast_forwarded': self._fast_forwarded,

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_embeddings_tpu.obs import trace as obs_trace
 from distributed_embeddings_tpu.ops.ragged import RaggedBatch
 from distributed_embeddings_tpu.parallel import mesh as mesh_lib
 from distributed_embeddings_tpu.parallel import quantization
@@ -1254,6 +1255,11 @@ class DistributedEmbedding:
               back_c = jax.lax.all_to_all(back_c, self.axis_name, 0, 0)
             back_parts.append(back_c)
 
+          # one 'fwd/exchange' span over the whole software-pipelined
+          # chunk loop: exchange and lookup/combine legs interleave by
+          # design, so they are not separable phases here (trace-time
+          # span — obs/trace.py; zero ops inserted either way)
+          tok = obs_trace.begin('fwd/exchange', chunks=n_chunks)
           pending = None
           for lo, hi in chunk_bounds(sub.n_cap, n_chunks):
             recv_c = (jax.lax.all_to_all(send[:, lo:hi], self.axis_name,
@@ -1263,13 +1269,17 @@ class DistributedEmbedding:
               process(*pending)
             pending = (lo, hi, recv_c)
           process(*pending)
+          obs_trace.end(tok)
           residuals.append(jnp.concatenate(routed_parts, axis=0)[None])
           sub_back.append(jnp.concatenate(back_parts, axis=1))
           continue
         # --- dp -> mp all_to_all (reference hvd.alltoall 'inp_dp_to_mp',
         # dist_model_parallel.py:404) -------------------------------------
+        tok = obs_trace.begin('fwd/exchange')
         recv = (jax.lax.all_to_all(send, self.axis_name, 0, 0)
                 if D > 1 else send)
+        obs_trace.end(tok)
+        tok = obs_trace.begin('fwd/lookup_combine')
         # [n_cap, D*B, h]: the slice's batch in source-major order (the
         # reference's [world_size * local] reshape, :405-410)
         ids = recv.transpose(1, 0, 2, 3).reshape(sub.n_cap, slice_batch, h)
@@ -1295,6 +1305,7 @@ class DistributedEmbedding:
         # shards (reference 'out_mp_to_dp', :434) -------------------------
         self._emit_outputs(sub, si, out, me, local_batch, merge_out,
                            sub_back)
+        obs_trace.end(tok)
       outs = self._assemble(subs, sub_back, merge_out)
       if with_residuals:
         return outs + tuple(residuals)
@@ -1515,6 +1526,8 @@ class DistributedEmbedding:
     def local_fn(*d_outs):
       me = jax.lax.axis_index(self.axis_name)
       gsubs = []
+      # trace-time span (obs/trace.py): the whole cotangent exchange
+      tok = obs_trace.begin('bwd/exchange')
       for sub in subs:
         w = sub.group.width
         dt = d_outs[0].dtype
@@ -1587,6 +1600,7 @@ class DistributedEmbedding:
                   r.input_id)
         g = cat[jnp.asarray(recon)[me]]
         gsubs.append(g[None])
+      obs_trace.end(tok)
       return tuple(gsubs)
 
     fn = jax.jit(
@@ -1791,6 +1805,9 @@ class DistributedEmbedding:
                     occ_c.reshape(D, hi - lo, local_batch, h, w).astype(
                         jnp.float32), axis=3))
 
+          # one 'fwd/exchange' trace-time span over the pipelined chunk
+          # loop (exchange and combine legs interleave by design)
+          tok = obs_trace.begin('fwd/exchange', chunks=n_chunks)
           pending = None
           for lo, hi in chunk_bounds(sub.n_cap, n_chunks):
             recv_c = (jax.lax.all_to_all(send_u[:, lo:hi],
@@ -1800,12 +1817,16 @@ class DistributedEmbedding:
               process(*pending)
             pending = (lo, hi, recv_c)
           process(*pending)
+          obs_trace.end(tok)
           if with_residuals:
             residuals.append(jnp.concatenate(routed_parts, axis=0)[None])
           comb = jnp.concatenate(comb_parts, axis=1)
         else:
+          tok = obs_trace.begin('fwd/exchange')
           recv = (jax.lax.all_to_all(send_u, self.axis_name, 0, 0)
                   if D > 1 else send_u)
+          obs_trace.end(tok)
+          tok = obs_trace.begin('fwd/lookup_combine')
           ids_u = recv.transpose(1, 0, 2).reshape(sub.n_cap, D * U)
           routed = _route_ids(ids_u[..., None],
                               jnp.asarray(sub.offsets)[me],
@@ -1831,6 +1852,7 @@ class DistributedEmbedding:
           comb = jnp.sum(
               occ.reshape(D, sub.n_cap, local_batch, h, w).astype(
                   jnp.float32), axis=3)
+          obs_trace.end(tok)
         for dev in range(D):
           for s, r in enumerate(sub.requests[dev]):
             k = (r.input_id, r.col_start, r.col_end)
@@ -1977,6 +1999,9 @@ class DistributedEmbedding:
                  else (self.axis_name,))
 
     def local_fn(*args):
+      # trace-time span (obs/trace.py): the deduplicated cold-cotangent
+      # exchange + the replicated hot-grad psum
+      tok = obs_trace.begin('bwd/exchange')
       d_outs = args[:self.num_inputs]
       inputs = args[self.num_inputs:]
       mem = self._hot_membership(inputs, hotness)
@@ -2112,6 +2137,7 @@ class DistributedEmbedding:
             total = jax.lax.psum(total, psum_axes)
         hot_out.append(total)
 
+      obs_trace.end(tok)
       return tuple(gsubs) + tuple(hot_out)
 
     bax = self._batch_axes

@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_embeddings_tpu.obs import metrics as obs_metrics
+from distributed_embeddings_tpu.obs import trace as obs_trace
 from distributed_embeddings_tpu.parallel import mesh as mesh_lib
 from distributed_embeddings_tpu.parallel.coldtier import TierIntegrityError
 from distributed_embeddings_tpu.utils import resilience
@@ -305,14 +307,22 @@ def fit(step_fn: Callable,
   def sync_window(i):
     """Host-sync the loss window — THE blocking point where a wedged
     device program manifests, so the watchdog lives here (and around
-    each dispatch below)."""
+    each dispatch below).  The obs 'train/sync' span records exactly
+    this wait: host time blocked on the device, the per-window stall
+    the trace report attributes (docs/design.md §15)."""
     stacked = jnp.stack(window)
     window.clear()
+    t0 = obs_trace.now()
     if step_timeout_s is None:
-      return np.asarray(stacked)
-    return resilience.call_with_timeout(
-        lambda: np.asarray(jax.block_until_ready(stacked)),
-        step_timeout_s, what=f'device-step sync at step {i}')
+      host = np.asarray(stacked)
+    else:
+      host = resilience.call_with_timeout(
+          lambda: np.asarray(jax.block_until_ready(stacked)),
+          step_timeout_s, what=f'device-step sync at step {i}')
+    sync_s = obs_trace.now() - t0
+    obs_trace.complete('train/sync', t0, sync_s, step=i)
+    obs_metrics.observe('train.sync_ms', sync_s * 1000.0)
+    return host
 
   def flush(i, final=False):
     nonlocal last_eval_at
@@ -341,6 +351,11 @@ def fit(step_fn: Callable,
       logs['loss'] = mean
       history['step'].append(i)
       history['loss'].append(mean)
+      obs_metrics.set_gauge('train.loss', mean)
+      # periodic registry snapshot through the resilience journal —
+      # one jsonl line per log point when the registry is armed, ZERO
+      # writes when it is not (design §15 disabled-path guarantee)
+      obs_metrics.journal_snapshot(step=i)
     # final covers both exits (steps reached, data drained): the run always
     # ends with an eval of the returned state — even when the iterator
     # drained exactly at a log boundary and the loss window is empty
@@ -369,6 +384,7 @@ def fit(step_fn: Callable,
     after an in-process rollback (training continues), False when the
     run must terminate (reason printed + journaled)."""
     nonlocal state, i, it, rollbacks, last_eval_at
+    obs_metrics.inc('train.anomalies')
     resilience.journal('anomaly_detected', anomaly=a.kind,
                        step=a.step, policy=on_anomaly, detail=a.detail)
     history.setdefault('anomalies', []).append(
@@ -413,6 +429,7 @@ def fit(step_fn: Callable,
                'terminating')
       return False
     rollbacks += 1
+    obs_metrics.inc('train.rollbacks')
     to_step = int(state.step)
     detect_at = i
     window.clear()
@@ -446,12 +463,17 @@ def fit(step_fn: Callable,
             args = next(it)
           except StopIteration:
             break
-          if step_timeout_s is not None:
-            state, loss = resilience.call_with_timeout(
-                lambda s=state, a=args: step_fn(s, *a),
-                step_timeout_s, what=f'train step dispatch at step {i}')
-          else:
-            state, loss = step_fn(state, *args)
+          # 'train/step' wraps the DISPATCH (async under jit: tracing +
+          # compile on the first call, enqueue after); the device wall
+          # it hides shows up in the log point's 'train/sync' span
+          with obs_trace.span('train/step', step=i + 1):
+            if step_timeout_s is not None:
+              state, loss = resilience.call_with_timeout(
+                  lambda s=state, a=args: step_fn(s, *a),
+                  step_timeout_s, what=f'train step dispatch at step {i}')
+            else:
+              state, loss = step_fn(state, *args)
+          obs_metrics.inc('train.steps')
           window.append(loss)
           i += 1
           if auditor is not None and i % auditor.every == 0:

@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from distributed_embeddings_tpu.obs import trace as obs_trace
 from distributed_embeddings_tpu.ops.ragged import RaggedBatch
 from distributed_embeddings_tpu.parallel.dist_embedding import (
     DistributedEmbedding, _valid_count)
@@ -1396,7 +1397,11 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
                 P(None, None) for _ in hot_gis),
         out_specs=(param_specs, state_spec, wb_spec),
         check_vma=False)
-    return fn(params, opt_state, lr, fetch, *res_and_g)
+    # trace-time span (obs/trace.py): the sparse optimizer apply
+    tok = obs_trace.begin('apply/update')
+    out = fn(params, opt_state, lr, fetch, *res_and_g)
+    obs_trace.end(tok)
+    return out
 
   dist._fn_cache[key] = apply
   return apply

@@ -45,6 +45,9 @@ from typing import List, Optional
 
 import numpy as np
 
+from distributed_embeddings_tpu.obs import metrics as obs_metrics
+from distributed_embeddings_tpu.obs import trace as obs_trace
+
 
 class ServeFuture:
   """Resolution handle of one submitted request."""
@@ -76,13 +79,16 @@ class ServeFuture:
 
 
 class _Slot:
-  __slots__ = ('cats', 'n', 'future', 't0')
+  __slots__ = ('cats', 'n', 'future', 't0', 't0p')
 
   def __init__(self, cats, n, t0):
     self.cats = cats
     self.n = n
     self.future = ServeFuture()
     self.t0 = t0
+    # queue-residency start on the TRACE clock (the 'serve/enqueue'
+    # async span the dispatcher closes); 0.0 when tracing is off
+    self.t0p = obs_trace.now() if obs_trace.enabled() else 0.0
 
 
 _CLOSE = object()
@@ -132,7 +138,9 @@ class DynamicBatcher:
     self._completed = 0
     self._batches = 0
     self._fill_sum = 0.0
-    self._latencies: List[float] = []
+    # the shared bounded exact-latency primitive (obs/metrics.py
+    # LatencyWindow) — stats() keys and percentile arithmetic unchanged
+    self._latencies = obs_metrics.LatencyWindow()
     self._feed = None
     self._queue_source = None
     self._consumer = None
@@ -159,6 +167,12 @@ class DynamicBatcher:
     """Enqueue one request (per-input id arrays for ``n`` samples);
     returns its ``ServeFuture``.  Admission-policy refusals raise HERE,
     synchronously, so the caller can repair the request."""
+    with obs_trace.span('serve/submit'):
+      fut = self._submit(cats)
+    obs_metrics.inc('serve.submitted')
+    return fut
+
+  def _submit(self, cats) -> ServeFuture:
     if self._closed.is_set():
       raise RuntimeError('batcher is closed')
     cats = [np.asarray(x) for x in cats]
@@ -244,8 +258,22 @@ class DynamicBatcher:
           break
         batch.append(nxt)
         n += nxt.n
+      if obs_trace.enabled():
+        # close each merged request's queue-residency interval: an
+        # ASYNC span (b/e pair) because neighbours overlap arbitrarily
+        # — no one thread's track could hold them nested.  Slots
+        # admitted BEFORE the tracer was armed carry t0p=0.0 (the raw
+        # clock epoch, hours in the past) and are skipped rather than
+        # rendered as a machine-uptime-long wait.
+        t1 = obs_trace.now()
+        for slot in batch:
+          if slot.t0p:
+            obs_trace.async_span('serve/enqueue', id(slot), slot.t0p,
+                                 t1, samples=slot.n)
       try:
-        self._launch(batch, n)
+        with obs_trace.span('serve/dispatch', requests=len(batch),
+                            samples=n):
+          self._launch(batch, n)
       except BaseException as e:
         # a failed merge/launch fails THIS batch's futures — the
         # dispatcher itself must survive, or every later request
@@ -342,12 +370,15 @@ class DynamicBatcher:
 
   def _execute(self, merged, batch, n):
     try:
-      outs = self.engine.lookup(merged)
-      host = [np.asarray(o) for o in outs]
+      with obs_trace.span('serve/execute', requests=len(batch),
+                          samples=n):
+        outs = self.engine.lookup(merged)
+        host = [np.asarray(o) for o in outs]
     except BaseException as e:
       for slot in batch:
         slot.future._resolve(err=e)
       return
+    tok = obs_trace.begin('serve/demux', requests=len(batch))
     now = time.monotonic()
     lats = [(now - slot.t0) * 1000.0 for slot in batch]
     # stats update BEFORE the futures resolve: a caller reading
@@ -358,13 +389,17 @@ class DynamicBatcher:
       self._fill_sum += n / self.max_batch
       self._completed += len(batch)
       self._latencies.extend(lats)
-      if len(self._latencies) > 65536:
-        del self._latencies[:-32768]
+    obs_metrics.inc('serve.batches')
+    obs_metrics.inc('serve.completed', len(batch))
+    obs_metrics.set_gauge('serve.batch_fill', n / self.max_batch)
+    for lat in lats:
+      obs_metrics.observe('serve.latency_ms', lat)
     off = 0
     for slot, lat in zip(batch, lats):
       out = [h[off:off + slot.n] for h in host]
       off += slot.n
       slot.future._resolve(out=out, latency_ms=lat)
+    obs_trace.end(tok)
 
   # ----------------------------------------------------------- lifecycle
 
@@ -413,7 +448,8 @@ class DynamicBatcher:
     ``max_batch``), and the feed's build/queue counters in csr_feed
     mode."""
     with self._lock:
-      lat = np.asarray(self._latencies, np.float64)
+      p50 = self._latencies.percentile(50)
+      p99 = self._latencies.percentile(99)
       out = {
           'submitted': self._submitted,
           'completed': self._completed,
@@ -422,10 +458,8 @@ class DynamicBatcher:
           'max_delay_ms': self.max_delay_ms,
           'batch_fill': (round(self._fill_sum / self._batches, 4)
                          if self._batches else None),
-          'p50_ms': (round(float(np.percentile(lat, 50)), 3)
-                     if lat.size else None),
-          'p99_ms': (round(float(np.percentile(lat, 99)), 3)
-                     if lat.size else None),
+          'p50_ms': round(p50, 3) if p50 is not None else None,
+          'p99_ms': round(p99, 3) if p99 is not None else None,
       }
     if self._feed is not None:
       out['csr_feed'] = self._feed.stats()

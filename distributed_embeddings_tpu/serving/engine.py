@@ -30,6 +30,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from distributed_embeddings_tpu.obs import metrics as obs_metrics
+from distributed_embeddings_tpu.obs import trace as obs_trace
 from distributed_embeddings_tpu.parallel import checkpoint
 from distributed_embeddings_tpu.parallel import mesh as mesh_lib
 from distributed_embeddings_tpu.parallel.dist_embedding import (
@@ -222,11 +224,22 @@ class ServingEngine:
             f'engine compiled for batch {self.batch_size}, got '
             f'{np.asarray(x).shape[0]} — pad smaller requests '
             '(lookup_padded) or batch them (DynamicBatcher)')
-    padded = [self._pad_input(i, x) for i, x in enumerate(cats)]
-    outs = self.dist.apply(self.params, padded)
+    # ONE measurement feeds both the span and the histogram (the
+    # trace-vs-stats agreement contract, obs/trace.py)
+    t0 = obs_trace.now()
+    try:
+      padded = [self._pad_input(i, x) for i, x in enumerate(cats)]
+      outs = self.dist.apply(self.params, padded)
+    finally:
+      lookup_ms = (obs_trace.now() - t0) * 1000.0
+      obs_trace.complete('serve/lookup', t0, lookup_ms / 1000.0,
+                         batch=self.batch_size)
     with self._lock:
       self._batches_served += 1
       self._samples_served += self.batch_size
+    obs_metrics.inc('engine.lookups')
+    obs_metrics.inc('engine.samples', self.batch_size)
+    obs_metrics.observe('engine.lookup_ms', lookup_ms)
     self._warm = True
     return list(outs)
 

@@ -64,6 +64,8 @@ REGISTERED_EVENTS = frozenset({
     'rollback_budget_exhausted', 'skip_window',
     # state-integrity auditor (parallel/audit.py + coldtier.py)
     'audit_failure', 'tier_integrity_failure',
+    # observability layer (obs/metrics.py periodic registry snapshots)
+    'metrics_snapshot',
 })
 
 _lock = threading.Lock()

@@ -170,11 +170,23 @@ def parse_args():
                       '--on_anomaly rollback; the next anomaly past the '
                       'budget terminates (journaled '
                       'rollback_budget_exhausted)')
+  parser.add_argument('--trace', default=None, metavar='PATH',
+                      help='arm the observability layer (obs/, design '
+                      '§15) and write the Chrome-trace JSON of the run '
+                      'to PATH — open it in Perfetto '
+                      '(https://ui.perfetto.dev) or feed it to '
+                      'tools/trace_report.py for the per-step phase '
+                      'breakdown and stall attribution.  Default: off '
+                      '(the untraced program is identical)')
   return parser.parse_args()
 
 
 def main():
   args = parse_args()
+
+  if args.trace:
+    from distributed_embeddings_tpu import obs
+    obs.enable(trace_path=args.trace)
 
   import jax
   import jax.numpy as jnp
@@ -600,18 +612,20 @@ def main():
     batch_iter = _tier_batches()
   else:
     batch_iter = ((n, c, l, None) for n, c, l in data_iter)
+  from distributed_embeddings_tpu.obs import trace as obs_trace
   for i, (numerical, cats, labels, fetch) in enumerate(batch_iter):
     numerical = jnp.asarray(numerical)
     cats = tuple(jnp.asarray(c) for c in cats)
     labels = jnp.asarray(labels)
-    if args.trainer == 'sparse':
-      if tier_pipe is not None:
-        state, loss = step(state, list(cats), (numerical, labels),
-                           cold_fetch=fetch)
+    with obs_trace.span('train/step', step=resume_step + i + 1):
+      if args.trainer == 'sparse':
+        if tier_pipe is not None:
+          state, loss = step(state, list(cats), (numerical, labels),
+                             cold_fetch=fetch)
+        else:
+          state, loss = step(state, list(cats), (numerical, labels))
       else:
-        state, loss = step(state, list(cats), (numerical, labels))
-    else:
-      state, loss = step(state, (numerical, cats, labels))
+        state, loss = step(state, (numerical, cats, labels))
     if tier_pipe is not None and i == 0:
       jax.block_until_ready(loss)
       tier_pipe.reset_stats()  # batch 0 has no prior step to hide behind
@@ -723,6 +737,13 @@ def main():
     save_train_npz(args.save_state, weights, st_tables, extras=extras,
                    plan=dist)
     print(f'saved resumable state to {args.save_state}')
+
+  if args.trace:
+    from distributed_embeddings_tpu.obs import trace as obs_trace
+    path = obs_trace.save(args.trace)
+    print(f'obs trace: {obs_trace.event_count()} event(s) -> {path} '
+          '(open in Perfetto, or: python tools/trace_report.py '
+          f'{path})')
 
 
 if __name__ == '__main__':

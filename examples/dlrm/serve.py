@@ -58,7 +58,17 @@ def main():
                       help='serving hot-cache coverage target '
                       '(0 disables the cache)')
   parser.add_argument('--hot_budget_mb', type=float, default=512.0)
+  parser.add_argument('--trace', default=None, metavar='PATH',
+                      help='arm the observability layer (obs/, design '
+                      '§15) and write the Chrome-trace JSON of the '
+                      'request path (submit -> enqueue -> dispatch -> '
+                      'lookup -> demux spans) to PATH — open in '
+                      'Perfetto or feed tools/trace_report.py')
   args = parser.parse_args()
+
+  if args.trace:
+    from distributed_embeddings_tpu import obs
+    obs.enable(trace_path=args.trace)
 
   import jax
   from distributed_embeddings_tpu import serving
@@ -123,6 +133,12 @@ def main():
       stats['serve_hot_hit_rate'] = serving.hot_hit_rate(
           hot_sets, configs, list(range(len(configs))), requests)
     print(json.dumps(stats))
+    if args.trace:
+      from distributed_embeddings_tpu.obs import trace as obs_trace
+      path = obs_trace.save(args.trace)
+      print(f'obs trace: {obs_trace.event_count()} event(s) -> {path} '
+            '(open in Perfetto, or: python tools/trace_report.py '
+            f'{path})')
   finally:
     if tmp is not None and os.path.exists(bundle):
       os.remove(bundle)

@@ -286,6 +286,40 @@ def test_serving_artifact_keys():
   assert 0.0 <= rate <= 1.0
 
 
+def test_obs_artifact_keys(bench):
+  """The ISSUE-11 journaled proof, library-level: the obs block bench
+  folds into the artifact carries the pinned keys, the direct-measured
+  obs_overhead_pct clears the <= 2 acceptance bar by construction on
+  any sane host (one span + one counter per step, microseconds against
+  a hundreds-of-ms step), and the metrics digest is a real sha256 —
+  so a future change that silently drops the obs measurement (or
+  renames its keys) fails tier-1 here."""
+  import re
+  from distributed_embeddings_tpu import obs
+  from distributed_embeddings_tpu.obs import metrics, trace
+  obs.reset()
+  obs.enable()
+  try:
+    with trace.span('train/step', step=1):
+      metrics.inc('train.steps')
+    block = bench.obs_block(500.0, 501.0)
+    for key in ('obs_trace', 'obs_trace_path', 'obs_trace_events',
+                'obs_off_ms', 'obs_on_ms', 'obs_window_delta_pct',
+                'obs_metrics_digest', 'obs_step_call_us',
+                'obs_overhead_pct'):
+      assert key in block, key
+    assert block['obs_trace'] is False     # no trace_path: buffered only
+    assert block['obs_trace_events'] >= 1  # the traced step is counted
+    assert block['obs_off_ms'] == 500.0
+    assert 0.0 <= block['obs_overhead_pct'] <= 2.0, block
+    assert block['obs_step_call_us'] > 0
+    assert re.fullmatch(r'[0-9a-f]{64}', block['obs_metrics_digest'])
+    # window delta keeps its sign (never laundered into the headline)
+    assert block['obs_window_delta_pct'] == pytest.approx(0.2)
+  finally:
+    obs.reset()
+
+
 def test_split_windows(bench):
   assert bench.split_windows(20, 3) == [7, 7, 6]
   assert bench.split_windows(2, 5) == [1, 1]   # never more windows than steps
