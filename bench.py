@@ -343,18 +343,28 @@ def main():
   parser.add_argument('--serve', action=argparse.BooleanOptionalAction,
                       default=None,
                       help='online-serving phase (serving/, design '
-                      '§14): freeze the trained tables into a '
+                      '§14, §16): freeze the trained tables into a '
                       'lookup-only ServingEngine (int8 payload+scale '
                       'unless the plan is already quantized) and '
-                      'measure the dynamic-batching off/on A/B over a '
-                      'concurrent request stream cut from the bench '
-                      'traffic — journals serve_p50_ms / serve_p99_ms '
-                      '/ serve_qps / serve_batch_fill plus the '
-                      'no-batch arm, all directly measured.  Default: '
-                      'on for the sparse trainer')
+                      'measure the THREE-arm serving A/B (no-batch / '
+                      'monolithic batcher / bucket-ladder+pipelined '
+                      'dispatch) over a concurrent request stream cut '
+                      'from the bench traffic — journals serve_p50_ms '
+                      '/ serve_p99_ms / serve_qps / serve_batch_fill '
+                      '+ the monolithic and no-batch arms, '
+                      'serve_pad_waste_pct, per-bucket launch counts '
+                      'and serve_pipeline_overlap_pct, all directly '
+                      'measured.  Default: on for the sparse trainer')
   parser.add_argument('--serve_batch', type=int, default=256,
-                      help='the ONE compiled serving batch (rounded '
-                      'down to a device-count multiple)')
+                      help='the LARGEST compiled serving batch — the '
+                      'top ladder rung (rounded down to a device-count '
+                      'multiple)')
+  parser.add_argument('--serve_buckets', default=None,
+                      help='comma-separated compiled-shape ladder '
+                      'rungs (design §16), e.g. "32,64,128,256"; '
+                      'default: the pow-2 ladder {B/8, B/4, B/2, B}. '
+                      'Pass the full batch alone to serve the '
+                      'monolithic single-signature engine.')
   parser.add_argument('--serve_requests', type=int, default=192,
                       help='request count per serving arm')
   parser.add_argument('--serve_max_delay_ms', type=float, default=2.0,
@@ -1144,16 +1154,21 @@ def main():
     except Exception as e:
       tier_stats = {'cold_tier_error': f'{type(e).__name__}: {e}'}
 
-  # Online-serving phase (serving/, design §14; ISSUE 9).  The trained
-  # tables freeze into a lookup-only ServingEngine — quantized to int8
-  # payload+scale unless the plan already carries a table_dtype, the
-  # production serving shape and 4x less host/device memory for the
-  # second table copy this phase holds — with a serving-sized READ-ONLY
-  # hot cache (state_copies=0: no optimizer slots to fund).  Both arms
-  # are measured directly over the same request stream cut from the
-  # bench traffic: per-request submit->demux latencies from the batcher
-  # itself (p50/p99), sequential full-batch dispatches for the no-batch
-  # arm.  Never fatal.
+  # Online-serving phase (serving/, design §14 + §16; ISSUES 9, 12).
+  # The trained tables freeze into a lookup-only ServingEngine —
+  # quantized to int8 payload+scale unless the plan already carries a
+  # table_dtype, the production serving shape and 4x less host/device
+  # memory for the second table copy this phase holds — with a
+  # serving-sized READ-ONLY hot cache (state_copies=0: no optimizer
+  # slots to fund) and the compiled-shape bucket ladder (warmup
+  # AOT-compiles every rung; no arm ever eats a compile).  All THREE
+  # arms are measured directly over the same request stream cut from
+  # the bench traffic: per-request submit->demux latencies from the
+  # batcher itself (p50/p99), sequential ladder-rung dispatches for
+  # the no-batch arm, the monolithic serial batcher as the middle arm,
+  # and the ladder+pipelined batcher as the headline — plus the
+  # pad-waste and pipeline-overlap accounting (design §16).  Never
+  # fatal.
   serve_stats = None
   use_serve = args.serve
   if use_serve is None:
@@ -1188,11 +1203,16 @@ def main():
       requests = serving_lib.split_requests(
           [np.asarray(c) for c in cats0], sizes=(1, 2, 4, 8),
           limit=args.serve_requests)
+      sv_buckets = None
+      if args.serve_buckets:
+        sv_buckets = [int(b) for b in
+                      str(args.serve_buckets).split(',') if b.strip()]
       engine = serving_lib.ServingEngine(
           dist0.table_configs, bundle_tables, batch_size=sv_batch,
           mesh=mesh, input_table_map=list(dist0.plan.input_table_map),
           hotness=[1 if np.asarray(c).ndim == 1 else
                    np.asarray(c).shape[1] for c in cats0],
+          buckets=sv_buckets,
           hot_sets=serve_hot)
       serve_stats = serving_lib.measure_serving(
           engine, requests, max_delay_ms=args.serve_max_delay_ms,

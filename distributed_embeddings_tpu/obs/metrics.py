@@ -79,8 +79,15 @@ METRIC_TYPES: Dict[str, str] = {
     'serve.batches': 'counter',
     'serve.batch_fill': 'gauge',
     'serve.latency_ms': 'histogram',
+    # pipelined dispatch stages (design §16)
+    'serve.merge_ms': 'histogram',
+    'serve.demux_ms': 'histogram',
     'engine.lookups': 'counter',
     'engine.samples': 'counter',
+    # bucket-ladder padding accounting (design §16): rows the compiled
+    # rung launched vs the sentinel rows among them
+    'engine.rows_launched': 'counter',
+    'engine.pad_rows': 'counter',
     'engine.lookup_ms': 'histogram',
 }
 

@@ -74,9 +74,12 @@ REGISTERED_SPANS = frozenset({
     'audit/check',
     # checkpoints (parallel/checkpoint.py)
     'ckpt/save', 'ckpt/restore',
-    # serving request path (serving/batcher.py + serving/engine.py)
-    'serve/submit', 'serve/enqueue', 'serve/dispatch', 'serve/lookup',
-    'serve/execute', 'serve/demux',
+    # serving request path (serving/batcher.py + serving/engine.py);
+    # serve/merge, serve/execute and serve/demux are the pipelined
+    # dispatcher's three stages (design §16) — on separate threads when
+    # the pipeline is on, nested under serve/dispatch when serial
+    'serve/submit', 'serve/enqueue', 'serve/dispatch', 'serve/merge',
+    'serve/lookup', 'serve/execute', 'serve/demux',
 })
 
 # Report classification (tools/trace_report.py): 'wait' spans are

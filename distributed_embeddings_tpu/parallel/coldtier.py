@@ -362,29 +362,34 @@ def compute_fetch_rows(dist, inputs):
   return rows, counts
 
 
-def _ensure_caps(dist, counts):
+def _ensure_caps(dist, counts, global_batch: int):
   """First-batch calibration of the static per-group fetch capacity
-  (margin + alignment); a later batch needing more rows than the
-  calibrated cap REFUSES actionably instead of silently dropping."""
+  (margin + alignment) — tracked PER GLOBAL BATCH, so every serving
+  ladder rung carries its own right-sized fetch shape (design §16); a
+  later batch at the same rung needing more rows than the calibrated
+  cap REFUSES actionably, naming the bucket, instead of silently
+  dropping."""
+  caps = dist.fetch_caps_for(global_batch)
   for gi, per_dev in counts.items():
     need = max(per_dev) if per_dev else 0
-    cap = dist._cold_fetch_caps.get(gi)
+    cap = caps.get(gi)
     if cap is None:
       cap = max(_FETCH_ALIGN,
                 -(-int(need * _FETCH_MARGIN) // _FETCH_ALIGN)
                 * _FETCH_ALIGN)
       cap = min(cap, dist.plan.groups[gi].tier_rows)
       cap = max(cap, min(_FETCH_ALIGN, dist.plan.groups[gi].tier_rows))
-      dist._cold_fetch_caps[gi] = cap
+      caps[gi] = cap
     if need > cap:
       raise ValueError(
-          f'cold-tier fetch overflow on group {gi}: this batch needs '
-          f'{need} tail rows on one device but the static fetch '
-          f'capacity is {cap}. Construct the layer with '
-          f'cold_fetch_rows={{{gi}: {int(need * _FETCH_MARGIN)}}} (or '
-          'a larger global value) so the buffers are sized for the '
-          'workload — silent dropping is never an option '
-          '(docs/design.md §12).')
+          f'cold-tier fetch overflow on group {gi} at batch bucket '
+          f'{global_batch}: this batch needs {need} tail rows on one '
+          f'device but the bucket\'s static fetch capacity is {cap}. '
+          f'Construct the layer with cold_fetch_rows={{{gi}: '
+          f'{int(need * _FETCH_MARGIN)}}} (or a larger global value), '
+          'or warm the engine on traffic representative of this '
+          'bucket, so the buffers are sized for the workload — silent '
+          'dropping is never an option (docs/design.md §12, §16).')
 
 
 def build_fetch(dist, inputs, rows=None) -> ColdFetch:
@@ -407,7 +412,9 @@ def _build_fetch(dist, inputs, rows=None) -> ColdFetch:
     rows, counts = compute_fetch_rows(dist, inputs)
   else:
     rows, counts = rows
-  _ensure_caps(dist, counts)
+  global_batch = int(inputs[0].shape[0]) if len(inputs) else 0
+  _ensure_caps(dist, counts, global_batch)
+  caps = dist.fetch_caps_for(global_batch)
   obs_metrics.inc('coldtier.fetch_rows',
                   sum(sum(per) for per in counts.values()))
   if tier.digests_enabled:
@@ -434,7 +441,7 @@ def _build_fetch(dist, inputs, rows=None) -> ColdFetch:
   for gi in plan.cold_tier_groups:
     g = plan.groups[gi]
     res = g.device_rows
-    cap = dist._cold_fetch_caps[gi]
+    cap = caps[gi]
     D = plan.world_size
     rows_pad = np.full((D, cap), g.rows_cap, np.int32)
     payload = np.zeros((D, cap, g.width), tier.payload[gi].dtype)

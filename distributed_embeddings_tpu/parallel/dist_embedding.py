@@ -179,7 +179,10 @@ class DistributedEmbedding:
     cold_fetch_rows: static per-batch fetch capacity (int, or
       ``{group_index: int}``) for the cold-tier host->device stream;
       ``None`` calibrates from the first batch with margin
-      (``parallel/coldtier.py``).
+      (``parallel/coldtier.py``).  Capacities are tracked per global
+      batch size — each serving ladder rung calibrates (and compiles)
+      its own fetch shape (design §16); explicit values here pin every
+      rung to the same cap.
   """
 
   def __init__(self,
@@ -362,14 +365,20 @@ class DistributedEmbedding:
     if self.plan.cold_tier_groups:
       from distributed_embeddings_tpu.parallel.coldtier import HostTier
       self.cold_tier = HostTier(self.plan, self.quant)
-    self._cold_fetch_caps: Dict[int, int] = {}
+    # static fetch capacities are PER GLOBAL BATCH (the serving bucket
+    # ladder compiles several batch rungs, each with its own calibrated
+    # fetch shape — design §16): _cold_fetch_caps maps
+    # global_batch -> {group: cap}.  Constructor-pinned rows apply at
+    # EVERY batch (they seed each rung's dict on first use).
+    self._cold_fetch_caps: Dict[int, Dict[int, int]] = {}
+    self._cold_fetch_pinned: Dict[int, int] = {}
     if cold_fetch_rows is not None:
       if isinstance(cold_fetch_rows, dict):
-        self._cold_fetch_caps = {int(k): int(v)
-                                 for k, v in cold_fetch_rows.items()}
+        self._cold_fetch_pinned = {int(k): int(v)
+                                   for k, v in cold_fetch_rows.items()}
       else:
-        self._cold_fetch_caps = {gi: int(cold_fetch_rows)
-                                 for gi in self.plan.cold_tier_groups}
+        self._cold_fetch_pinned = {gi: int(cold_fetch_rows)
+                                   for gi in self.plan.cold_tier_groups}
     if overlap_chunks > 1 and any(self.plan.row_sliced) \
         and not self.hot_enabled:
       raise ValueError(
@@ -396,8 +405,12 @@ class DistributedEmbedding:
             "lookup_impl='auto' for this model, or adjust "
             "param_dtype/combiners to SC-servable settings.")
     # compiled-function cache, keyed by shape signature; lives on the
-    # instance so dropping the layer frees its traced executables
+    # instance so dropping the layer frees its traced executables.
+    # compile_count increments on every cache MISS (a new signature
+    # being traced+built) — the serving no-mid-serve-compile pin reads
+    # it across warmed traffic (design §16).
     self._fn_cache: Dict[Any, Any] = {}
+    self.compile_count = 0
 
   def _lookup(self, table: jax.Array, routed: jax.Array,
               combiner: Optional[str], pack: int = 1,
@@ -512,21 +525,35 @@ class DistributedEmbedding:
                    on_batch_error=on_batch_error, io_retries=io_retries,
                    max_respawns=max_respawns)
 
+  def fetch_caps_for(self, global_batch: int) -> Dict[int, int]:
+    """The per-group static fetch capacities for ONE global batch size
+    (serving bucket rungs each carry their own calibrated caps —
+    design §16).  Constructor-pinned ``cold_fetch_rows`` seed every
+    rung; calibration (``coldtier._ensure_caps``) fills the rest from
+    the first concrete batch at that rung."""
+    caps = self._cold_fetch_caps.get(int(global_batch))
+    if caps is None:
+      caps = dict(self._cold_fetch_pinned)
+      self._cold_fetch_caps[int(global_batch)] = caps
+    return caps
+
   def compile_lookup(self, global_batch: int, hotness=None):
     """The LOOKUP-ONLY jitted forward for one ``(batch, hotness)``
     signature — the serving entry point (docs/design.md §14).
 
-    Returns the exact cached program ``apply`` dispatches to for that
-    signature: ``fn(params, *inputs)`` for plain layers,
+    Serving engines call this once per bucket rung of their compiled-
+    shape ladder (design §16); each rung is an independent cached
+    signature.  Returns the exact cached program ``apply`` dispatches
+    to for that signature: ``fn(params, *inputs)`` for plain layers,
     ``fn(params, fetch, *inputs)`` for hot-cache layers (``fetch`` is
     ``{}`` for fully resident plans).  The traced program contains the
     forward alone — no backward, no optimizer leaves, no donation — so
     a serving process never compiles (or holds) anything but the
-    lookup.  Cold-tier plans need their static fetch capacities fixed
-    first (``cold_fetch_rows=`` at construction, or one concrete
-    ``apply`` on representative traffic — ``ServingEngine.warmup``);
-    compiling before that would bake an arbitrary fetch shape into the
-    one program.
+    lookup.  Cold-tier plans need the rung's static fetch capacities
+    fixed first (``cold_fetch_rows=`` at construction, or one concrete
+    ``apply`` on representative traffic at that batch size —
+    ``ServingEngine.warmup`` runs every rung); compiling before that
+    would bake an arbitrary fetch shape into the rung's program.
     """
     hotness = tuple(int(h) for h in (hotness if hotness is not None
                                      else (1,) * self.num_inputs))
@@ -537,17 +564,19 @@ class DistributedEmbedding:
     if self.hot_enabled:
       caps = ()
       if self.cold_tier is not None:
+        batch_caps = self.fetch_caps_for(global_batch)
         missing = [gi for gi in self.plan.cold_tier_groups
-                   if gi not in self._cold_fetch_caps]
+                   if gi not in batch_caps]
         if missing:
           raise ValueError(
               f'cold-tier groups {missing} have no static fetch '
-              'capacity yet: pass cold_fetch_rows= at construction or '
-              'run one concrete forward on representative traffic '
-              '(ServingEngine.warmup) before compile_lookup '
-              '(docs/design.md §14)')
+              f'capacity for bucket {global_batch} yet: pass '
+              'cold_fetch_rows= at construction or run one concrete '
+              'forward on representative traffic at this batch size '
+              '(ServingEngine.warmup compiles every ladder rung) '
+              'before compile_lookup (docs/design.md §14, §16)')
         caps = tuple(sorted(
-            (gi, self._cold_fetch_caps[gi])
+            (gi, batch_caps[gi])
             for gi in self.plan.cold_tier_groups))
       return self._build_dp_forward_hot(global_batch, hotness,
                                         fetch_caps=caps)
@@ -1177,6 +1206,7 @@ class DistributedEmbedding:
     key = ('dp_fwd', global_batch, hotness, with_residuals)
     if key in self._fn_cache:
       return self._fn_cache[key]
+    self.compile_count += 1
     D = self.world_size
     # each slice serves its own contiguous [slice_batch] sub-batch with
     # its table replica; all collectives below stay intra-slice (ICI)
@@ -1337,6 +1367,7 @@ class DistributedEmbedding:
     key = ('mp_fwd', global_batch, hotness, with_residuals)
     if key in self._fn_cache:
       return self._fn_cache[key]
+    self.compile_count += 1
     D = self.world_size
     slice_batch = global_batch // self.num_slices
     local_batch = slice_batch // D
@@ -1726,6 +1757,7 @@ class DistributedEmbedding:
            fetch_caps)
     if key in self._fn_cache:
       return self._fn_cache[key]
+    self.compile_count += 1
     D = self.world_size
     slice_batch = global_batch // self.num_slices
     local_batch = slice_batch // D

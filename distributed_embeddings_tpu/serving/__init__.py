@@ -5,12 +5,14 @@ Training Infrastructure for Online Ads Recommendation ... at Google",
 PAPERS.md): a training checkpoint freezes into a read-only bundle
 (``export.py`` — optimizer slots stripped, quantized payload+scale kept
 narrow, manifest-verified), the bundle restores into a ``ServingEngine``
-(``engine.py`` — ONE compiled lookup-only forward over the existing
-dispatch paths, serving-sized read-only hot cache, fetch-only cold
-tier), and a ``DynamicBatcher`` (``batcher.py``) merges many small
-concurrent user requests into that one padded static device batch with
-per-request demux and p50/p99 latency accounting (``bench.py`` — the
-block bench.py journals in the standard artifact).
+(``engine.py`` — a bucketed LADDER of compiled lookup-only forwards
+over the existing dispatch paths (design §16), serving-sized read-only
+hot cache, fetch-only cold tier), and a ``DynamicBatcher``
+(``batcher.py``) merges many small concurrent user requests into
+padded static device batches at the smallest fitting ladder rung, with
+pipelined merge -> execute -> demux dispatch, per-request demux and
+p50/p99 latency accounting (``bench.py`` — the three-arm block
+bench.py journals in the standard artifact).
 """
 
 from distributed_embeddings_tpu.serving.export import (
@@ -19,7 +21,10 @@ from distributed_embeddings_tpu.serving.export import (
     export_serving_bundle,
     load_serving_bundle,
 )
-from distributed_embeddings_tpu.serving.engine import ServingEngine
+from distributed_embeddings_tpu.serving.engine import (
+    ServingEngine,
+    default_bucket_ladder,
+)
 from distributed_embeddings_tpu.serving.batcher import (
     DynamicBatcher,
     ServeFuture,

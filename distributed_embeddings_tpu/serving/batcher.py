@@ -1,13 +1,14 @@
 """Dynamic request batcher: many small requests -> one static device batch.
 
 The admission half of serving (docs/design.md §14 "Batcher admission
-policy").  Concurrent user requests (each a per-input list of id arrays
-for ``n`` samples) enqueue through ``submit``; a dispatcher thread
-merges them — launching as soon as the batch is FULL (``max_batch``
-samples) or the OLDEST queued request has waited ``max_delay_ms``,
-whichever comes first — into one ``-1``-padded batch at the engine's
-single compiled signature, runs the lookup, and demuxes each request's
-``[n, output_dim]`` slice back to its ``ServeFuture``.
+policy"; §16 for the dispatch pipeline).  Concurrent user requests
+(each a per-input list of id arrays for ``n`` samples) enqueue through
+``submit``; a dispatcher thread merges them — launching as soon as the
+batch is FULL (``max_batch`` samples) or the OLDEST queued request has
+waited ``max_delay_ms``, whichever comes first — into one ``-1``-padded
+batch at the SMALLEST compiled ladder rung that holds it
+(``engine.bucket_for``; design §16), runs the lookup, and demuxes each
+request's ``[n, output_dim]`` slice back to its ``ServeFuture``.
 
 Admission rules (all pinned in tests/test_serving.py):
 
@@ -20,19 +21,39 @@ Admission rules (all pinned in tests/test_serving.py):
   rides the NEXT batch (requests are never split);
 - demux is BIT-EXACT vs running the same request through
   ``engine.lookup_padded`` alone (hotness-1; multi-hot within the
-  pinned 1e-6 fold-order bound): per-sample lookup+combine is
-  independent of batch composition, so batching is pure scheduling.
+  pinned 1e-6 fold-order bound) AT EVERY LADDER RUNG: per-sample
+  lookup+combine is independent of batch composition AND of the
+  launched rung, so batching (and rung selection) is pure scheduling.
+
+Pipelined dispatch (``pipeline=True``, the default; design §16): the
+merge -> execute -> demux stages double-buffer across three threads the
+way ``CsrFeed`` hides the host CSR build — the dispatcher merges batch
+N+1 and the demux thread slices/resolves batch N-1 while the device
+executes batch N.  Stage hand-offs are bounded queues with liveness
+checks (a dead stage fails the batch fast, never wedges upstream),
+results demux in FIFO launch order, and a failed stage fails exactly
+its batch's futures — the admission policy, the
+exception-fails-the-batch contract and the stats-before-resolve rule
+are the serial path's, verbatim.  ``stats()['pipeline']`` measures the
+hidden host share from consumer blocked time (``OverlapStat``, the
+csr_feed/coldtier accounting): build = merge + demux walls, blocked =
+the executor's wait for a merged batch (bounded by that batch's merge
+wall — admission/idle waits are policy, not pipeline cost) plus its
+backpressure wait on the demux queue.
 
 With ``csr_feed=True`` merged batches additionally flow through a
 ``CsrFeed`` over a bounded in-memory ``QueueSource`` (no disk touch):
 batch N+1's padded static-CSR host buffers build on worker threads
 while the device runs batch N, and the feed's build/parity/queue
-counters fold into ``stats()``.  Same contract as the training
-pipeline (see ``csr_feed.py``): on SparseCore hardware the custom-call
-binding consumes the buffers directly; on the XLA/emulation backends
-they are the measured host-side feed cost the overlap exists to hide,
-while the jitted lookup recomputes the same content via the traced
-twin.
+counters fold into ``stats()``.  csr_feed mode launches every batch at
+the FULL engine signature and keeps its lookup+demux on the feed
+consumer thread — the feed's static CSR capacities calibrate once and
+must hold for every batch, so the bucket ladder and the stage pipeline
+stay out of its way.  Same contract as the training pipeline (see
+``csr_feed.py``): on SparseCore hardware the custom-call binding
+consumes the buffers directly; on the XLA/emulation backends they are
+the measured host-side feed cost the overlap exists to hide, while the
+jitted lookup recomputes the same content via the traced twin.
 """
 
 from __future__ import annotations
@@ -95,7 +116,7 @@ _CLOSE = object()
 
 
 class DynamicBatcher:
-  """Merge concurrent requests into the engine's one compiled batch.
+  """Merge concurrent requests into the engine's compiled batch ladder.
 
   Args:
     engine: a warmed (or warm-on-first-batch) ``ServingEngine``.
@@ -107,15 +128,24 @@ class DynamicBatcher:
       engine's ``batch_size`` — the padded remainder is sentinel rows).
     queue_depth: bound on queued requests (backpressure: ``submit``
       blocks when full).
+    pipeline: double-buffer merge/execute/demux across stage threads
+      (design §16; default on).  ``False`` runs the three stages
+      serially on the dispatcher thread — the pre-ladder monolithic
+      dispatch, kept as the bench A/B's middle arm.
+    bucket_ladder: launch each merged batch at the smallest engine
+      ladder rung that holds it (default on).  ``False`` launches every
+      batch at the full ``engine.batch_size`` signature.
     csr_feed: also build each merged batch's static-CSR host buffers
       through a ``CsrFeed`` over a bounded in-memory ``QueueSource``
-      (see module docstring).
+      (see module docstring; forces full-signature launches and the
+      feed-consumer execute path).
   """
 
   def __init__(self, engine, max_delay_ms: float = 2.0,
                max_batch: Optional[int] = None, queue_depth: int = 256,
                csr_feed: bool = False,
-               csr_feed_kwargs: Optional[dict] = None):
+               csr_feed_kwargs: Optional[dict] = None,
+               pipeline: bool = True, bucket_ladder: bool = True):
     self.engine = engine
     self.max_batch = int(max_batch if max_batch is not None
                          else engine.batch_size)
@@ -138,9 +168,15 @@ class DynamicBatcher:
     self._completed = 0
     self._batches = 0
     self._fill_sum = 0.0
+    # bucket-ladder padding accounting (design §16): rows launched vs
+    # the sentinel rows among them, plus per-rung launch counts
+    self._rows_launched = 0
+    self._pad_rows = 0
+    self._bucket_launches: dict = {}
     # the shared bounded exact-latency primitive (obs/metrics.py
     # LatencyWindow) — stats() keys and percentile arithmetic unchanged
     self._latencies = obs_metrics.LatencyWindow()
+    self.bucket_ladder = bool(bucket_ladder) and not csr_feed
     self._feed = None
     self._queue_source = None
     self._consumer = None
@@ -156,6 +192,24 @@ class DynamicBatcher:
                                         name='serve-feed-consumer',
                                         daemon=True)
       self._consumer.start()
+    # pipelined dispatch stages (design §16); csr_feed mode keeps its
+    # own overlap machinery (the feed IS the pipeline there)
+    self.pipeline = bool(pipeline) and not csr_feed
+    self._pipe = obs_metrics.OverlapStat() if self.pipeline else None
+    self._exec_q: Optional[queue.Queue] = None
+    self._demux_q: Optional[queue.Queue] = None
+    self._executor: Optional[threading.Thread] = None
+    self._demuxer: Optional[threading.Thread] = None
+    if self.pipeline:
+      self._exec_q = queue.Queue(maxsize=2)
+      self._demux_q = queue.Queue(maxsize=2)
+      self._demuxer = threading.Thread(target=self._demux_loop,
+                                       name='serve-demux', daemon=True)
+      self._demuxer.start()
+      self._executor = threading.Thread(target=self._execute_loop,
+                                        name='serve-executor',
+                                        daemon=True)
+      self._executor.start()
     self._dispatcher = threading.Thread(target=self._dispatch_loop,
                                         name='serve-batcher',
                                         daemon=True)
@@ -227,12 +281,13 @@ class DynamicBatcher:
       first = pending
       pending = None
       if first is None:
-        try:
-          first = self._q.get(timeout=0.05)
-        except queue.Empty:
-          if self._closed.is_set():
-            break
-          continue
+        if self._closed.is_set():
+          break
+        # IDLE: block indefinitely — an idle serving process burns
+        # zero scheduled wakeups (no 50 ms polling; pinned in
+        # tests/test_serving.py).  close() guarantees the _CLOSE
+        # sentinel lands, so this get always wakes on shutdown.
+        first = self._q.get()
         if first is _CLOSE:
           break
       batch = [first]
@@ -296,15 +351,15 @@ class DynamicBatcher:
     if self._queue_source is not None:
       self._queue_source.close()
 
-  def _merge(self, batch) -> List[np.ndarray]:
-    """One ``-1``-padded batch at the engine signature from the
-    requests' per-input arrays (request r's samples occupy rows
+  def _merge(self, batch, bucket: int) -> List[np.ndarray]:
+    """One ``-1``-padded batch at the ``bucket`` rung signature from
+    the requests' per-input arrays (request r's samples occupy rows
     ``[off_r, off_r + n_r)`` of every input)."""
     eng = self.engine
     merged = []
     for i in range(eng.dist.num_inputs):
       h = eng.hotness[i]
-      buf = np.full((eng.batch_size, h), -1, np.int32)
+      buf = np.full((bucket, h), -1, np.int32)
       off = 0
       for slot in batch:
         x = slot.cats[i]
@@ -314,8 +369,52 @@ class DynamicBatcher:
       merged.append(buf[:, 0] if h == 1 else buf)
     return merged
 
+  # a wedged (alive but stuck) downstream stage must not spin the
+  # upstream thread forever: past this deadline the hand-off gives up
+  # and fails the batch.  Generous — a legitimately busy executor is
+  # mid-device-lookup, which is seconds at worst, not minutes.
+  _STAGE_PUT_DEADLINE_S = 120.0
+
+  def _put_stage(self, q: queue.Queue, item, consumer, batch) -> bool:
+    """Bounded hand-off to a downstream stage thread with a liveness
+    check AND an overall deadline: a dead stage fails this batch's
+    futures fast, a wedged one fails them after the deadline — the
+    upstream thread (and with it every later request) never spins
+    forever on a queue nothing will drain."""
+    t0 = time.monotonic()
+    why = None
+    while why is None:
+      if consumer is None or not consumer.is_alive():
+        why = (f'({getattr(consumer, "name", "consumer")} exited)')
+      elif time.monotonic() - t0 > self._STAGE_PUT_DEADLINE_S:
+        why = (f'({getattr(consumer, "name", "consumer")} wedged: '
+               f'hand-off blocked > {self._STAGE_PUT_DEADLINE_S:g}s)')
+      else:
+        try:
+          q.put(item, timeout=0.2)
+          return True
+        except queue.Full:
+          continue
+    err = RuntimeError(
+        f'serving dispatch pipeline stage is stuck {why}; '
+        'request not served')
+    for slot in batch:
+      if not slot.future.done():
+        slot.future._resolve(err=err)
+    return False
+
   def _launch(self, batch, n):
-    merged = self._merge(batch)
+    # stage 1: MERGE — at the smallest ladder rung holding n (csr_feed
+    # mode pins the full signature; see module docstring)
+    eng = self.engine
+    bucket = (eng.bucket_for(n) if self.bucket_ladder
+              else eng.batch_size)
+    t0 = obs_trace.now()
+    merged = self._merge(batch, bucket)
+    merge_ms = (obs_trace.now() - t0) * 1000.0
+    obs_trace.complete('serve/merge', t0, merge_ms / 1000.0,
+                       requests=len(batch), samples=n, bucket=bucket)
+    obs_metrics.observe('serve.merge_ms', merge_ms)
     if self._queue_source is not None:
       # csr_feed mode: the merged batch rides the in-memory queue into
       # the CsrFeed; the consumer thread executes + demuxes in feed
@@ -344,7 +443,111 @@ class DynamicBatcher:
         if not slot.future.done():
           slot.future._resolve(err=err)
       return
+    if self.pipeline:
+      with self._lock:
+        self._pipe.add_build(merge_ms)
+      # stage hand-off: the executor thread runs the device lookup for
+      # this batch while the dispatcher merges the next
+      self._put_stage(self._exec_q, (merged, batch, n, merge_ms),
+                      self._executor, batch)
+      return
     self._execute(merged, batch, n)
+
+  def _execute_loop(self):
+    """Stage 2 thread: device execution.  The pipeline's CONSUMER for
+    the blocked-time overlap accounting — its wait for a merged batch
+    (bounded by that batch's merge wall: admission/idle waits are
+    policy, not pipeline cost) plus its backpressure wait on the demux
+    queue is exactly the host pipeline time the device felt."""
+    while True:
+      t0 = time.perf_counter()
+      item = self._exec_q.get()
+      wait_ms = (time.perf_counter() - t0) * 1000.0
+      if item is None:
+        self._demux_q.put(None)  # forward shutdown downstream, FIFO
+        return
+      merged, batch, n, merge_ms = item
+      with self._lock:
+        self._pipe.add_blocked(min(wait_ms, merge_ms))
+      self._execute(merged, batch, n)
+
+  def _demux_loop(self):
+    """Stage 3 thread: host demux in FIFO launch order (a single
+    consumer of a FIFO queue — order is structural, not scheduled)."""
+    while True:
+      item = self._demux_q.get()
+      if item is None:
+        return
+      host, batch, n = item
+      try:
+        self._demux(host, batch, n)
+      except BaseException as e:
+        # a torn demux fails exactly its batch; the stage survives
+        for slot in batch:
+          if not slot.future.done():
+            slot.future._resolve(err=e)
+
+  def _execute(self, merged, batch, n):
+    try:
+      with obs_trace.span('serve/execute', requests=len(batch),
+                          samples=n):
+        outs = self.engine.lookup(merged, samples=n)
+        host = [np.asarray(o) for o in outs]
+    except BaseException as e:
+      for slot in batch:
+        slot.future._resolve(err=e)
+      return
+    if self.pipeline:
+      t0 = time.perf_counter()
+      if self._put_stage(self._demux_q, (host, batch, n),
+                         self._demuxer, batch):
+        put_ms = (time.perf_counter() - t0) * 1000.0
+        with self._lock:
+          self._pipe.add_blocked(put_ms)  # demux backpressure
+      return
+    self._demux(host, batch, n)
+
+  def _demux(self, host, batch, n):
+    bucket = int(host[0].shape[0]) if host else 0
+    tok = obs_trace.begin('serve/demux', requests=len(batch))
+    t0 = time.perf_counter()
+    now = time.monotonic()
+    lats = [(now - slot.t0) * 1000.0 for slot in batch]
+    # the demux WORK (per-request slicing) happens before any future
+    # fires, so demux_ms — the stat and the pipeline build share the
+    # one measurement — covers it without racing the stats contract
+    off = 0
+    outs = []
+    for slot in batch:
+      outs.append([h[off:off + slot.n] for h in host])
+      off += slot.n
+    demux_ms = (time.perf_counter() - t0) * 1000.0
+    # EVERY stat updates BEFORE the futures resolve (pipeline
+    # accounting included): a caller reading stats() the moment
+    # result() returns must already see this batch fully counted
+    # (measure_serving journals straight off that read, and the
+    # pipeline.batches == batches pin reads the same way)
+    with self._lock:
+      self._batches += 1
+      self._fill_sum += n / self.max_batch
+      self._completed += len(batch)
+      self._latencies.extend(lats)
+      self._rows_launched += bucket
+      self._pad_rows += bucket - n
+      self._bucket_launches[bucket] = \
+          self._bucket_launches.get(bucket, 0) + 1
+      if self._pipe is not None:
+        self._pipe.add_build(demux_ms)
+        self._pipe.count_batch()
+    obs_metrics.inc('serve.batches')
+    obs_metrics.inc('serve.completed', len(batch))
+    obs_metrics.set_gauge('serve.batch_fill', n / self.max_batch)
+    obs_metrics.observe('serve.demux_ms', demux_ms)
+    for lat in lats:
+      obs_metrics.observe('serve.latency_ms', lat)
+    for slot, out, lat in zip(batch, outs, lats):
+      slot.future._resolve(out=out, latency_ms=lat)
+    obs_trace.end(tok)
 
   def _consume_feed(self):
     try:
@@ -368,53 +571,47 @@ class DynamicBatcher:
       slot.future._resolve(err=RuntimeError(
           'batcher closed before the request was served'))
 
-  def _execute(self, merged, batch, n):
-    try:
-      with obs_trace.span('serve/execute', requests=len(batch),
-                          samples=n):
-        outs = self.engine.lookup(merged)
-        host = [np.asarray(o) for o in outs]
-    except BaseException as e:
-      for slot in batch:
-        slot.future._resolve(err=e)
-      return
-    tok = obs_trace.begin('serve/demux', requests=len(batch))
-    now = time.monotonic()
-    lats = [(now - slot.t0) * 1000.0 for slot in batch]
-    # stats update BEFORE the futures resolve: a caller reading
-    # stats() the moment result() returns must already see this batch
-    # counted (measure_serving journals straight off that read)
-    with self._lock:
-      self._batches += 1
-      self._fill_sum += n / self.max_batch
-      self._completed += len(batch)
-      self._latencies.extend(lats)
-    obs_metrics.inc('serve.batches')
-    obs_metrics.inc('serve.completed', len(batch))
-    obs_metrics.set_gauge('serve.batch_fill', n / self.max_batch)
-    for lat in lats:
-      obs_metrics.observe('serve.latency_ms', lat)
-    off = 0
-    for slot, lat in zip(batch, lats):
-      out = [h[off:off + slot.n] for h in host]
-      off += slot.n
-      slot.future._resolve(out=out, latency_ms=lat)
-    obs_trace.end(tok)
-
   # ----------------------------------------------------------- lifecycle
 
+  def _put_sentinel(self, q: queue.Queue, item, thread,
+                    deadline_s: float = 30.0):
+    """Land a shutdown sentinel on a stage queue: retries while the
+    consuming thread is alive (it is draining, so space appears) up to
+    ``deadline_s`` — a WEDGED consumer must not make close() spin
+    forever; the joins below time out and the final sweep still fails
+    whatever never launched.  A dead consumer needs no sentinel."""
+    t0 = time.monotonic()
+    while thread is not None and thread.is_alive() \
+        and time.monotonic() - t0 <= deadline_s:
+      try:
+        q.put(item, timeout=0.1)
+        return
+      except queue.Full:
+        continue
+
   def close(self):
-    """Stop the dispatcher; pending requests fail with a clear error.
+    """Stop the dispatcher and the pipeline stages; launched batches
+    complete, never-launched requests fail with a clear error.
     Idempotent."""
     with self._submit_lock:
       if self._closed.is_set():
         return
       self._closed.set()
-    try:
-      self._q.put_nowait(_CLOSE)
-    except queue.Full:
-      pass
+    # the sentinel MUST land: the idle dispatcher blocks indefinitely
+    # on the queue (zero idle wakeups), so only the sentinel — or a
+    # drained backlog item — wakes it.  submit refuses once _closed is
+    # set, so the queue only drains from here and the retry put cannot
+    # livelock.
+    self._put_sentinel(self._q, _CLOSE, self._dispatcher)
     self._dispatcher.join(timeout=30.0)
+    if self.pipeline:
+      # flush the stages in launch order; the executor forwards the
+      # sentinel so every in-flight batch demuxes before the threads
+      # exit (a direct put covers an already-dead executor)
+      self._put_sentinel(self._exec_q, None, self._executor)
+      self._executor.join(timeout=30.0)
+      self._put_sentinel(self._demux_q, None, self._demuxer)
+      self._demuxer.join(timeout=30.0)
     # nothing can enqueue past this point (the _submit_lock pairing in
     # submit re-checks the flag before its put): one final sweep and
     # no future is ever stranded unresolved
@@ -445,11 +642,15 @@ class DynamicBatcher:
   def stats(self) -> dict:
     """Latency / fill accounting: ``p50_ms``/``p99_ms`` over resolved
     request latencies (submit -> demux), mean ``batch_fill`` (samples /
-    ``max_batch``), and the feed's build/queue counters in csr_feed
-    mode."""
+    ``max_batch``), the bucket-ladder padding accounting
+    (``rows_launched``/``pad_rows``/``pad_waste_pct`` +
+    ``bucket_launches`` per rung), the ``pipeline`` overlap block when
+    the staged dispatch is on, and the feed's build/queue counters in
+    csr_feed mode."""
     with self._lock:
       p50 = self._latencies.percentile(50)
       p99 = self._latencies.percentile(99)
+      launched = self._rows_launched
       out = {
           'submitted': self._submitted,
           'completed': self._completed,
@@ -460,7 +661,22 @@ class DynamicBatcher:
                          if self._batches else None),
           'p50_ms': round(p50, 3) if p50 is not None else None,
           'p99_ms': round(p99, 3) if p99 is not None else None,
+          'bucket_ladder': self.bucket_ladder,
+          'buckets': (list(self.engine.buckets) if self.bucket_ladder
+                      else [self.engine.batch_size]),
+          'bucket_launches': dict(self._bucket_launches),
+          'rows_launched': launched,
+          'pad_rows': self._pad_rows,
+          'pad_waste_pct': (round(100.0 * self._pad_rows / launched, 3)
+                            if launched else None),
       }
+      if self._pipe is not None:
+        out['pipeline'] = {
+            'batches': self._pipe.batches,
+            'merge_demux_ms': round(self._pipe.build_ms, 3),
+            'blocked_ms': round(self._pipe.blocked_ms, 3),
+            'overlap_pct': round(self._pipe.overlap_frac(), 4),
+        }
     if self._feed is not None:
       out['csr_feed'] = self._feed.stats()
     return out

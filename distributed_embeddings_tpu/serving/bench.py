@@ -1,21 +1,31 @@
 """Serving measurement: the p50/p99/QPS block bench.py journals.
 
-Two directly-measured arms over the SAME request set and the SAME
-compiled engine program (docs/design.md §14):
+THREE directly-measured arms over the SAME request set and the SAME
+warmed engine ladder (docs/design.md §14, §16):
 
-- ``serve_nobatch_*``: each request runs alone through the full-batch
-  program (``lookup_padded`` — the honest cost of serving without a
-  batcher: one device dispatch per request, batch fill = n/batch);
-- ``serve_*``: the same requests submitted concurrently through the
-  ``DynamicBatcher`` under a closed-loop load of ``concurrency``
-  in-flight requests; latencies are per-request submit->demux walls
-  recorded by the batcher itself, never a wall-clock subtraction.
+- ``serve_nobatch_*``: each request runs alone through
+  ``lookup_padded`` (the honest cost of serving without a batcher: one
+  device dispatch per request — at the smallest ladder rung that holds
+  it, so even this arm benefits from the compiled-shape ladder);
+- ``serve_mono_*``: the same requests submitted concurrently through a
+  MONOLITHIC ``DynamicBatcher`` (``bucket_ladder=False,
+  pipeline=False``) — every merged batch launches at the full
+  ``batch_size`` signature and merge/execute/demux run serially on the
+  dispatcher thread: the pre-§16 serving program, kept as the A/B
+  baseline;
+- ``serve_*`` (the headline): the ladder+pipeline batcher — merged
+  batches launch at the smallest fitting rung while the
+  merge -> execute -> demux stages double-buffer across threads.
 
-Percentiles are computed over the full per-request latency list, QPS
-over the arm's wall; ``serve_batch_fill`` is the mean fill of launched
-batches — together the off/on A/B states what dynamic batching bought
-(throughput) and cost (added queueing delay, bounded by
-``max_delay_ms``) on this host.
+Latencies are per-request submit->demux walls recorded by the batcher
+itself, never a wall-clock subtraction; QPS is requests over the arm's
+wall.  ``serve_pad_waste_pct`` (sentinel padding rows / launched rows)
+states what the ladder saved vs ``serve_mono_pad_waste_pct``;
+``serve_bucket_launches`` shows where the traffic landed on the
+ladder; ``serve_pipeline_overlap_pct`` is the measured hidden share of
+the host merge+demux walls (consumer blocked-time method —
+``obs/metrics.OverlapStat``, the same accounting ``CsrFeed`` and the
+cold-tier pipeline journal).
 """
 
 from __future__ import annotations
@@ -74,34 +84,10 @@ def _pct(lat, q) -> Optional[float]:
   return round(float(np.percentile(lat, q)), 3) if lat.size else None
 
 
-def measure_serving(engine, requests, *, max_delay_ms: float = 2.0,
-                    concurrency: int = 8,
-                    max_batch: Optional[int] = None) -> Dict:
-  """The off/on batching A/B over ``requests``; returns the artifact
-  block (``serve_p50_ms`` / ``serve_p99_ms`` / ``serve_qps`` + the
-  no-batch arm and fill counters).  ``engine`` warms (compiles) before
-  any timed work."""
-  requests = list(requests)
-  if not requests:
-    raise ValueError('measure_serving needs at least one request')
-  # no sample: a cold engine warms on uniform-random FULL-batch ids,
-  # which over-provisions a tiered engine's static fetch capacity by
-  # construction — warming on requests[0] (typically one sample) would
-  # calibrate near-empty caps and refuse on the first real batch
-  engine.warmup()
-
-  # ---- off arm: one full-batch dispatch per request, sequential ------
-  lat_off = []
-  t0 = time.monotonic()
-  for r in requests:
-    ta = time.monotonic()
-    engine.lookup_padded(r)  # returns host arrays: the demuxed answer
-    lat_off.append((time.monotonic() - ta) * 1000.0)
-  wall_off = time.monotonic() - t0
-
-  # ---- on arm: closed-loop concurrent submission through the batcher -
-  batcher = DynamicBatcher(engine, max_delay_ms=max_delay_ms,
-                           max_batch=max_batch)
+def _drive(batcher, requests, concurrency: int) -> float:
+  """Closed-loop concurrent submission of every request through one
+  batcher (``concurrency`` in-flight workers); returns the arm's wall.
+  Worker errors re-raise after the join."""
   idx_lock = threading.Lock()
   cursor = [0]
   errors: List[BaseException] = []
@@ -126,15 +112,69 @@ def measure_serving(engine, requests, *, max_delay_ms: float = 2.0,
     t.start()
   for t in threads:
     t.join()
-  wall_on = time.monotonic() - t0
-  st = batcher.stats()
-  batcher.close()
+  wall = time.monotonic() - t0
   if errors:
     raise errors[0]
+  return wall
 
+
+def measure_serving(engine, requests, *, max_delay_ms: float = 2.0,
+                    concurrency: int = 8,
+                    max_batch: Optional[int] = None) -> Dict:
+  """The three-arm serving A/B over ``requests`` (see module
+  docstring); returns the artifact block.  ``engine`` warms (compiles
+  EVERY ladder rung) before any timed work — no arm ever eats a
+  compile."""
+  requests = list(requests)
+  if not requests:
+    raise ValueError('measure_serving needs at least one request')
+  # no sample: a cold engine warms on uniform-random FULL-batch ids,
+  # which over-provisions a tiered engine's static fetch capacity by
+  # construction — warming on requests[0] (typically one sample) would
+  # calibrate near-empty caps and refuse on the first real batch
+  engine.warmup()
+
+  # ---- arm 1: one ladder-rung dispatch per request, sequential -------
+  lat_off = []
+  nb_launched = 0
+  nb_samples = 0
+  t0 = time.monotonic()
+  for r in requests:
+    n = int(np.asarray(r[0]).shape[0])
+    nb_launched += engine.bucket_for(n)
+    nb_samples += n
+    ta = time.monotonic()
+    engine.lookup_padded(r)  # returns host arrays: the demuxed answer
+    lat_off.append((time.monotonic() - ta) * 1000.0)
+  wall_off = time.monotonic() - t0
+
+  # ---- arm 2: monolithic batcher (full signature, serial dispatch) ---
+  # close() in finally: a worker error (e.g. a tier over-cap refusal)
+  # re-raises out of _drive, and bench treats serving as never-fatal —
+  # the batcher's stage threads must not outlive the failed arm
+  mono = DynamicBatcher(engine, max_delay_ms=max_delay_ms,
+                        max_batch=max_batch, pipeline=False,
+                        bucket_ladder=False)
+  try:
+    wall_mono = _drive(mono, requests, concurrency)
+    st_mono = mono.stats()
+  finally:
+    mono.close()
+
+  # ---- arm 3 (headline): bucket ladder + pipelined dispatch ----------
+  batcher = DynamicBatcher(engine, max_delay_ms=max_delay_ms,
+                           max_batch=max_batch)
+  try:
+    wall_on = _drive(batcher, requests, concurrency)
+    st = batcher.stats()
+  finally:
+    batcher.close()
+
+  pipe = st.get('pipeline') or {}
   return {
       'serve_requests': len(requests),
       'serve_batch': engine.batch_size,
+      'serve_buckets': list(engine.buckets),
       'serve_max_batch': st['max_batch'],
       'serve_max_delay_ms': max_delay_ms,
       'serve_concurrency': int(concurrency),
@@ -143,7 +183,24 @@ def measure_serving(engine, requests, *, max_delay_ms: float = 2.0,
       'serve_qps': round(len(requests) / max(wall_on, 1e-9), 2),
       'serve_batches': st['batches'],
       'serve_batch_fill': st['batch_fill'],
+      'serve_bucket_launches': {
+          str(k): v for k, v in sorted(st['bucket_launches'].items())},
+      'serve_rows_launched': st['rows_launched'],
+      'serve_pad_rows': st['pad_rows'],
+      'serve_pad_waste_pct': st['pad_waste_pct'],
+      'serve_pipeline_overlap_pct': pipe.get('overlap_pct'),
+      'serve_pipeline_merge_demux_ms': pipe.get('merge_demux_ms'),
+      'serve_pipeline_blocked_ms': pipe.get('blocked_ms'),
+      'serve_mono_p50_ms': st_mono['p50_ms'],
+      'serve_mono_p99_ms': st_mono['p99_ms'],
+      'serve_mono_qps': round(len(requests) / max(wall_mono, 1e-9), 2),
+      'serve_mono_batches': st_mono['batches'],
+      'serve_mono_batch_fill': st_mono['batch_fill'],
+      'serve_mono_pad_waste_pct': st_mono['pad_waste_pct'],
       'serve_nobatch_p50_ms': _pct(lat_off, 50),
       'serve_nobatch_p99_ms': _pct(lat_off, 99),
       'serve_nobatch_qps': round(len(requests) / max(wall_off, 1e-9), 2),
+      'serve_nobatch_pad_waste_pct': (
+          round(100.0 * (nb_launched - nb_samples) / nb_launched, 3)
+          if nb_launched else None),
   }

@@ -1,11 +1,19 @@
-"""ServingEngine: ONE compiled lookup-only forward over a frozen state.
+"""ServingEngine: a LADDER of compiled lookup-only forwards over a
+frozen state.
 
-The device half of serving (docs/design.md §14).  The engine owns a
-``DistributedEmbedding`` built for the SERVING mesh (which is routinely
-smaller than the training mesh — the canonical checkpoint layout
-reshards on restore), a frozen parameter pytree holding table leaves
-only (no optimizer state anywhere in the compiled program), and exactly
-ONE jitted forward signature ``(batch_size, hotness)``:
+The device half of serving (docs/design.md §14, §16).  The engine owns
+a ``DistributedEmbedding`` built for the SERVING mesh (which is
+routinely smaller than the training mesh — the canonical checkpoint
+layout reshards on restore), a frozen parameter pytree holding table
+leaves only (no optimizer state anywhere in the compiled program), and
+a bucketed compiled-shape ladder of forward signatures
+``(bucket, hotness)`` for ``bucket`` in ``buckets`` (default the pow-2
+ladder ``{B/8, B/4, B/2, B}`` rounded to device multiples): every
+lookup launches at the SMALLEST rung that holds its samples, so a
+deadline-launched straggler batch of 5 samples no longer pays the
+full-width device program.  ``warmup()`` AOT-compiles every rung —
+after it returns, a request never eats a mid-serve compile (pinned by
+test via ``DistributedEmbedding.compile_count``).
 
 - the read-only hot cache reuses the §10 replicated-buffer forward with
   a serving-sized hot set (``hotcache.serving_hot_sets`` — no optimizer
@@ -38,6 +46,23 @@ from distributed_embeddings_tpu.parallel.dist_embedding import (
     DistributedEmbedding)
 
 
+def default_bucket_ladder(batch_size: int, denom: int):
+  """The default compiled-shape ladder for one engine batch: the pow-2
+  rungs ``{B/8, B/4, B/2, B}``, each rounded UP to a multiple of the
+  device count ``denom`` and clamped to ``[denom, B]`` (design §16).
+  Duplicate rungs collapse, so tiny batches degrade gracefully toward
+  the monolithic single-signature engine."""
+  batch_size = int(batch_size)
+  denom = max(1, int(denom))
+  rungs = set()
+  for shift in (3, 2, 1, 0):
+    raw = max(1, batch_size >> shift)
+    rung = -(-raw // denom) * denom          # round up to device multiple
+    rungs.add(min(max(rung, denom), batch_size))
+  rungs.add(batch_size)
+  return tuple(sorted(rungs))
+
+
 def _resolve_bundle_dtype(weights) -> Optional[str]:
   """'auto' table_dtype: serve a uniformly quantized bundle at its own
   narrow dtype (rows never widen on device); anything else — plain f32
@@ -62,10 +87,18 @@ class ServingEngine:
     weights: global canonical per-table entries (arrays, ``.npy`` paths
       or ``QuantizedWeight`` pairs) — what ``load_serving_bundle``
       returns.
-    batch_size: the ONE static device batch every lookup runs at; must
-      be a multiple of the serving mesh's device count.  The dynamic
-      batcher fills it from concurrent requests; smaller direct calls
-      pad (``lookup_padded``).
+    batch_size: the LARGEST static device batch (the top ladder rung);
+      must be a multiple of the serving mesh's device count.  The
+      dynamic batcher fills it from concurrent requests; smaller
+      requests launch at the smallest ladder rung that holds them
+      (``lookup_padded``).
+    buckets: the compiled-shape ladder — batch-size rungs every lookup
+      snaps up to (design §16).  ``None`` (default) builds the pow-2
+      ladder ``default_bucket_ladder(batch_size, device_count)``; pass
+      an explicit sequence (each rung a positive device-count multiple
+      ``<= batch_size``; the full rung is always included) to shrink
+      or widen it, e.g. ``buckets=(batch_size,)`` for the monolithic
+      single-signature engine.
     mesh / axis_name: serving mesh (default: all local devices).
     input_table_map: as in ``DistributedEmbedding``.
     hotness: per-input static hot caps (default 1 per input) — the one
@@ -85,16 +118,17 @@ class ServingEngine:
     compute_dtype / lookup_impl / strategy / column_slice_threshold /
       row_slice: as in ``DistributedEmbedding``.
 
-  ``warmup()`` compiles the one program (and, for tiered plans without
-  explicit ``cold_fetch_rows``, calibrates the static fetch capacity
-  from a representative — or uniform-random, which over-provisions —
-  sample batch).
+  ``warmup()`` compiles EVERY ladder rung (and, for tiered plans
+  without explicit ``cold_fetch_rows``, calibrates each rung's static
+  fetch capacity from a representative — or uniform-random, which
+  over-provisions — sample batch).
   """
 
   def __init__(self, table_configs, weights, *, batch_size: int,
                mesh=None, axis_name: str = mesh_lib.DEFAULT_AXIS,
                input_table_map: Optional[Sequence[int]] = None,
                hotness: Optional[Sequence[int]] = None,
+               buckets: Optional[Sequence[int]] = None,
                hot_sets=None,
                table_dtype='auto',
                compute_dtype=None,
@@ -134,6 +168,20 @@ class ServingEngine:
           f'serving mesh device count {denom} (the one compiled '
           'signature is a static device batch)')
     self.batch_size = batch_size
+    if buckets is None:
+      self.buckets = default_bucket_ladder(batch_size, denom)
+    else:
+      rungs = {int(b) for b in buckets}
+      rungs.add(batch_size)  # the full rung must exist (max_batch)
+      for b in sorted(rungs):
+        if b < 1 or b % denom or b > batch_size:
+          raise ValueError(
+              f'bucket {b} must be a positive multiple of the serving '
+              f'mesh device count {denom}, <= batch_size {batch_size} '
+              '(every ladder rung is a static device batch — '
+              'docs/design.md §16)')
+      self.buckets = tuple(sorted(rungs))
+    self._bucket_set = frozenset(self.buckets)
     self.hotness = tuple(
         int(h) for h in (hotness if hotness is not None
                          else (1,) * self.dist.num_inputs))
@@ -157,6 +205,13 @@ class ServingEngine:
     self._lock = threading.Lock()
     self._batches_served = 0
     self._samples_served = 0
+    # bucket-ladder padding accounting (design §16): rows each launch
+    # actually paid for vs the sentinel-padding rows among them, plus
+    # per-rung launch counts — what the bench's serve_pad_waste_pct
+    # and per-bucket keys read
+    self._rows_launched = 0
+    self._pad_rows = 0
+    self._bucket_launches = {b: 0 for b in self.buckets}
 
   @classmethod
   def from_bundle(cls, path: str, *, table_configs=None, **kwargs
@@ -177,17 +232,34 @@ class ServingEngine:
 
   # ---------------------------------------------------------------- lookup
 
-  def _pad_input(self, i: int, x) -> np.ndarray:
-    """One input padded to the compiled ``[batch_size(, hot_cap)]``
-    signature (``-1`` sentinel = no id, dropped by every lookup path)."""
+  def bucket_for(self, n: int) -> int:
+    """The SMALLEST ladder rung holding ``n`` samples (design §16) —
+    the shape every lookup/launch snaps up to."""
+    n = int(n)
+    if n > self.batch_size:
+      raise ValueError(
+          f'request of {n} samples exceeds the engine batch '
+          f'{self.batch_size}: split the request or build the engine '
+          'with a larger batch_size')
+    for b in self.buckets:
+      if b >= n:
+        return b
+    return self.batch_size  # unreachable: buckets always include B
+
+  def _pad_input(self, i: int, x, width: Optional[int] = None
+                 ) -> np.ndarray:
+    """One input padded to the compiled ``[width(, hot_cap)]`` rung
+    signature (``-1`` sentinel = no id, dropped by every lookup path).
+    ``width`` defaults to the full batch."""
     x = np.asarray(x)
     h = self.hotness[i]
-    # already at the compiled signature (the batcher's merged buffers,
-    # or lookup_padded's own padding): no second alloc+copy on the
-    # per-batch hot path
+    width = self.batch_size if width is None else int(width)
+    # already at the compiled rung signature (the batcher's merged
+    # buffers, or lookup_padded's own padding): no second alloc+copy
+    # on the per-batch hot path
     if (x.dtype == np.int32
-        and ((h == 1 and x.shape == (self.batch_size,))
-             or (h > 1 and x.shape == (self.batch_size, h)))):
+        and ((h == 1 and x.shape == (width,))
+             or (h > 1 and x.shape == (width, h)))):
       return x
     x2 = x[:, None] if x.ndim == 1 else x
     if x2.ndim != 2:
@@ -199,78 +271,94 @@ class ServingEngine:
           f'compiled hot cap {h} — build the engine with '
           f'hotness[{i}] >= {x2.shape[1]}')
     n = x2.shape[0]
-    if n > self.batch_size:
+    if n > width:
       raise ValueError(
-          f'input {i}: {n} samples exceed the engine batch '
-          f'{self.batch_size}')
-    buf = np.full((self.batch_size, h), -1, np.int32)
+          f'input {i}: {n} samples exceed the launch bucket {width}')
+    buf = np.full((width, h), -1, np.int32)
     buf[:n, :x2.shape[1]] = x2
     return buf[:, 0] if h == 1 else buf
 
-  def lookup(self, cats) -> List:
-    """Full-batch lookup at the ONE compiled signature.
+  def lookup(self, cats, samples: Optional[int] = None) -> List:
+    """One device lookup at a compiled ladder-rung signature.
 
-    ``cats``: per-input ``[batch_size]`` / ``[batch_size, h<=cap]`` id
-    arrays (``-1`` padding).  Returns the per-input
-    ``[batch_size, output_dim]`` activations (jax arrays — callers
-    demuxing to hosts ``np.asarray`` them once per batch)."""
+    ``cats``: per-input ``[bucket]`` / ``[bucket, h<=cap]`` id arrays
+    (``-1`` padding) whose leading dim is a ladder rung (``buckets``).
+    ``samples``: the REAL sample count inside the rung (the rest being
+    sentinel padding) — callers that padded (``lookup_padded``, the
+    batcher) thread it through so ``samples_served``/``engine.samples``
+    count served samples, never padding; ``None`` counts the full rung
+    (an un-padded direct call).  Returns the per-input
+    ``[bucket, output_dim]`` activations (jax arrays — callers demuxing
+    to hosts ``np.asarray`` them once per batch)."""
     cats = list(cats)
     if len(cats) != self.dist.num_inputs:
       raise ValueError(f'expected {self.dist.num_inputs} inputs, '
                        f'got {len(cats)}')
+    b = int(np.asarray(cats[0]).shape[0]) if cats else 0
     for x in cats:
-      if np.asarray(x).shape[0] != self.batch_size:
+      if np.asarray(x).shape[0] != b:
         raise ValueError(
-            f'engine compiled for batch {self.batch_size}, got '
-            f'{np.asarray(x).shape[0]} — pad smaller requests '
-            '(lookup_padded) or batch them (DynamicBatcher)')
+            f'inputs disagree on batch: {np.asarray(x).shape[0]} vs '
+            f'{b}')
+    if b not in self._bucket_set:
+      raise ValueError(
+          f'batch {b} is not a compiled ladder rung {self.buckets} — '
+          'pad requests to a rung (lookup_padded picks the smallest '
+          'fitting one) or batch them (DynamicBatcher)')
+    real = b if samples is None else int(samples)
+    if not 0 <= real <= b:
+      raise ValueError(f'samples {real} outside [0, bucket {b}]')
     # ONE measurement feeds both the span and the histogram (the
     # trace-vs-stats agreement contract, obs/trace.py)
     t0 = obs_trace.now()
     try:
-      padded = [self._pad_input(i, x) for i, x in enumerate(cats)]
+      padded = [self._pad_input(i, x, b) for i, x in enumerate(cats)]
       outs = self.dist.apply(self.params, padded)
     finally:
       lookup_ms = (obs_trace.now() - t0) * 1000.0
       obs_trace.complete('serve/lookup', t0, lookup_ms / 1000.0,
-                         batch=self.batch_size)
+                         batch=b)
     with self._lock:
       self._batches_served += 1
-      self._samples_served += self.batch_size
+      self._samples_served += real
+      self._rows_launched += b
+      self._pad_rows += b - real
+      self._bucket_launches[b] += 1
     obs_metrics.inc('engine.lookups')
-    obs_metrics.inc('engine.samples', self.batch_size)
+    obs_metrics.inc('engine.samples', real)
+    obs_metrics.inc('engine.rows_launched', b)
+    obs_metrics.inc('engine.pad_rows', b - real)
     obs_metrics.observe('engine.lookup_ms', lookup_ms)
-    self._warm = True
     return list(outs)
 
   def lookup_padded(self, cats) -> List[np.ndarray]:
-    """One request (``n <= batch_size`` samples) through the full-batch
-    program: pad with ``-1`` sentinel samples, run, slice ``[:n]``.
-    The no-batching serving arm — and the per-request reference the
-    batcher's demux is pinned bit-exact against."""
+    """One request (``n <= batch_size`` samples) through the smallest
+    compiled rung that holds it: pad with ``-1`` sentinel samples to
+    the rung, run, slice ``[:n]``.  The no-batching serving arm — and
+    the per-request reference the batcher's demux is pinned bit-exact
+    against at every ladder rung."""
     cats = list(cats)
     n = int(np.asarray(cats[0]).shape[0]) if cats else 0
     if n == 0:
       return [np.zeros((0, d), np.float32) for d in self.output_dims]
-    if n > self.batch_size:
-      raise ValueError(
-          f'request of {n} samples exceeds the engine batch '
-          f'{self.batch_size}: split the request or build the engine '
-          'with a larger batch_size')
-    padded = [self._pad_input(i, x) for i, x in enumerate(cats)]
-    outs = self.lookup(padded)
+    bucket = self.bucket_for(n)
+    padded = [self._pad_input(i, x, bucket) for i, x in enumerate(cats)]
+    outs = self.lookup(padded, samples=n)
     return [np.asarray(o)[:n] for o in outs]
 
   def warmup(self, sample_cats=None, seed: int = 0) -> 'ServingEngine':
-    """Compile the one lookup program (idempotent).
+    """AOT-compile EVERY ladder rung (idempotent) — after ``warmup``
+    returns, no request can eat a mid-serve compile (design §16; the
+    pin reads ``dist.compile_count`` across warmed traffic).
 
-    ``sample_cats`` (a representative batch) drives the compile — and,
-    on cold-tier plans without explicit ``cold_fetch_rows``, calibrates
-    the static fetch capacity, so pass REAL traffic there when you can.
-    Without a sample, uniform-random ids over each full vocabulary are
-    used: they touch MORE distinct tail rows than any skewed real
-    stream, so the calibrated capacity over-provisions rather than
-    under- (a too-small cap would refuse mid-serve)."""
+    ``sample_cats`` (a representative full batch) drives the compiles
+    — and, on cold-tier plans without explicit ``cold_fetch_rows``,
+    calibrates each rung's static fetch capacity from its leading
+    slice, so pass REAL traffic there when you can.  Without a sample,
+    uniform-random ids over each full vocabulary are used: they touch
+    MORE distinct tail rows than any skewed real stream, so the
+    calibrated capacity over-provisions rather than under- (a
+    too-small cap would refuse mid-serve)."""
     if self._warm:
       return self
     if sample_cats is None:
@@ -282,21 +370,42 @@ class ServingEngine:
         shape = (self.batch_size,) if h == 1 else (self.batch_size, h)
         sample_cats.append(
             rng.integers(0, vocab, size=shape).astype(np.int32))
-    self.lookup_padded(sample_cats)
+    sample_cats = [np.asarray(c) for c in sample_cats]
+    if int(sample_cats[0].shape[0]) < self.batch_size:
+      # a short sample still warms every rung: tile it up to the full
+      # batch so each rung's slice below is non-degenerate
+      reps = -(-self.batch_size // int(sample_cats[0].shape[0]))
+      sample_cats = [
+          np.concatenate([c] * reps, axis=0)[:self.batch_size]
+          for c in sample_cats
+      ]
+    for bucket in sorted(self.buckets, reverse=True):
+      self.lookup_padded([c[:bucket] for c in sample_cats])
+    self._warm = True
     return self
 
-  def compiled(self):
-    """The underlying cached jitted forward for the engine's signature
-    (``DistributedEmbedding.compile_lookup``) — introspection/AOT hook;
-    plain serving goes through ``lookup``."""
-    return self.dist.compile_lookup(self.batch_size, self.hotness)
+  def compiled(self, bucket: Optional[int] = None):
+    """The underlying cached jitted forward for one rung signature
+    (``DistributedEmbedding.compile_lookup``; the full batch by
+    default) — introspection/AOT hook; plain serving goes through
+    ``lookup``."""
+    return self.dist.compile_lookup(
+        self.batch_size if bucket is None else int(bucket),
+        self.hotness)
 
   def stats(self) -> dict:
     with self._lock:
+      launched = self._rows_launched
       return {
           'batches_served': self._batches_served,
           'samples_served': self._samples_served,
           'batch_size': self.batch_size,
+          'buckets': list(self.buckets),
+          'bucket_launches': dict(self._bucket_launches),
+          'rows_launched': launched,
+          'pad_rows': self._pad_rows,
+          'pad_waste_pct': (round(100.0 * self._pad_rows / launched, 3)
+                            if launched else None),
           'world_size': self.dist.world_size,
           'hot_cache': bool(self.dist.hot_enabled),
           'cold_tier': self.dist.cold_tier is not None,

@@ -193,6 +193,7 @@ def main():
   import optax
   from distributed_embeddings_tpu.models.dlrm import DLRM, bce_with_logits
   from distributed_embeddings_tpu.parallel import (SparseSGD, create_mesh,
+                                                   export_tables,
                                                    get_optimizer_state,
                                                    get_weights,
                                                    init_hybrid_train_state,

@@ -9,14 +9,18 @@ quantized tables kept narrow), restores the bundle into a
 training mesh; the canonical checkpoint layout reshards on restore —
 and drives a simulated concurrent request stream through the
 ``DynamicBatcher``, printing the measured p50/p99 latency, QPS and
-batch-fill for the batching off/on A/B.
+batch-fill for the three-arm serving A/B (no batching / monolithic
+batcher / bucket-ladder + pipelined dispatch — design §16), including
+the pad-waste reduction the compiled-shape ladder bought, where the
+traffic landed on the ladder, and the measured pipeline overlap.
 
 Example::
 
     python examples/dlrm/main.py --synthetic --dp_input \
         --save_state /tmp/dlrm_state.npz ...
     python examples/dlrm/serve.py --checkpoint /tmp/dlrm_state.npz \
-        --batch 1024 --requests 512 --hot_coverage 0.98
+        --batch 1024 --requests 512 --hot_coverage 0.98 \
+        --serve_buckets 128,256,512,1024
 """
 
 import argparse
@@ -43,7 +47,14 @@ def main():
                       '(default: a temp file, deleted after the run)')
   parser.add_argument('--embedding_dim', type=int, default=128)
   parser.add_argument('--batch', type=int, default=1024,
-                      help='the ONE compiled serving batch')
+                      help='the LARGEST compiled serving batch (the '
+                      'top ladder rung)')
+  parser.add_argument('--serve_buckets', default=None,
+                      help='comma-separated compiled-shape ladder '
+                      'rungs (design §16), e.g. "128,256,512,1024"; '
+                      'default: the pow-2 ladder {B/8, B/4, B/2, B}. '
+                      'Pass the full batch alone for the monolithic '
+                      'single-signature engine.')
   parser.add_argument('--requests', type=int, default=512,
                       help='simulated request count')
   parser.add_argument('--request_sizes', default='1,2,4,8',
@@ -101,9 +112,14 @@ def main():
           budget_bytes=int(args.hot_budget_mb * 2**20), state_copies=0)
     n_dev = len(jax.devices())
     batch = max(n_dev, (args.batch // n_dev) * n_dev)
+    buckets = None
+    if args.serve_buckets:
+      buckets = [int(b) for b in str(args.serve_buckets).split(',')
+                 if b.strip()]
     engine = serving.ServingEngine(configs, weights, batch_size=batch,
-                                   hot_sets=hot_sets)
-    print(f'engine: batch {batch} on {n_dev} device(s), '
+                                   buckets=buckets, hot_sets=hot_sets)
+    print(f'engine: batch {batch} on {n_dev} device(s), ladder '
+          f'{list(engine.buckets)}, '
           f"table_dtype {engine.stats()['table_dtype']}, hot rows "
           f'{sum(h.size for h in (hot_sets or {}).values())}')
 
@@ -132,6 +148,33 @@ def main():
     if hot_sets:
       stats['serve_hot_hit_rate'] = serving.hot_hit_rate(
           hot_sets, configs, list(range(len(configs))), requests)
+    # the three-arm A/B, human-readable (design §16): what batching
+    # bought, what the ladder saved, what the pipeline hid
+    print('A/B  no-batch   : '
+          f"p50 {stats['serve_nobatch_p50_ms']} ms  "
+          f"p99 {stats['serve_nobatch_p99_ms']} ms  "
+          f"qps {stats['serve_nobatch_qps']}  "
+          f"pad {stats['serve_nobatch_pad_waste_pct']}%")
+    print('A/B  monolithic : '
+          f"p50 {stats['serve_mono_p50_ms']} ms  "
+          f"p99 {stats['serve_mono_p99_ms']} ms  "
+          f"qps {stats['serve_mono_qps']}  "
+          f"pad {stats['serve_mono_pad_waste_pct']}%  "
+          f"fill {stats['serve_mono_batch_fill']}")
+    print('A/B  ladder+pipe: '
+          f"p50 {stats['serve_p50_ms']} ms  "
+          f"p99 {stats['serve_p99_ms']} ms  "
+          f"qps {stats['serve_qps']}  "
+          f"pad {stats['serve_pad_waste_pct']}%  "
+          f"fill {stats['serve_batch_fill']}")
+    print(f"bucket ladder {stats['serve_buckets']}: launches "
+          f"{stats['serve_bucket_launches']} "
+          f"({stats['serve_pad_rows']} of "
+          f"{stats['serve_rows_launched']} launched rows were padding)")
+    print('pipeline overlap '
+          f"{stats['serve_pipeline_overlap_pct']} "
+          f"(merge+demux {stats['serve_pipeline_merge_demux_ms']} ms, "
+          f"consumer blocked {stats['serve_pipeline_blocked_ms']} ms)")
     print(json.dumps(stats))
     if args.trace:
       from distributed_embeddings_tpu.obs import trace as obs_trace

@@ -246,12 +246,14 @@ def test_a2a_overlap_measured_and_off_arm_counters_unchanged():
 
 
 def test_serving_artifact_keys():
-  """The ISSUE-9 journaled proof: the serving off/on batching A/B block
+  """The ISSUE-9/12 journaled proof: the serving three-arm A/B block
   bench folds into the artifact carries the pinned keys (serve_p50_ms /
-  serve_p99_ms / serve_qps + the no-batch arm and fill counters), the
-  percentiles are ordered, and both arms' QPS are real measurements —
-  so a future change that silently drops the serving measurement (or
-  renames its keys) fails tier-1 here."""
+  serve_p99_ms / serve_qps + the monolithic and no-batch arms, the
+  bucket-ladder padding accounting and the pipeline overlap), the
+  percentiles are ordered, every arm's QPS is a real measurement, and
+  the ladder strictly reduces padding vs the monolithic arm — so a
+  future change that silently drops the serving measurement (or
+  renames its keys, or disables the ladder) fails tier-1 here."""
   import jax
   import numpy as np
   from distributed_embeddings_tpu import serving
@@ -269,18 +271,44 @@ def test_serving_artifact_keys():
   cats = [rng.integers(0, c.input_dim, size=(32,)).astype(np.int32)
           for c in cfgs]
   requests = serving.split_requests(cats, sizes=(1, 2, 4))
+  # concurrency 3 over (1,2,4)-sized requests bounds every merged
+  # batch at 7 samples: the monolithic arm MUST launch 16-wide padded
+  # batches while the ladder stays on the 2/4/8 rungs — the strict
+  # pad-waste reduction below is structural, not timing luck
   st = serving.measure_serving(engine, requests, max_delay_ms=1.0,
-                               concurrency=4)
+                               concurrency=3)
   for key in ('serve_p50_ms', 'serve_p99_ms', 'serve_qps',
               'serve_batches', 'serve_batch_fill', 'serve_requests',
               'serve_batch', 'serve_max_delay_ms', 'serve_concurrency',
+              'serve_buckets', 'serve_bucket_launches',
+              'serve_rows_launched', 'serve_pad_rows',
+              'serve_pad_waste_pct', 'serve_pipeline_overlap_pct',
+              'serve_pipeline_merge_demux_ms',
+              'serve_pipeline_blocked_ms',
+              'serve_mono_p50_ms', 'serve_mono_p99_ms',
+              'serve_mono_qps', 'serve_mono_batches',
+              'serve_mono_batch_fill', 'serve_mono_pad_waste_pct',
               'serve_nobatch_p50_ms', 'serve_nobatch_p99_ms',
-              'serve_nobatch_qps'):
+              'serve_nobatch_qps', 'serve_nobatch_pad_waste_pct'):
     assert key in st, key
   assert st['serve_requests'] == len(requests)
   assert 0 < st['serve_p50_ms'] <= st['serve_p99_ms']
+  assert 0 < st['serve_mono_p50_ms'] <= st['serve_mono_p99_ms']
   assert st['serve_qps'] > 0 and st['serve_nobatch_qps'] > 0
+  assert st['serve_mono_qps'] > 0
   assert 0 < st['serve_batch_fill'] <= 1.0
+  # the ISSUE-12 acceptance bar: the ladder strictly reduces padding
+  # vs the monolithic full-signature arm over the same stream, the
+  # pipeline overlap is a real [0, 1] measurement, and the per-bucket
+  # launch counts cover exactly the launched rows
+  assert st['serve_pad_waste_pct'] < st['serve_mono_pad_waste_pct']
+  assert 0.0 <= st['serve_pipeline_overlap_pct'] <= 1.0
+  assert st['serve_buckets'] == list(engine.buckets)
+  launched = sum(int(b) * c
+                 for b, c in st['serve_bucket_launches'].items())
+  assert launched == st['serve_rows_launched'] > 0
+  assert all(int(b) in engine.buckets
+             for b in st['serve_bucket_launches'])
   # the hit-rate twin bench journals alongside: exact, host-side
   rate = serving.hot_hit_rate(hot, cfgs, [0, 1], requests)
   assert 0.0 <= rate <= 1.0

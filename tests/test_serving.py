@@ -24,6 +24,7 @@ The load-bearing claims pinned here:
 
 import os
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -206,12 +207,103 @@ class TestCrossDeviceRestore:
 
 class TestEngine:
 
-  def test_one_compiled_signature(self, served):
+  def test_ladder_signatures_stay_on_rungs(self, served):
+    """The compiled-shape ladder (design §16): every lookup lands on a
+    ladder rung — never an ad-hoc batch signature — and the default
+    pow-2 ladder is device-aligned with the full batch on top."""
     eng = served['engine']
+    denom = eng.dist.world_size * eng.dist.num_slices
+    assert eng.buckets == serving.default_bucket_ladder(BATCH, denom)
+    assert eng.buckets[-1] == BATCH
+    assert all(b % denom == 0 for b in eng.buckets)
+    assert list(eng.buckets) == sorted(set(eng.buckets))
+    # smallest rung >= n wins
+    assert eng.bucket_for(1) == eng.buckets[0]
+    for b in eng.buckets:
+      assert eng.bucket_for(b) == b
+    assert eng.bucket_for(eng.buckets[0] + 1) == eng.buckets[1]
     eng.lookup_padded([c[:3] for c in served['ids']])
     eng.lookup_padded([c[:1] for c in served['ids']])
-    sigs = {k for k in eng.dist._fn_cache if k[0].startswith('dp_fwd')}
-    assert len(sigs) == 1, sigs
+    sigs = {k[1] for k in eng.dist._fn_cache
+            if k[0].startswith('dp_fwd')}
+    assert sigs <= set(eng.buckets), sigs
+
+  def test_explicit_buckets_validate(self, served):
+    weights = served['weights']
+    mesh2 = create_mesh(jax.devices()[:2])
+    eng = serving.ServingEngine(CONFIGS, weights, batch_size=BATCH,
+                                mesh=mesh2, buckets=(4,))
+    assert eng.buckets == (4, BATCH)  # full rung always present
+    with pytest.raises(ValueError, match='multiple'):
+      serving.ServingEngine(CONFIGS, weights, batch_size=BATCH,
+                            mesh=mesh2, buckets=(3,))
+    with pytest.raises(ValueError, match='batch_size'):
+      serving.ServingEngine(CONFIGS, weights, batch_size=BATCH,
+                            mesh=mesh2, buckets=(2 * BATCH,))
+
+  def test_off_rung_lookup_refuses(self, served):
+    eng = served['engine']
+    off = [c[:3] for c in served['ids']]
+    assert 3 not in eng.buckets
+    with pytest.raises(ValueError, match='ladder rung'):
+      eng.lookup(off)
+
+  def test_warmup_compiles_every_rung_zero_compiles_after(self, served):
+    """The no-mid-serve-compile pin (design §16): after warmup() every
+    rung is compiled — mixed-size traffic through lookup_padded AND the
+    batcher lands on cached signatures only.  Belt and braces: the
+    compile counter must not move, and a monkeypatched fn-cache that
+    refuses insertions proves no new signature is even traced."""
+    rng = np.random.default_rng(2)
+    weights = served['weights']
+    eng = serving.ServingEngine(CONFIGS, weights, batch_size=BATCH,
+                                mesh=create_mesh(jax.devices()[:2]),
+                                hotness=HOTNESS)
+    eng.warmup()
+    assert {k[1] for k in eng.dist._fn_cache
+            if k[0].startswith('dp_fwd')} == set(eng.buckets)
+    before = eng.dist.compile_count
+
+    class _Frozen(dict):
+
+      def __setitem__(self, key, value):
+        raise AssertionError(f'mid-serve compile of signature {key}')
+
+    eng.dist._fn_cache = _Frozen(eng.dist._fn_cache)
+    for n in (1, 2, 3, 5, 9, BATCH):
+      eng.lookup_padded([c[:n] for c in _ids(rng)])
+    with serving.DynamicBatcher(eng, max_delay_ms=1.0) as bat:
+      futs = [bat.submit([c[:n] for c in _ids(rng)])
+              for n in (1, 4, 7, 2, BATCH // 2)]
+      for f in futs:
+        f.result(timeout=60.0)
+    assert eng.dist.compile_count == before
+    eng.dist._fn_cache = dict(eng.dist._fn_cache)
+
+  def test_samples_served_counts_samples_not_padding(self, served):
+    """Satellite: stats()/engine.samples count REAL served samples —
+    sentinel padding rows are accounted separately (pad_rows), so
+    stats-derived QPS is never inflated by padding."""
+    weights = served['weights']
+    eng = serving.ServingEngine(CONFIGS, weights, batch_size=BATCH,
+                                mesh=create_mesh(jax.devices()[:2]),
+                                hotness=HOTNESS)
+    eng.lookup_padded([c[:3] for c in served['ids']])
+    st = eng.stats()
+    bucket = eng.bucket_for(3)
+    assert st['samples_served'] == 3
+    assert st['rows_launched'] == bucket
+    assert st['pad_rows'] == bucket - 3
+    assert st['bucket_launches'][bucket] == 1
+    # merged batcher launches thread the real count through too
+    with serving.DynamicBatcher(eng, max_delay_ms=5.0) as bat:
+      futs = [bat.submit([c[:2] for c in served['ids']]),
+              bat.submit([c[:1] for c in served['ids']])]
+      for f in futs:
+        f.result(timeout=60.0)
+    st2 = eng.stats()
+    assert st2['samples_served'] == 3 + 3
+    assert st2['pad_rows'] == st2['rows_launched'] - 6
 
   def test_batch_size_must_divide(self):
     with pytest.raises(ValueError, match='multiple'):
@@ -278,13 +370,20 @@ class TestBatcher:
 
   def test_fuzzed_concurrent_parity(self, served):
     """Many concurrent requests from worker threads: every demuxed
-    result is identical to the same request run alone through the same
-    program — batching is pure scheduling (same compiled forward, so
-    even the multi-hot input compares bit-exact here)."""
+    result is identical to the same request run alone through
+    ``lookup_padded`` — batching is pure scheduling.  Request sizes
+    span the whole ladder (1..BATCH-3), so merged batches land on
+    DIFFERENT rungs within one run and the reference itself runs at a
+    different (smaller) rung than the merged launch: demux parity here
+    pins bit-exactness ACROSS rungs, not just within one signature
+    (design §16)."""
     rng = np.random.default_rng(11)
     reqs = []
-    for _ in range(36):
-      n = int(rng.integers(1, 6))
+    for k in range(36):
+      # every 4th request is large (forces the top rungs); the rest
+      # are small (land on the bottom rungs when merged thin)
+      n = int(rng.integers(BATCH - 6, BATCH - 2)) if k % 4 == 0 \
+          else int(rng.integers(1, 6))
       r = _ids(rng, n=n)
       mask = rng.random(size=r[1].shape) < 0.2
       r[1] = np.where(mask, -1, r[1]).astype(np.int32)
@@ -302,11 +401,88 @@ class TestBatcher:
         t.start()
       for t in threads:
         t.join()
-      assert bat.stats()['completed'] == len(reqs)
+      st = bat.stats()
+      assert st['completed'] == len(reqs)
+      # the run really exercised several ladder rungs
+      assert len(st['bucket_launches']) >= 2, st['bucket_launches']
+      assert set(st['bucket_launches']) <= set(served['engine'].buckets)
+      assert st['pipeline']['batches'] == st['batches']
     for r, out in zip(reqs, results):
       want = served['engine'].lookup_padded(r)
       for a, b in zip(want, out):
         np.testing.assert_array_equal(a, b)
+
+  def test_serial_monolithic_arm_parity(self, served):
+    """The bench A/B's middle arm (pipeline=False, bucket_ladder=False)
+    is the pre-§16 dispatch: full-signature launches, serial stages —
+    and stays demux-bit-exact."""
+    # 7 samples over 3 requests: strictly less than the full batch, so
+    # monolithic launches must carry sentinel padding
+    reqs = serving.split_requests(served['ids'], sizes=(1, 2, 4))[:3]
+    with serving.DynamicBatcher(served['engine'], max_delay_ms=10.0,
+                                pipeline=False,
+                                bucket_ladder=False) as bat:
+      outs = [f.result(timeout=60.0)
+              for f in [bat.submit(r) for r in reqs]]
+      st = bat.stats()
+    assert 'pipeline' not in st
+    assert set(st['bucket_launches']) == {served['engine'].batch_size}
+    assert st['pad_waste_pct'] > 0
+    for r, out in zip(reqs, outs):
+      want = served['engine'].lookup_padded(r)
+      for a, b in zip(want, out):
+        np.testing.assert_array_equal(a, b)
+
+  def test_pipeline_fails_batch_not_dispatcher(self, served, monkeypatch):
+    """The exception-fails-the-batch contract survives the staged
+    pipeline: a lookup blowing up on the executor thread fails exactly
+    that batch's futures, and the batcher keeps serving."""
+    eng = served['engine']
+    boom = {'armed': False}
+    orig = type(eng).lookup
+
+    def flaky(self, cats, samples=None):
+      if boom['armed']:
+        boom['armed'] = False
+        raise RuntimeError('injected device fault')
+      return orig(self, cats, samples=samples)
+
+    monkeypatch.setattr(type(eng), 'lookup', flaky)
+    with serving.DynamicBatcher(eng, max_delay_ms=1.0) as bat:
+      boom['armed'] = True
+      with pytest.raises(RuntimeError, match='injected device fault'):
+        bat.submit([c[:2] for c in served['ids']]).result(timeout=30.0)
+      got = bat.submit([c[:1] for c in served['ids']]).result(
+          timeout=30.0)
+    monkeypatch.undo()
+    want = served['engine'].lookup_padded([c[:1] for c in served['ids']])
+    for a, b in zip(want, got):
+      np.testing.assert_array_equal(a, b)
+
+  def test_idle_dispatcher_blocks_without_polling(self, served,
+                                                  monkeypatch):
+    """Satellite: an IDLE batcher burns zero scheduled wakeups — the
+    dispatcher parks in ONE untimed blocking get (no 50 ms poll), and
+    shutdown rides the _CLOSE sentinel."""
+    import queue as queue_mod
+    calls = []
+    orig_get = queue_mod.Queue.get
+
+    def spy(self, block=True, timeout=None):
+      calls.append((id(self), block, timeout))
+      return orig_get(self, block=block, timeout=timeout)
+
+    monkeypatch.setattr(queue_mod.Queue, 'get', spy)
+    bat = serving.DynamicBatcher(served['engine'], max_delay_ms=1.0)
+    qid = id(bat._q)
+    time.sleep(0.4)  # would be ~8 polls under the old 50 ms timeout
+    idle = [c for c in calls if c[0] == qid]
+    assert idle == [(qid, True, None)], idle
+    # wakes for real work after the idle stretch, and closes cleanly
+    got = bat.submit([c[:1] for c in served['ids']]).result(timeout=30.0)
+    assert got[0].shape == (1, 8)
+    bat.close()
+    assert not bat._dispatcher.is_alive()
 
   def test_bad_rank_refuses_and_dispatcher_survives(self, served):
     """A 3-D id array refuses at submit (it would otherwise blow up
@@ -454,6 +630,46 @@ class TestReadOnlyTier:
       tier.payload[gi][0, 0, 0] = orig
       tier.refresh_rows(gi, 0, np.array([0]))
 
+  def test_per_bucket_fetch_caps_calibrated(self, served, tiered):
+    """Each warmed ladder rung carries its OWN calibrated static fetch
+    capacity (design §16) — smaller rungs never inherit the full
+    batch's over-provisioned fetch shape."""
+    caps = tiered.dist._cold_fetch_caps
+    assert set(caps) >= set(tiered.buckets), (set(caps),
+                                              tiered.buckets)
+    for b in tiered.buckets:
+      assert set(caps[b]) == set(tiered.dist.plan.cold_tier_groups)
+      assert all(v > 0 for v in caps[b].values())
+
+  def test_over_cap_refusal_names_bucket(self):
+    """The §12 over-cap refusal survives per-bucket caps and its
+    sizing hint now names the bucket."""
+    from distributed_embeddings_tpu.parallel import coldtier
+
+    class _G:
+      tier_rows = 10_000
+
+    class _Plan:
+      groups = {3: _G()}
+
+    class _Dist:
+      plan = _Plan()
+      _cold_fetch_caps = {}
+      _cold_fetch_pinned = {3: 8}
+      fetch_caps_for = DistributedEmbedding.fetch_caps_for
+
+    d = _Dist()
+    with pytest.raises(ValueError, match='bucket 128'):
+      coldtier._ensure_caps(d, {3: [20]}, 128)
+    # a different bucket calibrates independently of the refused one
+    d2 = _Dist()
+    d2._cold_fetch_caps = {}
+    d2._cold_fetch_pinned = {}
+    coldtier._ensure_caps(d2, {3: [20]}, 64)
+    coldtier._ensure_caps(d2, {3: [3]}, 8)
+    assert set(d2._cold_fetch_caps) == {64, 8}
+    assert d2._cold_fetch_caps[64][3] >= 20
+
   def test_compile_lookup_needs_caps_first(self, served):
     weights, _ = serving.load_serving_bundle(served['bundle'])
     mesh2 = create_mesh(jax.devices()[:2])
@@ -505,13 +721,23 @@ def test_measure_serving_block(served):
                        concurrency=3)
   for key in ('serve_p50_ms', 'serve_p99_ms', 'serve_qps',
               'serve_batches', 'serve_batch_fill',
+              'serve_buckets', 'serve_bucket_launches',
+              'serve_pad_waste_pct', 'serve_pipeline_overlap_pct',
+              'serve_mono_p50_ms', 'serve_mono_p99_ms',
+              'serve_mono_qps', 'serve_mono_pad_waste_pct',
               'serve_nobatch_p50_ms', 'serve_nobatch_p99_ms',
               'serve_nobatch_qps', 'serve_requests', 'serve_batch'):
     assert key in st, key
   assert st['serve_requests'] == len(reqs)
   assert st['serve_qps'] > 0 and st['serve_nobatch_qps'] > 0
+  assert st['serve_mono_qps'] > 0
   assert st['serve_p99_ms'] >= st['serve_p50_ms'] > 0
+  assert st['serve_mono_p99_ms'] >= st['serve_mono_p50_ms'] > 0
   assert 0 < st['serve_batch_fill'] <= 1.0
+  # the ladder's whole point: strictly less padding than monolithic
+  assert st['serve_pad_waste_pct'] < st['serve_mono_pad_waste_pct']
+  assert 0.0 <= st['serve_pipeline_overlap_pct'] <= 1.0
+  assert st['serve_buckets'] == list(served['engine'].buckets)
   rate = serving.hot_hit_rate(HOT_SERVE, CONFIGS, [0, 1, 2], reqs)
   assert 0.0 <= rate <= 1.0
 
