@@ -60,6 +60,21 @@ def obs_block(step_ms: float, on_ms: float,
   }
 
 
+def lint_block() -> dict:
+  """The journaled static-analysis gate counts (design §17; keys
+  pinned by tests/test_bench_artifact.py): ``lint_findings`` is the
+  unwaived detlint finding count (0 on a healthy tree — the same gate
+  tier-1 and dryrun_multichip enforce), ``lint_waivers`` the active
+  rationale-bearing waiver count, so a quietly growing baseline is
+  visible in the round-over-round artifact record."""
+  from distributed_embeddings_tpu.analysis import run_repo
+  res = run_repo(os.path.dirname(os.path.abspath(__file__)))
+  return {
+      'lint_findings': len(res.findings) + len(res.unverifiable),
+      'lint_waivers': len(res.waived),
+  }
+
+
 def pick_baseline(model: str, n_devices: int):
   """Baseline at this device count; otherwise round UP to the smallest
   published count >= ours (more devices = faster baseline = harder target,
@@ -1276,6 +1291,14 @@ def main():
     except Exception as e:
       obs_stats = {'obs_error': f'{type(e).__name__}: {e}'}
 
+  # Static-analysis gate counts (design §17).  Pure host-side AST work
+  # (~a second); never fatal to the artifact.
+  lint_stats = None
+  try:
+    lint_stats = lint_block()
+  except Exception as e:
+    lint_stats = {'lint_error': f'{type(e).__name__}: {e}'}
+
   n_dev = len(devices)
   backend = devices[0].platform
   # the baselines are AT global batch 65536: a reduced-batch chip run
@@ -1361,6 +1384,8 @@ def main():
     result.update(serve_stats)
   if obs_stats:
     result.update(obs_stats)
+  if lint_stats:
+    result.update(lint_stats)
   if on_cpu:
     # a sweep window may have landed an on-chip line earlier this round;
     # carry it (labelled, with its own sha/timestamp) so the artifact is

@@ -1,0 +1,40 @@
+"""detlint: repo-wide static analysis (docs/design.md §17).
+
+One AST parse of the runtime tree, N visitor passes, findings with
+STABLE ids, and a waiver baseline with mandatory per-waiver rationale —
+the standing correctness gate every PR lands under
+(``python tools/detlint.py --strict``).
+
+The four shipped passes:
+
+- ``registry_schema``: every ``journal()`` / span / metric call site
+  resolves (alias-aware) and uses a registered name; ``stats()`` dict
+  keys and the bench-artifact keys pinned by
+  ``tests/test_bench_artifact.py`` come under the same discipline.
+  Replaces the three regex source scans the tests used to carry.
+- ``concurrency``: per-module lock/queue/thread topology — nested lock
+  acquisitions build the cross-module lock-order graph (cycles fail),
+  blocking queue ops under a held lock, untimed puts into bounded
+  queues, threads without a reachable join, and silent broad-except
+  swallows.
+- ``purity``: functions reachable from ``jax.jit``/``shard_map``
+  wrappers must not call banned host effects (journal, metrics,
+  ``time.*``, global RNG, file I/O) — the §15 "trace and stats can
+  never disagree" rule, codified.
+- ``docdrift``: every ``docs/api.md`` symbol resolves by import, every
+  CLI flag named in docs/examples exists in the corresponding argparse
+  definition, and every ``design.md §N`` cross-reference resolves.
+
+``locksan`` is the runtime sibling of the concurrency pass: an opt-in
+instrumented-lock capture that records the acquisition DAG during the
+fuzzed-concurrency tests and asserts it stays acyclic.
+"""
+
+from distributed_embeddings_tpu.analysis.core import (
+    Baseline, BaselineError, Finding, Result, build_context, list_passes,
+    run_passes, run_repo)
+from distributed_embeddings_tpu.analysis import locksan
+
+__all__ = ['Baseline', 'BaselineError', 'Finding', 'Result',
+           'build_context', 'list_passes', 'run_passes', 'run_repo',
+           'locksan']

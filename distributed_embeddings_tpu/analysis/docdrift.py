@@ -1,0 +1,249 @@
+"""doc-drift pass: docs must keep resolving against the code.
+
+Three gates, all cheap to keep green and expensive to let rot:
+
+- **api.md symbols** — every symbol row in ``docs/api.md`` resolves BY
+  IMPORT: the ``## `module` `` section header names the module, the
+  first dotted identifier of each ``| `symbol...` |`` row must
+  getattr-resolve against it (``Class.method`` walks into the class).
+  The ``## tools/`` section resolves rows as files under ``tools/``.
+- **CLI flags** — every ``--flag`` named in ``docs/*.md``,
+  ``examples/**`` shell scripts must exist in an argparse
+  definition: in the script a surrounding ``python <script>`` command
+  names when one is determinable, else in the union of every
+  ``add_argument`` flag in the repo (which still catches full renames).
+- **design.md §N refs** — every ``design.md §N`` / ``design §N``
+  cross-reference in docs and runtime sources resolves to a real
+  ``## N.`` section of ``docs/design.md``.
+
+Rules: ``docdrift/api-symbol-unresolved``, ``docdrift/cli-flag-unknown``,
+``docdrift/dangling-section-ref``.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import os
+import re
+
+from typing import Dict, List, Optional, Set
+
+from distributed_embeddings_tpu.analysis import core
+from distributed_embeddings_tpu.analysis.core import Context, Finding
+
+_SECTION_RE = re.compile(r'^##\s+`([\w./]+)`')
+_ROW_RE = re.compile(r'^\|\s*`([^`]+)`')
+_IDENT_RE = re.compile(r'^[A-Za-z_][A-Za-z0-9_.]*')
+_FLAG_RE = re.compile(r'(?<![\w-])--([A-Za-z][A-Za-z0-9_-]*)')
+_REF_RE = re.compile(r'(?:design(?:\.md)?\s+)§\s*(\d+[a-z]?)')
+_SELF_REF_RE = re.compile(r'§\s*(\d+[a-z]?)')
+_HEADING_RE = re.compile(r'^##\s+(\d+[a-z]?)\.')
+_CMD_RE = re.compile(r'python3?\s+(\S+\.py)')
+
+
+def _read(root: str, rel: str) -> Optional[str]:
+  p = os.path.join(root, rel)
+  if not os.path.exists(p):
+    return None
+  with open(p, 'r', encoding='utf-8') as f:
+    return f.read()
+
+
+def _resolve_by_import(modname: str, sym: str,
+                       cache: Dict[str, object]) -> bool:
+  try:
+    if modname not in cache:
+      cache[modname] = importlib.import_module(modname)
+    obj = cache[modname]
+  except Exception:
+    return False
+  for part in sym.split('.'):
+    try:
+      obj = getattr(obj, part)
+    except AttributeError:
+      # a submodule not imported by the package __init__
+      # (`layers.flax_embedding.DistEmbed`) still resolves by import
+      try:
+        obj = importlib.import_module(
+            f'{getattr(obj, "__name__", "")}.{part}')
+      except Exception:
+        return False
+  return True
+
+
+def _argparse_flags(ctx: Context) -> Dict[str, Set[str]]:
+  """relpath -> set of declared ``--flags`` (BooleanOptionalAction
+  implies the ``--no-`` twin)."""
+  out: Dict[str, Set[str]] = {}
+  for mod in ctx.modules.values():
+    flags: Set[str] = set()
+    for node in ast.walk(mod.tree):
+      if isinstance(node, ast.Call) \
+          and isinstance(node.func, ast.Attribute) \
+          and node.func.attr == 'add_argument':
+        boolopt = any(
+            (core.dotted(kw.value) or '').endswith(
+                'BooleanOptionalAction')
+            for kw in node.keywords if kw.arg == 'action')
+        for a in node.args:
+          if isinstance(a, ast.Constant) and isinstance(a.value, str) \
+              and a.value.startswith('--'):
+            flags.add(a.value)
+            if boolopt:
+              flags.add('--no-' + a.value[2:])
+    if flags:
+      out[mod.relpath] = flags
+  return out
+
+
+@core.register_pass('docdrift')
+def run(ctx: Context) -> List[Finding]:
+  findings: List[Finding] = []
+  root = ctx.root
+
+  # ---- api.md symbol resolution --------------------------------------
+  api = _read(root, os.path.join('docs', 'api.md'))
+  import_cache: Dict[str, object] = {}
+  n_syms = 0
+  if api is not None:
+    section: Optional[str] = None
+    for ln, line in enumerate(api.splitlines(), 1):
+      m = _SECTION_RE.match(line)
+      if m:
+        section = m.group(1)
+        continue
+      if line.startswith('## '):
+        section = None  # a section header we cannot map to a module
+        continue
+      r = _ROW_RE.match(line)
+      if not r or section is None:
+        continue
+      cell = r.group(1).strip()
+      if section.rstrip('/') == 'tools':
+        n_syms += 1
+        target = cell.split()[0]
+        target = target[len('tools/'):] if target.startswith('tools/') \
+            else target
+        if not os.path.exists(os.path.join(root, 'tools', target)):
+          findings.append(Finding(
+              rule='docdrift/api-symbol-unresolved', path='docs/api.md',
+              line=ln, symbol=f'tools/{target}',
+              message=f'api.md tools/ row names {target!r} which does '
+              'not exist under tools/'))
+        continue
+      im = _IDENT_RE.match(cell)
+      if not im:
+        continue
+      sym = im.group(0).rstrip('.')
+      # doc convention: rows under `## pkg.sub` may repeat the
+      # subpackage head (`models.dlrm.DLRM` under `...models`)
+      leaf = section.split('.')[-1]
+      if sym == leaf or sym.startswith(leaf + '.'):
+        sym = sym[len(leaf) + 1:] or leaf
+        if sym == leaf:  # the row documents the subpackage itself
+          sym = ''
+      n_syms += 1
+      if sym and not _resolve_by_import(section, sym, import_cache):
+        findings.append(Finding(
+            rule='docdrift/api-symbol-unresolved', path='docs/api.md',
+            line=ln, symbol=f'{section}.{sym}',
+            message=f'api.md documents {section}.{sym} but it does '
+            'not resolve by import — the symbol moved, was renamed, '
+            'or the doc row rotted'))
+  ctx.meta['docdrift_api_symbols'] = n_syms
+
+  # ---- CLI flags ------------------------------------------------------
+  declared = _argparse_flags(ctx)
+  all_flags: Set[str] = set().union(*declared.values()) if declared \
+      else set()
+  doc_files = [os.path.join('docs', f) for f in ('api.md',
+                                                 'userguide.md')]
+  for dirpath, dirnames, filenames in os.walk(
+      os.path.join(root, 'examples')):
+    dirnames[:] = [d for d in dirnames if d != '__pycache__']
+    for fn in filenames:
+      if fn.endswith('.sh'):
+        doc_files.append(os.path.relpath(os.path.join(dirpath, fn),
+                                         root))
+  n_flags = 0
+  for rel in doc_files:
+    text = _read(root, rel)
+    if text is None:
+      continue
+    is_sh = rel.endswith('.sh')
+    # command-block tracking: a `python some/script.py` line opens a
+    # scope (that script's argparse flags) that persists across
+    # backslash-continuation lines — how chip_run.sh writes its
+    # multi-line invocations
+    scope: Optional[Set[str]] = None
+    scope_name: Optional[str] = None
+    in_continuation = False
+    for ln, line in enumerate(text.splitlines(), 1):
+      cm = _CMD_RE.search(line)
+      if cm:
+        script = os.path.normpath(cm.group(1))
+        scope = declared.get(script)
+        scope_name = cm.group(1)
+      elif not in_continuation:
+        scope, scope_name = None, None
+      in_continuation = line.rstrip().endswith('\\')
+      if scope is None and is_sh:
+        # shell prose / shell-own flags (e.g. chip_run.sh --budget):
+        # only flags inside a python command block are checkable
+        continue
+      for fm in _FLAG_RE.finditer(line):
+        flag = '--' + fm.group(1)
+        if flag.startswith('--xla_'):
+          continue  # XLA runtime flags, not argparse surface
+        n_flags += 1
+        pool = scope if scope is not None else all_flags
+        base = flag[5:] if flag.startswith('--no-') else None
+        ok = flag in pool or (base is not None
+                              and f'--{base}' in pool)
+        if not ok:
+          findings.append(Finding(
+              rule='docdrift/cli-flag-unknown', path=rel, line=ln,
+              symbol=f'{flag}',
+              message=f'{flag} is named in {rel} but no argparse '
+              'definition declares it'
+              + (f' (checked against {scope_name})'
+                 if scope is not None else '')))
+  ctx.meta['docdrift_cli_flags'] = n_flags
+
+  # ---- design.md §N cross-references ---------------------------------
+  design = _read(root, os.path.join('docs', 'design.md')) or ''
+  sections = {m.group(1) for line in design.splitlines()
+              if (m := _HEADING_RE.match(line))}
+  n_refs = 0
+  docs_dir = os.path.join(root, 'docs')
+  scan_files = [os.path.join('docs', f)
+                for f in (os.listdir(docs_dir)
+                          if os.path.isdir(docs_dir) else [])
+                if f.endswith('.md')]
+  scan_files += [m.relpath for m in ctx.modules.values()]
+  for rel in sorted(set(scan_files)):
+    text = _read(root, rel)
+    if text is None:
+      continue
+    # inside design.md itself every bare §N is a self-reference;
+    # elsewhere only design-prefixed refs are unambiguous
+    ref_re = _SELF_REF_RE if rel == os.path.join('docs', 'design.md') \
+        else _REF_RE
+    for ln, line in enumerate(text.splitlines(), 1):
+      for rm in ref_re.finditer(line):
+        n_refs += 1
+        sec = rm.group(1)
+        if sec not in sections:
+          findings.append(Finding(
+              rule='docdrift/dangling-section-ref', path=rel, line=ln,
+              symbol=f'§{sec}',
+              message=f'design.md §{sec} is referenced but design.md '
+              f'has no section {sec} (sections: '
+              f'{sorted(sections)})'))
+  ctx.meta['docdrift_section_refs'] = n_refs
+  # de-dup identical ids (the same flag or §ref named on many lines)
+  uniq: Dict[str, Finding] = {}
+  for f in findings:
+    uniq.setdefault(f.id, f)
+  return list(uniq.values())

@@ -93,6 +93,71 @@ METRIC_TYPES: Dict[str, str] = {
 
 REGISTERED_METRICS = frozenset(METRIC_TYPES)
 
+# Component ``stats()`` key schema (docs/design.md §17): every string
+# key a runtime component's ``stats()`` method emits must be registered
+# here — the same rename-kills-every-consumer hazard as the metric
+# names, now under the detlint registry-schema pass instead of nothing.
+# Add the key HERE in the same change that introduces it.
+REGISTERED_STATS_KEYS = frozenset({
+    # shared overlap accounting (CsrFeed / ColdFetchPipeline / batcher)
+    'batches', 'build_ms', 'blocked_ms', 'overlap_pct',
+    # CsrFeed (parallel/csr_feed.py)
+    'builder', 'skipped', 'fast_forwarded', 'io_retries', 'respawns',
+    'queue_depth', 'queue_dropped',
+    # DynamicBatcher (serving/batcher.py)
+    'submitted', 'completed', 'max_batch', 'max_delay_ms', 'batch_fill',
+    'p50_ms', 'p99_ms', 'bucket_ladder', 'buckets', 'bucket_launches',
+    'rows_launched', 'pad_rows', 'pad_waste_pct', 'pipeline',
+    'merge_demux_ms', 'csr_feed',
+    # ServingEngine (serving/engine.py)
+    'batches_served', 'samples_served', 'batch_size', 'world_size',
+    'hot_cache', 'cold_tier', 'table_dtype',
+})
+
+# Bench-artifact key schema: the keys tests/test_bench_artifact.py pins
+# against the journaled artifact.  The detlint registry-schema pass
+# asserts every key here is still PRODUCED by a string literal
+# somewhere in the runtime sources, so a silent producer rename breaks
+# tier-1 at the registry instead of at a stale dashboard.
+REGISTERED_ARTIFACT_KEYS = frozenset({
+    # core artifact line (bench.py)
+    'metric', 'value', 'unit', 'vs_baseline', 'comparable', 'warmup_s',
+    'window_ms', 'loadavg', 'sha', 'prior_chip_evidence', 'recorded_at',
+    # hot-cache counters (parallel/hotcache.py)
+    'alltoall_rows_sent', 'alltoall_rows_sent_off', 'unique_cold_rows',
+    'hot_hit_rate', 'cold_occurrence_fraction', 'scatter_rows_per_step',
+    'scatter_rows_per_step_off', 'total_id_occurrences',
+    # chunked-exchange block (parallel/overlap.py)
+    'a2a_overlap_pct', 'overlap_chunks', 'a2a_group_chunks',
+    'a2a_off_ms', 'a2a_on_ms', 'a2a_exchange_ms',
+    # quantized storage + cold tier (parallel/quantization.py, coldtier.py)
+    'table_bytes_per_row', 'table_scale_bytes_per_row',
+    'table_total_bytes_per_row', 'table_payload_bytes',
+    'table_scale_bytes', 'table_rows',
+    'cold_tier_fetch_rows', 'cold_tier_fetch_bytes',
+    'cold_tier_fetch_scale_bytes', 'cold_tier_fetch_rows_per_group',
+    'cold_tier_row_bytes_per_group', 'cold_tier_resident_bytes',
+    'cold_tier_host_bytes',
+    # serving three-arm A/B (serving/bench.py)
+    'serve_p50_ms', 'serve_p99_ms', 'serve_qps', 'serve_batches',
+    'serve_batch_fill', 'serve_requests', 'serve_batch',
+    'serve_max_delay_ms', 'serve_concurrency', 'serve_buckets',
+    'serve_bucket_launches', 'serve_rows_launched', 'serve_pad_rows',
+    'serve_pad_waste_pct', 'serve_pipeline_overlap_pct',
+    'serve_pipeline_merge_demux_ms', 'serve_pipeline_blocked_ms',
+    'serve_mono_p50_ms', 'serve_mono_p99_ms', 'serve_mono_qps',
+    'serve_mono_batches', 'serve_mono_batch_fill',
+    'serve_mono_pad_waste_pct', 'serve_nobatch_p50_ms',
+    'serve_nobatch_p99_ms', 'serve_nobatch_qps',
+    'serve_nobatch_pad_waste_pct',
+    # observability block (bench.obs_block)
+    'obs_trace', 'obs_trace_path', 'obs_trace_events', 'obs_off_ms',
+    'obs_on_ms', 'obs_window_delta_pct', 'obs_metrics_digest',
+    'obs_step_call_us', 'obs_overhead_pct',
+    # static-analysis gate counts (bench.lint_block; design §17)
+    'lint_findings', 'lint_waivers',
+})
+
 # ~x2-2.5 geometric ladder, 10 us .. 60 s: percentile estimates from
 # bucket counts are bounded by one bucket's width (the resolution
 # contract tests/test_obs.py pins against exact NumPy percentiles).

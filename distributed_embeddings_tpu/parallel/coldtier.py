@@ -34,6 +34,7 @@ import dataclasses
 import queue
 import threading
 import time
+import weakref
 
 from typing import Dict, List, Optional, Tuple
 
@@ -585,24 +586,50 @@ class ColdFetchPipeline:
     # shared blocked-time primitive (obs/metrics.py OverlapStat) —
     # stats() keys unchanged
     self._overlap = obs_metrics.OverlapStat()
-    self._err = None
+    self._err_box: list = []
+    self._stop = threading.Event()
+    # the producer closes over the QUEUE and the stop event, never over
+    # the pipeline itself (the CsrFeed weakref discipline): an abandoned
+    # pipeline can be collected, __del__ -> close() sets the stop, and
+    # the timed puts below observe it instead of wedging forever on a
+    # full ring nobody will drain (detlint concurrency/
+    # untimed-put-bounded + thread-no-join)
+    q, stop, err_box = self._q, self._stop, self._err_box
+    ref = weakref.ref(self)
+
+    def put_or_stop(item) -> bool:
+      """The stop-aware bounded put (CsrFeed's timed-put discipline):
+      False when the stop flag ended the wait."""
+      while not stop.is_set():
+        try:
+          q.put(item, timeout=0.1)
+          return True
+        except queue.Full:
+          continue
+      return False
 
     def producer():
       try:
         for cats in cats_iter:
+          if stop.is_set():
+            return
           t0 = time.perf_counter()
           tok = obs_trace.begin('coldtier/prepass')
           prepped, _, _ = dist._prepare_inputs(list(cats))
           rows = compute_fetch_rows(dist, prepped)
           obs_trace.end(tok)
           prepass_ms = (time.perf_counter() - t0) * 1000.0
-          self._overlap.add_build(prepass_ms)
+          live = ref()
+          if live is not None:
+            live._overlap.add_build(prepass_ms)
+            del live
           obs_metrics.observe('coldtier.prepass_ms', prepass_ms)
-          self._q.put((cats, prepped, rows))
+          if not put_or_stop((cats, prepped, rows)):
+            return
       except BaseException as e:  # surfaced on the consumer side
-        self._err = e
+        err_box.append(e)
       finally:
-        self._q.put(None)
+        put_or_stop(None)
 
     self._thread = threading.Thread(target=producer, daemon=True,
                                     name='cold-tier-prefetch')
@@ -613,20 +640,58 @@ class ColdFetchPipeline:
 
   def __next__(self):
     t0 = time.perf_counter()
-    item = self._q.get()
+    while True:
+      try:
+        item = self._q.get(timeout=0.1)
+        break
+      except queue.Empty:
+        if self._stop.is_set():
+          raise StopIteration from None
     blocked_ms = (time.perf_counter() - t0) * 1000.0
     self._overlap.add_blocked(blocked_ms)
     obs_trace.complete('coldtier/wait', t0, blocked_ms / 1000.0)
     obs_metrics.observe('coldtier.blocked_ms', blocked_ms)
     if item is None:
-      if self._err is not None:
-        raise self._err
+      if self._err_box:
+        raise self._err_box[0]
       raise StopIteration
     cats, prepped, rows = item
     fetch = build_fetch(self.dist, prepped, rows=rows)
     self._overlap.count_batch()
     obs_metrics.inc('coldtier.batches')
     return cats, fetch
+
+  def close(self, join_timeout: float = 30.0):
+    """Stop the producer and drain the ring; idempotent.  Pre-passes
+    already built but not consumed are discarded."""
+    self._stop.set()
+
+    def drain():
+      while True:
+        try:
+          self._q.get_nowait()
+        except queue.Empty:
+          return
+
+    drain()  # frees a producer blocked mid-put so the join can land
+    if join_timeout > 0 and self._thread is not threading.current_thread():
+      self._thread.join(timeout=join_timeout)
+    # a producer that was ALREADY inside its timed put when the drain
+    # freed a slot may have landed one more item before observing the
+    # stop flag — drain again after the join so no stale pre-pass can
+    # ever be served as live
+    drain()
+
+  def __del__(self):
+    # an abandoned pipeline (iterator dropped without drain or close)
+    # must not leak a producer blocked on the full ring.  NO join here:
+    # GC can run on any thread, and waiting for a mid-build pre-pass
+    # would stall an unrelated (e.g. serving) thread — the stop flag +
+    # the producer's timed puts already guarantee the daemon exits
+    try:
+      self.close(join_timeout=0.0)
+    except Exception:
+      pass  # interpreter teardown: module globals may be gone
 
   def reset_stats(self):
     self._overlap = obs_metrics.OverlapStat()

@@ -464,7 +464,10 @@ class DynamicBatcher:
       item = self._exec_q.get()
       wait_ms = (time.perf_counter() - t0) * 1000.0
       if item is None:
-        self._demux_q.put(None)  # forward shutdown downstream, FIFO
+        # forward shutdown downstream, FIFO — via the liveness-checked
+        # bounded hand-off (a dead demuxer must not wedge this thread
+        # on the full queue; detlint concurrency/untimed-put-bounded)
+        self._put_stage(self._demux_q, None, self._demuxer, [])
         return
       merged, batch, n, merge_ms = item
       with self._lock:

@@ -50,8 +50,10 @@ _RING_CAP = 256
 REGISTERED_EVENTS = frozenset({
     # transient-I/O retry (retry_io)
     'io_retry', 'io_retry_exhausted',
-    # step watchdog (call_with_timeout)
-    'watchdog_fired',
+    # step watchdog (call_with_timeout); on_timeout_error: the caller's
+    # extra-diagnostics hook itself failed (detlint concurrency pass —
+    # a swallowed hook failure must leave evidence, design §17)
+    'watchdog_fired', 'watchdog_on_timeout_error',
     # input pipeline (parallel/csr_feed.py)
     'csr_feed_skipped_batch', 'csr_feed_respawn', 'csr_feed_fast_forward',
     # native-builder degradation (parallel/sparsecore.py)
@@ -222,8 +224,11 @@ def call_with_timeout(fn: Callable[[], Any],
     if on_timeout is not None:
       try:
         on_timeout()
-      except Exception:
-        pass
+      except Exception as e:
+        # the hook must never mask the timeout, but its failure is
+        # evidence too — journaled, never silent (detlint
+        # concurrency/silent-except)
+        journal('watchdog_on_timeout_error', what=what, error=repr(e))
     raise StepHangError(
         f'{what} exceeded the {timeout_s:g}s watchdog timeout; '
         'all-thread tracebacks dumped to stderr and the event journaled '

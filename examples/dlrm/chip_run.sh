@@ -33,6 +33,11 @@ fi
 # main.py prints which)
 make -C distributed_embeddings_tpu/cc >/dev/null 2>&1 || true
 
+# static-analysis gate (design §17): a chip window is too expensive to
+# burn on a tree that fails the standing detlint invariants — fail
+# fast (set -eu) before any data generation or compile work
+python tools/detlint.py --strict
+
 if [ ! -f "$DATA/model_size.json" ]; then
   python examples/dlrm/gen_data.py --data_path "$DATA" \
     --train_rows "$ROWS" --eval_rows 524288 --preset onechip

@@ -348,6 +348,48 @@ def test_obs_artifact_keys(bench):
     obs.reset()
 
 
+def test_lint_artifact_keys(bench):
+  """The ISSUE-13 journaled proof: the bench artifact carries the
+  static-analysis gate counts (design §17) — lint_findings is 0 on a
+  healthy tree (the SAME gate tier-1's test_lint.py enforces) and
+  lint_waivers equals the checked-in rationale-bearing baseline, so a
+  change that breaks the gate or quietly grows the baseline is visible
+  in the artifact record AND fails here."""
+  from distributed_embeddings_tpu.analysis import Baseline, core
+  block = bench.lint_block()
+  for key in ('lint_findings', 'lint_waivers'):
+    assert key in block, key
+  assert block['lint_findings'] == 0, block
+  base = Baseline.load(core.default_baseline_path())
+  # equality, not non-emptiness: an emptied baseline is the cleaner
+  # tree, never a failure
+  assert block['lint_waivers'] == len(base.waivers)
+
+
+def test_artifact_keys_registered():
+  """Every artifact key THIS test file pins is in
+  obs.metrics.REGISTERED_ARTIFACT_KEYS — the registry the detlint
+  registry-schema pass checks producers against — so the test pins and
+  the registry can never drift apart."""
+  import ast
+  import pathlib
+  from distributed_embeddings_tpu.obs import metrics as obs_metrics
+  tree = ast.parse(pathlib.Path(__file__).read_text())
+  pinned = set()
+  # the `for key in (...)` loops over artifact keys, by shape
+  for node in ast.walk(tree):
+    if isinstance(node, ast.For) and isinstance(node.target, ast.Name) \
+        and node.target.id == 'key' and isinstance(node.iter, ast.Tuple):
+      for elt in node.iter.elts:
+        if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+          pinned.add(elt.value)
+  assert len(pinned) > 30, 'key-loop scan broken?'
+  missing = pinned - obs_metrics.REGISTERED_ARTIFACT_KEYS
+  assert not missing, (
+      f'artifact keys pinned here but not registered: {missing} — add '
+      'them to obs.metrics.REGISTERED_ARTIFACT_KEYS in the same change')
+
+
 def test_split_windows(bench):
   assert bench.split_windows(20, 3) == [7, 7, 6]
   assert bench.split_windows(2, 5) == [1, 1]   # never more windows than steps

@@ -407,7 +407,11 @@ def test_feed_poison_batch_default_raises(feed_dist):
 def test_feed_producer_killed_respawns_zero_loss(feed_dist):
   """kill_thread (the died-pool-worker injector) lands while batch 2
   builds; the respawned producer re-builds the in-flight batch and the
-  consumer sees the full ordered stream."""
+  consumer sees the full ordered stream.  The whole drill runs under
+  the locksan capture (design §17): the feed's ring + respawn path
+  must never invert an acquisition order, even across a killed and
+  respawned producer."""
+  from distributed_embeddings_tpu.analysis import locksan
   batches = _feed_batches(7, seed=3)
   entered = threading.Event()
   killed_once = []
@@ -419,14 +423,17 @@ def test_feed_producer_killed_respawns_zero_loss(feed_dist):
       time.sleep(0.5)  # the async kill is delivered when this returns
     return item[1]
 
-  feed = CsrFeed(feed_dist, batches, cats_fn=cats_fn, depth=1)
-  got = [next(feed).item[0]]
-  assert entered.wait(timeout=10)
-  assert faultinject.kill_thread(feed._thread)
-  got += [fed.item[0] for fed in feed]
+  with locksan.capture('csr-feed-respawn') as lock_cap:
+    feed = CsrFeed(feed_dist, batches, cats_fn=cats_fn, depth=1)
+    got = [next(feed).item[0]]
+    assert entered.wait(timeout=10)
+    assert faultinject.kill_thread(feed._thread)
+    got += [fed.item[0] for fed in feed]
   assert got == list(range(7))  # nothing lost, nothing duplicated
   assert feed.stats()['respawns'] == 1
   assert resilience.recent('csr_feed_respawn')
+  assert lock_cap.locks_created > 0
+  lock_cap.assert_acyclic()  # the observed acquisition DAG stays a DAG
 
 
 def test_feed_producer_dead_beyond_max_respawns(feed_dist):
@@ -993,28 +1000,37 @@ def test_csr_feed_skip_to_fast_forward(feed_dist):
   assert ev and ev[-1]['to_seq'] == 4
 
 
-def test_journal_event_names_registered():
+def test_journal_event_names_registered_detlint(tmp_path):
   """Schema hardening: every journal() call site in the runtime uses a
   name registered in resilience.REGISTERED_EVENTS — a misspelled or
-  unregistered kind is invisible to every journal consumer."""
+  unregistered kind is invisible to every journal consumer.  Enforced
+  by the detlint registry-schema pass (docs/design.md §17), which
+  resolves call sites alias-aware — strictly stronger than the regex
+  scan this test replaces (renamed direct imports are covered; a
+  derived name raises an explicit unverifiable finding instead of
+  silently missing).  The seeded fixture pins the regex-equivalent
+  surface so enforcement can never get weaker."""
   import pathlib
-  import re
+  from distributed_embeddings_tpu.analysis import run_passes
   root = pathlib.Path(__file__).resolve().parents[1]
-  pat = re.compile(r"""journal\(\s*(['"])([A-Za-z0-9_]+)\1""")
-  sources = [p for p in (root / 'distributed_embeddings_tpu').rglob('*.py')]
-  sources += [root / 'bench.py', root / '__graft_entry__.py']
-  sources += list((root / 'tools').glob('*.py'))
-  sources += list((root / 'examples').rglob('*.py'))
-  found = {}
-  for f in sources:
-    for m in pat.finditer(f.read_text()):
-      found.setdefault(m.group(2), []).append(f.name)
-  assert found, 'source scan found no journal() call sites — scan broken?'
-  unregistered = {k: v for k, v in found.items()
-                  if k not in resilience.REGISTERED_EVENTS}
-  assert not unregistered, (
-      f'journal() call sites with unregistered event names: '
-      f'{unregistered} — add them to resilience.REGISTERED_EVENTS')
+  res = run_passes(str(root), passes=['registry'])
+  bad = [f for f in (res.findings + res.unverifiable + res.waived)
+         if f.rule.startswith('registry/journal')
+         or f.rule == 'registry/unverifiable-name']
+  assert not bad, '\n'.join(f.brief() for f in bad)
+  assert res.meta['registry_sites']['journal'] > 10, \
+      'registry pass resolved no journal() call sites — pass broken?'
+  # seeded violation: the exact shape the old regex matched
+  pkg = tmp_path / 'distributed_embeddings_tpu'
+  pkg.mkdir()
+  (pkg / 'seeded.py').write_text(
+      'from distributed_embeddings_tpu.utils import resilience\n'
+      'def f():\n'
+      "  resilience.journal('misspelled_event_kind', step=1)\n")
+  seeded = run_passes(str(tmp_path), passes=['registry'])
+  assert any(f.rule == 'registry/journal-unregistered'
+             and f.symbol == 'misspelled_event_kind'
+             for f in seeded.findings)
 
 
 # --------------------------------------------------------------------------

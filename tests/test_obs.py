@@ -9,7 +9,6 @@ import importlib.util
 import json
 import os
 import pathlib
-import re
 import threading
 
 import numpy as np
@@ -434,51 +433,51 @@ def test_trace_report_rejects_malformed_truncated_and_unregistered(
 
 
 # --------------------------------------------------------------------------
-# source scans: the REGISTERED_EVENTS discipline, extended (§15)
+# registry discipline (§15), enforced by the detlint registry-schema
+# pass (docs/design.md §17) — the AST-resolving successor of the regex
+# source scans that used to live here
 # --------------------------------------------------------------------------
 
 
-def _runtime_sources():
-  sources = [p for p in (ROOT / 'distributed_embeddings_tpu').rglob('*.py')]
-  sources += [ROOT / 'bench.py', ROOT / '__graft_entry__.py']
-  sources += list((ROOT / 'tools').glob('*.py'))
-  sources += list((ROOT / 'examples').rglob('*.py'))
-  return sources
+def test_span_and_metric_names_registered_detlint():
+  """Every trace/metric call site in the runtime uses a registered
+  name — a typo'd phase silently vanishes from every report otherwise.
+  The detlint registry-schema pass resolves call sites alias-aware
+  (strictly stronger than the old regex scan: renamed direct imports
+  are covered, and a derived name raises an explicit unverifiable
+  finding instead of a silent miss)."""
+  from distributed_embeddings_tpu.analysis import run_passes
+  res = run_passes(str(ROOT), passes=['registry'])
+  bad = [f for f in (res.findings + res.unverifiable + res.waived)
+         if f.rule.startswith(('registry/span', 'registry/metric'))
+         or f.rule == 'registry/unverifiable-name']
+  assert not bad, '\n'.join(f.brief() for f in bad)
+  # the scan-not-broken guard the regex tests carried: real sites seen
+  assert res.meta['registry_sites']['span'] > 10
+  assert res.meta['registry_sites']['metric'] > 10
 
 
-def test_span_names_registered_source_scan():
-  """Every trace call site in the runtime uses a REGISTERED_SPANS name
-  — a typo'd phase silently vanishes from every report otherwise."""
-  pat = re.compile(
-      r"""(?:obs_)?trace\s*\.\s*"""
-      r"""(?:span|begin|complete|async_span|instant)\(\s*"""
-      r"""(['"])([A-Za-z0-9_/.]+)\1""")
-  found = {}
-  for f in _runtime_sources():
-    for m in pat.finditer(f.read_text()):
-      found.setdefault(m.group(2), []).append(f.name)
-  assert found, 'source scan found no trace call sites — scan broken?'
-  unregistered = {k: v for k, v in found.items()
-                  if k not in obs_trace.REGISTERED_SPANS}
-  assert not unregistered, (
-      f'trace call sites with unregistered span names: {unregistered} '
-      '— add them to obs.trace.REGISTERED_SPANS')
-
-
-def test_metric_names_registered_source_scan():
-  pat = re.compile(
-      r"""(?:obs_)?metrics\s*\.\s*(?:inc|observe|set_gauge)\(\s*"""
-      r"""(['"])([A-Za-z0-9_./]+)\1""")
-  found = {}
-  for f in _runtime_sources():
-    for m in pat.finditer(f.read_text()):
-      found.setdefault(m.group(2), []).append(f.name)
-  assert found, 'source scan found no metric call sites — scan broken?'
-  unregistered = {k: v for k, v in found.items()
-                  if k not in obs_metrics.REGISTERED_METRICS}
-  assert not unregistered, (
-      f'metric call sites with unregistered names: {unregistered} '
-      '— add them to obs.metrics.METRIC_TYPES')
+def test_span_and_metric_enforcement_no_weaker(tmp_path):
+  """Seeded-violation pin: everything the deleted regex scans caught,
+  the pass still catches — the exact surface shapes the regexes
+  matched (`obs_trace.span('x')`, `metrics.inc('y')`) seed a fixture
+  tree and must each produce a finding."""
+  from distributed_embeddings_tpu.analysis import run_passes
+  pkg = tmp_path / 'distributed_embeddings_tpu'
+  pkg.mkdir()
+  (pkg / 'seeded.py').write_text(
+      'from distributed_embeddings_tpu.obs import trace as obs_trace\n'
+      'from distributed_embeddings_tpu.obs import metrics\n'
+      'def f():\n'
+      "  tok = obs_trace.begin('typo/phase')\n"
+      "  obs_trace.end(tok)\n"
+      "  with obs_trace.span('another/typo'):\n"
+      "    metrics.inc('typo.metric')\n")
+  res = run_passes(str(tmp_path), passes=['registry'])
+  caught = {(f.rule, f.symbol) for f in res.findings}
+  assert ('registry/span-unregistered', 'typo/phase') in caught
+  assert ('registry/span-unregistered', 'another/typo') in caught
+  assert ('registry/metric-unregistered', 'typo.metric') in caught
 
 
 # --------------------------------------------------------------------------

@@ -384,20 +384,26 @@ def test_cold_fetch_cap_overflow_refuses():
 
 def test_cold_fetch_pipeline_ordered_and_measured():
   """ColdFetchPipeline yields batches in order with their fetches and
-  measures overlap directly from consumer blocked time."""
+  measures overlap directly from consumer blocked time — under the
+  locksan capture (design §17): the prefetch ring's observed
+  acquisition DAG must stay acyclic."""
+  from distributed_embeddings_tpu.analysis import locksan
   rng = np.random.default_rng(41)
   d = _tiered('int8')
   set_weights(d, _weights(rng))
   batches = [_ids(np.random.default_rng(100 + i), 8) for i in range(4)]
-  pipe = coldtier.ColdFetchPipeline(d, iter(batches))
   seen = []
-  for cats, fetch in pipe:
-    ref = d.build_cold_fetch([jnp.asarray(x) for x in cats])
-    for gi in d.plan.cold_tier_groups:
-      for dev in range(d.world_size):
-        np.testing.assert_array_equal(fetch.rows_np[gi][dev],
-                                      ref.rows_np[gi][dev])
-    seen.append([np.asarray(c) for c in cats])
+  with locksan.capture('cold-fetch-pipeline') as lock_cap:
+    pipe = coldtier.ColdFetchPipeline(d, iter(batches))
+    for cats, fetch in pipe:
+      ref = d.build_cold_fetch([jnp.asarray(x) for x in cats])
+      for gi in d.plan.cold_tier_groups:
+        for dev in range(d.world_size):
+          np.testing.assert_array_equal(fetch.rows_np[gi][dev],
+                                        ref.rows_np[gi][dev])
+      seen.append([np.asarray(c) for c in cats])
+  assert lock_cap.locks_created > 0
+  lock_cap.assert_acyclic()
   assert len(seen) == 4
   for got, want in zip(seen, batches):  # order preserved
     for a, b in zip(got, want):

@@ -389,24 +389,31 @@ class TestBatcher:
       r[1] = np.where(mask, -1, r[1]).astype(np.int32)
       reqs.append(r)
     results = [None] * len(reqs)
-    with serving.DynamicBatcher(served['engine'],
-                                max_delay_ms=1.0) as bat:
-      def worker(lo):
-        for i in range(lo, len(reqs), 6):
-          results[i] = bat.submit(reqs[i]).result(timeout=60.0)
+    # the 8-thread fuzzed submission runs under the locksan capture
+    # (design §17): the batcher's three-stage pipeline + submit path
+    # must never invert an acquisition order under real contention
+    from distributed_embeddings_tpu.analysis import locksan
+    with locksan.capture('batcher-fuzz') as lock_cap:
+      with serving.DynamicBatcher(served['engine'],
+                                  max_delay_ms=1.0) as bat:
+        def worker(lo):
+          for i in range(lo, len(reqs), 6):
+            results[i] = bat.submit(reqs[i]).result(timeout=60.0)
 
-      threads = [threading.Thread(target=worker, args=(k,))
-                 for k in range(6)]
-      for t in threads:
-        t.start()
-      for t in threads:
-        t.join()
-      st = bat.stats()
-      assert st['completed'] == len(reqs)
-      # the run really exercised several ladder rungs
-      assert len(st['bucket_launches']) >= 2, st['bucket_launches']
-      assert set(st['bucket_launches']) <= set(served['engine'].buckets)
-      assert st['pipeline']['batches'] == st['batches']
+        threads = [threading.Thread(target=worker, args=(k,))
+                   for k in range(6)]
+        for t in threads:
+          t.start()
+        for t in threads:
+          t.join()
+        st = bat.stats()
+    assert lock_cap.locks_created > 0
+    lock_cap.assert_acyclic()
+    assert st['completed'] == len(reqs)
+    # the run really exercised several ladder rungs
+    assert len(st['bucket_launches']) >= 2, st['bucket_launches']
+    assert set(st['bucket_launches']) <= set(served['engine'].buckets)
+    assert st['pipeline']['batches'] == st['batches']
     for r, out in zip(reqs, results):
       want = served['engine'].lookup_padded(r)
       for a, b in zip(want, out):

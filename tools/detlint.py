@@ -1,0 +1,105 @@
+#!/usr/bin/env python3
+"""detlint CLI: the repo-wide static-analysis gate (docs/design.md §17).
+
+One AST parse, four passes (registry-schema, concurrency, traced-purity,
+doc-drift), findings with stable ids, a waiver baseline with mandatory
+rationale.  CI semantics mirror ``tools/trace_report.py``:
+
+  exit 0  clean (every finding waived with rationale)
+  exit 1  unwaived verifiable findings
+  exit 2  malformed baseline (unparseable, or a waiver without
+          rationale) or an unparseable source tree
+  exit 3  --strict only: unverifiable findings (derived names the
+          resolver cannot check) or stale waivers
+
+    python tools/detlint.py                 # report
+    python tools/detlint.py --strict        # the tier-1 / CI gate
+    python tools/detlint.py --json          # machine-readable
+    python tools/detlint.py --passes registry,concurrency
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from distributed_embeddings_tpu.analysis import core as lint_core  # noqa: E402
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+  ap = argparse.ArgumentParser(
+      description='AST static-analysis gate: registry-schema, '
+      'concurrency (lock-order), traced-purity and doc-drift passes '
+      'with stable finding ids and a rationale-bearing waiver '
+      'baseline; nonzero exit on violations (pipeline-gate friendly).')
+  ap.add_argument('--root', default=None,
+                  help='repo root (default: this checkout)')
+  ap.add_argument('--baseline', default=None,
+                  help='waiver file (default: tools/detlint_baseline'
+                  '.toml under the root); every waiver must carry a '
+                  'rationale')
+  ap.add_argument('--passes', default=None,
+                  help='comma-separated pass subset (default: all of '
+                  f'{",".join(lint_core.list_passes())})')
+  ap.add_argument('--json', action='store_true',
+                  help='emit the result as JSON instead of text')
+  ap.add_argument('--strict', action='store_true',
+                  help='also fail (exit 3) on unverifiable findings '
+                  'and stale waivers')
+  args = ap.parse_args(argv)
+  root = os.path.abspath(args.root or lint_core.default_root())
+  baseline_path = args.baseline or lint_core.default_baseline_path(root)
+  passes = ([p for p in args.passes.split(',') if p]
+            if args.passes else None)
+  try:
+    baseline = lint_core.Baseline.load(baseline_path)
+    res = lint_core.run_passes(root, passes=passes, baseline=baseline)
+  except (lint_core.BaselineError, RuntimeError, ValueError) as e:
+    print(f'detlint: MALFORMED: {e}', file=sys.stderr)
+    return 2
+
+  if args.json:
+    print(json.dumps({
+        'root': root,
+        'counts': res.counts,
+        'findings': [vars(f) | {'id': f.id} for f in res.findings],
+        'unverifiable': [vars(f) | {'id': f.id}
+                         for f in res.unverifiable],
+        'waived': [f.id for f in res.waived],
+        'stale_waivers': res.stale_waivers,
+        'meta': res.meta,
+    }, indent=2, default=str))
+  else:
+    for f in res.findings:
+      print(f.brief())
+    for f in res.unverifiable:
+      print(f.brief())
+    c = res.counts
+    print(f"detlint: {c['findings']} finding(s), "
+          f"{c['unverifiable']} unverifiable, {c['waived']} waived, "
+          f"{c['stale_waivers']} stale waiver(s) "
+          f"[{res.meta.get('registry_sites')}, "
+          f"lock_graph={res.meta.get('lock_graph')}, "
+          f"purity={res.meta.get('purity')}]")
+
+  if res.findings:
+    print(f'detlint: {len(res.findings)} unwaived finding(s)',
+          file=sys.stderr)
+    return 1
+  if args.strict and (res.unverifiable or res.stale_waivers):
+    print(f'detlint: STRICT: {len(res.unverifiable)} unverifiable '
+          f'finding(s), {len(res.stale_waivers)} stale waiver(s) '
+          f'{res.stale_waivers}', file=sys.stderr)
+    return 3
+  return 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())
