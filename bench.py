@@ -75,6 +75,33 @@ def lint_block() -> dict:
   }
 
 
+def graphlint_block() -> dict:
+  """The journaled IR-analysis gate counts (design §18; keys pinned by
+  tests/test_bench_artifact.py): the flagship program catalog traced
+  on THIS backend.  ``graphlint_findings`` is the unwaived finding
+  count (0 on a healthy tree), ``graphlint_donation_ok`` whether every
+  sparse-train-step state leaf came back input-output aliased in the
+  compiled executable, ``graphlint_retraces`` the compile/retrace
+  count across the monitored 3-step fit + warmed serving ladder (0 or
+  a hot path is recompiling), and ``graphlint_peak_hbm_bytes`` the
+  largest per-program per-device memory estimate — the journaled twin
+  of the perf_notes fits ladder."""
+  from distributed_embeddings_tpu.analysis import graphlint
+  res = graphlint.run_repo(os.path.dirname(os.path.abspath(__file__)))
+  don = res.meta.get('graphlint_donation', {})
+  ret = res.meta.get('graphlint_retrace', {})
+  hbm = res.meta.get('graphlint_hbm', {})
+  return {
+      'graphlint_findings': len(res.findings) + len(res.unverifiable),
+      'graphlint_donation_ok': bool(don) and all(
+          v['aliased'] == v['expected'] for v in don.values()),
+      'graphlint_retraces': sum(v['compile_count_delta']
+                                for v in ret.values()),
+      'graphlint_peak_hbm_bytes': max(
+          (v['peak'] for v in hbm.values()), default=0),
+  }
+
+
 def pick_baseline(model: str, n_devices: int):
   """Baseline at this device count; otherwise round UP to the smallest
   published count >= ours (more devices = faster baseline = harder target,
@@ -1299,6 +1326,15 @@ def main():
   except Exception as e:
     lint_stats = {'lint_error': f'{type(e).__name__}: {e}'}
 
+  # IR-analysis gate counts (design §18): the flagship program catalog
+  # traced+compiled on this backend (~10 s of tiny CPU compiles; on a
+  # TPU tunnel it rides the persistent compile cache).  Never fatal.
+  graphlint_stats = None
+  try:
+    graphlint_stats = graphlint_block()
+  except Exception as e:
+    graphlint_stats = {'graphlint_error': f'{type(e).__name__}: {e}'}
+
   n_dev = len(devices)
   backend = devices[0].platform
   # the baselines are AT global batch 65536: a reduced-batch chip run
@@ -1386,6 +1422,8 @@ def main():
     result.update(obs_stats)
   if lint_stats:
     result.update(lint_stats)
+  if graphlint_stats:
+    result.update(graphlint_stats)
   if on_cpu:
     # a sweep window may have landed an on-chip line earlier this round;
     # carry it (labelled, with its own sha/timestamp) so the artifact is

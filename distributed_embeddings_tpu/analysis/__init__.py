@@ -28,6 +28,17 @@ The four shipped passes:
 ``locksan`` is the runtime sibling of the concurrency pass: an opt-in
 instrumented-lock capture that records the acquisition DAG during the
 fuzzed-concurrency tests and asserts it stays acyclic.
+
+``graphlint`` (docs/design.md §18) is the second analysis TIER: where
+the passes above read the source, it traces the repo's real programs
+(lookup dispatch paths, chunked + monolithic sparse train step,
+serving ladder rungs, cold-tier fetch) and gates their jaxprs and
+compiled executables — collective schedules, donation/aliasing,
+retrace signatures, host syncs, HBM accounting — under the SAME
+waiver baseline and CLI contract (``python tools/graphlint.py
+--strict``).  Import it explicitly
+(``from distributed_embeddings_tpu.analysis import graphlint``): it
+pulls in jax, which this package root deliberately does not.
 """
 
 from distributed_embeddings_tpu.analysis.core import (

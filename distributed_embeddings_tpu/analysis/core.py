@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import datetime
 import os
 import re
 
@@ -286,13 +287,25 @@ class BaselineError(ValueError):
 
 
 _KV_RE = re.compile(r'^([A-Za-z_][A-Za-z0-9_]*)\s*=\s*"(.*)"\s*$')
+_EXPIRES_RE = re.compile(r'^\d{4}-\d{2}-\d{2}$')
+
+
+def today() -> str:
+  """Today as an ISO date string — the comparison key for waiver
+  ``expires`` dates (ISO strings order lexicographically)."""
+  return datetime.date.today().isoformat()
 
 
 class Baseline:
   """``tools/detlint_baseline.toml``: a list of ``[[waiver]]`` tables,
-  each ``id = "..."`` + ``rationale = "..."``.  Parsed with a strict
-  TOML-subset reader (double-quoted single-line strings only) so the
-  gate needs no third-party dependency on py3.10."""
+  each ``id = "..."`` + ``rationale = "..."`` and an optional
+  ``expires = "YYYY-MM-DD"`` (a waiver tied to an open ROADMAP item
+  carries the date it should be re-justified by; past it, ``--strict``
+  fails and echoes the rationale).  Parsed with a strict TOML-subset
+  reader (double-quoted single-line strings only) so the gate needs no
+  third-party dependency on py3.10.  Shared by detlint (the AST tier)
+  and graphlint (the IR tier, design §18) — ownership is by rule
+  prefix, so neither runner reports the other's waivers stale."""
 
   def __init__(self, waivers: List[Dict[str, str]], path: str = ''):
     self.path = path
@@ -306,10 +319,30 @@ class Baseline:
         raise BaselineError(
             f'{path}: waiver {wid!r} has no rationale — every waiver '
             'must say WHY the finding is acceptable')
+      exp = w.get('expires')
+      if exp is not None and not _EXPIRES_RE.match(exp):
+        raise BaselineError(
+            f'{path}: waiver {wid!r} has malformed expires {exp!r} '
+            '(must be "YYYY-MM-DD")')
       if wid in seen:
         raise BaselineError(f'{path}: duplicate waiver id {wid!r}')
       seen.add(wid)
     self.ids = seen
+
+  def expired(self, executed: Set[str],
+              on: Optional[str] = None) -> List[str]:
+    """Expired waivers owned by the ``executed`` passes (rule prefix
+    before the first ``/``), each echoed with its rationale — the
+    ``--strict`` escalation for a suppression that outlived the date
+    its author tied it to."""
+    ref = on or today()
+    out = []
+    for w in self.waivers:
+      exp = w.get('expires')
+      wid = w.get('id', '')
+      if exp and exp < ref and wid.split('/', 1)[0] in executed:
+        out.append(f'{wid} (expired {exp}): {w.get("rationale", "")}')
+    return sorted(out)
 
   @classmethod
   def load(cls, path: str) -> 'Baseline':
@@ -351,6 +384,10 @@ class Result:
   waived: List[Finding]            # matched a baseline waiver
   stale_waivers: List[str]         # waiver ids matching no finding
   meta: Dict[str, Any]
+  # waivers past their optional `expires` date (strict-only, rationale
+  # echoed) — an expired waiver still suppresses by default so a date
+  # lapse degrades to a strict failure, never a surprise hard gate
+  expired_waivers: List[str] = dataclasses.field(default_factory=list)
 
   @property
   def counts(self) -> Dict[str, int]:
@@ -359,6 +396,7 @@ class Result:
         'unverifiable': len(self.unverifiable),
         'waived': len(self.waived),
         'stale_waivers': len(self.stale_waivers),
+        'expired_waivers': len(self.expired_waivers),
     }
 
 
@@ -403,6 +441,19 @@ def run_passes(root: str, passes: Optional[List[str]] = None,
       raise ValueError(f'unknown pass {name!r}; available: '
                        f'{list_passes()}')
     all_findings.extend(PASSES[name](ctx))
+  return apply_baseline(all_findings, baseline, set(names),
+                        dict(ctx.meta))
+
+
+def apply_baseline(all_findings: List[Finding],
+                   baseline: Optional[Baseline],
+                   executed: Set[str],
+                   meta: Dict[str, Any]) -> Result:
+  """Dedupe, sort and split findings against the waiver baseline — the
+  shared back half of both analysis tiers (detlint's AST passes and
+  graphlint's IR passes, design §17/§18), so waiver arithmetic,
+  staleness ownership and expiry semantics can never drift between
+  them."""
   # one finding per id: two sites violating the same rule with the
   # same symbol (e.g. two call sites of one unregistered name) are ONE
   # actionable fact, and a well-defined count is what the waiver
@@ -418,8 +469,8 @@ def run_passes(root: str, passes: Optional[List[str]] = None,
   matched = {f.id for f in waived}
   # a waiver is stale only when the pass owning its rule actually RAN
   # and produced no matching finding — `--passes registry` must not
-  # report every concurrency waiver stale (rule prefix == pass name)
-  executed = set(names)
+  # report every concurrency waiver stale (rule prefix == pass name),
+  # and detlint must not report graphlint's waivers stale (or expired)
   stale = sorted(w for w in base.ids - matched
                  if w.split('/', 1)[0] in executed)
   return Result(
@@ -427,7 +478,8 @@ def run_passes(root: str, passes: Optional[List[str]] = None,
       unverifiable=[f for f in live if not f.verifiable],
       waived=waived,
       stale_waivers=stale,
-      meta=dict(ctx.meta),
+      meta=meta,
+      expired_waivers=base.expired(executed),
   )
 
 

@@ -155,6 +155,30 @@ def run(ctx: Context) -> List[Finding]:
 
   # ---- CLI flags ------------------------------------------------------
   declared = _argparse_flags(ctx)
+  # the tools/ CLIs build their parser through the shared scaffold
+  # (tools/_cli.py `make_parser`), which declares the contract flags
+  # on their behalf — credit each script ONLY the flags its own
+  # make_parser call actually gets: `--json` unless json_flag=False,
+  # `--strict` only when a strict_help is passed (crediting --strict
+  # blanket-wide would green-light docs for tools that reject it)
+  for mod in ctx.modules.values():
+    if not mod.relpath.startswith('tools' + os.sep):
+      continue
+    for node in ast.walk(mod.tree):
+      if not (isinstance(node, ast.Call)
+              and (core.dotted(node.func) or '').endswith(
+                  'make_parser')):
+        continue
+      kw = {k.arg: k.value for k in node.keywords}
+      got: Set[str] = set()
+      jf = kw.get('json_flag')
+      if not (isinstance(jf, ast.Constant) and jf.value is False):
+        got.add('--json')
+      sh = kw.get('strict_help')
+      if sh is not None and not (isinstance(sh, ast.Constant)
+                                 and sh.value is None):
+        got.add('--strict')
+      declared[mod.relpath] = declared.get(mod.relpath, set()) | got
   all_flags: Set[str] = set().union(*declared.values()) if declared \
       else set()
   doc_files = [os.path.join('docs', f) for f in ('api.md',

@@ -156,6 +156,9 @@ REGISTERED_ARTIFACT_KEYS = frozenset({
     'obs_step_call_us', 'obs_overhead_pct',
     # static-analysis gate counts (bench.lint_block; design §17)
     'lint_findings', 'lint_waivers',
+    # IR-analysis gate counts (bench.graphlint_block; design §18)
+    'graphlint_findings', 'graphlint_donation_ok',
+    'graphlint_retraces', 'graphlint_peak_hbm_bytes',
 })
 
 # ~x2-2.5 geometric ladder, 10 us .. 60 s: percentile estimates from

@@ -1609,6 +1609,12 @@ def make_hybrid_train_step(dist: DistributedEmbedding,
     dist.cold_write_back(fetch, writeback)
     return state, loss
 
+  # introspection surface for the IR-analysis tier (analysis/graphlint,
+  # design §18): the raw jitted step (trace/lower/compile without
+  # executing) and its donation contract — every state leaf must come
+  # back input-output aliased in the compiled executable
+  run.jitted = jitted
+  run.donate_argnums = (0,) if donate else ()
   return run
 
 

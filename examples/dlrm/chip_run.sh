@@ -38,6 +38,12 @@ make -C distributed_embeddings_tpu/cc >/dev/null 2>&1 || true
 # fast (set -eu) before any data generation or compile work
 python tools/detlint.py --strict
 
+# IR-analysis gate (design §18): trace the real programs on a forced
+# 8-device CPU mesh and verify the collective schedules, train-state
+# donation/aliasing, zero-retrace and host-sync contracts — the other
+# class of regression a chip window must not burn time discovering
+python tools/graphlint.py --strict
+
 if [ ! -f "$DATA/model_size.json" ]; then
   python examples/dlrm/gen_data.py --data_path "$DATA" \
     --train_rows "$ROWS" --eval_rows 524288 --preset onechip

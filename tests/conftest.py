@@ -72,6 +72,7 @@ def _hang_alarm(request):
                                 f'{request.node.nodeid}')
     resilience.journal('test_alarm_fired', test=request.node.nodeid,
                        timeout_s=timeout_s)
+    _dump_collective_ledger(request.node.nodeid)
 
   timer = threading.Timer(timeout_s, fire)
   timer.daemon = True
@@ -80,3 +81,58 @@ def _hang_alarm(request):
     yield
   finally:
     timer.cancel()
+
+
+def _dump_collective_ledger(nodeid):
+  """When the alarm catches a thread wedged inside a jit/shard_map
+  dispatch (the known XLA-CPU rendezvous flake), print graphlint's
+  checked-in collective-schedule ledger (design §18) so the stall is
+  attributable to a named program's collective sequence from the
+  tier-1 log alone — not just a rerun note.
+
+  A wedged collective usually shows NO python jax frame (the C++ pjit
+  fastpath dispatches straight into the executable), so the detector
+  matches the INNERMOST python frame — the frame actually blocked in
+  the C call — against the jax package or the library's own dispatch
+  sites.  Innermost-only matters: idle pipeline daemons (batcher
+  dispatcher, CsrFeed producer) carry package frames higher up their
+  stacks during most tests while blocked in stdlib queue.get, and a
+  hang in pure pytest/IO code must stay quiet.  Best-effort by the
+  same contract as dump_diagnostics: diagnostics must never mask the
+  hang they are evidence for."""
+  import json
+  import sys
+  import traceback
+  try:
+    frames = sys._current_frames()
+    wedged = []
+    for tid, frame in frames.items():
+      stack = traceback.extract_stack(frame)
+      if not stack:
+        continue
+      fn = stack[-1].filename.replace(os.sep, '/')
+      if '/jax/' in fn or ('/distributed_embeddings_tpu/' in fn
+                           and '/utils/resilience' not in fn):
+        wedged.append(tid)
+    if not wedged:
+      return
+    ledger_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        'tools', 'graphlint_ledger.json')
+    if not os.path.exists(ledger_path):
+      return
+    with open(ledger_path, 'r', encoding='utf-8') as f:
+      ledger = json.load(f)
+    print(f'\n=== collective-schedule ledger (test alarm: {nodeid}; '
+          f'{len(wedged)} thread(s) inside jax dispatch) ===',
+          file=sys.stderr)
+    for name in sorted(ledger):
+      ops = ledger[name].get('collectives', [])
+      seq = ', '.join(f"{o['primitive']}@{o['axis']}"
+                      f"{'*' if o.get('loop') else ''}" for o in ops)
+      print(f'  {name}: [{seq}]', file=sys.stderr)
+    print('=== a wedged shard_map collective should match one '
+          'program\'s sequence above (tools/graphlint.py '
+          '--tier full --write-ledger refreshes) ===', file=sys.stderr)
+  except Exception as e:  # noqa: BLE001 — diagnostics stay best-effort
+    print(f'collective-ledger dump failed: {e!r}', file=sys.stderr)

@@ -355,15 +355,37 @@ def test_lint_artifact_keys(bench):
   lint_waivers equals the checked-in rationale-bearing baseline, so a
   change that breaks the gate or quietly grows the baseline is visible
   in the artifact record AND fails here."""
-  from distributed_embeddings_tpu.analysis import Baseline, core
+  from distributed_embeddings_tpu.analysis import (Baseline, core,
+                                                   list_passes)
   block = bench.lint_block()
   for key in ('lint_findings', 'lint_waivers'):
     assert key in block, key
   assert block['lint_findings'] == 0, block
   base = Baseline.load(core.default_baseline_path())
   # equality, not non-emptiness: an emptied baseline is the cleaner
-  # tree, never a failure
-  assert block['lint_waivers'] == len(base.waivers)
+  # tree, never a failure.  The file is shared with graphlint
+  # (design §18): only detlint-owned waivers match lint_block's count
+  detlint_owned = [w for w in base.waivers
+                   if w['id'].split('/', 1)[0] in list_passes()]
+  assert block['lint_waivers'] == len(detlint_owned)
+
+
+def test_graphlint_artifact_keys(bench):
+  """The ISSUE-14 journaled proof: the bench artifact carries the
+  IR-analysis gate counts (design §18) — graphlint_findings is 0 on a
+  healthy tree (the SAME gate tier-1's test_graphlint.py enforces),
+  the donation proof holds (every sparse-train-step state leaf
+  input-output aliased), the monitored windows saw zero retraces, and
+  the peak per-device estimate is a real nonzero figure next to the
+  perf_notes fits ladder."""
+  block = bench.graphlint_block()
+  for key in ('graphlint_findings', 'graphlint_donation_ok',
+              'graphlint_retraces', 'graphlint_peak_hbm_bytes'):
+    assert key in block, key
+  assert block['graphlint_findings'] == 0, block
+  assert block['graphlint_donation_ok'] is True, block
+  assert block['graphlint_retraces'] == 0, block
+  assert block['graphlint_peak_hbm_bytes'] > 0, block
 
 
 def test_artifact_keys_registered():

@@ -79,8 +79,13 @@ def test_live_tree_detlint_strict_clean():
   # enforces it; this pins that the file actually loads)
   base = Baseline.load(str(ROOT / 'tools' / 'detlint_baseline.toml'))
   # equality, not non-emptiness: an EMPTIED baseline (every waived
-  # finding fixed) is the cleaner tree, never a failure
-  assert len(base.waivers) == len(res.waived)
+  # finding fixed) is the cleaner tree, never a failure.  The file is
+  # SHARED with graphlint (design §18) — only detlint-owned waivers
+  # (rule prefix naming a detlint pass) are expected to match here
+  detlint_owned = [w for w in base.waivers
+                   if w['id'].split('/', 1)[0]
+                   in lint_core.list_passes()]
+  assert len(detlint_owned) == len(res.waived)
   # every pass genuinely ran over real sites — a silently broken scan
   # must fail here, not pass vacuously (the old regex tests' guard)
   assert res.meta['registry_sites']['journal'] > 10
@@ -634,6 +639,48 @@ def test_waiver_suppresses_and_stale_fails_strict(tmp_path):
 def test_unknown_pass_refuses():
   with pytest.raises(ValueError, match='unknown pass'):
     run_passes(str(ROOT), passes=['no_such_pass'])
+
+
+def test_expired_waiver_fails_strict_with_rationale(tmp_path):
+  """The ISSUE-14 waiver-hygiene contract: an `expires`-dated waiver
+  keeps suppressing by default, fails `--strict` past its date with
+  the rationale echoed, stays clean while future-dated, and a
+  malformed date refuses outright (exit 2)."""
+  root = _fixture_tree(tmp_path, {
+      'distributed_embeddings_tpu/bad.py': _SWALLOW})
+  fid = run_passes(root, passes=['concurrency']).findings[0].id
+  base = tmp_path / 'base.toml'
+
+  def write(expires):
+    base.write_text(
+        f'[[waiver]]\nid = "{fid}"\n'
+        'rationale = "tied to an open roadmap item"\n'
+        f'expires = "{expires}"\n')
+
+  cli = _detlint_cli()
+  write('2001-01-01')  # long past
+  # expired still SUPPRESSES by default — the lapse degrades to a
+  # strict failure, never a surprise hard gate
+  assert cli.main(['--root', root, '--baseline', str(base),
+                   '--passes', 'concurrency']) == 0
+  assert cli.main(['--root', root, '--baseline', str(base),
+                   '--passes', 'concurrency', '--strict']) == 3
+  # the strict failure carries the rationale (Baseline.expired echo)
+  b = Baseline.load(str(base))
+  exp = b.expired({'concurrency'})
+  assert len(exp) == 1
+  assert 'open roadmap item' in exp[0] and '2001-01-01' in exp[0]
+  # ...but only for the passes that ran: another pass's subset run
+  # must not fail on this waiver (the ownership rule staleness uses)
+  assert b.expired({'registry'}) == []
+  write('2999-12-31')  # future-dated: strict clean
+  assert cli.main(['--root', root, '--baseline', str(base),
+                   '--passes', 'concurrency', '--strict']) == 0
+  write('soonish')     # malformed date: refuse like a bare rationale
+  with pytest.raises(BaselineError, match='malformed expires'):
+    Baseline.load(str(base))
+  assert cli.main(['--root', root, '--baseline', str(base),
+                   '--passes', 'concurrency']) == 2
 
 
 # --------------------------------------------------------------------------

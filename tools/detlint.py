@@ -3,14 +3,17 @@
 
 One AST parse, four passes (registry-schema, concurrency, traced-purity,
 doc-drift), findings with stable ids, a waiver baseline with mandatory
-rationale.  CI semantics mirror ``tools/trace_report.py``:
+rationale (shared with graphlint, the IR tier — design §18).  Exit
+codes are the tools/ contract (``tools/_cli.py``):
 
   exit 0  clean (every finding waived with rationale)
   exit 1  unwaived verifiable findings
   exit 2  malformed baseline (unparseable, or a waiver without
           rationale) or an unparseable source tree
   exit 3  --strict only: unverifiable findings (derived names the
-          resolver cannot check) or stale waivers
+          resolver cannot check), stale waivers, or expired waivers
+          (past their ``expires = "YYYY-MM-DD"`` date, rationale
+          echoed)
 
     python tools/detlint.py                 # report
     python tools/detlint.py --strict        # the tier-1 / CI gate
@@ -20,8 +23,6 @@ rationale.  CI semantics mirror ``tools/trace_report.py``:
 
 from __future__ import annotations
 
-import argparse
-import json
 import os
 import sys
 
@@ -29,16 +30,22 @@ from typing import List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import _cli  # noqa: E402
 
 from distributed_embeddings_tpu.analysis import core as lint_core  # noqa: E402
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-  ap = argparse.ArgumentParser(
+  ap = _cli.make_parser(
+      'detlint',
       description='AST static-analysis gate: registry-schema, '
       'concurrency (lock-order), traced-purity and doc-drift passes '
       'with stable finding ids and a rationale-bearing waiver '
-      'baseline; nonzero exit on violations (pipeline-gate friendly).')
+      'baseline; nonzero exit on violations (pipeline-gate friendly).',
+      strict_help='also fail (exit 3) on unverifiable findings, stale '
+      'waivers and expired waivers')
   ap.add_argument('--root', default=None,
                   help='repo root (default: this checkout)')
   ap.add_argument('--baseline', default=None,
@@ -48,11 +55,6 @@ def main(argv: Optional[List[str]] = None) -> int:
   ap.add_argument('--passes', default=None,
                   help='comma-separated pass subset (default: all of '
                   f'{",".join(lint_core.list_passes())})')
-  ap.add_argument('--json', action='store_true',
-                  help='emit the result as JSON instead of text')
-  ap.add_argument('--strict', action='store_true',
-                  help='also fail (exit 3) on unverifiable findings '
-                  'and stale waivers')
   args = ap.parse_args(argv)
   root = os.path.abspath(args.root or lint_core.default_root())
   baseline_path = args.baseline or lint_core.default_baseline_path(root)
@@ -62,43 +64,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     baseline = lint_core.Baseline.load(baseline_path)
     res = lint_core.run_passes(root, passes=passes, baseline=baseline)
   except (lint_core.BaselineError, RuntimeError, ValueError) as e:
-    print(f'detlint: MALFORMED: {e}', file=sys.stderr)
-    return 2
+    return _cli.fail('detlint', 'MALFORMED', e)
 
-  if args.json:
-    print(json.dumps({
-        'root': root,
-        'counts': res.counts,
-        'findings': [vars(f) | {'id': f.id} for f in res.findings],
-        'unverifiable': [vars(f) | {'id': f.id}
-                         for f in res.unverifiable],
-        'waived': [f.id for f in res.waived],
-        'stale_waivers': res.stale_waivers,
-        'meta': res.meta,
-    }, indent=2, default=str))
-  else:
-    for f in res.findings:
-      print(f.brief())
-    for f in res.unverifiable:
-      print(f.brief())
+  def text() -> str:
+    lines = [f.brief() for f in res.findings + res.unverifiable]
     c = res.counts
-    print(f"detlint: {c['findings']} finding(s), "
-          f"{c['unverifiable']} unverifiable, {c['waived']} waived, "
-          f"{c['stale_waivers']} stale waiver(s) "
-          f"[{res.meta.get('registry_sites')}, "
-          f"lock_graph={res.meta.get('lock_graph')}, "
-          f"purity={res.meta.get('purity')}]")
+    lines.append(
+        f"detlint: {c['findings']} finding(s), "
+        f"{c['unverifiable']} unverifiable, {c['waived']} waived, "
+        f"{c['stale_waivers']} stale, {c['expired_waivers']} expired "
+        f"waiver(s) [{res.meta.get('registry_sites')}, "
+        f"lock_graph={res.meta.get('lock_graph')}, "
+        f"purity={res.meta.get('purity')}]")
+    return '\n'.join(lines)
 
-  if res.findings:
-    print(f'detlint: {len(res.findings)} unwaived finding(s)',
-          file=sys.stderr)
-    return 1
-  if args.strict and (res.unverifiable or res.stale_waivers):
-    print(f'detlint: STRICT: {len(res.unverifiable)} unverifiable '
-          f'finding(s), {len(res.stale_waivers)} stale waiver(s) '
-          f'{res.stale_waivers}', file=sys.stderr)
-    return 3
-  return 0
+  _cli.emit(_cli.lint_payload(res, root=root, meta=res.meta),
+            args.json, text)
+  return _cli.finish_lint('detlint', res, args.strict)
 
 
 if __name__ == '__main__':

@@ -25,7 +25,6 @@ Usage::
 
 from __future__ import annotations
 
-import argparse
 import os
 import sys
 
@@ -34,6 +33,9 @@ import sys
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
   sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import _cli  # noqa: E402
 
 
 def _parse_tables(spec):
@@ -49,9 +51,7 @@ def _parse_tables(spec):
 
 
 def main(argv=None) -> int:
-  parser = argparse.ArgumentParser(
-      description=__doc__,
-      formatter_class=argparse.RawDescriptionHelpFormatter)
+  parser = _cli.make_parser('export_serving', description=__doc__)
   parser.add_argument('checkpoint',
                       help='a save_train_npz file, or a checkpoint '
                       'directory (newest valid file wins)')
@@ -82,17 +82,21 @@ def main(argv=None) -> int:
                                             table_configs=configs,
                                             combiner=comb)
   except (ValueError, FileNotFoundError) as e:
-    print(f'export failed: {e}', file=sys.stderr)
-    return 1
-  qn = ','.join(summary['quantized']) or 'f32'
-  step = summary['step'] if summary['step'] is not None else '?'
+    return _cli.fail('export_serving', 'FINDINGS',
+                     f'export failed: {e}')
   size = os.path.getsize(args.out)
-  print(f"exported {summary['tables']} table(s) from "
-        f"{os.path.basename(summary['source'])} (step {step}) -> "
-        f"{args.out} [{qn}; {size} bytes; "
-        f"{summary['stripped_state_leaves']} optimizer slot(s) "
-        'stripped]')
-  return 0
+
+  def text() -> str:
+    qn = ','.join(summary['quantized']) or 'f32'
+    step = summary['step'] if summary['step'] is not None else '?'
+    return (f"exported {summary['tables']} table(s) from "
+            f"{os.path.basename(summary['source'])} (step {step}) -> "
+            f"{args.out} [{qn}; {size} bytes; "
+            f"{summary['stripped_state_leaves']} optimizer slot(s) "
+            'stripped]')
+
+  _cli.emit(dict(summary, out=args.out, bytes=size), args.json, text)
+  return _cli.EXIT_OK
 
 
 if __name__ == '__main__':

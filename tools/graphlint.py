@@ -1,0 +1,151 @@
+#!/usr/bin/env python3
+"""graphlint CLI: the IR-level program-analysis gate (docs/design.md §18).
+
+Traces the repo's real programs (lookup dispatch paths, chunked +
+monolithic sparse train step, serving ladder rungs, cold-tier fetch)
+on a forced-CPU virtual mesh and runs the graph passes — collective
+schedule, donation/aliasing, retrace ledger, host-sync, HBM accounting
+— over their jaxprs and compiled executables.  Shares detlint's waiver
+baseline (``tools/detlint_baseline.toml``) and the tools/ exit-code
+contract (``tools/_cli.py``):
+
+  exit 0  clean (every finding waived with rationale)
+  exit 1  unwaived verifiable findings
+  exit 2  malformed baseline, or a program that no longer traces
+  exit 3  --strict only: unverifiable findings, stale or expired
+          waivers
+
+    python tools/graphlint.py                 # report (flagship set)
+    python tools/graphlint.py --strict        # the CI gate
+    python tools/graphlint.py --tier full     # every dispatch path
+    python tools/graphlint.py --json          # machine-readable
+    python tools/graphlint.py --passes schedule,donation
+    python tools/graphlint.py --write-ledger  # refresh the checked-in
+                                              # collective-schedule ledger
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from typing import List, Optional
+
+# The catalog traces shard_map programs over an N-device mesh; the
+# device-count XLA flag only applies before the first backend
+# initialisation, so it is pinned here, before jax is ever imported
+# (the same forced-CPU recipe as dryrun_multichip's child process).
+# The thread-pinning flags are guarded INDEPENDENTLY, exactly like
+# tests/conftest.py: an environment that already exports a device
+# count must still get one schedulable thread per faked device, or
+# the XLA-CPU collective rendezvous can deadlock on small hosts.
+_N_DEVICES = int(os.environ.get('DET_GRAPHLINT_DEVICES', '8'))
+_flags = os.environ.get('XLA_FLAGS', '')
+if 'xla_force_host_platform_device_count' not in _flags:
+  _flags += f' --xla_force_host_platform_device_count={_N_DEVICES}'
+if 'intra_op_parallelism_threads' not in _flags:
+  _flags += (' --xla_cpu_multi_thread_eigen=false'
+             ' intra_op_parallelism_threads=1')
+os.environ['XLA_FLAGS'] = _flags
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import _cli  # noqa: E402
+
+from distributed_embeddings_tpu.analysis import core as lint_core  # noqa: E402
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+  ap = _cli.make_parser(
+      'graphlint',
+      description='IR-level program-analysis gate: collective-schedule, '
+      'donation/aliasing, retrace-ledger, host-sync and HBM passes over '
+      "the repo's real traced programs, with stable finding ids and the "
+      'shared rationale-bearing waiver baseline; nonzero exit on '
+      'violations (pipeline-gate friendly).',
+      strict_help='also fail (exit 3) on unverifiable findings, stale '
+      'waivers and expired waivers')
+  ap.add_argument('--root', default=None,
+                  help='root for the BASELINE and ledger paths only '
+                  '(default: this checkout) — unlike detlint, the '
+                  'traced programs always come from the installed '
+                  'checkout this CLI imports')
+  ap.add_argument('--baseline', default=None,
+                  help='waiver file (default: the shared tools/'
+                  'detlint_baseline.toml under the root)')
+  ap.add_argument('--tier', default='flagship',
+                  choices=['flagship', 'full'],
+                  help='program catalog: flagship (the tier-1/CI set) '
+                  'or full (adds the sparsecore + pallas dispatch '
+                  'paths)')
+  ap.add_argument('--passes', default=None,
+                  help='comma-separated pass subset (default: all of '
+                  'schedule,donation,retrace,hostsync,hbm)')
+  ap.add_argument('--write-ledger', action='store_true',
+                  help='also refresh the collective-schedule ledger '
+                  'the conftest deadlock watchdog dumps; the '
+                  'checked-in default path requires --tier full (a '
+                  'flagship write would silently drop the '
+                  'sparsecore/pallas rows)')
+  ap.add_argument('--ledger-out', default=None,
+                  help='ledger path (default: tools/graphlint_ledger'
+                  '.json under the root)')
+  args = ap.parse_args(argv)
+  root = os.path.abspath(args.root or lint_core.default_root())
+  baseline_path = args.baseline or lint_core.default_baseline_path(root)
+  passes = ([p for p in args.passes.split(',') if p]
+            if args.passes else None)
+  # baseline malformedness fails FAST (exit 2) — before any tracing
+  try:
+    baseline = lint_core.Baseline.load(baseline_path)
+  except lint_core.BaselineError as e:
+    return _cli.fail('graphlint', 'MALFORMED', e)
+  if args.write_ledger and args.ledger_out is None \
+      and args.tier != 'full':
+    # also a fast-fail: the checked-in ledger is the full-tier
+    # superset the freshness test pins — a flagship write would
+    # silently truncate it
+    return _cli.fail(
+        'graphlint', 'MALFORMED',
+        '--write-ledger to the checked-in path requires --tier full '
+        '(pass --ledger-out for a partial ledger elsewhere)')
+
+  from distributed_embeddings_tpu.analysis import graphlint
+  try:
+    programs = graphlint.build_programs(tier=args.tier)
+    res = graphlint.run_programs(programs, passes=passes,
+                                 baseline=baseline)
+  except (lint_core.BaselineError, RuntimeError, ValueError) as e:
+    return _cli.fail('graphlint', 'MALFORMED', e)
+
+  if args.write_ledger:
+    path = graphlint.write_ledger(
+        programs, args.ledger_out
+        or graphlint.default_ledger_path(root))
+    print(f'graphlint: ledger -> {path}', file=sys.stderr)
+
+  def text() -> str:
+    lines = [f.brief() for f in res.findings + res.unverifiable]
+    c = res.counts
+    hbm = res.meta.get('graphlint_hbm', {})
+    peak = max((v['peak'] for v in hbm.values()), default=0)
+    lines.append(
+        f"graphlint: {c['findings']} finding(s), "
+        f"{c['unverifiable']} unverifiable, {c['waived']} waived, "
+        f"{c['stale_waivers']} stale, {c['expired_waivers']} expired "
+        f"waiver(s) over "
+        f"{len(res.meta.get('graphlint_programs', []))} program(s) "
+        f'[peak {peak} B/device]')
+    return '\n'.join(lines)
+
+  _cli.emit(_cli.lint_payload(res, root=root, tier=args.tier,
+                              meta=res.meta),
+            args.json, text)
+  return _cli.finish_lint('graphlint', res, args.strict)
+
+
+if __name__ == '__main__':
+  sys.exit(main())

@@ -28,7 +28,6 @@ trusting it.
 
 from __future__ import annotations
 
-import argparse
 import json
 import os
 import sys
@@ -37,6 +36,9 @@ from typing import Any, Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import _cli  # noqa: E402
 
 from distributed_embeddings_tpu.obs.trace import (  # noqa: E402
     REGISTERED_SPANS, span_category)
@@ -259,16 +261,14 @@ def format_report(rep: Dict[str, Any]) -> str:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-  ap = argparse.ArgumentParser(
+  ap = _cli.make_parser(
+      'trace_report',
       description='Per-step phase breakdown + stall attribution over an '
       'obs Chrome-trace file; nonzero exit on a malformed trace '
-      '(pipeline-gate friendly).')
+      '(pipeline-gate friendly).',
+      strict_help='exit 3 when any span name is not in '
+      'obs.REGISTERED_SPANS')
   ap.add_argument('trace', help='trace JSON written by obs.trace.save()')
-  ap.add_argument('--json', action='store_true',
-                  help='emit the report dict as JSON instead of text')
-  ap.add_argument('--strict', action='store_true',
-                  help='exit 3 when any span name is not in '
-                  'obs.REGISTERED_SPANS')
   ap.add_argument('--require', default=None,
                   help='comma-separated span names that must appear; '
                   'exit 4 otherwise')
@@ -276,22 +276,19 @@ def main(argv: Optional[List[str]] = None) -> int:
   try:
     events = load_trace(args.trace)
   except TraceFormatError as e:
-    print(f'trace_report: MALFORMED: {e}', file=sys.stderr)
-    return 2
+    return _cli.fail('trace_report', 'MALFORMED', e)
   rep = report(events)
-  print(json.dumps(rep, indent=2) if args.json else format_report(rep))
+  _cli.emit(rep, args.json, lambda: format_report(rep))
   if args.strict and rep['unregistered']:
-    print(f"trace_report: STRICT: unregistered span name(s) "
-          f"{rep['unregistered']}", file=sys.stderr)
-    return 3
+    return _cli.fail('trace_report', 'STRICT',
+                     f"unregistered span name(s) {rep['unregistered']}")
   if args.require:
     missing = [n for n in args.require.split(',')
                if n and n not in rep['phases']]
     if missing:
-      print(f'trace_report: REQUIRE: missing span(s) {missing}',
-            file=sys.stderr)
-      return 4
-  return 0
+      return _cli.fail('trace_report', 'REQUIRE',
+                       f'missing span(s) {missing}')
+  return _cli.EXIT_OK
 
 
 if __name__ == '__main__':

@@ -29,7 +29,6 @@ Usage::
 
 from __future__ import annotations
 
-import argparse
 import glob as glob_lib
 import os
 import sys
@@ -39,7 +38,9 @@ import sys
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
   sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+import _cli  # noqa: E402
 import numpy as np
 
 
@@ -122,8 +123,7 @@ def collect(paths, pattern):
 
 
 def main(argv=None) -> int:
-  parser = argparse.ArgumentParser(
-      description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+  parser = _cli.make_parser('verify_checkpoint', description=__doc__)
   parser.add_argument('paths', nargs='+',
                       help='checkpoint directories and/or .npz files')
   parser.add_argument('--pattern', default='*.npz',
@@ -133,21 +133,34 @@ def main(argv=None) -> int:
   args = parser.parse_args(argv)
   files = collect(args.paths, args.pattern)
   if not files:
-    print(f'no checkpoint files matched {args.pattern!r} under '
-          f'{args.paths}', file=sys.stderr)
-    return 2
-  width = max(len(os.path.basename(f)) for f in files)
-  failures = 0
-  for f in files:
-    verdict, detail = verify_one(f)
-    if verdict == 'FAIL':
-      failures += 1
-    if args.quiet and verdict != 'FAIL':
-      continue
-    print(f'{os.path.basename(f):<{width}}  {verdict:<11}  {detail}')
-  total = len(files)
-  print(f'-- {total} file(s): {total - failures} ok, {failures} failing')
-  return 1 if failures else 0
+    return _cli.fail(
+        'verify_checkpoint', 'MALFORMED',
+        f'no checkpoint files matched {args.pattern!r} under '
+        f'{args.paths}')
+  rows = [(f, *verify_one(f)) for f in files]
+  failures = sum(1 for _, verdict, _ in rows if verdict == 'FAIL')
+
+  def text() -> str:
+    width = max(len(os.path.basename(f)) for f in files)
+    lines = [
+        f'{os.path.basename(f):<{width}}  {verdict:<11}  {detail}'
+        for f, verdict, detail in rows
+        if not (args.quiet and verdict != 'FAIL')
+    ]
+    lines.append(f'-- {len(files)} file(s): {len(files) - failures} '
+                 f'ok, {failures} failing')
+    return '\n'.join(lines)
+
+  _cli.emit({
+      'files': [{'path': f, 'verdict': verdict, 'detail': detail}
+                for f, verdict, detail in rows],
+      'total': len(files),
+      'failures': failures,
+  }, args.json, text)
+  if failures:
+    return _cli.fail('verify_checkpoint', 'FINDINGS',
+                     f'{failures} failing file(s)')
+  return _cli.EXIT_OK
 
 
 if __name__ == '__main__':
