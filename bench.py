@@ -21,6 +21,13 @@ import sys
 import time
 import traceback
 
+# Artifact schema version (design §19): bumped whenever the artifact's
+# key set or semantics change, so tools/perf_sentinel.py and any other
+# longitudinal consumer can tell an old-schema line from a missing key.
+# v2 adds schema_version itself, available_mem_mb, the per-device
+# imbalance counters and the devprof block.
+SCHEMA_VERSION = 2
+
 # Published step times, ms, by model -> device count
 # (synthetic_models/README.md:69-75).
 BASELINES_MS = {
@@ -204,6 +211,22 @@ def host_load():
     return [round(x, 2) for x in os.getloadavg()]
   except (AttributeError, OSError):
     return None
+
+
+def host_mem():
+  """Available host memory in MiB (``MemAvailable`` from
+  /proc/meminfo), the second host-pressure gauge next to loadavg
+  (design §19): a bench line measured while the host was swapping
+  carries its own evidence, and the perf sentinel's reader can discount
+  it.  None where /proc/meminfo is absent (non-Linux)."""
+  try:
+    with open('/proc/meminfo', 'r', encoding='ascii') as f:
+      for line in f:
+        if line.startswith('MemAvailable:'):
+          return round(int(line.split()[1]) / 1024.0, 1)
+  except (OSError, ValueError, IndexError):
+    pass
+  return None
 
 
 def chip_evidence(max_age_h: float = 14.0):
@@ -433,6 +456,18 @@ def main():
                       'amortized against the headline step, which '
                       'stays program-identical to the obs-off build.  '
                       'Default: on for the sparse trainer')
+  parser.add_argument('--devprof', action=argparse.BooleanOptionalAction,
+                      default=None,
+                      help='device-time attribution (obs/devprof.py, '
+                      'design §19): after the measured windows, run the '
+                      "step's phases (exchange, lookup/combine, "
+                      'backward exchange, apply) as individually '
+                      'synced sub-programs and journal per-phase '
+                      'device ms + the cost-model cross-check; with '
+                      'the obs arm traced, the phases land on the '
+                      "trace's device lane.  NEVER runs inside a "
+                      'measured headline window.  Default: rides the '
+                      'obs arm for the sparse trainer')
   parser.add_argument('--trace_path', default=None,
                       help='write the obs phase trace (Chrome-trace '
                       'JSON; open in Perfetto or feed '
@@ -1309,11 +1344,38 @@ def main():
         sync_loss(loss, f'obs-arm window sync at step {oi}')
         obs_window_ms.append((time.perf_counter() - t0) / wsteps * 1000)
       obs_on_ms = min(obs_window_ms)
+      # device-time attribution (obs/devprof.py, design §19): AFTER
+      # every measured window (devprof is opt-in and never touches a
+      # headline loop), with the tracer still armed so the per-phase
+      # events land on this trace's device lane.  Never fatal to the
+      # obs block.
+      use_devprof = args.devprof
+      if use_devprof is None:
+        use_devprof = args.trainer == 'sparse'
+      devprof_stats = None
+      # an explicit --devprof on an unsupported combination must reach
+      # devprof's own refusal (journaled as devprof_error with the
+      # actionable message), never be dropped silently
+      if use_devprof:
+        try:
+          from distributed_embeddings_tpu.obs import devprof as devprof_lib
+          # profile with the HEADLINE emb optimizer (calibrated
+          # capacities): the attributed apply phase is the real step's
+          # apply, not a default-capacity stand-in
+          prof = devprof_lib.profile_step(
+              model.dist_embedding, [jnp.asarray(c) for c in cats0],
+              params=state.params['embedding'], emb_optimizer=emb_opt,
+              reps=3)
+          devprof_stats = devprof_lib.artifact_block(prof)
+        except Exception as e:
+          devprof_stats = {'devprof_error': f'{type(e).__name__}: {e}'}
       # one periodic registry snapshot through the resilience sink —
       # the journaled proof the metrics path is wired end to end
       obs_metrics.journal_snapshot(step=oi, source='bench')
       obs_stats = obs_block(step_ms, obs_on_ms,
                             trace_path=args.trace_path)
+      if devprof_stats:
+        obs_stats.update(devprof_stats)
       obs_lib.reset()
     except Exception as e:
       obs_stats = {'obs_error': f'{type(e).__name__}: {e}'}
@@ -1399,6 +1461,8 @@ def main():
       # number carries its own noise evidence
       'window_ms': [round(w, 3) for w in window_ms],
       'loadavg': host_load(),
+      'available_mem_mb': host_mem(),
+      'schema_version': SCHEMA_VERSION,
       'packed_storage': args.packed_storage,
       'fast_compile': args.fast_compile,
       'lookup_impl': args.lookup_impl,

@@ -298,6 +298,29 @@ def aliased_param_indices(compiled) -> Set[int]:
   return {int(g.group(1)) for g in _ALIAS_ENTRY_RE.finditer(block)}
 
 
+def cost_estimate(compiled) -> Optional[Dict[str, float]]:
+  """XLA cost-model totals from the compiled executable's
+  ``cost_analysis()``: ``flops`` and ``bytes`` (bytes accessed).  The
+  harvest the devprof device lane (design §19) cross-checks its
+  measured per-phase walls against — held HERE next to
+  ``memory_estimate`` so the two analysis consumers (graphlint's HBM
+  ledger, devprof's cost contract) read the backend surface one way.
+  None when the backend exposes no analysis."""
+  try:
+    ca = compiled.cost_analysis()
+  except Exception:  # backend-dependent surface; absence is not a finding
+    return None
+  if isinstance(ca, (list, tuple)):  # older jax: one dict per device
+    ca = ca[0] if ca else None
+  if not ca:
+    return None
+  try:
+    return {'flops': float(ca.get('flops', 0.0)),
+            'bytes': float(ca.get('bytes accessed', 0.0))}
+  except (AttributeError, TypeError, ValueError):
+    return None
+
+
 def memory_estimate(compiled) -> Optional[Dict[str, int]]:
   """Per-device byte estimate from the executable's memory analysis:
   ``resident`` (argument bytes — what the fits ladder budgets) and

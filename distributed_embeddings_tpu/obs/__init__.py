@@ -30,7 +30,7 @@ name from ``REGISTERED_METRICS`` — pinned by the source-scan tests in
 instead of silently vanishing from every report.
 """
 
-from distributed_embeddings_tpu.obs import metrics, trace
+from distributed_embeddings_tpu.obs import devprof, metrics, trace
 from distributed_embeddings_tpu.obs.metrics import REGISTERED_METRICS
 from distributed_embeddings_tpu.obs.trace import REGISTERED_SPANS
 
@@ -50,8 +50,10 @@ def disable():
 
 
 def reset():
-  """Disarm AND drop all buffered events/instrument state."""
-  trace.disable()
+  """Disarm AND drop all buffered events/instrument state (clears any
+  ``trace.enable(pin=True)`` re-entrancy pins — reset is the hard
+  teardown; plain ``disable()`` respects pins)."""
+  trace.disable(force=True)
   trace.clear()
   metrics.disable()
   metrics.reset()
@@ -93,5 +95,5 @@ def measure_overhead(step_ms: float, reps: int = 2000) -> dict:
   }
 
 
-__all__ = ['trace', 'metrics', 'REGISTERED_SPANS', 'REGISTERED_METRICS',
-           'enable', 'disable', 'reset']
+__all__ = ['trace', 'metrics', 'devprof', 'REGISTERED_SPANS',
+           'REGISTERED_METRICS', 'enable', 'disable', 'reset']

@@ -89,6 +89,13 @@ METRIC_TYPES: Dict[str, str] = {
     'engine.rows_launched': 'counter',
     'engine.pad_rows': 'counter',
     'engine.lookup_ms': 'histogram',
+    # device-time attribution (obs/devprof.py, design §19)
+    'devprof.runs': 'counter',
+    'devprof.phase_ms': 'histogram',
+    # per-device exchange imbalance (parallel/hotcache.py, design §19):
+    # skew gauges over the per-source-device exchanged-row counters
+    'exchange.rows_max': 'gauge',
+    'exchange.rows_mean': 'gauge',
 }
 
 REGISTERED_METRICS = frozenset(METRIC_TYPES)
@@ -159,6 +166,17 @@ REGISTERED_ARTIFACT_KEYS = frozenset({
     # IR-analysis gate counts (bench.graphlint_block; design §18)
     'graphlint_findings', 'graphlint_donation_ok',
     'graphlint_retraces', 'graphlint_peak_hbm_bytes',
+    # artifact schema + host-pressure gauges (bench.py; design §19 —
+    # the perf sentinel's comparability/noise inputs)
+    'schema_version', 'available_mem_mb',
+    # per-device imbalance accounting (parallel/hotcache.py, design §19)
+    'alltoall_rows_sent_per_device', 'alltoall_rows_sent_off_per_device',
+    'hot_hit_rate_per_device', 'total_id_occurrences_per_device',
+    'scatter_rows_per_device', 'exchange_rows_max', 'exchange_rows_mean',
+    'hottest_shard',
+    # device-time attribution block (obs/devprof.py, design §19)
+    'devprof_phase_ms', 'devprof_step_ms', 'devprof_coverage_pct',
+    'devprof_cost', 'devprof_cost_ok', 'devprof_serve_rung_ms',
 })
 
 # ~x2-2.5 geometric ladder, 10 us .. 60 s: percentile estimates from

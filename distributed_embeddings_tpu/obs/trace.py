@@ -80,16 +80,26 @@ REGISTERED_SPANS = frozenset({
     # the pipeline is on, nested under serve/dispatch when serial
     'serve/submit', 'serve/enqueue', 'serve/dispatch', 'serve/merge',
     'serve/lookup', 'serve/execute', 'serve/demux',
+    # device-time attribution lane (obs/devprof.py, design §19): each
+    # phase of the step measured as an individually synced sub-program
+    # and emitted as an X event on the dedicated 'device' track
+    # (``device_tid``) — never from inside a measured headline window
+    'dev/fwd/exchange', 'dev/fwd/lookup_combine', 'dev/bwd/exchange',
+    'dev/bwd/grad', 'dev/apply/update', 'dev/serve/execute',
 })
 
 # Report classification (tools/trace_report.py): 'wait' spans are
 # blocked time (the stall-attribution numerator), 'trace' spans are
-# trace-time program phases, everything else is measured host work.
+# trace-time program phases, 'device' spans are measured device time on
+# the devprof lane (design §19), everything else is measured host work.
 SPAN_CATEGORIES: Dict[str, str] = {
     'feed/wait': 'wait', 'coldtier/wait': 'wait', 'train/sync': 'wait',
     'serve/enqueue': 'wait',
     'fwd/exchange': 'trace', 'fwd/lookup_combine': 'trace',
     'bwd/exchange': 'trace', 'apply/update': 'trace',
+    'dev/fwd/exchange': 'device', 'dev/fwd/lookup_combine': 'device',
+    'dev/bwd/exchange': 'device', 'dev/bwd/grad': 'device',
+    'dev/apply/update': 'device', 'dev/serve/execute': 'device',
 }
 
 
@@ -121,6 +131,15 @@ _path: Optional[str] = None
 _max_events = _DEFAULT_MAX_EVENTS
 _tids: Dict[Any, int] = {}
 _pid = os.getpid()
+_pins = 0
+_segments = 0
+_rotated_dropped = 0  # dropped-counter value at the last rotation
+
+# Reserved track key for the device-time lane (obs/devprof.py): device
+# phases are measured offline, not on any live thread, so they render
+# on one dedicated labelled track instead of whichever thread ran the
+# profiler.
+_DEVICE_TRACK_KEY = ('device', 'device')
 
 
 def enabled() -> bool:
@@ -133,15 +152,22 @@ def now() -> float:
   return time.perf_counter()
 
 
-def enable(path: Optional[str] = None, max_events: Optional[int] = None):
+def enable(path: Optional[str] = None, max_events: Optional[int] = None,
+           pin: bool = False):
   """Arm the tracer (idempotent; re-arming keeps buffered events).
   ``path`` is remembered as the default ``save()`` target;
   ``max_events`` bounds the buffer — past it events are counted as
   dropped instead of growing host memory without bound.  Both are
   sticky: a re-arm without them (another component calling
   ``enable()``) keeps the previously configured values instead of
-  silently lifting a user-set memory bound."""
-  global _enabled, _t0, _path, _max_events, _pid
+  silently lifting a user-set memory bound.
+
+  ``pin=True`` takes a re-entrancy pin: while any pin is held,
+  ``disable()`` is a no-op (a long-running owner — the streaming/online
+  training loop — stays traced across nested components whose teardown
+  calls ``disable()``; release with ``unpin()`` or force with
+  ``disable(force=True)``)."""
+  global _enabled, _t0, _path, _max_events, _pid, _pins
   with _lock:
     if not _enabled and not _events:
       _t0 = time.perf_counter()
@@ -150,12 +176,32 @@ def enable(path: Optional[str] = None, max_events: Optional[int] = None):
       _path = path
     if max_events is not None:
       _max_events = int(max_events)
+    if pin:
+      _pins += 1
     _enabled = True
 
 
-def disable():
-  global _enabled
-  _enabled = False
+def disable(force: bool = False) -> bool:
+  """Disarm the tracer.  While an ``enable(pin=True)`` pin is held this
+  is a no-op returning False (the owner's capture survives a nested
+  component's teardown); ``force=True`` clears every pin and disarms
+  unconditionally.  Returns whether the tracer is now disarmed."""
+  global _enabled, _pins
+  with _lock:
+    if force:
+      _pins = 0
+    if _pins > 0:
+      return False
+    _enabled = False
+    return True
+
+
+def unpin():
+  """Release one ``enable(pin=True)`` re-entrancy pin (floored at 0);
+  the tracer stays armed until a subsequent ``disable()``."""
+  global _pins
+  with _lock:
+    _pins = max(0, _pins - 1)
 
 
 def clear():
@@ -163,13 +209,15 @@ def clear():
   (keeps the enabled flag untouched) — a fresh capture starts from the
   defaults, while a mid-capture ``enable()`` re-arm keeps whatever the
   user configured (see ``enable``)."""
-  global _dropped, _t0, _max_events, _path
+  global _dropped, _t0, _max_events, _path, _segments, _rotated_dropped
   with _lock:
     _events.clear()
     _tids.clear()
     _dropped = 0
     _max_events = _DEFAULT_MAX_EVENTS
     _path = None
+    _segments = 0
+    _rotated_dropped = 0
     _t0 = time.perf_counter()
 
 
@@ -191,6 +239,26 @@ def _tid() -> int:
         'args': {'name': name},
     })
   return tid
+
+
+def device_tid() -> int:
+  """Track id of the dedicated 'device' lane (obs/devprof.py emits its
+  per-phase X events here via ``complete(..., tid=device_tid())``).
+  Allocates the track + its ``thread_name`` label on first use; returns
+  0 without allocating when tracing is disabled (the emit that would
+  use it is a no-op anyway)."""
+  if not _enabled:
+    return 0
+  with _lock:
+    tid = _tids.get(_DEVICE_TRACK_KEY)
+    if tid is None:
+      tid = len(_tids) + 1
+      _tids[_DEVICE_TRACK_KEY] = tid
+      _events.append({
+          'name': 'thread_name', 'ph': 'M', 'pid': _pid, 'tid': tid,
+          'args': {'name': 'device'},
+      })
+    return tid
 
 
 def _emit(event: Dict[str, Any]):
@@ -337,6 +405,29 @@ def truncate(count: int, dropped_to: Optional[int] = None):
       _dropped = int(dropped_to)
 
 
+def _payload(events: List[Dict[str, Any]], dropped_count: int,
+             **other) -> Dict[str, Any]:
+  """The one Perfetto-loadable wrapper shape shared by ``save`` and
+  ``save_rotating`` (a schema change must hit both paths at once)."""
+  return {
+      'traceEvents': events,
+      'displayTimeUnit': 'ms',
+      'otherData': {
+          'producer': 'distributed_embeddings_tpu.obs.trace',
+          'dropped_events': dropped_count,
+          **other,
+      },
+  }
+
+
+def _atomic_write(path: str, payload: Dict[str, Any]) -> str:
+  tmp = f'{path}.tmp.{os.getpid()}'
+  with open(tmp, 'w', encoding='utf-8') as f:
+    json.dump(payload, f)
+  os.replace(tmp, path)
+  return path
+
+
 def save(path: Optional[str] = None) -> str:
   """Write the buffered trace as one Perfetto-loadable JSON object;
   returns the path written.  Raises ``ValueError`` without a path (no
@@ -345,16 +436,54 @@ def save(path: Optional[str] = None) -> str:
   if not path:
     raise ValueError('trace.save() needs a path (or enable(path=...))')
   with _lock:
-    payload = {
-        'traceEvents': list(_events),
-        'displayTimeUnit': 'ms',
-        'otherData': {
-            'producer': 'distributed_embeddings_tpu.obs.trace',
-            'dropped_events': _dropped,
-        },
-    }
-  tmp = f'{path}.tmp.{os.getpid()}'
-  with open(tmp, 'w', encoding='utf-8') as f:
-    json.dump(payload, f)
-  os.replace(tmp, path)
-  return path
+    payload = _payload(list(_events), _dropped)
+  return _atomic_write(path, payload)
+
+
+def segment_count() -> int:
+  """Segments written by ``save_rotating`` since the last ``clear``."""
+  with _lock:
+    return _segments
+
+
+def save_rotating(path: Optional[str] = None,
+                  max_events: int = 100_000) -> Optional[str]:
+  """Rotate the buffer into a numbered segment file once it holds
+  ``max_events`` events; the long-run twin of ``save``.
+
+  The bounded buffer drops-with-count past its limit — correct for a
+  bench window, but a multi-hour streaming/online-training run would
+  lose the HEAD of the trace (the interesting warmup/compile phases)
+  or grow host memory without bound.  Call this periodically (each log
+  point): below the threshold it is a no-op returning None; at or past
+  it, the buffered events flush to ``<path minus .json>.segNNNN.json``
+  (atomic tmp+replace, same payload shape as ``save``) and the buffer
+  empties — keeping the ``thread_name`` track labels and the clock
+  base, so segments share one timeline and concatenating their
+  ``traceEvents`` reconstructs the full run.  Returns the segment path
+  written."""
+  global _segments, _rotated_dropped
+  path = path or _path
+  if not path:
+    raise ValueError(
+        'trace.save_rotating() needs a path (or enable(path=...))')
+  with _lock:
+    real = [e for e in _events if e.get('ph') != 'M']
+    # a buffer whose own bound (enable(max_events=...)) sits at or
+    # below the rotation threshold stops growing before the threshold
+    # is ever reached — if NEW drops happened since the last rotation,
+    # the buffer is full and waiting loses events: flush now
+    hit_bound = _dropped > _rotated_dropped and bool(real)
+    if len(real) < max(1, int(max_events)) and not hit_bound:
+      return None
+    _rotated_dropped = _dropped
+    seg = _segments
+    _segments += 1
+    meta = [e for e in _events if e.get('ph') == 'M']
+    payload = _payload(list(_events), _dropped, segment=seg)
+    # the thread registry still maps live threads to these tids: keep
+    # the labels so the next segment's spans land on named tracks
+    _events.clear()
+    _events.extend(meta)
+  base = path[:-5] if path.endswith('.json') else path
+  return _atomic_write(f'{base}.seg{seg:04d}.json', payload)

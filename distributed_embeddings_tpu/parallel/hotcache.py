@@ -34,6 +34,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from distributed_embeddings_tpu.obs import metrics as obs_metrics
+
 
 @dataclasses.dataclass(frozen=True)
 class HotSet:
@@ -316,6 +318,21 @@ def measure_exchange_counters(dist, cats,
     cache on, hot rows leave the scatter entirely (they apply as one
     dense add on the replicated buffer).
 
+  Per-device imbalance accounting (design §19): alongside each global
+  counter the per-source-device breakdown is reported —
+  ``alltoall_rows_sent_per_device`` / ``_off_per_device`` (rows each
+  source block ships), ``hot_hit_rate_per_device`` +
+  ``total_id_occurrences_per_device`` (per-block hit rates with their
+  weights), ``scatter_rows_per_device`` (unique update rows each OWNER
+  device scatters, summed over groups) — plus the skew gauges
+  ``exchange_rows_max`` / ``exchange_rows_mean`` (also set on the
+  registered ``exchange.rows_max`` / ``exchange.rows_mean`` metrics
+  when the registry is armed) and ``hottest_shard``
+  (``'g{group}@dev{device}'`` of the busiest scatter shard).  The
+  per-device lists are computed INDEPENDENTLY of the global scalars
+  and reconciled before returning — a sum mismatch raises instead of
+  journaling a silently inconsistent artifact.
+
   ``hot_sets`` defaults to the plan's own
   (``dist.plan.hot_sets``); pass ``{}`` to compute the off-path
   counters for a cache-less layer.
@@ -347,6 +364,32 @@ def measure_exchange_counters(dist, cats,
       total_cold += int((~m).sum())
     else:
       total_cold += v.size
+
+  # per-SOURCE-device occurrence accounting (design §19), computed
+  # independently of the scalars above (its own block slicing, isin and
+  # unique calls) so the reconciliation below cross-checks the
+  # error-prone dedup/routing arithmetic instead of replaying it
+  S = D * dist.num_slices
+  valid_per_src = np.zeros((S,), np.int64)
+  hot_per_src = np.zeros((S,), np.int64)
+  blk_valid: Dict[tuple, int] = {}      # (input, src) -> valid ids
+  blk_uniq_cold: Dict[tuple, int] = {}  # (input, src) -> unique cold
+  for inp, ids in enumerate(cats):
+    tid = plan.input_table_map[inp]
+    vocab = plan.table_configs[tid].input_dim
+    x2 = np.asarray(ids).reshape(batch, -1)
+    for src in range(S):
+      blk = x2[src * local_batch:(src + 1) * local_batch].reshape(-1)
+      v = _clip_valid(blk, vocab)
+      valid_per_src[src] += v.size
+      if tid in hot_ids:
+        m = np.isin(v, hot_ids[tid])
+        hot_per_src[src] += int(m.sum())
+        cold_blk = v[~m]
+      else:
+        cold_blk = v
+      blk_valid[(inp, src)] = int(v.size)
+      blk_uniq_cold[(inp, src)] = int(np.unique(cold_blk).size)
 
   sent_off = 0
   sent_on = 0
@@ -405,12 +448,67 @@ def measure_exchange_counters(dist, cats,
         else:
           routed_on.setdefault((dev, sub.gi), []).append(rows)
 
-  def scatter_rows(routed: Dict[tuple, List[np.ndarray]]) -> int:
+  def scatter_stats(routed: Dict[tuple, List[np.ndarray]]):
+    """(global, per-owner-device list, hottest (gi, dev, rows)): the
+    global count stays the §10 quantity — per-group max over devices,
+    summed over groups (the static row count a calibrated capacity
+    pays); the per-device list and the named hottest shard are the §19
+    imbalance view over the same uniques."""
     per_group: Dict[int, int] = {}
-    for (dev, gi), streams in routed.items():
+    per_dev = np.zeros((D,), np.int64)
+    hottest = (None, -1)
+    for (dev, gi), streams in sorted(routed.items()):
       u = np.unique(np.concatenate(streams)).size if streams else 0
       per_group[gi] = max(per_group.get(gi, 0), u)
-    return int(sum(per_group.values()))
+      per_dev[dev] += u
+      if u > hottest[1]:
+        hottest = ((gi, dev), u)
+    return int(sum(per_group.values())), per_dev, hottest
+
+  scatter_off, _, _ = scatter_stats(routed_off)
+  scatter_on, scatter_per_dev, hottest = scatter_stats(routed_on)
+
+  # per-source-device WIRE counters, rebuilt from the independently
+  # computed per-block dedup counts: each input's block count ships
+  # once per (device, slot) request referencing it — the request
+  # multiplicity is re-derived here from the plan, so only the (shared,
+  # declarative) routing table is common with the global path; the
+  # dedup/clip arithmetic behind both views ran twice
+  req_mult: Dict[int, int] = {}
+  for sub in subs:
+    for dev in range(D):
+      for r in sub.requests[dev]:
+        req_mult[r.input_id] = req_mult.get(r.input_id, 0) + 1
+  sent_off_per_src = np.zeros((S,), np.int64)
+  sent_on_per_src = np.zeros((S,), np.int64)
+  for (inp, src), n_valid in blk_valid.items():
+    m = req_mult.get(inp, 0)
+    sent_off_per_src[src] += m * n_valid
+    sent_on_per_src[src] += m * blk_uniq_cold[(inp, src)]
+
+  # reconciliation invariant (design §19): the per-device breakdowns
+  # were accumulated on an independent path from the global scalars —
+  # they MUST sum back to them, or the artifact would journal two
+  # disagreeing views of the same exchange
+  recon = (
+      ('alltoall_rows_sent', int(sent_on_per_src.sum()), int(sent_on)),
+      ('alltoall_rows_sent_off', int(sent_off_per_src.sum()),
+       int(sent_off)),
+      ('total_id_occurrences', int(valid_per_src.sum()),
+       int(total_valid)),
+      ('hot_occurrences', int(hot_per_src.sum()), int(total_hot)),
+  )
+  bad = [(k, s, g) for k, s, g in recon if s != g]
+  if bad:
+    raise ValueError(
+        'per-device counter reconciliation failed (design §19): '
+        + '; '.join(f'{k}: sum(per-device)={s} != global={g}'
+                    for k, s, g in bad))
+
+  obs_metrics.set_gauge('exchange.rows_max',
+                        float(sent_on_per_src.max()) if S else 0.0)
+  obs_metrics.set_gauge('exchange.rows_mean',
+                        float(sent_on_per_src.mean()) if S else 0.0)
 
   return {
       'alltoall_rows_sent_off': int(sent_off),
@@ -421,8 +519,22 @@ def measure_exchange_counters(dist, cats,
       'cold_occurrence_fraction': round(total_cold / total_valid, 4)
                                   if total_valid else 0.0,
       'total_id_occurrences': int(total_valid),
-      'scatter_rows_per_step_off': scatter_rows(routed_off),
-      'scatter_rows_per_step': scatter_rows(routed_on),
+      'scatter_rows_per_step_off': scatter_off,
+      'scatter_rows_per_step': scatter_on,
+      # per-device imbalance accounting + skew gauges (design §19)
+      'alltoall_rows_sent_per_device': [int(x) for x in sent_on_per_src],
+      'alltoall_rows_sent_off_per_device': [int(x)
+                                            for x in sent_off_per_src],
+      'hot_hit_rate_per_device': [
+          round(float(h) / float(v), 4) if v else 0.0
+          for h, v in zip(hot_per_src, valid_per_src)],
+      'total_id_occurrences_per_device': [int(x) for x in valid_per_src],
+      'scatter_rows_per_device': [int(x) for x in scatter_per_dev],
+      'exchange_rows_max': int(sent_on_per_src.max()) if S else 0,
+      'exchange_rows_mean': round(float(sent_on_per_src.mean()), 2)
+                            if S else 0.0,
+      'hottest_shard': (f'g{hottest[0][0]}@dev{hottest[0][1]}'
+                        if hottest[0] is not None else None),
   }
 
 

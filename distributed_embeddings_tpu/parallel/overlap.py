@@ -99,27 +99,25 @@ def a2a_overlap_stats(off_ms: float, on_ms: float, exchange_ms: float,
   return out
 
 
-def measure_exchange_ms(dist, cats, chunks: Optional[int] = None,
-                        repeats: int = 5) -> float:
-  """Per-step wall time of the dp<->mp exchanges ALONE.
+def build_exchange_program(dist, cats, chunks: Optional[int] = None,
+                           rows_only: bool = False):
+  """The jitted exchange-only program: ``(fn, inputs)``.
 
-  Builds (and times) a jitted program that runs exactly the chunked id
-  exchange and the row-return exchange of every subgroup — the send
-  buffers are assembled from the real inputs, each chunk's dp->mp
-  ``all_to_all`` ships the real ids, and the return leg ships a
-  width-``w`` broadcast of the received ids (real bytes that cannot
-  constant-fold away) — with no lookup/combine in between.  This is the
-  denominator of ``overlap_pct``: the exchange wall the pipeline tries
-  to hide.  Min over ``repeats`` timed calls after one warmup.
+  ``fn(*inputs)`` runs exactly the chunked id exchange and the
+  row-return exchange of every subgroup — the send buffers are
+  assembled from the real inputs, each chunk's dp->mp ``all_to_all``
+  ships the real ids, and the return leg ships a width-``w`` broadcast
+  of the received ids (real bytes that cannot constant-fold away) —
+  with no lookup/combine in between.  ``measure_exchange_ms`` times it
+  for the §11 overlap denominator; the devprof device lane (design
+  §19) AOT-compiles it for the ``dev/fwd/exchange`` phase and its cost
+  harvest.
 
-  On a single-device mesh the collectives vanish (``D == 1`` skips
-  them, exactly like the runtime) and the returned time is only the
-  buffer plumbing — ``overlap_pct`` then reports against that
-  near-zero wall, which is the honest statement that there was no
-  exchange to hide.
+  ``rows_only=True`` builds the BACKWARD-exchange twin: only the
+  width-``w`` f32 row leg ships (one ``all_to_all`` per chunk per
+  subgroup, the shape of the cotangent exchange in ``_build_backward``)
+  with no id leg — the ``dev/bwd/exchange`` phase.
   """
-  import time
-
   import jax
   import jax.numpy as jnp
   from jax.sharding import PartitionSpec as P
@@ -129,8 +127,8 @@ def measure_exchange_ms(dist, cats, chunks: Optional[int] = None,
   cats = [jnp.asarray(c) for c in cats]
   inputs, global_batch, hotness = dist._prepare_inputs(cats)
   if not dist.dp_input:
-    raise ValueError('measure_exchange_ms needs a dp_input layer (the '
-                     'measured exchange is the dp<->mp pair)')
+    raise ValueError('build_exchange_program needs a dp_input layer '
+                     '(the measured exchange is the dp<->mp pair)')
   D = dist.world_size
   slice_batch = global_batch // dist.num_slices
   local_batch = slice_batch // D
@@ -157,6 +155,16 @@ def measure_exchange_ms(dist, cats, chunks: Optional[int] = None,
           _ids)
       for lo, hi in chunk_bounds(sub.n_cap, req):
         part = send[:, lo:hi]
+        if rows_only:
+          # cotangent-shaped leg alone: width-w f32 rows through ONE
+          # a2a per chunk (the _build_backward exchange shape)
+          rows = jnp.broadcast_to(
+              part[:, :, :, 0, None].astype(jnp.float32),
+              (D, hi - lo, local_batch, w))
+          if D > 1:
+            rows = jax.lax.all_to_all(rows, dist.axis_name, 0, 0)
+          total = total + jnp.sum(rows)
+          continue
         recv = (jax.lax.all_to_all(part, dist.axis_name, 0, 0)
                 if D > 1 else part)
         ids = recv.transpose(1, 0, 2, 3).reshape(hi - lo, slice_batch, h)
@@ -180,6 +188,25 @@ def measure_exchange_ms(dist, cats, chunks: Optional[int] = None,
                         for h in hotness),
                     out_specs=P(),
                     check_vma=False))
+  return fn, inputs
+
+
+def measure_exchange_ms(dist, cats, chunks: Optional[int] = None,
+                        repeats: int = 5) -> float:
+  """Per-step wall time of the dp<->mp exchanges ALONE
+  (``build_exchange_program`` timed).  This is the denominator of
+  ``overlap_pct``: the exchange wall the pipeline tries to hide.  Min
+  over ``repeats`` timed calls after one warmup.
+
+  On a single-device mesh the collectives vanish (``D == 1`` skips
+  them, exactly like the runtime) and the returned time is only the
+  buffer plumbing — ``overlap_pct`` then reports against that
+  near-zero wall, which is the honest statement that there was no
+  exchange to hide.
+  """
+  import time
+
+  fn, inputs = build_exchange_program(dist, cats, chunks=chunks)
   fn(*inputs).block_until_ready()  # compile + warmup
   best = float('inf')
   for _ in range(max(1, int(repeats))):

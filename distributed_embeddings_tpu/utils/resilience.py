@@ -68,6 +68,12 @@ REGISTERED_EVENTS = frozenset({
     'audit_failure', 'tier_integrity_failure',
     # observability layer (obs/metrics.py periodic registry snapshots)
     'metrics_snapshot',
+    # device-time attribution (obs/devprof.py, design §19): one event
+    # per profile run with the per-phase device ms + cost cross-check
+    'devprof_profile',
+    # longitudinal perf sentinel (tools/perf_sentinel.py, design §19):
+    # one event per flagged regression with key/delta/baseline sha
+    'perf_regression',
 })
 
 _lock = threading.Lock()

@@ -44,6 +44,15 @@ python tools/detlint.py --strict
 # class of regression a chip window must not burn time discovering
 python tools/graphlint.py --strict
 
+# perf sentinel (design §19): before burning a chip window, gate on the
+# longitudinal record — the newest journaled bench artifact must sit
+# inside the noise-aware band of the prior rounds' baselines (fail
+# fast under set -eu; a first run with no comparable history passes)
+LATEST_BENCH=$(ls -1 BENCH_r*.json 2>/dev/null | sort | tail -1 || true)
+if [ -n "$LATEST_BENCH" ]; then
+  python tools/perf_sentinel.py "$LATEST_BENCH" --history . --threshold 15
+fi
+
 if [ ! -f "$DATA/model_size.json" ]; then
   python examples/dlrm/gen_data.py --data_path "$DATA" \
     --train_rows "$ROWS" --eval_rows 524288 --preset onechip

@@ -164,6 +164,119 @@ def test_hot_cache_counters_present_and_consistent():
   assert c['hot_hit_rate'] > 0.3, c
 
 
+def test_schema_version_and_host_pressure_gauges(bench):
+  """The ISSUE-15 artifact-schema satellite: the artifact carries a
+  schema_version (so tools/perf_sentinel.py can tell an old line from
+  a missing key) and BOTH host-pressure gauges — loadavg (since PR 1)
+  plus available memory — each registered in the artifact-key
+  schema."""
+  assert isinstance(bench.SCHEMA_VERSION, int)
+  assert bench.SCHEMA_VERSION >= 2
+  mem = bench.host_mem()
+  assert mem is None or mem > 0
+  from distributed_embeddings_tpu.obs import metrics as obs_metrics
+  for key in ('schema_version', 'available_mem_mb'):
+    assert key in obs_metrics.REGISTERED_ARTIFACT_KEYS, key
+
+
+@pytest.mark.parametrize('seed', [0, 1, 2])
+def test_per_device_counters_reconcile_fuzzed(seed):
+  """The ISSUE-15 reconciliation pin, fuzzed over plan/batch/hot-set
+  draws on the faked 8-device mesh: the per-device imbalance lists are
+  computed on an independent path from the global scalars and must sum
+  back to them exactly; the skew gauges derive from the same lists;
+  the hottest shard is a real named (group, device) cell."""
+  import re
+  import jax
+  import numpy as np
+  from distributed_embeddings_tpu.parallel import (DistributedEmbedding,
+                                                   TableConfig,
+                                                   create_mesh, hotcache)
+
+  rng = np.random.default_rng(seed)
+  n_tables = int(rng.integers(2, 5))
+  cfgs = [TableConfig(int(rng.integers(32, 257)),
+                      int(rng.choice([8, 16])), 'sum')
+          for _ in range(n_tables)]
+  mesh = create_mesh(jax.devices()[:8])
+  dist = DistributedEmbedding(cfgs, mesh=mesh, dp_input=True)
+  batch = 8 * int(rng.integers(4, 17))
+  cats = [np.minimum(rng.zipf(1.3, size=(batch,)) - 1,
+                     c.input_dim - 1).astype(np.int32) for c in cfgs]
+  hot = {}
+  for t, c in enumerate(cfgs):
+    if rng.random() < 0.7:
+      k = int(rng.integers(1, max(2, c.input_dim // 8)))
+      hot[t] = hotcache.HotSet(
+          t, np.sort(rng.choice(c.input_dim, size=k,
+                                replace=False)).astype(np.int64))
+  c = hotcache.measure_exchange_counters(dist, cats, hot_sets=hot)
+  for key in ('alltoall_rows_sent_per_device',
+              'alltoall_rows_sent_off_per_device',
+              'hot_hit_rate_per_device',
+              'total_id_occurrences_per_device',
+              'scatter_rows_per_device', 'exchange_rows_max',
+              'exchange_rows_mean', 'hottest_shard'):
+    assert key in c, key
+  S = 8
+  assert len(c['alltoall_rows_sent_per_device']) == S
+  # the reconciliation invariant: per-device sums == the global keys
+  assert sum(c['alltoall_rows_sent_per_device']) \
+      == c['alltoall_rows_sent']
+  assert sum(c['alltoall_rows_sent_off_per_device']) \
+      == c['alltoall_rows_sent_off']
+  assert sum(c['total_id_occurrences_per_device']) \
+      == c['total_id_occurrences']
+  # occurrence-weighted per-device hit rates reconstruct the global
+  weighted = sum(r * n for r, n in
+                 zip(c['hot_hit_rate_per_device'],
+                     c['total_id_occurrences_per_device']))
+  assert abs(weighted / max(1, c['total_id_occurrences'])
+             - c['hot_hit_rate']) < 1e-3
+  # skew gauges derive from the same per-device list
+  assert c['exchange_rows_max'] == max(c['alltoall_rows_sent_per_device'])
+  assert c['exchange_rows_mean'] == pytest.approx(
+      np.mean(c['alltoall_rows_sent_per_device']), abs=0.01)
+  # global scatter = per-group max over devices, summed: it bounds any
+  # single device's group-summed scatter from above
+  assert c['scatter_rows_per_step'] >= max(c['scatter_rows_per_device'])
+  if c['hottest_shard'] is not None:
+    assert re.fullmatch(r'g\d+@dev\d+', c['hottest_shard'])
+
+
+def test_devprof_artifact_keys():
+  """The ISSUE-15 device-lane journaled proof, block-level: the
+  devprof block bench folds into the artifact carries the pinned keys
+  (each registered — test_artifact_keys_registered scans this loop)."""
+  from distributed_embeddings_tpu.obs import devprof
+  prof = devprof.StepProfile(
+      phases={n: 1.0 for n in devprof.STEP_PHASES},
+      direct={n: True for n in devprof.STEP_PHASES},
+      step_ms=5.0, coverage_pct=100.0,
+      cost={'fwd': {'flops': 1.0, 'bytes': 2.0}}, cost_ok=True)
+  block = devprof.artifact_block(prof, serve_rung_ms={8: 0.25})
+  for key in ('devprof_phase_ms', 'devprof_step_ms',
+              'devprof_coverage_pct', 'devprof_cost',
+              'devprof_cost_ok', 'devprof_serve_rung_ms'):
+    assert key in block, key
+  import json
+  json.dumps(block)
+
+
+def test_per_device_artifact_keys_registered():
+  """Every per-device imbalance key measure_exchange_counters emits is
+  in REGISTERED_ARTIFACT_KEYS (the same scan-pin discipline as the
+  scalar counters)."""
+  from distributed_embeddings_tpu.obs import metrics as obs_metrics
+  for key in ('alltoall_rows_sent_per_device',
+              'alltoall_rows_sent_off_per_device',
+              'hot_hit_rate_per_device',
+              'total_id_occurrences_per_device',
+              'scatter_rows_per_device', 'exchange_rows_max',
+              'exchange_rows_mean', 'hottest_shard'):
+    assert key in obs_metrics.REGISTERED_ARTIFACT_KEYS, key
+
+
 def test_a2a_overlap_stats_math():
   """The journaled exchange-overlap block (design §11): the derived
   a2a_overlap_pct is (off - on) / exchange clamped to [0, 1], a

@@ -433,6 +433,128 @@ def test_trace_report_rejects_malformed_truncated_and_unregistered(
 
 
 # --------------------------------------------------------------------------
+# device lane (design §19): round trip + critical-path split
+# --------------------------------------------------------------------------
+
+
+def test_device_lane_round_trip_and_report_split(tmp_path):
+  """Device-lane X events (the obs.devprof emission shape) land on ONE
+  dedicated track labelled 'device', validate under ``trace_report
+  --strict``, and split the critical path's unattributed remainder
+  into device-attributed vs residue."""
+  obs.enable()
+  tid = obs_trace.device_tid()
+  assert tid > 0
+  base = obs_trace.now() - 0.020
+  obs_trace.complete('dev/fwd/exchange', base, 0.004, tid=tid,
+                     direct=True)
+  obs_trace.complete('dev/fwd/lookup_combine', base + 0.004, 0.006,
+                     tid=tid, direct=False)
+  obs_trace.complete('dev/apply/update', base + 0.010, 0.002, tid=tid,
+                     direct=True)
+  with obs_trace.span('train/step', step=1):
+    pass
+  path = str(tmp_path / 'dev.json')
+  obs_trace.save(path)
+  tr = _load_trace_report()
+  events = tr.load_trace(path)
+  dev = [e for e in events
+         if e.get('ph') == 'X' and e.get('cat') == 'device']
+  assert len(dev) == 3
+  assert len({e['tid'] for e in dev}) == 1, 'one device track'
+  meta = [e for e in events if e.get('ph') == 'M']
+  assert any(e['args']['name'] == 'device' and e['tid'] == dev[0]['tid']
+             for e in meta), 'device track must be labelled'
+  rep = tr.report(events)
+  cp = rep['critical_path']
+  assert cp['device_ms'] == pytest.approx(12.0, abs=0.5)
+  assert 'residue_ms' in cp
+  assert cp['residue_ms'] <= cp['unattributed_ms'] + 1e-6
+  assert rep['phases']['dev/fwd/exchange']['cat'] == 'device'
+  assert rep['unregistered'] == []
+  assert tr.main([path, '--strict', '--require',
+                  'dev/fwd/exchange,dev/apply/update']) == 0
+
+
+def test_device_tid_disabled_allocates_nothing():
+  assert obs_trace.device_tid() == 0
+  assert obs_trace.event_count() == 0
+
+
+# --------------------------------------------------------------------------
+# long-run capture: enable() re-entrancy pin + rotating segments (§19)
+# --------------------------------------------------------------------------
+
+
+def test_enable_pin_survives_nested_disable():
+  """A long-run owner pins the tracer; a nested component's teardown
+  ``disable()`` must not disarm it (re-entrancy pin contract)."""
+  obs_trace.enable(pin=True)
+  assert obs_trace.enabled()
+  assert obs_trace.disable() is False   # nested teardown: no-op
+  assert obs_trace.enabled()
+  obs_trace.unpin()
+  assert obs_trace.disable() is True
+  assert not obs_trace.enabled()
+  obs_trace.enable(pin=True)
+  assert obs_trace.disable(force=True) is True  # the hard teardown
+  assert not obs_trace.enabled()
+
+
+def test_save_rotating_segments_keep_head_and_labels(tmp_path):
+  """save_rotating flushes numbered segment files instead of dropping:
+  the HEAD of a long run survives in segment 0, the buffer empties
+  (keeping thread labels so later spans stay on named tracks), and
+  each segment is independently report-loadable."""
+  obs_trace.enable()
+  path = str(tmp_path / 'rot.json')
+  assert obs_trace.save_rotating(path, max_events=5) is None  # below
+  for k in range(5):
+    with obs_trace.span('train/step', step=k):
+      pass
+  seg0 = obs_trace.save_rotating(path, max_events=5)
+  assert seg0 is not None and seg0.endswith('.seg0000.json')
+  tr = _load_trace_report()
+  ev0 = tr.load_trace(seg0)
+  assert [e['args']['step'] for e in ev0 if e.get('ph') == 'X'] \
+      == [0, 1, 2, 3, 4], 'the head of the run must survive rotation'
+  assert obs_trace.dropped() == 0
+  assert all(e['ph'] == 'M' for e in obs_trace.events())
+  for k in range(5, 10):
+    with obs_trace.span('train/step', step=k):
+      pass
+  seg1 = obs_trace.save_rotating(path, max_events=5)
+  assert seg1 is not None and seg1.endswith('.seg0001.json')
+  ev1 = tr.load_trace(seg1)
+  assert [e['args']['step'] for e in ev1 if e.get('ph') == 'X'] \
+      == [5, 6, 7, 8, 9]
+  x_tids = {e['tid'] for e in ev1 if e['ph'] == 'X'}
+  m_tids = {e['tid'] for e in ev1 if e['ph'] == 'M'}
+  assert x_tids <= m_tids, 'rotated segments must keep track labels'
+  assert obs_trace.segment_count() == 2
+  with open(seg1, encoding='utf-8') as f:
+    assert json.load(f)['otherData']['segment'] == 1
+
+
+def test_save_rotating_flushes_a_bound_limited_buffer(tmp_path):
+  """A buffer whose own enable(max_events=) bound sits below the
+  rotation threshold stops growing before the threshold is reached —
+  once NEW drops happen, save_rotating must flush instead of waiting
+  forever (the data loss it exists to prevent), and must not re-flush
+  on every later call without new drops."""
+  obs_trace.enable(max_events=6)
+  path = str(tmp_path / 'bound.json')
+  for k in range(10):           # > bound: drops accrue
+    with obs_trace.span('train/step', step=k):
+      pass
+  assert obs_trace.dropped() > 0
+  seg0 = obs_trace.save_rotating(path, max_events=100)  # threshold unmet
+  assert seg0 is not None, 'full buffer with drops must flush'
+  # buffer emptied, no new drops: the next call is a no-op again
+  assert obs_trace.save_rotating(path, max_events=100) is None
+
+
+# --------------------------------------------------------------------------
 # registry discipline (§15), enforced by the detlint registry-schema
 # pass (docs/design.md §17) — the AST-resolving successor of the regex
 # source scans that used to live here

@@ -1,0 +1,220 @@
+"""Longitudinal perf sentinel (tools/perf_sentinel.py, design §19):
+seeded regressions flagged nonzero with a journaled perf_regression,
+within-band wiggles pass, noise bands widen with the artifact's own
+window spread and double under load, malformed/failed artifacts exit 2,
+and the driver-wrapper / jsonl artifact shapes load."""
+
+import importlib.util
+import json
+import os
+import pathlib
+
+import pytest
+
+from distributed_embeddings_tpu.utils import resilience
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _load_sentinel():
+  spec = importlib.util.spec_from_file_location(
+      'perf_sentinel_for_test', ROOT / 'tools' / 'perf_sentinel.py')
+  mod = importlib.util.module_from_spec(spec)
+  spec.loader.exec_module(mod)
+  return mod
+
+
+BASE = {
+    'metric': 'synthetic-tiny train step time, global batch 4096, '
+              'Adagrad, 1 cpu chip(s)',
+    'value': 100.0,
+    'unit': 'ms/step',
+    'window_ms': [100.0, 101.0, 102.0],
+    'loadavg': [0.2, 0.2, 0.2],
+    'sha': 'basesha',
+    'schema_version': 2,
+}
+
+
+def _write(path, obj):
+  with open(path, 'w', encoding='utf-8') as f:
+    f.write(json.dumps(obj))
+  return str(path)
+
+
+@pytest.fixture()
+def hist(tmp_path):
+  d = tmp_path / 'hist'
+  d.mkdir()
+  _write(d / 'BENCH_r01.json', BASE)
+  return str(d)
+
+
+def test_seeded_regression_flagged_and_journaled(tmp_path, hist,
+                                                 monkeypatch):
+  """The acceptance pin: a >= 10% step-time regression exits nonzero
+  and journals perf_regression with the offending key, delta and
+  baseline sha."""
+  monkeypatch.setenv('DET_FT_JOURNAL', str(tmp_path / 'journal.jsonl'))
+  ps = _load_sentinel()
+  cur = _write(tmp_path / 'cur.json',
+               dict(BASE, value=115.0, sha='cursha'))
+  resilience.clear_recent()
+  rc = ps.main([cur, '--history', hist])
+  assert rc == 1
+  evs = resilience.recent('perf_regression')
+  assert evs, 'a flagged regression must journal'
+  ev = evs[-1]
+  assert ev['key'] == 'value'
+  assert ev['baseline_sha'] == 'basesha'
+  assert ev['current_sha'] == 'cursha'
+  assert ev['delta_pct'] == pytest.approx(15.0)
+  with open(tmp_path / 'journal.jsonl', encoding='utf-8') as f:
+    assert any(json.loads(l)['kind'] == 'perf_regression' for l in f)
+
+
+def test_within_band_and_window_noise_widens(tmp_path, hist):
+  """A wiggle inside threshold+noise passes; a baseline whose own
+  windows spread 30% absorbs a 15% delta (min-of-k discipline across
+  rounds, noise evidence from within the run)."""
+  ps = _load_sentinel()
+  ok = _write(tmp_path / 'ok.json', dict(BASE, value=105.0, sha='c'))
+  assert ps.main([ok, '--history', hist, '--no-journal']) == 0
+  noisy_hist = tmp_path / 'noisy'
+  noisy_hist.mkdir()
+  _write(noisy_hist / 'b.json',
+         dict(BASE, window_ms=[100.0, 130.0, 101.0]))
+  wiggle = _write(tmp_path / 'wiggle.json',
+                  dict(BASE, value=115.0, sha='c'))
+  assert ps.main([wiggle, '--history', str(noisy_hist),
+                  '--no-journal']) == 0
+
+
+def test_loadavg_gate_doubles_noise_band(tmp_path):
+  """A loaded host (1-min loadavg past the cap) doubles the noise term
+  — scheduler weather must not trip CI — and the check says so."""
+  ps = _load_sentinel()
+  hist_d = tmp_path / 'h'
+  hist_d.mkdir()
+  _write(hist_d / 'b.json', dict(BASE, window_ms=[100.0, 110.0, 101.0]))
+  cur = dict(BASE, value=116.0, sha='c', loadavg=[999.0, 1.0, 1.0])
+  p = _write(tmp_path / 'cur.json', cur)
+  # unloaded twin: threshold 5 + noise 10 = band 15 < delta 16 -> trips
+  p_cold = _write(tmp_path / 'cold.json', dict(cur, loadavg=[0.1, 0, 0]))
+  assert ps.main([p_cold, '--history', str(hist_d), '--threshold', '5',
+                  '--no-journal']) == 1
+  # loaded: noise doubles to 20, band 25 -> passes, labelled
+  v = ps.compare(cur, [json.load(open(hist_d / 'b.json'))],
+                 threshold_pct=5.0)
+  assert not v['regressions']
+  assert v['checks'][0]['loadavg_gated'] is True
+  assert ps.main([p, '--history', str(hist_d), '--threshold', '5',
+                  '--no-journal']) == 0
+
+
+def test_malformed_and_failed_artifacts_exit_2(tmp_path, hist):
+  ps = _load_sentinel()
+  garbage = tmp_path / 'garbage.json'
+  garbage.write_text('not json at all')
+  assert ps.main([str(garbage), '--history', hist]) == 2
+  failed = _write(tmp_path / 'failed.json',
+                  {'metric': 'benchmark failed', 'value': None,
+                   'unit': 'ms/step'})
+  assert ps.main([failed, '--history', hist]) == 2
+  missing = tmp_path / 'missing.json'
+  assert ps.main([str(missing), '--history', hist]) == 2
+
+
+def test_wrapper_and_jsonl_shapes_load(tmp_path):
+  """The driver's BENCH_r*.json wrapper ({'parsed': {...}}) and a
+  jsonl whose last line is the artifact both load; history files that
+  fail to parse are skipped, not fatal."""
+  ps = _load_sentinel()
+  wrapped = _write(tmp_path / 'wrapped.json',
+                   {'n': 5, 'rc': 0, 'parsed': dict(BASE, value=99.0)})
+  art = ps.load_artifact(wrapped)
+  assert art['value'] == 99.0
+  jsonl = tmp_path / 'lines.jsonl'
+  with open(jsonl, 'w', encoding='utf-8') as f:
+    f.write('warmup noise line\n')
+    f.write(json.dumps(dict(BASE, value=98.0)) + '\n')
+  assert ps.load_artifact(str(jsonl))['value'] == 98.0
+  hist_d = tmp_path / 'h'
+  hist_d.mkdir()
+  (hist_d / 'broken.json').write_text('{truncated')
+  _write(hist_d / 'good.json', BASE)
+  arts = ps.history_artifacts(str(hist_d))
+  assert len(arts) == 1 and arts[0]['sha'] == 'basesha'
+
+
+def test_incomparable_history_passes_with_note(tmp_path):
+  """A metric-line change (different model/batch/devices) is a new
+  workload, not a regression — rc 0 with the note; bracketed backend
+  labels do NOT break comparability."""
+  ps = _load_sentinel()
+  hist_d = tmp_path / 'h'
+  hist_d.mkdir()
+  _write(hist_d / 'other.json',
+         dict(BASE, metric='synthetic-jumbo something else'))
+  cur = _write(tmp_path / 'cur.json', dict(BASE, value=500.0))
+  assert ps.main([cur, '--history', str(hist_d), '--no-journal']) == 0
+  # bracketed notes stripped: a fallback label is the same workload
+  labelled = dict(BASE, metric=BASE['metric'] + ' [backend unavailable,'
+                  ' fell back to CPU: probe hung]')
+  v = ps.compare(dict(BASE, value=150.0), [labelled], threshold_pct=10)
+  assert v['comparable_artifacts'] == 1
+  assert v['regressions'], 'same workload under a label must compare'
+
+
+def test_serving_keys_compared_when_present(tmp_path):
+  ps = _load_sentinel()
+  base = dict(BASE, serve_p50_ms=2.0, serve_p99_ms=5.0)
+  cur = dict(BASE, value=100.0, serve_p50_ms=4.0, serve_p99_ms=5.1)
+  v = ps.compare(cur, [base], threshold_pct=10)
+  by_key = {c['key']: c for c in v['checks']}
+  assert set(by_key) == {'value', 'serve_p50_ms', 'serve_p99_ms'}
+  assert [r['key'] for r in v['regressions']] == ['serve_p50_ms']
+
+
+def test_non_numeric_window_entries_never_crash(tmp_path):
+  """History is best-effort evidence: a hand-edited artifact with
+  string window_ms entries must degrade to a zero noise band, not kill
+  the tool with an exit status chip_run.sh would read as a
+  regression."""
+  ps = _load_sentinel()
+  hist_d = tmp_path / 'h'
+  hist_d.mkdir()
+  _write(hist_d / 'b.json', dict(BASE, window_ms=['100.0', '130.0']))
+  cur = _write(tmp_path / 'cur.json', dict(BASE, value=105.0))
+  assert ps.main([cur, '--history', str(hist_d), '--no-journal']) == 0
+  assert ps.window_noise_pct({'window_ms': ['100.0', '130.0']}) == 0.0
+  assert ps.window_noise_pct({'window_ms': [100.0, 'x', 130.0]}) \
+      == pytest.approx(30.0)
+
+
+def test_old_schema_baselines_skipped(tmp_path):
+  """Pre-v2 artifacts (no window_ms/loadavg noise evidence — the early
+  CPU-fallback rounds whose walls swing far past any threshold) are
+  not baselines: skipped, counted, and alone they gate nothing."""
+  ps = _load_sentinel()
+  hist_d = tmp_path / 'h'
+  hist_d.mkdir()
+  old = {k: v for k, v in BASE.items()
+         if k not in ('schema_version', 'window_ms', 'loadavg')}
+  _write(hist_d / 'BENCH_r01.json', dict(old, value=50.0))
+  cur = _write(tmp_path / 'cur.json', dict(BASE, value=100.0))
+  assert ps.main([cur, '--history', str(hist_d), '--no-journal']) == 0
+  v = ps.compare(json.loads(open(cur).read()),
+                 ps.history_artifacts(str(hist_d)))
+  assert v['comparable_artifacts'] == 0
+  assert v['old_schema_skipped'] == 1
+  # explicit opt-in still compares the old line
+  assert ps.main([cur, '--history', str(hist_d), '--min-schema', '0',
+                  '--no-journal']) == 1
+
+
+def test_sentinel_events_registered():
+  """The §19 journal names ride the REGISTERED_EVENTS schema like every
+  other degraded-mode event (detlint registry discipline)."""
+  assert 'perf_regression' in resilience.REGISTERED_EVENTS
+  assert 'devprof_profile' in resilience.REGISTERED_EVENTS

@@ -1,0 +1,319 @@
+#!/usr/bin/env python3
+"""Longitudinal perf-regression sentinel over bench artifacts
+(docs/design.md §19).
+
+``bench.py`` journals one JSON artifact per round (``BENCH_r*.json``),
+but nothing compared them across runs — a step-time regression only got
+caught when a human reread perf_notes.  This tool closes the loop: it
+compares the CURRENT artifact against a HISTORY directory of prior
+artifacts with noise-aware bands and exits nonzero past threshold, so
+``chip_run.sh`` / ``dryrun_multichip`` can gate on it.
+
+Band policy (design §19): for each compared key (headline ``value`` by
+default, plus serving percentiles when both sides carry them; all
+lower-is-better milliseconds) the baseline is the MIN over comparable
+history artifacts — the same min-of-k discipline bench applies within a
+run, applied across rounds.  The allowed band is ``--threshold`` plus a
+NOISE term: the worst within-run window spread
+(``(max - min) / min`` over ``window_ms``) of either side — a run whose
+own windows wobbled 20% cannot cry regression at 12% — and when either
+side's 1-minute loadavg exceeds ``--loadavg-cap`` (default: the host's
+CPU count) the noise term doubles and the line is labelled, because a
+loaded driver host inflates walls in bursts (the round-5 phantom
+regression).  Comparability is gated on the artifact's normalized
+``metric`` line (model/batch/device-count, bracketed notes stripped)
+and ``unit``; a failed artifact (``value`` null) is malformed input,
+not a clean pass.
+
+Every flagged regression journals a ``perf_regression`` event
+(key/delta/band/baseline sha) through the resilience journal, so an
+unattended CI trip leaves evidence.
+
+Exit codes (tools/_cli.py): 0 clean (including: no comparable
+history), 1 regression(s), 2 malformed current artifact.
+
+    python tools/perf_sentinel.py /tmp/bench_line.json --history .
+    python tools/perf_sentinel.py BENCH_r05.json --history . \
+        --threshold 10 --json
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import _cli  # noqa: E402
+
+from distributed_embeddings_tpu.utils import resilience  # noqa: E402
+
+# lower-is-better millisecond keys compared when BOTH sides carry them;
+# 'value' (the headline ms/step) is always compared
+DEFAULT_KEYS = ('value', 'serve_p50_ms', 'serve_p99_ms')
+
+
+class ArtifactError(ValueError):
+  """The file is not a usable bench artifact (unreadable, not JSON, or
+  a failed run with no measurement)."""
+
+
+def load_artifact(path: str) -> Dict[str, Any]:
+  """One bench artifact from ``path``: a raw bench JSON line, a driver
+  wrapper (``{'parsed': {...}}`` — the ``BENCH_r*.json`` shape), or a
+  jsonl whose LAST parseable object wins.  Raises ``ArtifactError`` on
+  anything else."""
+  try:
+    with open(path, 'r', encoding='utf-8') as f:
+      text = f.read()
+  except OSError as e:
+    raise ArtifactError(f'{path}: unreadable: {e}') from e
+  objs: List[Dict[str, Any]] = []
+  try:
+    obj = json.loads(text)
+    objs = [obj] if isinstance(obj, dict) else []
+  except json.JSONDecodeError:
+    for line in text.splitlines():
+      line = line.strip()
+      if not line:
+        continue
+      try:
+        o = json.loads(line)
+      except json.JSONDecodeError:
+        continue
+      if isinstance(o, dict):
+        objs.append(o)
+  if not objs:
+    raise ArtifactError(f'{path}: no JSON artifact object found')
+  art = objs[-1]
+  if isinstance(art.get('parsed'), dict):  # driver wrapper shape
+    art = art['parsed']
+  if 'metric' not in art or 'value' not in art:
+    raise ArtifactError(
+        f'{path}: not a bench artifact (no metric/value keys)')
+  return art
+
+
+def normalized_metric(art: Dict[str, Any]) -> str:
+  """The comparability key: the metric line up to its first bracketed
+  note (backend-fallback and compile-effort labels vary run to run;
+  the model/batch/device-count prefix is the identity)."""
+  return str(art.get('metric', '')).split(' [')[0].strip()
+
+
+def window_noise_pct(art: Dict[str, Any]) -> float:
+  """Within-run window spread of one artifact, percent: the min-of-k
+  windows bench journals carry their own noise evidence — a missing or
+  degenerate list reads as 0 (no extra band, the conservative side for
+  old-schema artifacts)."""
+  ws = art.get('window_ms')
+  if not isinstance(ws, list):
+    return 0.0
+  ws = [w for w in ws if isinstance(w, (int, float))]
+  if len(ws) < 2:
+    return 0.0
+  lo, hi = min(ws), max(ws)
+  if lo <= 0:
+    return 0.0
+  return (hi - lo) / lo * 100.0
+
+
+def loaded(art: Dict[str, Any], cap: float) -> bool:
+  la = art.get('loadavg')
+  return bool(isinstance(la, list) and la
+              and isinstance(la[0], (int, float)) and la[0] > cap)
+
+
+def compare(current: Dict[str, Any],
+            history: List[Dict[str, Any]],
+            threshold_pct: float = 10.0,
+            keys: Optional[List[str]] = None,
+            loadavg_cap: Optional[float] = None,
+            min_schema: int = 2) -> Dict[str, Any]:
+  """The sentinel's verdict dict: per-key current/baseline/delta/band
+  plus the regression list.  ``history`` entries that fail the
+  comparability gate are skipped (and counted).  Baselines below
+  ``min_schema`` are skipped too: pre-v2 artifacts carry no
+  window_ms/loadavg noise evidence, and the CPU-fallback walls of the
+  early rounds swing far past any honest threshold on a shared driver
+  host — a band policy cannot price noise it cannot see."""
+  if loadavg_cap is None:
+    loadavg_cap = float(os.cpu_count() or 1)
+  keys = list(keys) if keys else list(DEFAULT_KEYS)
+  cur_metric = normalized_metric(current)
+  same_line = [a for a in history
+               if normalized_metric(a) == cur_metric
+               and a.get('unit') == current.get('unit')
+               and isinstance(a.get('value'), (int, float))]
+  comparable = [a for a in same_line
+                if int(a.get('schema_version') or 0) >= int(min_schema)]
+  out: Dict[str, Any] = {
+      'metric': cur_metric,
+      'history_artifacts': len(history),
+      'comparable_artifacts': len(comparable),
+      'old_schema_skipped': len(same_line) - len(comparable),
+      'threshold_pct': float(threshold_pct),
+      'checks': [],
+      'regressions': [],
+  }
+  if not comparable:
+    out['note'] = ('no comparable history artifact (first run for this '
+                   'metric, a changed workload line, or only '
+                   f'pre-schema-v{min_schema} artifacts without noise '
+                   'evidence) — nothing to gate against')
+    return out
+  cur_noise = window_noise_pct(current)
+  cur_loaded = loaded(current, loadavg_cap)
+  for key in keys:
+    cur_v = current.get(key)
+    pool = [(a.get(key), a) for a in comparable
+            if isinstance(a.get(key), (int, float)) and a.get(key) > 0]
+    if not isinstance(cur_v, (int, float)) or cur_v <= 0 or not pool:
+      continue
+    base_v, base_art = min(pool, key=lambda t: t[0])
+    noise = max(cur_noise, window_noise_pct(base_art))
+    was_loaded = cur_loaded or loaded(base_art, loadavg_cap)
+    if was_loaded:
+      # a loaded host inflates walls in bursts: double the noise term
+      # and say so, rather than tripping CI on scheduler weather
+      noise *= 2.0
+    band = float(threshold_pct) + noise
+    delta = (cur_v - base_v) / base_v * 100.0
+    check = {
+        'key': key,
+        'current': round(float(cur_v), 3),
+        'baseline': round(float(base_v), 3),
+        'baseline_sha': base_art.get('sha'),
+        'delta_pct': round(delta, 2),
+        'band_pct': round(band, 2),
+        'noise_pct': round(noise, 2),
+        'loadavg_gated': was_loaded,
+    }
+    out['checks'].append(check)
+    if delta > band:
+      out['regressions'].append(check)
+  return out
+
+
+def journal_regressions(verdict: Dict[str, Any],
+                        current: Dict[str, Any]) -> None:
+  for reg in verdict['regressions']:
+    resilience.journal('perf_regression',
+                       key=reg['key'],
+                       delta_pct=reg['delta_pct'],
+                       band_pct=reg['band_pct'],
+                       current=reg['current'],
+                       baseline=reg['baseline'],
+                       baseline_sha=reg['baseline_sha'],
+                       current_sha=current.get('sha'),
+                       metric=verdict['metric'])
+
+
+def history_artifacts(history_dir: str,
+                      exclude: Optional[str] = None
+                      ) -> List[Dict[str, Any]]:
+  """Every loadable artifact under ``history_dir`` (``*.json`` +
+  ``*.jsonl``, non-recursive), skipping ``exclude`` (the current file)
+  and anything that fails to parse — history is best-effort evidence,
+  only the CURRENT artifact must be well-formed."""
+  out = []
+  ex = os.path.realpath(exclude) if exclude else None
+  for pat in ('*.json', '*.jsonl'):
+    for p in sorted(glob.glob(os.path.join(history_dir, pat))):
+      if ex and os.path.realpath(p) == ex:
+        continue
+      try:
+        art = load_artifact(p)
+      except ArtifactError:
+        continue
+      if isinstance(art.get('value'), (int, float)):
+        out.append(art)
+  return out
+
+
+def format_verdict(v: Dict[str, Any]) -> str:
+  out = [f"perf_sentinel: {v['metric'] or '<no metric>'}"]
+  skipped = (f", {v['old_schema_skipped']} old-schema skipped"
+             if v.get('old_schema_skipped') else '')
+  out.append(f"  history: {v['comparable_artifacts']} comparable of "
+             f"{v['history_artifacts']} artifact(s){skipped}, "
+             f"threshold {v['threshold_pct']}%")
+  if v.get('note'):
+    out.append(f"  note: {v['note']}")
+  for c in v['checks']:
+    flag = 'REGRESSION' if c in v['regressions'] else 'ok'
+    gate = ' [loadavg-gated: band doubled]' if c['loadavg_gated'] else ''
+    out.append(
+        f"  {c['key']}: {c['current']} vs baseline {c['baseline']} "
+        f"(sha {c['baseline_sha']}) delta {c['delta_pct']:+.2f}% "
+        f"band {c['band_pct']:.2f}%{gate} -> {flag}")
+  return '\n'.join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+  ap = _cli.make_parser(
+      'perf_sentinel',
+      description='Compare the current bench artifact against a history '
+      'directory of prior artifacts with noise-aware bands; nonzero '
+      'exit on a regression past threshold (CI-gate friendly, design '
+      '§19).')
+  ap.add_argument('current', help='current bench artifact (JSON line, '
+                  'driver wrapper, or jsonl)')
+  ap.add_argument('--history', required=True,
+                  help='directory of prior artifacts to baseline '
+                  'against')
+  ap.add_argument('--threshold', type=float, default=10.0,
+                  help='regression threshold in percent before the '
+                  'noise band is added (default 10)')
+  ap.add_argument('--keys', default=None,
+                  help='comma-separated artifact keys to compare '
+                  '(lower-is-better ms values; default: value + the '
+                  'serving percentiles when present)')
+  ap.add_argument('--loadavg-cap', type=float, default=None,
+                  help='1-minute loadavg above which a side counts as '
+                  'loaded and the noise band doubles (default: the '
+                  'host CPU count)')
+  ap.add_argument('--min-schema', type=int, default=2,
+                  help='skip baseline artifacts below this '
+                  'schema_version (pre-v2 lines carry no '
+                  'window_ms/loadavg noise evidence; default 2)')
+  ap.add_argument('--no-journal', action='store_true',
+                  help='do not journal perf_regression events (dry '
+                  'run)')
+  args = ap.parse_args(argv)
+  try:
+    current = load_artifact(args.current)
+    if not isinstance(current.get('value'), (int, float)):
+      raise ArtifactError(
+          f'{args.current}: failed artifact (value is '
+          f'{current.get("value")!r}) — a run with no measurement '
+          'cannot pass a perf gate')
+  except ArtifactError as e:
+    return _cli.fail('perf_sentinel', 'MALFORMED', e)
+  keys = ([k.strip() for k in args.keys.split(',') if k.strip()]
+          if args.keys else None)
+  history = history_artifacts(args.history, exclude=args.current)
+  verdict = compare(current, history, threshold_pct=args.threshold,
+                    keys=keys, loadavg_cap=args.loadavg_cap,
+                    min_schema=args.min_schema)
+  _cli.emit(verdict, args.json, lambda: format_verdict(verdict))
+  if verdict['regressions']:
+    if not args.no_journal:
+      journal_regressions(verdict, current)
+    return _cli.fail(
+        'perf_sentinel', 'FINDINGS',
+        f"{len(verdict['regressions'])} perf regression(s) past the "
+        'band: ' + ', '.join(
+            f"{r['key']} {r['delta_pct']:+.1f}% (band {r['band_pct']}%)"
+            for r in verdict['regressions']))
+  return _cli.EXIT_OK
+
+
+if __name__ == '__main__':
+  sys.exit(main())

@@ -203,6 +203,12 @@ def report(events) -> Dict[str, Any]:
   span1 = max((r['ts'] + r['dur'] for r in rows), default=0.0)
   wall_ms = (span1 - span0) / 1000.0
   attributed = union_ms([r for r in rows if r['cat'] in ('host', 'wait')])
+  # the devprof device lane (design §19): measured per-phase device
+  # time splits the old unattributed remainder into device-attributed
+  # wall vs the residue no span covers
+  device_ms = union_ms([r for r in rows if r['cat'] == 'device'])
+  covered = union_ms([r for r in rows
+                      if r['cat'] in ('host', 'wait', 'device')])
   return {
       'events': len(rows),
       'wall_ms': round(wall_ms, 3),
@@ -221,6 +227,10 @@ def report(events) -> Dict[str, Any]:
           # and untraced host code — the honest remainder, never
           # claimed as attributed
           'unattributed_ms': round(max(0.0, wall_ms - attributed), 3),
+          # the remainder's split (design §19): wall the device lane
+          # attributes, and the residue no span of any category covers
+          'device_ms': round(device_ms, 3),
+          'residue_ms': round(max(0.0, wall_ms - covered), 3),
       },
   }
 
@@ -243,6 +253,11 @@ def format_report(rep: Dict[str, Any]) -> str:
              f"trace-time {cp['trace_time_ms']:.1f} ms, "
              f"unattributed (device + untraced host) "
              f"{cp['unattributed_ms']:.1f} ms")
+  if cp.get('device_ms'):
+    out.append('device lane: '
+               f"{cp['device_ms']:.1f} ms device-attributed "
+               '(obs.devprof segmented dispatch), residue '
+               f"{cp['residue_ms']:.1f} ms uncovered by any span")
   if rep['steps']:
     out.append('')
     out.append('per-step breakdown:')
