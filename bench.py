@@ -365,6 +365,17 @@ def main():
                       'exchange-only wall) and the derived '
                       'a2a_overlap_pct.  Default: 4 for the sparse '
                       'trainer off the sparsecore path; 1 skips the A/B')
+  parser.add_argument('--dcn_ab', action=argparse.BooleanOptionalAction,
+                      default=None,
+                      help='hierarchical DCNxICI exchange A/B (design '
+                      '§20): re-measure the step on a two-axis '
+                      '(2, n/2) mesh with tables flat-replicated vs '
+                      'sharded over the axis product, and journal the '
+                      'exact dcn_rows / dcn_rows_off / dcn_dedup_ratio '
+                      'counters proving each distinct row crosses DCN '
+                      'at most once per slice.  The HEADLINE number is '
+                      'untouched.  Default: on for the sparse trainer '
+                      'off the sparsecore path with >= 4 devices')
   parser.add_argument('--hot_coverage', type=float, default=0.85,
                       help='per-table occurrence coverage target for the '
                       'hot set (0.85 measured: 8.5x fewer exchanged '
@@ -567,6 +578,26 @@ def main():
                        '--lookup_impl sparsecore (that path pipelines '
                        'through the static-CSR host feed; design §11 '
                        'refusal matrix)')
+  use_dcn_ab = args.dcn_ab
+  if use_dcn_ab is None:
+    use_dcn_ab = (args.trainer == 'sparse'
+                  and args.lookup_impl != 'sparsecore'
+                  and len(devices) >= 4 and len(devices) % 2 == 0)
+  elif use_dcn_ab:
+    # explicit --dcn_ab: fail fast (same discipline as --hot_cache)
+    # instead of journaling an artifact without the requested A/B
+    if args.trainer != 'sparse':
+      raise SystemExit('--dcn_ab requires --trainer sparse (the '
+                       'hierarchical exchange lives in the sparse '
+                       'dp<->mp path; design §20)')
+    if args.lookup_impl == 'sparsecore':
+      raise SystemExit('--dcn_ab is incompatible with --lookup_impl '
+                       'sparsecore (the SC path mod-shards; '
+                       'hierarchical layouts need contiguous windows; '
+                       'design §20 refusal matrix)')
+    if len(devices) < 4 or len(devices) % 2:
+      raise SystemExit('--dcn_ab needs an even device count >= 4 '
+                       '(the A/B mesh is (2, n/2); design §20)')
   quant_dtype = args.table_dtype
   if quant_dtype is None:
     # default: journal the int8 storage A/B for every sparse power-law
@@ -1029,6 +1060,84 @@ def main():
     except Exception as e:
       a2a_stats = {'a2a_overlap_error': f'{type(e).__name__}: {e}'}
 
+  # Hierarchical DCNxICI exchange A/B (parallel/planner.py
+  # hierarchical_layout + dist_embedding dcn_sharding, design §20;
+  # PR 16 tentpole).  Both arms run on a two-axis (2, n/2) mesh with
+  # natural (pack=1) storage so the ONLY delta is the table placement:
+  # the flat arm replicates tables across the dcn axis (zero exchange
+  # rows cross DCN, replication pays the HBM), the hierarchical arm
+  # shards over the axis product and dedups each slice's id union at
+  # the slice-local representative before anything crosses DCN.  The
+  # counters are EXACT host-side accounting (measure_exchange_counters
+  # mirrors HierGroupLayout.map_rows): dcn_rows vs dcn_rows_off is the
+  # dedup-at-the-boundary win, dcn_dedup_ratio > 1 whenever slices
+  # hold cross-chip duplicates.  The HEADLINE number is untouched.
+  # Never fatal.
+  dcn_stats = None
+  if use_dcn_ab:
+    try:
+      from distributed_embeddings_tpu.parallel import hotcache
+      from distributed_embeddings_tpu.parallel.mesh import (
+          create_mesh as _dcn_mesh)
+      n_dev2 = len(devices)
+      hier_mesh = _dcn_mesh((2, n_dev2 // 2))
+      hostpool = [((np.asarray(num), [np.asarray(c) for c in cats]),
+                   np.asarray(lab)) for (num, cats), lab in gen.pool]
+      dcn_arm_ms = {}
+      for arm, shard in (('flat', False), ('hier', True)):
+        model_d = SyntheticModel(config,
+                                 mesh=hier_mesh,
+                                 dp_input=True,
+                                 row_slice=args.row_slice,
+                                 param_dtype=jnp.dtype(args.param_dtype),
+                                 compute_dtype=compute_dtype,
+                                 packed_storage=False,
+                                 lookup_impl=args.lookup_impl,
+                                 dcn_sharding=shard)
+        if shard:
+          # exact counters from the hierarchical layer's own layout
+          dcn_stats = hotcache.measure_exchange_counters(
+              model_d.dist_embedding,
+              [np.asarray(c) for c in cats0], hot_sets={})
+        d_params = model_d.init(0)
+        d_raw = make_hybrid_train_step(model_d.dist_embedding,
+                                       head_loss_fn, optimizer,
+                                       emb_opt, jit=False)
+        copts = ({'exec_time_optimization_effort': -1.0,
+                  'memory_fitting_effort': -1.0}
+                 if args.fast_compile else None)
+        d_step = jax.jit(
+            lambda st, batch, _raw=d_raw: _raw(st, list(batch[0][1]),
+                                               (batch[0][0], batch[1])),
+            donate_argnums=(0,), compiler_options=copts)
+        dstate = init_hybrid_train_state(model_d.dist_embedding,
+                                         d_params, optimizer, emb_opt)
+        for i in range(max(3, args.warmup)):
+          dstate, dloss = d_step(dstate, hostpool[i % len(hostpool)])
+        sync_loss(dloss, f'dcn-ab {arm} warmup sync')
+        arm_window_ms = []
+        i = 0
+        for wsteps in split_windows(args.steps, args.measure_windows):
+          t0 = time.perf_counter()
+          for _ in range(wsteps):
+            dstate, dloss = d_step(dstate, hostpool[i % len(hostpool)])
+            i += 1
+          sync_loss(dloss, f'dcn-ab {arm} window sync at step {i}')
+          arm_window_ms.append((time.perf_counter() - t0) / wsteps
+                               * 1000)
+        dcn_arm_ms[arm] = round(min(arm_window_ms), 3)
+        del dstate
+      dcn_stats = dcn_stats or {}
+      dcn_stats.update({
+          'dcn_sharding': True,
+          'dcn_ab_flat_ms': dcn_arm_ms['flat'],
+          'dcn_ab_hier_ms': dcn_arm_ms['hier'],
+          'dcn_ab_mesh_shape': [2, n_dev2 // 2],
+      })
+    except Exception as e:
+      dcn_stats = dcn_stats or {}
+      dcn_stats['dcn_ab_error'] = f'{type(e).__name__}: {e}'
+
   # Quantized table storage A/B (parallel/quantization.py, design §12;
   # ISSUE 7).  The OFF arm is the headline step (unquantized, program-
   # identical to pre-PR); the ON arm re-measures the same model with
@@ -1463,6 +1572,10 @@ def main():
       'loadavg': host_load(),
       'available_mem_mb': host_mem(),
       'schema_version': SCHEMA_VERSION,
+      # the headline mesh's axis sizes (design §20): perf_sentinel only
+      # compares like-for-like, and a (2, 4) hierarchical line must
+      # never diff against an (8,) flat one
+      'mesh_shape': [int(s) for s in mesh.devices.shape],
       'packed_storage': args.packed_storage,
       'fast_compile': args.fast_compile,
       'lookup_impl': args.lookup_impl,
@@ -1474,6 +1587,8 @@ def main():
     result.update(hot_stats)
   if a2a_stats:
     result.update(a2a_stats)
+  if dcn_stats:
+    result.update(dcn_stats)
   if quant_stats:
     result.update(quant_stats)
   if tier_stats:

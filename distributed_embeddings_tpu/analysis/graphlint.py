@@ -823,6 +823,67 @@ def build_programs(tier: str = 'flagship') -> List[Program]:
       prog.hostsync = HostSyncRecord(sites=mon.sites)
     programs.append(prog)
 
+  # ---- hierarchical (dcn x ici) train step — design §20 -------------
+  # Flat-vs-hierarchical schedules are DISTINCT BY DESIGN: the
+  # hierarchical step adds the cross-slice DCN all_to_all pair per
+  # chunk, so pinning the two into ONE parity group would assert a
+  # falsehood.  Each arm is its own single-member group instead — the
+  # ledger records BOTH schedules (drift in either trips the ledger
+  # diff) without ever claiming they match.  The hierarchical arm also
+  # carries the donation/aliasing expectation (all state leaves — the
+  # two-level exchange must not cost a second copy of the tables) and
+  # its own 3-call zero-retrace + host-sync proof, exactly like the
+  # monolithic flat step above.
+  if world >= 4 and world % 2 == 0:
+    mesh_h = create_mesh((2, world // 2))
+    for shard, name, par in ((False, 'train/hier-flat-twin',
+                              'train-hier-flat'),
+                             (True, 'train/hierarchical', 'train-hier')):
+      dist = DistributedEmbedding(cfg2, mesh=mesh_h, dp_input=True,
+                                  packed_storage=False,
+                                  dcn_sharding=shard)
+      opt = SparseAdagrad(learning_rate=0.05)
+      # fresh kernel leaf per arm: the monolithic retrace proof above
+      # DONATED (and thereby deleted) the shared `kernel` buffer
+      kernel_h = jnp.asarray(np.full((8 * len(cfg2), 1), 0.1,
+                                     dtype=np.float32))
+      state = init_hybrid_train_state(
+          dist, {'embedding': dist.init(0), 'kernel': kernel_h},
+          optax.sgd(0.05), opt)
+      step = make_hybrid_train_step(dist, head_loss, optax.sgd(0.05),
+                                    opt)
+      traced = step.jitted.trace(state, cats_t, labels)
+      compiled = traced.lower().compile()
+      donate_expected = None
+      if 0 in step.donate_argnums:
+        flat, _ = jax.tree_util.tree_flatten_with_path(state)
+        donate_expected = [(i, jax.tree_util.keystr(path))
+                           for i, (path, _) in enumerate(flat)]
+      prog = Program(name, jaxpr=traced.jaxpr, compiled=compiled,
+                     parity=par,
+                     donate_expected=donate_expected,
+                     hbm_budget=dist.plan.device_hbm_budget,
+                     resident_state_bytes=measure_resident_bytes(
+                         (state.params['embedding'],
+                          state.opt_state[1])))
+      if shard:
+        c0 = dist.compile_count
+        sigs = []
+        mon = HostSyncMonitor()
+        cur = state
+        for i in range(3):
+          sigs.append(signature(cur, cats_t, labels))
+          if i == 0:
+            cur, _ = compiled(cur, cats_t, labels)
+          else:
+            with mon:
+              cur, _ = compiled(cur, cats_t, labels)
+        prog.retrace = RetraceRecord(
+            calls=3, sigs=sigs,
+            compile_count_delta=dist.compile_count - c0)
+        prog.hostsync = HostSyncRecord(sites=mon.sites)
+      programs.append(prog)
+
   # ---- serving ladder rungs + the warmed-ladder retrace proof -------
   eng = serving_lib.ServingEngine(cfg2, weights, batch_size=batch,
                                   mesh=mesh)

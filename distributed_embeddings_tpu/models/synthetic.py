@@ -252,6 +252,10 @@ class SyntheticModel:
       forwarded to ``DistributedEmbedding`` — quantized table storage
       (per-row-scaled int8 / float8_e4m3 payloads) and the host-DRAM
       cold tier (docs/design.md §12).
+    dcn_sharding: forwarded to ``DistributedEmbedding`` — shard tables
+      over the ``(dcn, data)`` axis PRODUCT of a two-axis mesh with the
+      two-level DCNxICI exchange (docs/design.md §20).  Requires a
+      two-axis mesh and ``packed_storage=False``.
   """
   config: ModelConfig
   mesh: Optional[Mesh] = None
@@ -269,6 +273,7 @@ class SyntheticModel:
   cold_tier: bool = False
   device_hbm_budget: Optional[int] = None
   cold_fetch_rows: Any = None
+  dcn_sharding: bool = False
 
   def __post_init__(self):
     tables, input_table_map, hotness = expand_tables(self.config)
@@ -291,7 +296,8 @@ class SyntheticModel:
         table_dtype=self.table_dtype,
         cold_tier=self.cold_tier,
         device_hbm_budget=self.device_hbm_budget,
-        cold_fetch_rows=self.cold_fetch_rows)
+        cold_fetch_rows=self.cold_fetch_rows,
+        dcn_sharding=self.dcn_sharding)
     total_width = sum(
         tables[t].output_dim for t in input_table_map)
     if self.config.interact_stride is not None:

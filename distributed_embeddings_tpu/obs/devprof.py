@@ -65,6 +65,16 @@ from distributed_embeddings_tpu.utils import resilience
 STEP_PHASES = ('dev/fwd/exchange', 'dev/fwd/lookup_combine',
                'dev/bwd/exchange', 'dev/bwd/grad', 'dev/apply/update')
 
+# dcn/ici sub-lanes of the two exchange phases under hierarchical
+# (dcn x data)-product sharding (design §20).  They SEGMENT the parent
+# phases rather than extend them — their ms nest inside the exchange
+# walls, never add to coverage — so flat profiles keep the exact
+# STEP_PHASES surface.  The ici lane is the directly measured ICI-only
+# twin program (``build_exchange_program(dcn_leg=False)``); the dcn
+# lane is the synced-wall remainder of the full exchange, floored at 0.
+DCN_LANES = ('dev/fwd/exchange/ici', 'dev/fwd/exchange/dcn',
+             'dev/bwd/exchange/ici', 'dev/bwd/exchange/dcn')
+
 # nested-prefix byte slack: the cost-model BYTES-ACCESSED totals of
 # fwd <= fwd+bwd <= step may wobble by backend bookkeeping (fusion
 # boundaries shift a few percent); a violation past this factor means
@@ -89,7 +99,10 @@ class StepProfile:
   100% when no floor clamped; ``cost`` holds the per-program XLA
   cost-model harvest (``{program: {'flops', 'bytes'}}``) and
   ``cost_ok`` the nested-prefix cross-check verdict (None when the
-  backend exposes no cost analysis)."""
+  backend exposes no cost analysis).  ``dcn_lanes`` (hierarchical
+  layers only, design §20) maps the ``DCN_LANES`` names to attributed
+  ms nested INSIDE the exchange phases (``dcn_direct`` mirrors
+  ``direct`` for them); None on flat profiles."""
   phases: Dict[str, float]
   direct: Dict[str, bool]
   step_ms: float
@@ -98,6 +111,8 @@ class StepProfile:
   cost_ok: Optional[bool]
   cost_note: str = ''
   reps: int = 0
+  dcn_lanes: Optional[Dict[str, float]] = None
+  dcn_direct: Optional[Dict[str, bool]] = None
 
 
 def _aot(jitted, *args):
@@ -221,6 +236,19 @@ def profile_step(dist, cats, params=None, emb_optimizer=None,
                                                       rows_only=True)
   programs['exb'] = (_aot(exb_fn, *exb_in), exb_in)
 
+  # ---- dcn/ici lane twins (hierarchical layers only, design §20):
+  # the ICI-only exchange program is the flat exchange shape on the
+  # same layer; the DCN lane falls out as the synced-wall remainder
+  hier = (bool(getattr(dist, 'dcn_sharding', False))
+          and dist.num_slices > 1)
+  if hier:
+    exfi_fn, exfi_in = overlap_lib.build_exchange_program(
+        dist, cats, dcn_leg=False)
+    programs['exf_ici'] = (_aot(exfi_fn, *exfi_in), exfi_in)
+    exbi_fn, exbi_in = overlap_lib.build_exchange_program(
+        dist, cats, rows_only=True, dcn_leg=False)
+    programs['exb_ici'] = (_aot(exbi_fn, *exbi_in), exbi_in)
+
   # ---- forward (compile_lookup: the lookup-only program) ------------
   fwd_fn = dist.compile_lookup(gb, hotness)
   programs['fwd'] = (_aot(fwd_fn, params, *inputs), (params,) + tuple(inputs))
@@ -274,7 +302,9 @@ def profile_step(dist, cats, params=None, emb_optimizer=None,
                            own_p, own_s, *inputs),
                       tuple(inputs))
 
-  for name in ('exf', 'exb', 'fwd', 'fwdbwd'):
+  timed = (('exf', 'exb', 'exf_ici', 'exb_ici', 'fwd', 'fwdbwd')
+           if hier else ('exf', 'exb', 'fwd', 'fwdbwd'))
+  for name in timed:
     compiled, args = programs[name]
     walls[name] = _timed_ms(compiled, args, reps)
     cost[name] = graphlint.cost_estimate(compiled)
@@ -295,6 +325,24 @@ def profile_step(dist, cats, params=None, emb_optimizer=None,
   direct = {'dev/fwd/exchange': True, 'dev/fwd/lookup_combine': False,
             'dev/bwd/exchange': True, 'dev/bwd/grad': False,
             'dev/apply/update': True}
+  # dcn/ici segmentation of the exchange phases (design §20): ici is
+  # the measured ICI-only twin, dcn the remainder — nested inside the
+  # parent walls, so the phase/coverage surface above is untouched
+  dcn_lanes = None
+  dcn_direct = None
+  if hier:
+    dcn_lanes = {
+        'dev/fwd/exchange/ici': round(walls['exf_ici'], 4),
+        'dev/fwd/exchange/dcn': round(
+            max(0.0, walls['exf'] - walls['exf_ici']), 4),
+        'dev/bwd/exchange/ici': round(walls['exb_ici'], 4),
+        'dev/bwd/exchange/dcn': round(
+            max(0.0, walls['exb'] - walls['exb_ici']), 4),
+    }
+    dcn_direct = {'dev/fwd/exchange/ici': True,
+                  'dev/fwd/exchange/dcn': False,
+                  'dev/bwd/exchange/ici': True,
+                  'dev/bwd/exchange/dcn': False}
   step_ms = walls['step']
   coverage = (100.0 * sum(phases.values()) / step_ms if step_ms > 0
               else 0.0)
@@ -303,7 +351,8 @@ def profile_step(dist, cats, params=None, emb_optimizer=None,
                      direct=direct, step_ms=round(step_ms, 4),
                      coverage_pct=round(coverage, 2), cost=cost,
                      cost_ok=cost_ok, cost_note=cost_note,
-                     reps=int(reps))
+                     reps=int(reps), dcn_lanes=dcn_lanes,
+                     dcn_direct=dcn_direct)
 
   # ---- emit: device lane + metrics + journal ------------------------
   if obs_trace.enabled():
@@ -330,14 +379,39 @@ def profile_step(dist, cats, params=None, emb_optimizer=None,
     obs_trace.complete('dev/apply/update', spans['dev/apply/update'],
                        phases['dev/apply/update'] / 1000.0, tid=tid,
                        direct=True)
+    if dcn_lanes is not None:
+      # lanes nest INSIDE their parent exchange span's window (ici
+      # first, dcn after) so trace_report's union_ms never
+      # double-counts the segmented wall (design §20)
+      t_lane = spans['dev/fwd/exchange']
+      obs_trace.complete('dev/fwd/exchange/ici', t_lane,
+                         dcn_lanes['dev/fwd/exchange/ici'] / 1000.0,
+                         tid=tid, direct=True)
+      t_lane += dcn_lanes['dev/fwd/exchange/ici'] / 1000.0
+      obs_trace.complete('dev/fwd/exchange/dcn', t_lane,
+                         dcn_lanes['dev/fwd/exchange/dcn'] / 1000.0,
+                         tid=tid, direct=False)
+      t_lane = spans['dev/bwd/exchange']
+      obs_trace.complete('dev/bwd/exchange/ici', t_lane,
+                         dcn_lanes['dev/bwd/exchange/ici'] / 1000.0,
+                         tid=tid, direct=True)
+      t_lane += dcn_lanes['dev/bwd/exchange/ici'] / 1000.0
+      obs_trace.complete('dev/bwd/exchange/dcn', t_lane,
+                         dcn_lanes['dev/bwd/exchange/dcn'] / 1000.0,
+                         tid=tid, direct=False)
   obs_metrics.inc('devprof.runs')
   for ms in prof.phases.values():
     obs_metrics.observe('devprof.phase_ms', ms)
+  if prof.dcn_lanes:
+    for ms in prof.dcn_lanes.values():
+      obs_metrics.observe('devprof.phase_ms', ms)
   resilience.journal('devprof_profile', phases=prof.phases,
                      step_ms=prof.step_ms,
                      coverage_pct=prof.coverage_pct,
                      cost=prof.cost, cost_ok=prof.cost_ok,
-                     cost_note=prof.cost_note, reps=prof.reps)
+                     cost_note=prof.cost_note, reps=prof.reps,
+                     **({'dcn_lanes': prof.dcn_lanes}
+                        if prof.dcn_lanes else {}))
   return prof
 
 
@@ -395,6 +469,10 @@ def artifact_block(prof: StepProfile,
       'devprof_cost': dict(prof.cost),
       'devprof_cost_ok': prof.cost_ok,
   }
+  if prof.dcn_lanes:
+    # hierarchical layers only (design §20): the dcn/ici segmentation
+    # of the exchange phases, nested ms that never add to coverage
+    out['devprof_dcn_lane_ms'] = dict(prof.dcn_lanes)
   if serve_rung_ms:
     out['devprof_serve_rung_ms'] = {str(k): v
                                     for k, v in serve_rung_ms.items()}

@@ -96,6 +96,13 @@ METRIC_TYPES: Dict[str, str] = {
     # skew gauges over the per-source-device exchanged-row counters
     'exchange.rows_max': 'gauge',
     'exchange.rows_mean': 'gauge',
+    # hierarchical DCNxICI exchange (design §20): rows crossing each
+    # link class per step, and the within-slice dedup leverage —
+    # ici_rows / dcn_rows (>1 whenever slices hold cross-chip
+    # duplicates; ==1 when every id is unique within its slice)
+    'exchange.dcn_rows': 'gauge',
+    'exchange.ici_rows': 'gauge',
+    'exchange.dcn_dedup_ratio': 'gauge',
 }
 
 REGISTERED_METRICS = frozenset(METRIC_TYPES)
@@ -174,9 +181,20 @@ REGISTERED_ARTIFACT_KEYS = frozenset({
     'hot_hit_rate_per_device', 'total_id_occurrences_per_device',
     'scatter_rows_per_device', 'exchange_rows_max', 'exchange_rows_mean',
     'hottest_shard',
+    # hierarchical DCNxICI exchange (parallel/hotcache.py, design §20):
+    # per-link row counts, the flat-exchange counterfactual, the dedup
+    # leverage, per-slice breakdowns, and the mesh shape tag that keeps
+    # perf_sentinel comparisons like-for-like across topologies
+    'dcn_rows', 'dcn_rows_off', 'ici_rows', 'dcn_dedup_ratio',
+    'dcn_rows_per_slice', 'dcn_rows_off_per_slice', 'mesh_shape',
+    # the flat-vs-hierarchical bench A/B arm (bench.py, design §20)
+    'dcn_sharding', 'dcn_ab_flat_ms', 'dcn_ab_hier_ms',
+    'dcn_ab_mesh_shape', 'dcn_ab_error',
     # device-time attribution block (obs/devprof.py, design §19)
     'devprof_phase_ms', 'devprof_step_ms', 'devprof_coverage_pct',
     'devprof_cost', 'devprof_cost_ok', 'devprof_serve_rung_ms',
+    # dcn/ici sub-lanes of the exchange phases (design §20)
+    'devprof_dcn_lane_ms',
 })
 
 # ~x2-2.5 geometric ladder, 10 us .. 60 s: percentile estimates from

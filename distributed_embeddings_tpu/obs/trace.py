@@ -86,6 +86,12 @@ REGISTERED_SPANS = frozenset({
     # (``device_tid``) — never from inside a measured headline window
     'dev/fwd/exchange', 'dev/fwd/lookup_combine', 'dev/bwd/exchange',
     'dev/bwd/grad', 'dev/apply/update', 'dev/serve/execute',
+    # dcn/ici sub-lanes of the exchange phases under hierarchical
+    # (dcn x data)-product sharding (design §20): the ICI-only twin
+    # program is measured directly, the DCN remainder derived — nested
+    # inside the parent exchange span so union_ms never double-counts
+    'dev/fwd/exchange/ici', 'dev/fwd/exchange/dcn',
+    'dev/bwd/exchange/ici', 'dev/bwd/exchange/dcn',
 })
 
 # Report classification (tools/trace_report.py): 'wait' spans are
@@ -100,6 +106,8 @@ SPAN_CATEGORIES: Dict[str, str] = {
     'dev/fwd/exchange': 'device', 'dev/fwd/lookup_combine': 'device',
     'dev/bwd/exchange': 'device', 'dev/bwd/grad': 'device',
     'dev/apply/update': 'device', 'dev/serve/execute': 'device',
+    'dev/fwd/exchange/ici': 'device', 'dev/fwd/exchange/dcn': 'device',
+    'dev/bwd/exchange/ici': 'device', 'dev/bwd/exchange/dcn': 'device',
 }
 
 

@@ -120,6 +120,27 @@ def _load(weight: WeightLike) -> np.ndarray:
   return np.asarray(weight)
 
 
+def _refuse_dcn_sharding(dist, op: str):
+  """Checkpoint resharding is not yet defined for hierarchical
+  (``dcn_sharding=True``) layers: their group leaves are ``[S*D,
+  rows_cap_h, ...]`` stacks over the (dcn, data) axis PRODUCT with
+  permuted per-slice row windows (design §20), while every gather/
+  scatter path here walks ``dist.world_size`` flat shards — reading
+  them as flat would silently drop or misplace rows.  Refuse loudly;
+  the supported route is the flat-twin one: checkpoint the flat model
+  with the same plan geometry, restore it, and reshard its params with
+  ``dist_embedding.hierarchical_params`` (exact row relocation — the
+  same conversion the §20 parity suite uses).
+  """
+  if getattr(dist, 'dcn_sharding', False):
+    raise NotImplementedError(
+        f'{op} does not support dcn_sharding=True layers yet: the '
+        f'hierarchical (dcn x ici) layout shards over the axis product '
+        f'with per-slice row permutations (design §20). Checkpoint a '
+        f'flat twin of the same plan geometry and convert with '
+        f'dist_embedding.hierarchical_params(dist, flat_params).')
+
+
 def _chunked_shards(dist: DistributedEmbedding, arr: jax.Array,
                     chunk_elems: int) -> List[np.ndarray]:
   """Stream one ``[D, rows_cap, ...]`` group array to host, device by
@@ -230,6 +251,7 @@ def set_weights(dist: DistributedEmbedding,
   Raises:
     ValueError: on length or shape mismatch.
   """
+  _refuse_dcn_sharding(dist, 'set_weights')
   plan = dist.plan
   weights = list(weights)
   if len(weights) != len(plan.table_configs):
@@ -463,6 +485,7 @@ def get_weights(dist: DistributedEmbedding,
   Returns:
     List of ``[rows, width]`` numpy arrays in global table order.
   """
+  _refuse_dcn_sharding(dist, 'get_weights')
   plan = dist.plan
   group_index = {g.key: gi for gi, g in enumerate(plan.groups)}
   host_shards = {
@@ -543,6 +566,7 @@ def get_optimizer_state(dist: DistributedEmbedding,
     ``[{'acc': [rows, width]}, ...]``); empty dicts for stateless
     optimizers.
   """
+  _refuse_dcn_sharding(dist, 'get_optimizer_state')
   plan = dist.plan
   group_index = {g.key: gi for gi, g in enumerate(plan.groups)}
   leaf_names = sorted({k for gs in opt_state.values() for k in gs})
@@ -636,6 +660,7 @@ def set_optimizer_state(dist: DistributedEmbedding,
   to every column slice of their table.  Padding rows (never looked up)
   are zero-filled.
   """
+  _refuse_dcn_sharding(dist, 'set_optimizer_state')
   plan = dist.plan
   if len(table_states) != len(plan.table_configs):
     raise ValueError(
@@ -1357,6 +1382,9 @@ def restore_train_state(dist: DistributedEmbedding, state, source: str,
 
 
 def _restore_train_state(dist, state, source, quarantine):
+  # refuse BEFORE any file I/O: the reshard below would read the
+  # hierarchical axis-product leaves as flat shards (design §20)
+  _refuse_dcn_sharding(dist, 'restore_train_state')
   if os.path.isdir(source):
     path, (weights, st_tables, extras) = load_latest_valid(
         source, expect_plan=dist, quarantine=quarantine)

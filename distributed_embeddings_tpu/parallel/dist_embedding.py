@@ -47,9 +47,9 @@ from distributed_embeddings_tpu.parallel import mesh as mesh_lib
 from distributed_embeddings_tpu.parallel import quantization
 from distributed_embeddings_tpu.parallel.overlap import (chunk_bounds,
                                                          effective_chunks)
-from distributed_embeddings_tpu.parallel.planner import (GroupSpec,
-                                                         ShardingPlan,
-                                                         TableConfig)
+from distributed_embeddings_tpu.parallel.planner import (
+    GroupSpec, ShardingPlan, TableConfig, hierarchical_layout,
+    price_exchange)
 from distributed_embeddings_tpu.utils.initializers import get_initializer
 
 _SENTINEL = -1
@@ -206,7 +206,8 @@ class DistributedEmbedding:
                table_dtype=None,
                cold_tier: bool = False,
                device_hbm_budget: Optional[int] = None,
-               cold_fetch_rows=None):
+               cold_fetch_rows=None,
+               dcn_sharding: bool = False):
     if row_slice is not None and (isinstance(row_slice, bool)
                                   or not isinstance(row_slice,
                                                     (int, np.integer))):
@@ -240,10 +241,15 @@ class DistributedEmbedding:
           f'mesh may have at most one extra (DCN/slice) axis besides '
           f'{axis_name!r}, got axes {self.mesh.axis_names}')
     # Two-axis (ICI x DCN) topology: tables shard over the inner
-    # ``axis_name`` (all_to_all/psum_scatter ride ICI) and REPLICATE over
-    # the outer slice axis; the batch data-parallelises over the product.
-    # Cross-slice traffic is only the per-step update-stream gather
-    # (sparse path, parallel/sparse.py) / dense-grad psum (autodiff).
+    # ``axis_name`` (all_to_all/psum_scatter ride ICI) and by default
+    # REPLICATE over the outer slice axis; the batch data-parallelises
+    # over the product.  Cross-slice traffic is then only the per-step
+    # update-stream gather (sparse path, parallel/sparse.py) /
+    # dense-grad psum (autodiff).  ``dcn_sharding=True`` shards tables
+    # over the AXIS PRODUCT instead: the dp<->mp exchange becomes
+    # two-level — ids ride ICI to the slice-local representative, the
+    # representative deduplicates its slice's ids, and only distinct
+    # rows cross DCN (docs/design.md §20).
     self.dcn_axis = extra[0] if extra else None
     self.num_slices = self.mesh.shape[self.dcn_axis] if self.dcn_axis else 1
     self._batch_axes = ((self.dcn_axis, axis_name) if self.dcn_axis
@@ -337,13 +343,58 @@ class DistributedEmbedding:
             'rounding the untiered program applies (docs/design.md '
             '§12 refusal matrix). Quantize instead: '
             "table_dtype='int8' halves storage twice as hard as bf16.")
+    # ---- hierarchical (dcn x ici) placement refusal matrix (§20) ----
+    if dcn_sharding:
+      if self.dcn_axis is None:
+        raise ValueError(
+            'dcn_sharding=True needs a two-axis (dcn, data) mesh '
+            '(create_mesh((slices, chips))): with one axis there is no '
+            'DCN boundary to shard across')
+      if not dp_input:
+        raise ValueError(
+            'dcn_sharding requires dp_input=True: the two-level '
+            'exchange deduplicates the dp->mp id stream at the '
+            'slice-local representative, which the model-parallel '
+            'input path does not have (docs/design.md §20)')
+      if lookup_impl == 'sparsecore':
+        raise ValueError(
+            "dcn_sharding is incompatible with "
+            "lookup_impl='sparsecore': the SparseCore path owns its "
+            'own mod-sharded table storage and feed (design §8); '
+            'hierarchically re-sharding under it would run a different '
+            "program under its label. Use lookup_impl='auto'.")
+      if mod_sharding:
+        raise ValueError(
+            'dcn_sharding is incompatible with mod_sharding: strided '
+            'mod windows cannot split into the contiguous per-slice '
+            'sub-windows the hierarchical placement is built from '
+            '(docs/design.md §20)')
+      if row_slice is not None:
+        raise ValueError(
+            'dcn_sharding is incompatible with row_slice: the DCN '
+            'axis itself row-shards every table S-fold; combine it '
+            'with column slicing (column_slice_threshold) instead')
+      if lookup_impl == 'pallas':
+        raise ValueError(
+            "dcn_sharding is incompatible with lookup_impl='pallas': "
+            'the two-level exchange replaces the per-device fused '
+            'lookup with a dedup->DCN-fetch->scatter pipeline that '
+            'the Pallas gather kernel does not implement; running '
+            'the XLA path under the pallas label would be a silent '
+            "masquerade (design §7). Use lookup_impl='auto'.")
     self.plan = ShardingPlan(self.table_configs,
                              world_size=self.world_size,
                              strategy=strategy,
                              input_table_map=input_table_map,
                              column_slice_threshold=column_slice_threshold,
                              row_slice_threshold=row_slice,
-                             packed_storage=packed_storage,
+                             # hierarchical placement needs natural
+                             # (pack=1) storage: the packed lane fold
+                             # changes the f32 reduction association
+                             # across pack groups, which would break
+                             # flat-vs-hierarchical bit-exactness
+                             packed_storage=(packed_storage
+                                             and not dcn_sharding),
                              mod_sharding=mod_sharding,
                              num_sc=num_sc,
                              hot_sets=hot_cache,
@@ -354,6 +405,23 @@ class DistributedEmbedding:
                              param_itemsize=self.param_dtype.itemsize)
     self.hot_enabled = bool(self.plan.hot_sets)
     self.overlap_chunks = self.plan.overlap_chunks
+    # hierarchical (dcn x ici) placement: derived FROM the flat plan
+    # (per-member S-way contiguous sub-windows) so the two-level path
+    # stays bit-exact vs the flat one (docs/design.md §20)
+    self.dcn_sharding = bool(dcn_sharding)
+    self.hier = (hierarchical_layout(self.plan, self.num_slices)
+                 if self.dcn_sharding else None)
+    if self.num_slices > 1:
+      # price this plan's exchange under the per-axis cost model and
+      # journal the assumption (event 'exchange_cost_model', one per
+      # planning run — design §20).  Hotness is not known until inputs
+      # arrive, so the priced floor assumes one id per sample; the
+      # dynamic valid-row counters live in
+      # hotcache.measure_exchange_counters.
+      price_exchange(self.plan, 8 * self.num_slices * self.world_size,
+                     [1] * len(self.plan.input_table_map),
+                     num_slices=self.num_slices,
+                     hierarchical=self.dcn_sharding)
     # quantized storage: the payload dtype tables (and hot buffers)
     # physically store at; scales live in scale_group_{gi} leaves
     self.quant = self.plan.table_spec
@@ -705,6 +773,39 @@ class DistributedEmbedding:
           full.shape, g.rows_cap, g.storage_pack, g.param_width)
       return full[None]
 
+    def make_hier_shard(key, s, dev, g, hl):
+      """Hierarchical device ``(s, dev)``'s ``[1, rows_cap_h, width]``
+      shard: each flat member draws at its FULL flat shape with the
+      FLAT key derivation, then slices its slice-``s`` sub-window — so
+      hierarchical init is bit-identical to flat init resharded
+      (``hierarchical_params``), which is what the parity suite needs
+      to compare applied updates without a conversion step at t=0."""
+      chunks = []
+      for lt, (start, size) in zip(g.member_tables[dev],
+                                   hl.sub_windows[s][dev]):
+        cfg = self.table_configs[lt.table_id]
+        init = get_initializer(cfg.initializer)
+        kwargs = {}
+        if (getattr(init, 'row_scale_sensitive', False)
+            and lt.input_dim != cfg.input_dim):
+          kwargs['rows'] = cfg.input_dim
+        sub = jax.random.fold_in(
+            jax.random.fold_in(
+                jax.random.fold_in(key, lt.table_id), lt.col_start),
+            lt.row_start)
+        nat = init(sub, (lt.input_dim, lt.width), self.param_dtype,
+                   **kwargs).astype(self.param_dtype)
+        if size:
+          chunks.append(nat[start:start + size])
+      pad_rows = hl.rows_cap_h - hl.rows_h[s][dev]
+      if pad_rows or not chunks:
+        chunks.append(jnp.zeros((pad_rows, g.width), self.param_dtype))
+      full = (chunks[0] if len(chunks) == 1 else
+              jnp.concatenate(chunks, axis=0))
+      assert full.shape == (hl.rows_cap_h, g.param_width), (
+          full.shape, hl.rows_cap_h, g.param_width)
+      return full[None]
+
     def build_all(key):
       # Per-device structure is data under SPMD: every device runs the
       # same program and a lax.switch on its axis index picks the branch
@@ -713,12 +814,25 @@ class DistributedEmbedding:
       # init — the earlier per-device jax.jit(make_shard) loop compiled
       # O(devices x groups) programs (VERDICT.md round 1, weak #4).
       me = jax.lax.axis_index(self.axis_name)
+      if self.dcn_sharding:
+        # hierarchical placement: one branch per (slice, device) cell
+        # of the axis product
+        me = (jax.lax.axis_index(self.dcn_axis) * self.world_size + me)
       out = {}
       for gi, g in enumerate(self.plan.groups):
-        branches = [
-            (lambda k, dev=dev, g=g: make_shard(k, dev, g))
-            for dev in range(self.world_size)
-        ]
+        if self.dcn_sharding:
+          hl = self.hier.groups[gi]
+          branches = [
+              (lambda k, s=s, dev=dev, g=g, hl=hl:
+               make_hier_shard(k, s, dev, g, hl))
+              for s in range(self.num_slices)
+              for dev in range(self.world_size)
+          ]
+        else:
+          branches = [
+              (lambda k, dev=dev, g=g: make_shard(k, dev, g))
+              for dev in range(self.world_size)
+          ]
         shard = jax.lax.switch(me, branches, key)
         if self.quant is not None:
           # quantized storage (design §12): the f32 draw quantizes
@@ -732,13 +846,15 @@ class DistributedEmbedding:
       return out
 
     n_groups = len(self.plan.groups)
+    shard_ax = ((self.dcn_axis, self.axis_name) if self.dcn_sharding
+                else self.axis_name)
     out_specs = {
-        f'group_{gi}': P(self.axis_name, None, None)
+        f'group_{gi}': P(shard_ax, None, None)
         for gi in range(n_groups)
     }
     if self.quant is not None:
       out_specs.update({
-          f'scale_group_{gi}': P(self.axis_name, None, None)
+          f'scale_group_{gi}': P(shard_ax, None, None)
           for gi in range(n_groups)
       })
     fn = jax.jit(
@@ -798,16 +914,16 @@ class DistributedEmbedding:
 
     def local_fn(params):
       me = jax.lax.axis_index(self.axis_name)
+      if self.dcn_sharding:
+        me = (jax.lax.axis_index(self.dcn_axis) * self.world_size + me)
       out = {}
       for gi in hot_gis:
         g = plan.groups[gi]
         table = params[f'group_{gi}'][0]
         tscale = self._scale_of(params, gi)
 
-        def one_dev(operand, dev, g=g):
+        def one_dev(operand, rows, dst, g=g):
           table, tscale = operand
-          rows = g.hot_owner_rows[dev]
-          dst = g.hot_owner_dst[dev]
           dt = jnp.float32 if self.quant else self.param_dtype
           buf = jnp.zeros((g.hot_rows_cap, g.width), dt)
           if rows.size == 0:
@@ -821,13 +937,37 @@ class DistributedEmbedding:
             vals = vals.astype(jnp.float32) * tscale[jnp.asarray(rows)]
           return buf.at[jnp.asarray(dst)].set(vals.astype(dt))
 
-        branches = [
-            (lambda t, dev=dev, g=g: one_dev(t, dev, g))
-            for dev in range(self.world_size)
-        ]
+        if self.dcn_sharding:
+          # hierarchical shards: a hot row of flat device ``dev`` is
+          # resident on exactly ONE (slice, dev) cell — each cell
+          # gathers its share (static per-branch row/dst arrays via
+          # the host-side interval map) and the two-axis psum below
+          # replicates the union
+          hl = self.hier.groups[gi]
+          cells = []
+          for s in range(self.num_slices):
+            for dev in range(self.world_size):
+              owner, hrow = hl.map_rows(dev, g.hot_owner_rows[dev])
+              sel = owner == s
+              cells.append((hrow[sel],
+                            np.asarray(g.hot_owner_dst[dev])[sel]))
+          branches = [
+              (lambda t, rows=rows, dst=dst, g=g:
+               one_dev(t, rows, dst, g))
+              for rows, dst in cells
+          ]
+        else:
+          branches = [
+              (lambda t, dev=dev, g=g:
+               one_dev(t, g.hot_owner_rows[dev], g.hot_owner_dst[dev],
+                       g))
+              for dev in range(self.world_size)
+          ]
         buf = jax.lax.switch(me, branches, (table, tscale))
         if self.world_size > 1:
           buf = jax.lax.psum(buf, self.axis_name)
+        if self.dcn_sharding and self.num_slices > 1:
+          buf = jax.lax.psum(buf, self.dcn_axis)
         if self.quant is not None:
           payload, scale = quantization.quantize_jnp(buf, self.quant)
           out[f'hot_group_{gi}'] = payload
@@ -1276,8 +1416,10 @@ class DistributedEmbedding:
             routed_c = _route_ids(ids_c, offs[lo:hi], voc[lo:hi],
                                   rows_cap, rlo[lo:hi], rhi[lo:hi],
                                   rst[lo:hi] if rst is not None else None)
-            out_c = self._lookup(table, routed_c, sub.lookup_combiner,
-                                 pack=spack, scale=tscale)
+            out_c = (self._hier_lookup(params, sub, routed_c)
+                     if self.dcn_sharding else
+                     self._lookup(table, routed_c, sub.lookup_combiner,
+                                  pack=spack, scale=tscale))
             routed_parts.append(routed_c)
             back_c = out_c.reshape(hi - lo, D, local_batch,
                                    w).transpose(1, 0, 2, 3)
@@ -1320,10 +1462,13 @@ class DistributedEmbedding:
                             jnp.asarray(sub.row_hi)[me],
                             (jnp.asarray(sub.row_stride)[me]
                              if sub.has_mod_windows else None))
-        out = self._lookup(params[f'group_{sub.gi}'][0], routed,
-                           sub.lookup_combiner,
-                           pack=self.plan.groups[sub.gi].storage_pack,
-                           scale=self._scale_of(params, sub.gi))
+        if self.dcn_sharding:
+          out = self._hier_lookup(params, sub, routed)
+        else:
+          out = self._lookup(params[f'group_{sub.gi}'][0], routed,
+                             sub.lookup_combiner,
+                             pack=self.plan.groups[sub.gi].storage_pack,
+                             scale=self._scale_of(params, sub.gi))
         if sub.mean_row_sliced:
           # mean row shards look up with 'sum'; divide by the TRUE
           # per-sample id count HERE, where the full raw ids are in hand
@@ -1968,6 +2113,10 @@ class DistributedEmbedding:
     table = params[f'group_{gi}'][0]
     scale = self._scale_of(params, gi)
     if g.tier_rows == 0:
+      if self.dcn_sharding:
+        # hierarchical residency: the cold-id union routes through the
+        # slice-wide dedup + DCN fetch instead of the local gather
+        return lambda routed: self._hier_cold_gather(params, gi, routed)
       return lambda routed: self._lookup(table, routed, None,
                                          pack=g.storage_pack, scale=scale)
     f = fetch[gi]
@@ -1978,15 +2127,18 @@ class DistributedEmbedding:
 
   def _param_specs(self):
     """shard_map in_specs for the params pytree: fused group shards on
-    the mesh axis, hot-cache buffers replicated, per-row scale leaves
+    the mesh axis (the (dcn, data) axis PRODUCT under dcn_sharding —
+    design §20), hot-cache buffers replicated, per-row scale leaves
     (quantized storage, design §12) following their tables."""
+    shard_ax = ((self.dcn_axis, self.axis_name) if self.dcn_sharding
+                else self.axis_name)
     specs = {
-        f'group_{gi}': P(self.axis_name, None, None)
+        f'group_{gi}': P(shard_ax, None, None)
         for gi in range(len(self.plan.groups))
     }
     if self.quant is not None:
       for gi in range(len(self.plan.groups)):
-        specs[f'scale_group_{gi}'] = P(self.axis_name, None, None)
+        specs[f'scale_group_{gi}'] = P(shard_ax, None, None)
     for gi in self.plan.hot_groups:
       specs[f'hot_group_{gi}'] = P(None, None)
       if self.quant is not None:
@@ -1999,6 +2151,114 @@ class DistributedEmbedding:
     if self.quant is None:
       return None
     return params[f'scale_group_{gi}'][0]
+
+  # ------------- hierarchical (dcn x ici) two-level exchange (§20) -------
+
+  def _hier_fetch_unique(self, params, gi, uniq):
+    """Fetch rows for per-slot DEDUPLICATED flat-space ids across the
+    DCN boundary (docs/design.md §20).
+
+    ``uniq``: ``[n_cap, U]`` flat fused-local row ids of this flat
+    device column, ``-1`` padding.  Each id maps through the static
+    interval tables (``HierGroupLayout.cut_*``) to its owner
+    ``(slice, hier row)``; a cross-slice all_to_all ships ids out
+    (sentinel ``rows_cap_h`` marks positions not destined for a slice),
+    owners gather (dequantizing — exact), and the mirror all_to_all
+    ships rows back, where ``take_along_axis`` selects each id's owner
+    column — exact selection, no summation, so nothing perturbs the
+    flat numerics.  Returns ``[n_cap, U, w]`` rows (zeros at padding)
+    in the table dtype (f32 when quantized).  Each DISTINCT id crosses
+    DCN at most once per source slice — the dedup-at-the-boundary
+    contract the §20 counters audit.
+    """
+    hl = self.hier.groups[gi]
+    S = self.num_slices
+    me_d = jax.lax.axis_index(self.axis_name)
+    cut_lo = jnp.asarray(hl.cut_lo)[me_d]
+    cut_sl = jnp.asarray(hl.cut_slice)[me_d]
+    cut_h = jnp.asarray(hl.cut_hier)[me_d]
+    cap_h = hl.rows_cap_h
+    valid = uniq >= 0
+    safe = jnp.maximum(uniq, 0)
+    k = jnp.clip(
+        jnp.searchsorted(cut_lo, safe.reshape(-1), side='right') - 1,
+        0, cut_lo.shape[0] - 1).reshape(safe.shape)
+    owner = cut_sl[k]
+    hrow = safe - cut_lo[k] + cut_h[k]
+    dest = jax.lax.broadcasted_iota(jnp.int32, (S,) + uniq.shape, 0)
+    send = jnp.where(valid[None] & (owner[None] == dest), hrow[None],
+                     cap_h).astype(jnp.int32)
+    recv = (jax.lax.all_to_all(send, self.dcn_axis, 0, 0)
+            if S > 1 else send)
+    table = params[f'group_{gi}'][0]
+    scale = self._scale_of(params, gi)
+    mask = recv < cap_h
+    safe_r = jnp.where(mask, recv, 0)
+    rows = jnp.take(table, safe_r, axis=0)
+    if scale is not None:
+      rows = rows.astype(jnp.float32) * jnp.take(scale, safe_r, axis=0)
+    rows = jnp.where(mask[..., None], rows, 0)
+    back = (jax.lax.all_to_all(rows, self.dcn_axis, 0, 0)
+            if S > 1 else rows)
+    sel = jnp.broadcast_to(owner[None, ..., None].astype(jnp.int32),
+                           (1,) + owner.shape + (back.shape[-1],))
+    rows_u = jnp.take_along_axis(back, sel, axis=0)[0]
+    return jnp.where(valid[..., None], rows_u, 0)
+
+  def _hier_lookup(self, params, sub, routed):
+    """Two-level lookup+combine of one subgroup slot buffer: per-slot
+    slice-wide sort-unique dedup (the §10 machinery), DCN fetch of the
+    distinct rows (``_hier_fetch_unique``), inverse-position scatter
+    back to occurrences, then the SAME ``_combine_rows`` tail as the
+    flat path — identical addends in identical association, so the
+    hierarchical forward is bit-exact vs flat.  ``routed``:
+    ``[n_cap, GB, h]`` flat fused-space ids, sentinel ``rows_cap``.
+    """
+    g = self.plan.groups[sub.gi]
+    rows_cap = g.rows_cap
+    n_cap, gb, h = routed.shape
+    vr = jnp.where(routed < rows_cap, routed, -1)
+    vr = vr.reshape(n_cap, gb * h).astype(jnp.int32)
+    uniq, inv = _unique_with_inverse(vr, gb * h)
+    rows_u = self._hier_fetch_unique(params, sub.gi, uniq)
+    w = rows_u.shape[-1]
+    rows_ext = jnp.concatenate(
+        [rows_u, jnp.zeros((n_cap, 1, w), rows_u.dtype)], axis=1)
+    occ = jnp.take_along_axis(
+        rows_ext,
+        jnp.broadcast_to(inv[..., None], (n_cap, gb * h, w)), axis=1)
+    occ = occ.reshape(n_cap, gb, h, w)
+    mask = routed < rows_cap
+    tdt = jnp.float32 if self.quant is not None else occ.dtype
+    return _combine_rows(occ, mask, sub.lookup_combiner, tdt,
+                         self.compute_dtype)
+
+  def _hier_cold_gather(self, params, gi, routed):
+    """Hierarchical owner-side cold-row gather (hot-cache forward): the
+    routed ids are the slice's cold-id UNION for this owner column
+    (per-source deduplicated upstream); dedup the union once more —
+    the representative's slice-wide dedup the §20 contract names — so
+    each distinct row crosses DCN at most once per slice, fetch, and
+    scatter back by inverse position.  Returns exactly what the flat
+    resident gather returns: ``[n_cap, M, w]`` combiner-None rows in
+    compute_dtype.  ``routed``: ``[n_cap, M, 1]``.
+    """
+    g = self.plan.groups[gi]
+    rows_cap = g.rows_cap
+    r = routed[..., 0]
+    n_cap, m = r.shape
+    vr = jnp.where(r < rows_cap, r, -1).astype(jnp.int32)
+    uniq, inv = _unique_with_inverse(vr, m)
+    rows_u = self._hier_fetch_unique(params, gi, uniq)
+    w = rows_u.shape[-1]
+    rows_ext = jnp.concatenate(
+        [rows_u, jnp.zeros((n_cap, 1, w), rows_u.dtype)], axis=1)
+    occ = jnp.take_along_axis(
+        rows_ext, jnp.broadcast_to(inv[..., None], (n_cap, m, w)),
+        axis=1)
+    tdt = jnp.float32 if self.quant is not None else occ.dtype
+    return _combine_rows(occ[:, :, None, :], (r < rows_cap)[:, :, None],
+                         None, tdt, self.compute_dtype)
 
   def _build_backward_hot(self, global_batch: int, hotness: tuple,
                           with_sq: bool = False,
@@ -2554,3 +2814,58 @@ def _fused_lookup_packed(table: jax.Array, routed: jax.Array, pack: int,
     counts = jnp.sum(mask, axis=2).astype(acc)
     out = out / jnp.maximum(counts, 1)[..., None]
   return out.astype(compute_dtype)
+
+
+def hierarchical_params(dist, flat_params):
+  """Reshard a FLAT twin's params pytree into the hierarchical
+  (dcn x ici) layout of ``dist`` (a ``dcn_sharding=True`` model).
+
+  Host-side and exact — pure row relocation through the
+  ``HierGroupLayout`` interval map, no arithmetic — this is the
+  conversion the §20 parity suite uses to compare applied updates:
+  flat-step-then-reshard must equal reshard-then-hier-step bit for bit
+  on every real row.  ``flat_params`` comes from a flat model with the
+  same plan geometry (same tables/budgets, ``packed_storage=False`` —
+  which ``dcn_sharding`` forces anyway).  Hot-cache leaves are
+  replicated unions of the same row values in both layouts and copy
+  through unchanged.  Padding rows beyond each hier shard's real rows
+  are filler (payload 0, scale 1) — they are never read (the
+  ``rows_cap_h`` sentinel masks them) and are NOT comparable across
+  layouts.  Returns a pytree device_put on ``dist.mesh`` with the
+  axis-product sharding.
+  """
+  if not getattr(dist, 'dcn_sharding', False):
+    raise ValueError(
+        'hierarchical_params needs a dcn_sharding=True DistributedEmbedding')
+  S, D = dist.num_slices, dist.world_size
+  prod_sh = NamedSharding(dist.mesh,
+                          P((dist.dcn_axis, dist.axis_name), None, None))
+  out = {}
+  for gi, g in enumerate(dist.plan.groups):
+    hl = dist.hier.groups[gi]
+    leaves = [(f'group_{gi}', 0)]
+    if dist.quant is not None:
+      leaves.append((f'scale_group_{gi}', 1.0))
+    for nm, fill in leaves:
+      flat = np.asarray(jax.device_get(flat_params[nm]))
+      if flat.shape[0] != D:
+        raise ValueError(
+            f'{nm}: flat leaf has {flat.shape[0]} device shards, the '
+            f'hierarchical mesh has {D} per slice — plan geometry differs')
+      w = flat.shape[-1]
+      stack = np.full((S * D, hl.rows_cap_h, w), fill, flat.dtype)
+      for s in range(S):
+        for d in range(D):
+          parts = [flat[d, lo:lo + size]
+                   for lo, size in hl.flat_ranges[s][d] if size]
+          n = sum(p.shape[0] for p in parts)
+          assert n == hl.rows_h[s][d], (nm, s, d, n, hl.rows_h[s][d])
+          if parts:
+            stack[s * D + d, :n] = np.concatenate(parts, axis=0)
+      out[nm] = jax.device_put(stack, prod_sh)
+  for nm, leaf in flat_params.items():
+    if nm.startswith('hot_'):
+      arr = np.asarray(jax.device_get(leaf))
+      out[nm] = jax.device_put(
+          arr, NamedSharding(dist.mesh, P(*([None] * arr.ndim))))
+  return out

@@ -333,6 +333,30 @@ def measure_exchange_counters(dist, cats,
   and reconciled before returning — a sum mismatch raises instead of
   journaling a silently inconsistent artifact.
 
+  Hierarchical DCNxICI exchange counters (design §20): when the layer
+  shards over the ``(dcn, data)`` axis product (``dist.dcn_sharding``),
+  the two-level exchange is audited too — ``ici_rows`` (rows crossing
+  the intra-slice dp->mp leg; identical to ``alltoall_rows_sent`` by
+  construction, kept as its own key so the artifact names the lane),
+  ``dcn_rows`` (distinct off-slice-owned rows crossing the cross-slice
+  DCN leg AFTER the representative's slice-wide dedup — the
+  dedup-at-the-boundary contract: each distinct row crosses DCN at most
+  once per source slice per slot) and ``dcn_rows_off`` (the same wire
+  without that dedup: every arriving off-slice occurrence forwarded
+  verbatim), with ``dcn_dedup_ratio = dcn_rows_off / dcn_rows`` — the
+  §20 win in one number.  Per-SOURCE-slice breakdowns
+  (``dcn_rows_per_slice`` / ``dcn_rows_off_per_slice``) are computed on
+  an independent arithmetic path (per-source blocks routed one at a
+  time + set-union dedup, vs the global path's concatenated-union
+  ``np.unique``) and reconciled against the globals exactly like the
+  §19 per-device lists — a mismatch raises.  The owner mapping is
+  ``HierGroupLayout.map_rows``, the very table the runtime's traced
+  interval lookup is built from, so the counters mirror the routing by
+  construction.  On flat layers the DCN keys report zero traffic and a
+  ratio of 1.0.  The three registered gauges ``exchange.dcn_rows`` /
+  ``exchange.ici_rows`` / ``exchange.dcn_dedup_ratio`` are set when the
+  registry is armed.
+
   ``hot_sets`` defaults to the plan's own
   (``dist.plan.hot_sets``); pass ``{}`` to compute the off-path
   counters for a cache-less layer.
@@ -486,6 +510,72 @@ def measure_exchange_counters(dist, cats,
     sent_off_per_src[src] += m * n_valid
     sent_on_per_src[src] += m * blk_uniq_cold[(inp, src)]
 
+  # hierarchical DCN leg counters (design §20): what crosses the
+  # cross-slice wire, with and without the representative's slice-wide
+  # dedup.  The global scalars run the union dedup directly
+  # (unique-of-concat over the slice's arriving stream); the per-slice
+  # lists below rebuild the same quantities from per-source blocks with
+  # set-union arithmetic — two independent computations of one wire,
+  # reconciled like the §19 per-device lists.
+  NS = dist.num_slices
+  hier = (getattr(dist, 'hier', None)
+          if getattr(dist, 'dcn_sharding', False) else None)
+  dcn_on = 0
+  dcn_off = 0
+  dcn_on_per_slice = np.zeros((max(NS, 1),), np.int64)
+  dcn_off_per_slice = np.zeros((max(NS, 1),), np.int64)
+  if hier is not None and NS > 1:
+    # (input, src) -> the stream that source block delivers over ICI:
+    # per-source sort-uniqued cold ids on the cache path (what the §10
+    # exchange ships), raw valid occurrences otherwise
+    arriving: Dict[tuple, np.ndarray] = {}
+
+    def _arriving(inp: int, src: int) -> np.ndarray:
+      key = (inp, src)
+      if key not in arriving:
+        tid = plan.input_table_map[inp]
+        vocab = plan.table_configs[tid].input_dim
+        x2 = cats[inp].reshape(batch, -1)
+        blk = x2[src * local_batch:(src + 1) * local_batch].reshape(-1)
+        v = _clip_valid(blk, vocab)
+        if tid in hot_ids:
+          v = np.unique(v[~np.isin(v, hot_ids[tid])])
+        arriving[key] = v
+      return arriving[key]
+
+    def _route(r, ids: np.ndarray) -> np.ndarray:
+      if r.row_stride > 1:
+        mine = ids[(ids % r.row_stride) == r.row_start]
+        return r.row_offset + (mine - r.row_start) // r.row_stride
+      mine = ids[(ids >= r.row_start) & (ids < r.row_end)]
+      return r.row_offset + mine - r.row_start
+
+    for sub in subs:
+      hl = dist.hier.groups[sub.gi]
+      for dev in range(D):
+        for r in sub.requests[dev]:
+          for s0 in range(NS):
+            # GLOBAL path: concatenate the slice's arriving blocks,
+            # route once, unique once
+            occ = np.concatenate(
+                [_arriving(r.input_id, s0 * D + j) for j in range(D)]
+            ) if D else np.zeros((0,), np.int64)
+            rows = _route(r, occ)
+            owner_s, _ = hl.map_rows(dev, rows)
+            off_slice = rows[owner_s != s0]
+            dcn_off += int(off_slice.size)
+            dcn_on += int(np.unique(off_slice).size)
+            # PER-SLICE path: each source block routed on its own,
+            # occurrence counts summed per block, dedup via set union
+            uniq_set: set = set()
+            for j in range(D):
+              rows_j = _route(r, _arriving(r.input_id, s0 * D + j))
+              owner_j, _ = hl.map_rows(dev, rows_j)
+              off_j = rows_j[owner_j != s0]
+              dcn_off_per_slice[s0] += int(off_j.size)
+              uniq_set.update(int(x) for x in off_j)
+            dcn_on_per_slice[s0] += len(uniq_set)
+
   # reconciliation invariant (design §19): the per-device breakdowns
   # were accumulated on an independent path from the global scalars —
   # they MUST sum back to them, or the artifact would journal two
@@ -497,6 +587,10 @@ def measure_exchange_counters(dist, cats,
       ('total_id_occurrences', int(valid_per_src.sum()),
        int(total_valid)),
       ('hot_occurrences', int(hot_per_src.sum()), int(total_hot)),
+      # §20 DCN wire: per-source-slice set-union view vs the global
+      # concatenated-union view
+      ('dcn_rows', int(dcn_on_per_slice.sum()), int(dcn_on)),
+      ('dcn_rows_off', int(dcn_off_per_slice.sum()), int(dcn_off)),
   )
   bad = [(k, s, g) for k, s, g in recon if s != g]
   if bad:
@@ -509,6 +603,10 @@ def measure_exchange_counters(dist, cats,
                         float(sent_on_per_src.max()) if S else 0.0)
   obs_metrics.set_gauge('exchange.rows_mean',
                         float(sent_on_per_src.mean()) if S else 0.0)
+  dedup_ratio = round(dcn_off / dcn_on, 4) if dcn_on else 1.0
+  obs_metrics.set_gauge('exchange.dcn_rows', float(dcn_on))
+  obs_metrics.set_gauge('exchange.ici_rows', float(sent_on))
+  obs_metrics.set_gauge('exchange.dcn_dedup_ratio', float(dedup_ratio))
 
   return {
       'alltoall_rows_sent_off': int(sent_off),
@@ -535,6 +633,13 @@ def measure_exchange_counters(dist, cats,
                             if S else 0.0,
       'hottest_shard': (f'g{hottest[0][0]}@dev{hottest[0][1]}'
                         if hottest[0] is not None else None),
+      # hierarchical DCNxICI exchange (design §20)
+      'dcn_rows': int(dcn_on),
+      'dcn_rows_off': int(dcn_off),
+      'ici_rows': int(sent_on),
+      'dcn_dedup_ratio': dedup_ratio,
+      'dcn_rows_per_slice': [int(x) for x in dcn_on_per_slice],
+      'dcn_rows_off_per_slice': [int(x) for x in dcn_off_per_slice],
   }
 
 

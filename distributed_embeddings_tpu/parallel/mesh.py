@@ -7,8 +7,17 @@ data-parallel batch sharding and model-parallel table placement (the
 reference likewise equates DP ranks and MP ranks,
 dist_model_parallel.py:348-349) — or, for multi-slice topologies, a
 two-axis ``('dcn', 'data')`` mesh (``create_mesh((slices, chips))``)
-where tables shard over the inner ICI axis, replicate across slices,
-and the batch data-parallelises over the product.
+where tables shard over the inner ICI axis and either replicate across
+slices (the default) or, with
+``DistributedEmbedding(dcn_sharding=True)``, shard over the AXIS
+PRODUCT via the hierarchical two-level exchange (docs/design.md §20);
+the batch data-parallelises over the product either way.
+
+Each mesh axis carries link metadata (``axis_link`` /
+``mesh_link_info``): the outer axis crosses the slow data-center
+network, the inner one rides intra-slice ICI.  The planner's per-axis
+cost model (``planner.ExchangeCostModel``) and the hierarchical
+exchange both key off this distinction.
 """
 
 from __future__ import annotations
@@ -24,6 +33,32 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DEFAULT_AXIS = 'data'
 DCN_AXIS = 'dcn'
 
+# Link kinds a mesh axis can ride (per-axis metadata, design §20): the
+# inner axis of a two-axis mesh is intra-slice ICI, the outer one the
+# data-center network.  Relative per-byte cost lives in the planner's
+# configurable-and-journaled ExchangeCostModel; these names only say
+# WHICH wire an axis crosses.
+LINK_ICI = 'ici'
+LINK_DCN = 'dcn'
+
+
+def axis_link(mesh: Mesh, axis_name: str) -> str:
+  """Link kind of one mesh axis: the OUTER axis of a multi-axis mesh
+  crosses DCN, every other axis (and the single axis of a flat mesh)
+  rides ICI."""
+  names = tuple(mesh.axis_names)
+  if axis_name not in names:
+    raise ValueError(f'axis {axis_name!r} not in mesh axes {names}')
+  if len(names) > 1 and axis_name == names[0]:
+    return LINK_DCN
+  return LINK_ICI
+
+
+def mesh_link_info(mesh: Mesh) -> dict:
+  """``{axis_name: link_kind}`` for every axis — the per-axis link
+  metadata the hierarchical planner and devprof segmentation consume."""
+  return {a: axis_link(mesh, a) for a in mesh.axis_names}
+
 
 def create_mesh(devices: Optional[Sequence] = None,
                 axis_name: str = DEFAULT_AXIS,
@@ -33,9 +68,12 @@ def create_mesh(devices: Optional[Sequence] = None,
   multi-slice topologies: the OUTER axis spans slices (traffic crosses
   DCN), the INNER axis spans a slice's chips (traffic rides ICI).  The
   runtime places tables on the inner axis — every all_to_all/psum_scatter
-  stays intra-slice — replicates them across the outer axis, and
-  data-parallelises the batch over the product (the cross-slice exchange
-  is the once-per-step update-stream gather, see parallel/sparse.py).
+  stays intra-slice — and by default replicates them across the outer
+  axis (the cross-slice exchange is the once-per-step update-stream
+  gather, see parallel/sparse.py); ``dcn_sharding=True`` layers shard
+  tables over the AXIS PRODUCT instead, deduplicating within each slice
+  before any row crosses DCN (docs/design.md §20).  The batch
+  data-parallelises over the product either way.
   Device order follows ``jax.devices()``, which enumerates slice-major on
   multi-slice TPU deployments; pass an explicit ``[S, D]`` device array
   to override.

@@ -100,7 +100,8 @@ def a2a_overlap_stats(off_ms: float, on_ms: float, exchange_ms: float,
 
 
 def build_exchange_program(dist, cats, chunks: Optional[int] = None,
-                           rows_only: bool = False):
+                           rows_only: bool = False,
+                           dcn_leg: bool = True):
   """The jitted exchange-only program: ``(fn, inputs)``.
 
   ``fn(*inputs)`` runs exactly the chunked id exchange and the
@@ -117,6 +118,17 @@ def build_exchange_program(dist, cats, chunks: Optional[int] = None,
   width-``w`` f32 row leg ships (one ``all_to_all`` per chunk per
   subgroup, the shape of the cotangent exchange in ``_build_backward``)
   with no id leg — the ``dev/bwd/exchange`` phase.
+
+  ``dist.dcn_sharding`` layers append the hierarchical DCN leg per
+  chunk (design §20): the intra-slice ICI pair above, then the
+  cross-slice ``all_to_all`` over the ``dcn`` axis shipping the
+  slice-deduplicated id stream out and the fused f32 rows back — the
+  exact collective shapes of ``_hier_fetch_unique``.  The devprof
+  lane segmentation keys off the axis each collective rides, so the
+  dcn/ici split of this program is what ``trace_report`` attributes:
+  ``dcn_leg=False`` builds the ICI-ONLY twin (the flat exchange shape
+  on the same hierarchical layer), and the devprof ``dcn`` lane is
+  the synced-wall difference of the two programs.
   """
   import jax
   import jax.numpy as jnp
@@ -134,6 +146,10 @@ def build_exchange_program(dist, cats, chunks: Optional[int] = None,
   local_batch = slice_batch // D
   subs = dist._subgroups(hotness)
   req = dist.overlap_chunks if chunks is None else int(chunks)
+
+  S = dist.num_slices
+  hier_dcn = (bool(getattr(dist, 'dcn_sharding', False)) and S > 1
+              and dcn_leg)
 
   def local_fn(*inputs):
     total = jnp.zeros((), jnp.float32)
@@ -163,11 +179,32 @@ def build_exchange_program(dist, cats, chunks: Optional[int] = None,
               (D, hi - lo, local_batch, w))
           if D > 1:
             rows = jax.lax.all_to_all(rows, dist.axis_name, 0, 0)
+          if hier_dcn:
+            # hierarchical backward: the deduplicated gradient-row
+            # stream crosses DCN to the owners (the apply exchange
+            # shape of _build_sparse_apply's hier branch)
+            hrows = jnp.broadcast_to(
+                rows[None, 0], (S,) + rows.shape[1:])
+            hrows = jax.lax.all_to_all(hrows, dist.dcn_axis, 0, 0)
+            total = total + jnp.sum(hrows)
           total = total + jnp.sum(rows)
           continue
         recv = (jax.lax.all_to_all(part, dist.axis_name, 0, 0)
                 if D > 1 else part)
         ids = recv.transpose(1, 0, 2, 3).reshape(hi - lo, slice_batch, h)
+        if hier_dcn:
+          # DCN leg (design §20): slice-deduplicated ids out, fused
+          # f32 rows back — the _hier_fetch_unique collective pair,
+          # riding the OUTER (dcn) axis so devprof segments it apart
+          # from the ICI pair above
+          hsend = jnp.broadcast_to(ids[None, :, :, 0],
+                                   (S, hi - lo, slice_batch))
+          hrecv = jax.lax.all_to_all(hsend, dist.dcn_axis, 0, 0)
+          hrows = jnp.broadcast_to(
+              hrecv[..., None].astype(jnp.float32),
+              (S, hi - lo, slice_batch, w))
+          hback = jax.lax.all_to_all(hrows, dist.dcn_axis, 0, 0)
+          total = total + jnp.sum(hback)
         # return leg: the received ids broadcast to the row width —
         # real data-dependent bytes, so the collective cannot fold away
         rows = jnp.broadcast_to(

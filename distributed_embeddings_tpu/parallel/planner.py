@@ -1146,3 +1146,269 @@ class ShardingPlan:
     lines.append(f'  elements/device: min={min(mem)} max={max(mem)} '
                  f'padded={self.padded_memory_elements()}')
     return '\n'.join(lines)
+
+
+# ---------------------------------------------------------------------------
+# hierarchical (dcn x ici) layout: pod-scale placement over the axis product
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class HierGroupLayout:
+  """Hierarchical placement of one fusion group over the ``(dcn, data)``
+  axis PRODUCT (docs/design.md §20).
+
+  The layout is derived FROM the flat D-device plan, never planned
+  independently: flat device ``d``'s fused rows are split S ways into
+  contiguous per-member sub-windows (first-windows-bigger remainder
+  rule, the same as ``overlap.chunk_bounds``), and hierarchical device
+  ``(s, d)`` stores, in member order, the ``s``-th sub-window of every
+  member table flat device ``d`` holds.  Deriving from the flat plan is
+  load-bearing for bit-exactness: every flat fused row maps to exactly
+  one hierarchical ``(slice, local row)`` and the multi-hot combine
+  still sums occurrence rows in the flat slot order, so the hierarchical
+  forward/backward reproduce the flat numerics bit for bit
+  (tests/test_hierarchical_exchange.py pins it).
+
+  Attributes:
+    gi: fusion-group index in ``plan.groups``.
+    num_slices: S, the ``dcn`` axis size.
+    rows_h: ``[S][D]`` resident row counts of hierarchical device
+      ``(s, d)`` (before ``rows_cap_h`` padding).
+    rows_cap_h: padded per-device row capacity over all ``(s, d)``
+      shards (multiple of 8; the hierarchical row sentinel).
+    cut_lo / cut_slice / cut_hier: ``[D, K]`` int32 interval tables
+      (K = max member count x S, tail padded with ``rows_cap + 1``):
+      flat-local row ``r`` of flat device ``d`` falls in interval
+      ``k = searchsorted(cut_lo[d], r, 'right') - 1`` and lives on
+      slice ``cut_slice[d, k]`` at local row
+      ``r - cut_lo[d, k] + cut_hier[d, k]``.  Zero-width sub-windows
+      are safe by construction: at a tied ``lo`` the LAST entry wins
+      under the right-searchsorted convention, and the last entry at
+      any valid row's ``lo`` always has nonzero width.
+    flat_ranges: ``[S][D]`` lists of ``(flat_lo, size)`` member-order
+      windows — hierarchical shard ``(s, d)`` is the concatenation of
+      ``flat[d, lo:lo+size]`` over its list (the exact row permutation
+      ``hierarchical_params`` and the parity tests use).
+    sub_windows: ``[S][D]`` lists of ``(start, size)`` member-LOCAL
+      windows aligned with ``plan.groups[gi].member_tables[d]`` — the
+      init path draws each flat member in full and slices this window,
+      so hierarchical init is bit-identical to resharded flat init.
+  """
+  gi: int
+  num_slices: int
+  rows_h: List[List[int]]
+  rows_cap_h: int
+  cut_lo: np.ndarray
+  cut_slice: np.ndarray
+  cut_hier: np.ndarray
+  flat_ranges: List[List[List[Tuple[int, int]]]]
+  sub_windows: List[List[List[Tuple[int, int]]]]
+
+  def map_rows(self, dev: int, rows) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side twin of the traced interval mapping: flat-local fused
+    rows of flat device ``dev`` -> ``(owner_slice, hier_local_row)``,
+    exact NumPy (the init hot-buffer gather and the hotcache DCN
+    counters both use it, so the counters mirror the runtime's routing
+    arithmetic by construction)."""
+    rows = np.asarray(rows, np.int64)
+    lo = self.cut_lo[dev].astype(np.int64)
+    k = np.clip(np.searchsorted(lo, rows, side='right') - 1,
+                0, lo.size - 1)
+    return (self.cut_slice[dev][k].astype(np.int64),
+            rows - lo[k] + self.cut_hier[dev][k].astype(np.int64))
+
+
+@dataclasses.dataclass
+class HierLayout:
+  """Per-group hierarchical layouts of one plan (``hierarchical_layout``)."""
+  num_slices: int
+  world_size: int
+  groups: List[HierGroupLayout]
+
+  def fingerprint_material(self) -> str:
+    return json.dumps([
+        self.num_slices, self.world_size,
+        [[g.rows_h, g.rows_cap_h] for g in self.groups],
+    ])
+
+
+def hierarchical_layout(plan: 'ShardingPlan',
+                        num_slices: int) -> HierLayout:
+  """Derive the hierarchical ``(dcn, data)``-product placement from a
+  flat plan: each flat device's fused rows split S ways into contiguous
+  per-member sub-windows (first-windows-bigger), one sub-window set per
+  slice (docs/design.md §20).
+
+  Requires natural (pack=1) storage — the packed lane fold changes the
+  f32 reduction association across pack-group boundaries, so a packed
+  hierarchical gather could not stay bit-exact vs the flat path — and
+  contiguous (non-mod) row windows.
+  """
+  S = int(num_slices)
+  if S <= 1:
+    raise ValueError(f'hierarchical_layout needs num_slices > 1, got {S}')
+  if plan.mod_sharding:
+    raise ValueError('hierarchical_layout does not support mod_sharding '
+                     '(strided windows cannot split into contiguous '
+                     'per-slice sub-windows)')
+  D = plan.world_size
+  groups = []
+  for gi, g in enumerate(plan.groups):
+    if g.storage_pack != 1:
+      raise ValueError(
+          f'hierarchical_layout needs natural (pack=1) storage, group '
+          f'{g.key} packs {g.storage_pack} rows/lane-row: build the plan '
+          f'with packed_storage=False')
+    rows_h = [[0] * D for _ in range(S)]
+    flat_ranges = [[[] for _ in range(D)] for _ in range(S)]
+    sub_windows = [[[] for _ in range(D)] for _ in range(S)]
+    K = max(S * max((len(g.member_tables[d]) for d in range(D)),
+                    default=0), 1)
+    cut_lo = np.full((D, K), g.rows_cap + 1, np.int32)
+    cut_slice = np.zeros((D, K), np.int32)
+    cut_hier = np.zeros((D, K), np.int32)
+    for d in range(D):
+      flat_off = 0
+      hier_off = [0] * S
+      k = 0
+      for lt in g.member_tables[d]:
+        rows = lt.input_dim
+        base, rem = divmod(rows, S)
+        for s in range(S):
+          start = s * base + min(s, rem)
+          size = base + (1 if s < rem else 0)
+          cut_lo[d, k] = flat_off + start
+          cut_slice[d, k] = s
+          cut_hier[d, k] = hier_off[s]
+          k += 1
+          flat_ranges[s][d].append((flat_off + start, size))
+          sub_windows[s][d].append((start, size))
+          rows_h[s][d] += size
+          hier_off[s] += size
+        flat_off += rows
+    max_rows = max((r for per in rows_h for r in per), default=0)
+    rows_cap_h = max(8, _round_up(max(max_rows, 1), 8))
+    groups.append(
+        HierGroupLayout(gi=gi, num_slices=S, rows_h=rows_h,
+                        rows_cap_h=rows_cap_h, cut_lo=cut_lo,
+                        cut_slice=cut_slice, cut_hier=cut_hier,
+                        flat_ranges=flat_ranges, sub_windows=sub_windows))
+  return HierLayout(num_slices=S, world_size=D, groups=groups)
+
+
+# ---------------------------------------------------------------------------
+# per-axis exchange cost model: dcn_bytes priced separately from ici_bytes
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ExchangeCostModel:
+  """Per-axis link-rate model for pricing the dp<->mp exchange.
+
+  Before this, priced claims in perf_notes used ONE link rate for every
+  exchanged byte; a DCN byte is ~an order of magnitude slower than an
+  ICI byte, so a flat rate silently undercosts pod-scale plans.  The
+  ratio is CONFIGURABLE and JOURNALED (``journal()``, event
+  ``exchange_cost_model``) so every priced claim names its assumption.
+
+  Attributes:
+    ici_gbps: per-device ICI injection bandwidth, GB/s.
+    dcn_ici_ratio: how many times slower a DCN byte is than an ICI
+      byte (DCN rate = ``ici_gbps / dcn_ici_ratio``).
+  """
+  ici_gbps: float = 100.0
+  dcn_ici_ratio: float = 10.0
+
+  def __post_init__(self):
+    if self.ici_gbps <= 0 or self.dcn_ici_ratio < 1:
+      raise ValueError(
+          f'ExchangeCostModel needs ici_gbps > 0 and dcn_ici_ratio >= 1, '
+          f'got {self.ici_gbps} / {self.dcn_ici_ratio}')
+
+  @property
+  def dcn_gbps(self) -> float:
+    return self.ici_gbps / self.dcn_ici_ratio
+
+  def cost_us(self, ici_bytes: int, dcn_bytes: int) -> float:
+    """Wire microseconds for the given per-device byte split."""
+    return (ici_bytes / self.ici_gbps + dcn_bytes / self.dcn_gbps) / 1e3
+
+  def journal(self, **fields):
+    """Journal the model's assumption next to whatever it priced."""
+    from distributed_embeddings_tpu.utils import resilience
+    return resilience.journal('exchange_cost_model',
+                              ici_gbps=self.ici_gbps,
+                              dcn_ici_ratio=self.dcn_ici_ratio,
+                              dcn_gbps=self.dcn_gbps, **fields)
+
+
+def exchange_bytes(plan: 'ShardingPlan', global_batch: int,
+                   hotness: Sequence[int], num_slices: int = 1,
+                   hierarchical: bool = False,
+                   itemsize: int = 4) -> Dict[str, int]:
+  """Static per-device exchange capacity bytes, split per axis.
+
+  Prices the STATIC buffers the collectives actually ship (all_to_all
+  moves the padded capacity whatever the valid-id count; the dynamic
+  valid-row counters live in ``hotcache.measure_exchange_counters``):
+
+  - ``ici_bytes``: the intra-slice dp<->mp id + row legs (identical for
+    flat and hierarchical placement — the hierarchy changes what
+    crosses DCN, not the ICI exchange).
+  - ``dcn_bytes``: flat pays the sparse-apply update-stream all_gather
+    across slices; hierarchical pays the per-slot deduplicated id/row
+    all_to_alls plus its (identically shaped) apply exchange.
+
+  Capacities are per-request upper bounds (per-slot unique caps), so a
+  priced claim is conservative; ``num_slices == 1`` has zero DCN bytes
+  on either path.
+  """
+  D = plan.world_size
+  S = max(1, int(num_slices))
+  slice_batch = global_batch // S
+  ici = 0
+  dcn = 0
+  for g in plan.groups:
+    w = g.width
+    n_req = 0
+    occ = 0   # id occurrences arriving at owners, summed over slots
+    for dev in range(D):
+      for r in g.requests[dev]:
+        h = hotness[r.input_id]
+        n_req += 1
+        occ += slice_batch * h
+        # ICI legs: ids out (int32) + combined rows back, per slot
+        ici += slice_batch * h * 4 + slice_batch * w * itemsize
+    if S > 1:
+      if hierarchical:
+        # per-slot dedup caps the DCN id leg at the slot's occurrence
+        # count; fused rows return at width w (f32 when dequantized)
+        dcn += occ * 4 + occ * w * itemsize
+      # sparse-apply update stream crosses DCN on both paths: each
+      # device receives (S-1) foreign compacted streams of up to
+      # rows_cap + 2 rows x (id + w grad columns)
+      pcap = min(occ, g.rows_cap + 2)
+      dcn += (S - 1) * pcap * (1 + w) * 4
+  return {'ici_bytes': int(ici), 'dcn_bytes': int(dcn)}
+
+
+def price_exchange(plan: 'ShardingPlan', global_batch: int,
+                   hotness: Sequence[int], num_slices: int = 1,
+                   hierarchical: bool = False,
+                   model: Optional[ExchangeCostModel] = None,
+                   journal: bool = True) -> Dict[str, Any]:
+  """Price one step's exchange under the per-axis model and (by
+  default) journal the assumption alongside the priced split."""
+  model = model or ExchangeCostModel()
+  split = exchange_bytes(plan, global_batch, hotness,
+                         num_slices=num_slices, hierarchical=hierarchical)
+  out = dict(split)
+  out['exchange_cost_us'] = round(
+      model.cost_us(split['ici_bytes'], split['dcn_bytes']), 3)
+  out['hierarchical'] = bool(hierarchical)
+  if journal:
+    # model.journal supplies the rate/ratio fields itself
+    model.journal(**out)
+  out['dcn_ici_ratio'] = model.dcn_ici_ratio
+  return out

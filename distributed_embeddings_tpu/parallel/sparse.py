@@ -56,7 +56,8 @@ def compact_segments(ids: jax.Array,
                      sentinel: int,
                      with_sq: bool = False,
                      order: Optional[jax.Array] = None,
-                     g_index: Optional[jax.Array] = None):
+                     g_index: Optional[jax.Array] = None,
+                     max_seg: Optional[int] = None):
   """Sort-dedup and COMPACT segment sums into static capacity ``cap``.
 
   The key fact motivating this (measured on v5e, docs/perf_notes.md):
@@ -91,6 +92,16 @@ def compact_segments(ids: jax.Array,
       broadcasts never materialise — the sorted payload gathers
       straight from the compact rows (same contract as
       ``pallas_segwalk.segwalk_apply``).
+    max_seg: optional static bound on non-sentinel segment length.
+      When given, segment totals use an EXACT unrolled left fold over
+      at most ``max_seg`` positions instead of the cumsum-difference
+      trick: the cumsum trick folds the running prefix into every
+      total (``(P + g1 + g2) - P != g1 + g2`` in f32), so a row's sum
+      depends on unrelated neighbours in the sorted stream — which
+      breaks flat-vs-hierarchical bit-parity for the cross-slice
+      update merge, where each row appears at most once per slice
+      (design §20).  The sentinel segment may exceed the bound; its
+      (garbage) total is dropped with the segment as always.
 
   Returns:
     ``(uids[c], sum_g[c, w], sum_sq[c, w] | None, num_unique)`` with
@@ -124,6 +135,18 @@ def compact_segments(ids: jax.Array,
   # temporaries halve and one n-row random gather disappears.
   fp = first_pos[order2]                             # [cap]
 
+  if max_seg is not None:
+    # exact bounded-multiplicity totals (see Args): complete at each
+    # segment's last position, which is exactly what order2 selects
+    sum_g = jnp.where(valid[:, None],
+                      _seg_fold_bounded(sg, first_pos, max_seg)[order2],
+                      0.0)
+    sum_sq = (jnp.where(
+        valid[:, None],
+        _seg_fold_bounded(sg * sg, first_pos, max_seg)[order2], 0.0)
+              if with_sq else None)
+    return uids, sum_g, sum_sq, num_unique
+
   def seg_tot(csum):
     hi = csum[order2]
     lo = jnp.where((fp > 0)[:, None], csum[jnp.maximum(fp - 1, 0)], 0.0)
@@ -132,6 +155,24 @@ def compact_segments(ids: jax.Array,
   sum_g = seg_tot(jnp.cumsum(sg, axis=0))
   sum_sq = seg_tot(jnp.cumsum(sg * sg, axis=0)) if with_sq else None
   return uids, sum_g, sum_sq, num_unique
+
+
+def _seg_fold_bounded(x: jax.Array, first_pos: jax.Array,
+                      max_seg: int) -> jax.Array:
+  """Per-position left-fold segment totals over SORTED payload ``x``
+  for streams whose (non-sentinel) segments are at most ``max_seg``
+  long: ``tot[p] = ((x[fp] + x[fp+1]) + ...) + x[p]`` — the same f32
+  association wherever the segment lands, with NO dependence on the
+  rest of the stream.  ``max_seg - 1`` vectorised shift-add passes
+  (the cross-slice merge has ``max_seg = num_slices``, a handful).
+  Totals are complete at each segment's LAST position; earlier
+  positions hold the partial prefix folds."""
+  off = (jnp.arange(x.shape[0], dtype=jnp.int32) - first_pos)
+  tot = x
+  for k in range(1, max_seg):
+    prev = jnp.concatenate([jnp.zeros_like(tot[:1]), tot[:-1]], axis=0)
+    tot = jnp.where((off == k)[:, None], prev + x, tot)
+  return tot
 
 
 def _sorted_segments(sid: jax.Array):
@@ -172,6 +213,24 @@ def dedup_rows(ids: jax.Array, grads: jax.Array,
   _, is_last, _, seg_total = _sorted_segments(sid)
   uids = jnp.where(is_last, sid, sentinel)
   return uids, seg_total(sg)
+
+
+def _rounded_square(x: jax.Array) -> jax.Array:
+  """``x * x`` forced to a ROUNDED product.
+
+  XLA's backend emitters may contract ``acc + x*x`` into an FMA — or
+  not — depending on how the surrounding ops fuse, so the SAME update
+  stream can yield accumulators differing by 1 ulp between the flat
+  and hierarchical layouts of one table (observed on CPU; breaks
+  design §20's applied-update bit-parity contract).  The select below
+  severs the mul->add contraction pattern at codegen level — neither
+  ``optimization_barrier`` nor ``reduce_precision`` does, since
+  contraction happens in the emitter, which sees through both.  The
+  ``x == x`` predicate is false only for NaN, where the taken branch
+  is NaN too, so the function is value-identical to ``x * x``.
+  """
+  sq = x * x
+  return jnp.where(x == x, sq, jnp.asarray(jnp.nan, x.dtype))
 
 
 def _distinct_oob(uids: jax.Array, limit: int) -> jax.Array:
@@ -376,7 +435,10 @@ class SparseAdagrad:
     pass per step (~143 ms each at synthetic-tiny scale, trace in
     docs/perf_notes.md).
     """
-    add = sum_g * sum_g if self.dedup else sum_sq
+    # _rounded_square: pins `acc + g*g` to mul-then-add rounding so the
+    # accumulator is layout-independent (design §20 bit-parity; the
+    # compacted operand is small, so the severed fusion costs nothing)
+    add = _rounded_square(sum_g) if self.dedup else sum_sq
     safe = jnp.clip(uids, 0, limit - 1)
     # compacted ids are ascending; _distinct_oob makes them strictly
     # unique (clipped sentinel gathers may duplicate the last row, hence
@@ -414,7 +476,8 @@ class SparseAdagrad:
     they are bit-preserved (incl. bf16 accumulator stores: the f32
     up-cast/round-trip of a bf16 value is exact)."""
     del count
-    add = sum_g * sum_g if self.dedup else sum_sq
+    # same FMA-contraction pinning as row_updates (design §20)
+    add = _rounded_square(sum_g) if self.dedup else sum_sq
     acc_rows = state['acc'].astype(jnp.float32) + add
     update = (-lr * sum_g * jax.lax.rsqrt(acc_rows + self.epsilon)).astype(
         hot.dtype)
@@ -624,7 +687,8 @@ class _QuantizedTableOptimizer:
     return (npay, nscale), state2
 
 
-def _lane_pack(uids, sum_g, sum_sq, pack: int, rows_cap: int):
+def _lane_pack(uids, sum_g, sum_sq, pack: int, rows_cap: int,
+               exact: bool = False):
   """Re-compact per-row updates at packed-row granularity.
 
   View the ``[rows_cap, w]`` table as ``[rows_cap // pack, pack * w]``
@@ -635,6 +699,15 @@ def _lane_pack(uids, sum_g, sum_sq, pack: int, rows_cap: int):
   updates that is another ``pack``-fold shrink on top of the unique-row
   compaction (e.g. synthetic-tiny's 31 small tables: 60k unique rows ->
   3.8k packed rows at width 8).
+
+  ``exact``: merge lanes with the bounded exact fold instead of the
+  cumsum-difference trick.  The lanes of one packed row are DISJOINT,
+  so the true merge is pure placement — but the cumsum trick folds the
+  running prefix of a lane COLUMN (other packed rows' lanes) into each
+  total, making the result depend on which rows share the stream.
+  The parity-critical cross-slice merge (design §20) needs
+  layout-independent totals: a pid segment holds at most ``pack``
+  unique rows, so the fold bound is ``pack``.
 
   Returns ``(pids, g_packed, sq_packed)`` sized
   ``min(len(uids), rows_cap // pack + 2)``.
@@ -653,7 +726,8 @@ def _lane_pack(uids, sum_g, sum_sq, pack: int, rows_cap: int):
   # compact_segments, so pids is already sorted: skip the argsort
   pids_c, pay_c, _, _ = compact_segments(
       pids, payload, cap2, psent,
-      order=jnp.arange(c, dtype=jnp.int32))
+      order=jnp.arange(c, dtype=jnp.int32),
+      max_seg=pack if exact else None)
   g_packed = pay_c[:, :lanes]
   sq_packed = pay_c[:, lanes:] if sum_sq is not None else None
   return pids_c, g_packed, sq_packed
@@ -706,7 +780,7 @@ def _apply_unique_chunked(optimizer, table, state, uids, sum_g, sum_sq,
 def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
                      rows_cap: int, cap_rows: Optional[int] = None,
                      flat_sq=None, storage_pack: int = 1, g_index=None,
-                     n_chunks: int = 1):
+                     n_chunks: int = 1, max_seg: Optional[int] = None):
   """Compact duplicate update rows, then run the optimizer on the unique
   rows only.
 
@@ -789,7 +863,8 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
           for k, v in state.items()}
     t2, s2 = _dedup_and_apply(optimizer, tn, sn, flat_ids, flat_g, lr,
                               rows_cap, cap_rows=cap_rows, flat_sq=flat_sq,
-                              g_index=g_index, n_chunks=n_chunks)
+                              g_index=g_index, n_chunks=n_chunks,
+                              max_seg=max_seg)
     return t2.reshape(packed_shape), {
         k: (v.reshape(packed_shape) if v.shape == (rows_cap, w) else v)
         for k, v in s2.items()
@@ -814,19 +889,21 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
         [flat_g.astype(jnp.float32),
          flat_sq.astype(jnp.float32)], axis=1)
     uids, tot, _, num_unique = compact_segments(
-        flat_ids, payload, cap, sentinel, order=order)
+        flat_ids, payload, cap, sentinel, order=order, max_seg=max_seg)
     sum_g, sum_sq = tot[:, :w], tot[:, w:]
   else:
     uids, sum_g, sum_sq, num_unique = compact_segments(
         flat_ids, flat_g, cap, sentinel, with_sq=with_sq, order=order,
-        g_index=g_index)
+        g_index=g_index, max_seg=max_seg)
   if storage_packed:
     # updates lane-pack against the physically packed operand directly
-    pids, g_p, sq_p = _lane_pack(uids, sum_g, sum_sq, pack, rows_cap)
+    pids, g_p, sq_p = _lane_pack(uids, sum_g, sum_sq, pack, rows_cap,
+                                 exact=max_seg is not None)
     t2, s2 = _apply_unique_chunked(optimizer, table, state, pids, g_p,
                                    sq_p, lr, n_chunks)
   elif packable:
-    pids, g_p, sq_p = _lane_pack(uids, sum_g, sum_sq, pack, rows_cap)
+    pids, g_p, sq_p = _lane_pack(uids, sum_g, sum_sq, pack, rows_cap,
+                                 exact=max_seg is not None)
     ptable = table.reshape(rows_cap // pack, pack * w)
     pstate = {
         k: v.reshape(rows_cap // pack, pack * w) for k, v in state.items()
@@ -850,7 +927,11 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
     sid = flat_ids[order]
     sg = (flat_g[order] if g_index is None else
           flat_g[jnp.take(g_index, order)]).astype(jnp.float32)
-    is_first, is_last, _, seg_total = _sorted_segments(sid)
+    is_first, is_last, first_pos_c, seg_total = _sorted_segments(sid)
+    if max_seg is not None:
+      # the bounded exact fold of the main wave (layout-independent
+      # totals, design §20) — the correction must sum identically
+      seg_total = lambda x: _seg_fold_bounded(x, first_pos_c, max_seg)
     rank = jnp.cumsum(is_first.astype(jnp.int32)) - 1
     keep = is_last & (rank >= cap)
     key2 = jnp.where(keep, rank, n)
@@ -868,7 +949,8 @@ def _dedup_and_apply(optimizer, table, state, flat_ids, flat_g, lr,
       # correction rows lane-pack too (uids2 is ascending-with-sentinels
       # like the main wave's compacted buffer, so _lane_pack's
       # sorted-pids shortcut holds)
-      pids2, g_p2, sq_p2 = _lane_pack(uids2, tot_g, tot_sq, pack, rows_cap)
+      pids2, g_p2, sq_p2 = _lane_pack(uids2, tot_g, tot_sq, pack, rows_cap,
+                                      exact=max_seg is not None)
       return optimizer.apply_unique(t3, s3, pids2, g_p2, sq_p2, lr)
     return optimizer.apply_unique(t3, s3, uids2, tot_g, tot_sq, lr)
 
@@ -1040,6 +1122,12 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
   tiered = set(getattr(dist.plan, 'cold_tier_groups', []))
   opt_q = (_QuantizedTableOptimizer(optimizer, quant)
            if quant is not None else optimizer)
+  # hierarchical (dcn x ici) placement (design §20): tables shard over
+  # the axis PRODUCT, so the cross-slice leg becomes an all_to_all of
+  # per-owner hier-row streams instead of the replicated all_gather —
+  # each deduplicated row's update crosses DCN once, to its one owner
+  # (slice, device) cell, and only that cell applies it.
+  hier = dist.hier if getattr(dist, 'dcn_sharding', False) else None
 
   def local_fn(params, opt_state, lr, fetch, *res_and_g):
     residuals = res_and_g[:len(subs)]
@@ -1052,6 +1140,12 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
     for gi, group in enumerate(dist.plan.groups):
       ids_list, grad_list, gidx_list = [], [], []
       rows_cap = group.rows_cap
+      # hier: downstream applies run in the OWNER's hier-local row
+      # space ([rows_cap_h, w] shards, sentinel rows_cap_h); the
+      # pre-compaction above stays in flat fused space (sentinel
+      # rows_cap), exactly like the flat path
+      rows_cap_apply = (hier.groups[gi].rows_cap_h if hier is not None
+                        else rows_cap)
       w = group.width
       slots = [(si, sub) for si, sub in enumerate(subs) if sub.gi == gi]
       if not slots:
@@ -1149,16 +1243,55 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
         uids_s, sum_g_s, sum_sq_s, _ = compact_segments(
             flat_ids, g_rows, pcap, rows_cap,
             with_sq=needs_sq and not cached, g_index=g_idx)
-        # ONE DCN collective per group: ids ride as a bitcast f32
-        # column alongside the grad (and square) payload
-        packed = [
-            jax.lax.bitcast_convert_type(uids_s, jnp.float32)[:, None],
-            sum_g_s
-        ]
-        if needs_sq and not cached:
-          packed.append(sum_sq_s)
-        gathered = jax.lax.all_gather(jnp.concatenate(packed, axis=1),
-                                      dist.dcn_axis, axis=0, tiled=True)
+        if hier is not None:
+          # Hierarchical update exchange (design §20): each compacted
+          # row maps through the static interval tables to its owner
+          # (slice, hier row); ONE DCN all_to_all per group ships every
+          # per-slice sum to its owner cell (same inner device index —
+          # pure cross-slice traffic), with non-owned positions at the
+          # hier sentinel so the apply drops them.  The receiver
+          # flattens slice-major, reproducing the flat all_gather's
+          # position order — so per-row segment sums add in the same
+          # sequence and the applied updates stay bit-exact vs flat.
+          hl = hier.groups[gi]
+          S = dist.num_slices
+          cap_h = hl.rows_cap_h
+          me_d = jax.lax.axis_index(ax)
+          cut_lo = jnp.asarray(hl.cut_lo)[me_d]
+          cut_sl = jnp.asarray(hl.cut_slice)[me_d]
+          cut_h = jnp.asarray(hl.cut_hier)[me_d]
+          valid = (uids_s >= 0) & (uids_s < rows_cap)
+          safe = jnp.clip(uids_s, 0, rows_cap - 1)
+          k2 = jnp.clip(
+              jnp.searchsorted(cut_lo, safe, side='right') - 1,
+              0, cut_lo.shape[0] - 1)
+          owner = cut_sl[k2]
+          hrow = safe - cut_lo[k2] + cut_h[k2]
+          dest = jax.lax.broadcasted_iota(jnp.int32,
+                                          (S,) + uids_s.shape, 0)
+          hids = jnp.where(valid[None] & (owner[None] == dest),
+                           hrow[None], cap_h).astype(jnp.int32)
+          packed = [
+              jax.lax.bitcast_convert_type(hids, jnp.float32)[..., None],
+              jnp.broadcast_to(sum_g_s[None], (S,) + sum_g_s.shape)
+          ]
+          if needs_sq and not cached:
+            packed.append(
+                jnp.broadcast_to(sum_sq_s[None], (S,) + sum_sq_s.shape))
+          gathered = jax.lax.all_to_all(
+              jnp.concatenate(packed, axis=2), dist.dcn_axis, 0, 0)
+          gathered = gathered.reshape(-1, gathered.shape[2])
+        else:
+          # ONE DCN collective per group: ids ride as a bitcast f32
+          # column alongside the grad (and square) payload
+          packed = [
+              jax.lax.bitcast_convert_type(uids_s, jnp.float32)[:, None],
+              sum_g_s
+          ]
+          if needs_sq and not cached:
+            packed.append(sum_sq_s)
+          gathered = jax.lax.all_gather(jnp.concatenate(packed, axis=1),
+                                        dist.dcn_axis, axis=0, tiled=True)
         flat_ids = jax.lax.bitcast_convert_type(gathered[:, 0], jnp.int32)
         flat_g = gathered[:, 1:1 + w]
         if needs_sq:
@@ -1178,7 +1311,7 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
         table_op = params[key][0]
         scale_op = (params[f'scale_group_{gi}'][0]
                     if quant is not None else None)
-        rows_eff = rows_cap
+        rows_eff = rows_cap_apply
         res = group.device_rows
         if gi in tiered:
           f = fetch[gi]
@@ -1213,11 +1346,15 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
                                         g_index=g_idx,
                                         n_chunks=n_chunks)
         else:
+          # post-gather merge: each row appears at most once per slice,
+          # so the bounded exact fold keeps the merged totals
+          # layout-independent (flat-vs-hier bit-parity, design §20)
           t2, state2 = _dedup_and_apply(opt_q, operand, state_g,
                                         flat_ids, flat_g, lr, rows_eff,
                                         cap_rows=cap_rows,
                                         flat_sq=flat_sq,
-                                        n_chunks=n_chunks)
+                                        n_chunks=n_chunks,
+                                        max_seg=dist.num_slices)
         pay2, sc2 = t2 if quant is not None else (t2, None)
         if gi in tiered:
           wb = {'payload': pay2[res:][None]}
@@ -1273,13 +1410,18 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
                                            storage_pack=spack,
                                            g_index=g_idx,
                                            n_chunks=n_chunks)
-        else:  # multi-slice: the DCN exchange already compacted
+        else:  # multi-slice: the DCN exchange already compacted; each
+          #       row appears at most once per slice, so the bounded
+          #       exact fold keeps the merged totals layout-independent
+          #       (flat-vs-hier bit-parity, design §20)
           table, state2 = _dedup_and_apply(optimizer, params[key][0],
                                            state_g, flat_ids, flat_g, lr,
-                                           rows_cap, cap_rows=cap_rows,
+                                           rows_cap_apply,
+                                           cap_rows=cap_rows,
                                            flat_sq=flat_sq,
                                            storage_pack=spack,
-                                           n_chunks=n_chunks)
+                                           n_chunks=n_chunks,
+                                           max_seg=dist.num_slices)
       new_params[key] = table[None]
       new_state[key] = {k: v[None] for k, v in state2.items()}
       fence = table[0, 0]
@@ -1347,10 +1489,13 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
     return new_params, new_state, writeback
 
   n_groups = len(dist.plan.groups)
-  param_specs = {f'group_{gi}': P(ax, None, None) for gi in range(n_groups)}
+  # hier: table (and scale / optimizer-state) shards live on the
+  # (dcn, data) axis PRODUCT (design §20)
+  gax = (dist.dcn_axis, ax) if hier is not None else ax
+  param_specs = {f'group_{gi}': P(gax, None, None) for gi in range(n_groups)}
   if quant is not None:
     for gi in range(n_groups):
-      param_specs[f'scale_group_{gi}'] = P(ax, None, None)
+      param_specs[f'scale_group_{gi}'] = P(gax, None, None)
   for gi in hot_gis:
     param_specs[f'hot_group_{gi}'] = P(None, None)
     if quant is not None:
@@ -1366,7 +1511,7 @@ def _build_sparse_apply(dist: DistributedEmbedding, optimizer,
             lambda x: P(*([None] * x.ndim)), leaves)
       else:
         out[k] = jax.tree.map(
-            lambda x: P(ax, *([None] * (x.ndim - 1))), leaves)
+            lambda x: P(gax, *([None] * (x.ndim - 1))), leaves)
     return out
 
   def _fetch_spec(fetch):

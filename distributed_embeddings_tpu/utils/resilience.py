@@ -74,6 +74,10 @@ REGISTERED_EVENTS = frozenset({
     # longitudinal perf sentinel (tools/perf_sentinel.py, design §19):
     # one event per flagged regression with key/delta/baseline sha
     'perf_regression',
+    # hierarchical DCNxICI exchange cost model (parallel/planner.py
+    # ExchangeCostModel, design §20): one event per planning run with
+    # the priced per-axis exchange bytes and the DCN:ICI ratio used
+    'exchange_cost_model',
 })
 
 _lock = threading.Lock()

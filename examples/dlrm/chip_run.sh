@@ -53,6 +53,19 @@ if [ -n "$LATEST_BENCH" ]; then
   python tools/perf_sentinel.py "$LATEST_BENCH" --history . --threshold 15
 fi
 
+# hierarchical DCNxICI A/B (design §20): flat vs dcn_sharding arms over
+# a (2, n/2) two-axis mesh on this backend, one mesh-tagged artifact
+# line carrying both steady-state walls AND the exact dedup counters
+# (dcn_rows / dcn_rows_off / dcn_dedup_ratio) — the journaled evidence
+# that each distinct row crossed DCN once per slice, and the line the
+# perf sentinel bands only against same-mesh history.  Needs an even
+# device count >= 4; a single-chip window skips the row rather than
+# faking a pod topology.
+NDEV=$(python -c 'import jax; print(len(jax.devices()))')
+if [ "$NDEV" -ge 4 ] && [ $((NDEV % 2)) -eq 0 ]; then
+  python bench.py --model tiny --steps 10 --warmup 2 --dcn_ab
+fi
+
 if [ ! -f "$DATA/model_size.json" ]; then
   python examples/dlrm/gen_data.py --data_path "$DATA" \
     --train_rows "$ROWS" --eval_rows 524288 --preset onechip

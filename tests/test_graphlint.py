@@ -102,7 +102,8 @@ def test_donation_proves_all_train_state_leaves_aliased(live):
   every state leaf (params + optimizer + step counter) input-output
   aliased in the compiled executable."""
   don = live.meta['graphlint_donation']
-  assert set(don) == {'train/monolithic', 'train/chunked'}, don
+  assert set(don) == {'train/monolithic', 'train/chunked',
+                      'train/hier-flat-twin', 'train/hierarchical'}, don
   for name, d in don.items():
     assert d['expected'] >= 4, (name, d)   # tables, kernel, accum, step
     assert d['aliased'] == d['expected'], (name, d)

@@ -1,0 +1,253 @@
+"""Hierarchical DCNxICI exchange (design §20): flat-vs-hierarchical
+parity fuzz plus the dedup-at-the-boundary counter contract.
+
+``DistributedEmbedding(dcn_sharding=True)`` shards table placements
+over the (dcn, data) axis PRODUCT and splits the dp<->mp exchange into
+an intra-slice ICI leg and a slice-deduplicated cross-slice DCN leg.
+The §20 contract is BIT-EXACTNESS against the flat layer — forward,
+per-step losses AND applied updates — because the two-level routing
+moves pure data movement (sort-unique + exact owner selection), never
+math.  The fuzz here re-samples that claim over random (plan, batch,
+hot-set, chunk, dtype) draws on a 2x4 two-axis mesh, the same shape as
+PR 5's hot-cache fuzz; the counter test pins the ``each distinct row
+crosses DCN at most once per source slice`` invariant against an
+independent host-side bound, the PR 15 way (the counters themselves
+already reconcile two arithmetic paths internally and raise on
+mismatch — a green return IS the reconciliation check).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_embeddings_tpu.parallel import (DistributedEmbedding,
+                                                 SparseAdagrad, SparseSGD,
+                                                 TableConfig, create_mesh,
+                                                 init_hybrid_train_state,
+                                                 make_hybrid_train_step,
+                                                 hotcache)
+from distributed_embeddings_tpu.parallel.dist_embedding import (
+    hierarchical_params)
+from distributed_embeddings_tpu.parallel.hotcache import HotSet
+
+GB = 16
+
+
+def _draw_tables(rng, n_lo=4, n_hi=6):
+  configs, hots = [], []
+  for _ in range(int(rng.integers(n_lo, n_hi + 1))):
+    rows = int(rng.integers(16, 120))
+    width = int(rng.choice([4, 8]))
+    combiner = rng.choice([None, 'sum', 'mean'])
+    configs.append(TableConfig(rows, width, combiner))
+    hots.append(1 if combiner is None else int(rng.integers(2, 5)))
+  return configs, hots
+
+
+def _draw_inputs(rng, configs, hots, pad=True):
+  ins = []
+  for c, h in zip(configs, hots):
+    if h == 1:
+      ins.append(rng.integers(0, c.input_dim, (GB,)).astype(np.int32))
+    else:
+      x = rng.integers(0, c.input_dim, (GB, h)).astype(np.int32)
+      if pad:
+        x[rng.random((GB, h)) < 0.25] = -1
+      ins.append(x)
+  return ins
+
+
+def _draw_hot_sets(rng, configs):
+  hot_sets = {}
+  for tid, c in enumerate(configs):
+    if rng.random() < 0.6:
+      k = int(rng.integers(1, max(2, c.input_dim // 3)))
+      ids = np.sort(rng.choice(c.input_dim, size=k, replace=False))
+      hot_sets[tid] = HotSet(tid, ids.astype(np.int64))
+  if not hot_sets:
+    hot_sets[0] = HotSet(0, np.array([0]))
+  return hot_sets
+
+
+def _assert_hier_rows_equal(hier, conv, params_h, ctx, quant=False):
+  """Every REAL row of every hier group leaf matches the resharded flat
+  leaf bit for bit (padding beyond ``rows_h`` is filler, not
+  comparable — design §20)."""
+  S, D = hier.num_slices, hier.world_size
+  for gi in range(len(hier.plan.groups)):
+    hl = hier.hier.groups[gi]
+    names = [f'group_{gi}'] + ([f'scale_group_{gi}'] if quant else [])
+    for nm in names:
+      a = np.asarray(jax.device_get(conv[nm]))
+      b = np.asarray(jax.device_get(params_h[nm]))
+      for s in range(S):
+        for d in range(D):
+          n = hl.rows_h[s][d]
+          np.testing.assert_array_equal(
+              a[s * D + d, :n], b[s * D + d, :n],
+              err_msg=f'{ctx} {nm} shard ({s},{d})')
+  for nm in conv:
+    if nm.startswith('hot_'):
+      np.testing.assert_array_equal(
+          np.asarray(jax.device_get(conv[nm])),
+          np.asarray(jax.device_get(params_h[nm])),
+          err_msg=f'{ctx} {nm}')
+
+
+# Seed 0 (plain SGD) and seed 1 (hot-cache + Adagrad) are the tier-1
+# flagships; the int8 and overlap-chunked draws ride the slow lane
+# (budget discipline, PR 7 precedent).
+@pytest.mark.parametrize('seed', [
+    0,
+    1,
+    pytest.param(2, marks=pytest.mark.slow),
+    pytest.param(3, marks=pytest.mark.slow),
+])
+def test_fuzz_hier_parity(seed):
+  """Flat vs hierarchical over fuzzed draws: bit-exact forward,
+  bit-exact per-step losses, and flat-step-then-reshard == hier-step
+  params on every real row (the applied-updates leg of the §20
+  contract)."""
+  import optax
+  rng = np.random.default_rng(7000 + seed)
+  mesh = create_mesh((2, 4))
+  configs, hots = _draw_tables(rng)
+  # deterministic variant coverage on top of the fuzzed plan draw
+  kw = {}
+  if seed == 1:
+    kw['hot_cache'] = _draw_hot_sets(rng, configs)
+  if seed == 2:
+    kw['table_dtype'] = 'int8'
+  if seed == 3:
+    kw['hot_cache'] = _draw_hot_sets(rng, configs)
+    kw['overlap_chunks'] = 3
+  flat = DistributedEmbedding(configs, mesh=mesh, packed_storage=False,
+                              **kw)
+  hier = DistributedEmbedding(configs, mesh=mesh, dcn_sharding=True, **kw)
+  assert hier.num_slices == 2 and hier.world_size == 4
+  key = jax.random.PRNGKey(seed)
+  pf = flat.init(key)
+  ph = hier.init(key)
+  ctx = f'seed {seed} kw {sorted(kw)}'
+  quant = 'table_dtype' in kw
+
+  # init parity: the hier init IS the resharded flat init
+  _assert_hier_rows_equal(hier, hierarchical_params(hier, pf), ph, ctx,
+                          quant=quant)
+
+  # forward: bit-exact (dedup + DCN fetch is exact owner selection; the
+  # bag fold runs the same _combine_rows tail in both layouts)
+  ins = _draw_inputs(rng, configs, hots)
+  jins = [jnp.asarray(x) for x in ins]
+  for t, (a, b) in enumerate(zip(flat.apply(pf, jins),
+                                 hier.apply(ph, jins))):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                  err_msg=f'{ctx} forward input {t}')
+
+  # applied updates: 2 fuzz-drawn steps, losses equal bit for bit and
+  # the trained hier params equal the trained-then-resharded flat ones
+  opt = (SparseSGD(learning_rate=0.3) if seed % 2 == 0
+         else SparseAdagrad(learning_rate=0.3))
+  W = [np.asarray(jax.random.normal(jax.random.PRNGKey(90 + i), (w,)),
+                  np.float32)
+       for i, w in enumerate(c.output_dim for c in configs)]
+
+  def loss_fn(dense_params, emb_outs, batch):
+    return sum(jnp.sum(o * jnp.asarray(wv))
+               for o, wv in zip(emb_outs, W)) / GB
+
+  outs = []
+  rng_save = rng.bit_generator.state
+  for dist, p in ((flat, pf), (hier, ph)):
+    rng.bit_generator.state = rng_save
+    st = init_hybrid_train_state(dist, {'embedding': dict(p)},
+                                 optax.sgd(0.1), opt)
+    step = make_hybrid_train_step(dist, loss_fn, optax.sgd(0.1), opt,
+                                  donate=False)
+    losses = []
+    for _ in range(2):
+      st, l = step(st, [jnp.asarray(x)
+                        for x in _draw_inputs(rng, configs, hots)], None)
+      losses.append(float(l))
+    outs.append((st, losses))
+  (stf, lf), (sth, lh) = outs
+  assert lf == lh, (ctx, lf, lh)
+  _assert_hier_rows_equal(
+      hier, hierarchical_params(hier, stf.params['embedding']),
+      sth.params['embedding'], ctx, quant=quant)
+
+
+def test_dcn_crosses_once_counters():
+  """The dedup-at-the-boundary invariant, counted: each distinct row
+  crosses DCN at most once per source slice, so ``dcn_rows_per_slice[s]``
+  is bounded by the number of distinct valid ids slice ``s``'s batch
+  block requests — a bound computed here straight from the input
+  streams, independent of the counters' own two (already mutually
+  reconciled, PR 15 style) routing paths.  Small vocabularies force
+  cross-chip duplicates, so the dedup must WIN (``dcn_dedup_ratio >
+  1``); flat layers on the same mesh report an idle DCN lane."""
+  rng = np.random.default_rng(42)
+  mesh = create_mesh((2, 4))
+  configs = [TableConfig(10, 4, 'sum'), TableConfig(12, 4, 'mean'),
+             TableConfig(8, 8, None), TableConfig(14, 4, 'sum')]
+  hots = [3, 4, 1, 3]
+  cats = _draw_inputs(rng, configs, hots, pad=False)
+
+  flat = DistributedEmbedding(configs, mesh=mesh, packed_storage=False)
+  out = hotcache.measure_exchange_counters(flat, cats)
+  assert out['dcn_rows'] == 0 and out['dcn_rows_off'] == 0
+  assert out['dcn_dedup_ratio'] == 1.0
+  assert out['ici_rows'] == out['alltoall_rows_sent']
+
+  hier = DistributedEmbedding(configs, mesh=mesh, dcn_sharding=True)
+  out = hotcache.measure_exchange_counters(hier, cats)
+  S = hier.num_slices
+  per, per_off = out['dcn_rows_per_slice'], out['dcn_rows_off_per_slice']
+  assert len(per) == len(per_off) == S
+  assert out['dcn_rows'] == sum(per)
+  assert out['dcn_rows_off'] == sum(per_off)
+  assert out['ici_rows'] == out['alltoall_rows_sent']
+  # the win: deduplicated wire strictly narrower than the verbatim one
+  assert 0 < out['dcn_rows'] < out['dcn_rows_off']
+  assert out['dcn_dedup_ratio'] == round(
+      out['dcn_rows_off'] / out['dcn_rows'], 4) > 1.0
+  # at-most-once-per-slice: distinct ids each slice block requests are
+  # the most rows it could ever push across DCN (some are owned
+  # in-slice and cross zero times, so <=, not ==)
+  slice_batch = GB // S
+  for s in range(S):
+    bound = 0
+    for x in cats:
+      blk = x[s * slice_batch:(s + 1) * slice_batch]
+      bound += int(np.unique(blk[blk >= 0]).size)
+    assert per[s] <= bound, (s, per[s], bound)
+    assert per[s] <= per_off[s]
+
+
+def test_checkpoint_refuses_hier():
+  """Checkpoint resharding walks ``world_size`` FLAT shards; reading a
+  hierarchical axis-product leaf that way would silently misplace rows
+  — so every dist-facing checkpoint entry point refuses loudly and
+  names the flat-twin + ``hierarchical_params`` route (design §20)."""
+  from distributed_embeddings_tpu.parallel import (checkpoint,
+                                                   get_weights,
+                                                   set_weights)
+  mesh = create_mesh((2, 4))
+  configs = [TableConfig(24, 4, 'sum'), TableConfig(16, 4, 'mean')]
+  hier = DistributedEmbedding(configs, mesh=mesh, dcn_sharding=True)
+  params = hier.init(jax.random.PRNGKey(0))
+  weights = [np.zeros((c.input_dim, c.output_dim), np.float32)
+             for c in configs]
+  with pytest.raises(NotImplementedError, match='hierarchical_params'):
+    set_weights(hier, weights)
+  with pytest.raises(NotImplementedError, match='dcn_sharding'):
+    get_weights(hier, params)
+  with pytest.raises(NotImplementedError, match='dcn_sharding'):
+    checkpoint.get_optimizer_state(hier, {})
+  with pytest.raises(NotImplementedError, match='dcn_sharding'):
+    checkpoint.set_optimizer_state(hier, {}, [{} for _ in configs])
+  # refusal fires BEFORE any file I/O: no checkpoint dir needed
+  with pytest.raises(NotImplementedError, match='dcn_sharding'):
+    checkpoint.restore_train_state(hier, None, '/nonexistent')

@@ -150,7 +150,14 @@ def compare(current: Dict[str, Any],
   same_line = [a for a in history
                if normalized_metric(a) == cur_metric
                and a.get('unit') == current.get('unit')
-               and isinstance(a.get('value'), (int, float))]
+               and isinstance(a.get('value'), (int, float))
+               # like-for-like topology (design §20): a hierarchical
+               # (2, 4) line must never band against an (8,) flat one.
+               # Missing on either side (pre-§20 schema) compares —
+               # the old behavior, so history does not orphan.
+               and (a.get('mesh_shape') is None
+                    or current.get('mesh_shape') is None
+                    or a.get('mesh_shape') == current.get('mesh_shape'))]
   comparable = [a for a in same_line
                 if int(a.get('schema_version') or 0) >= int(min_schema)]
   out: Dict[str, Any] = {
