@@ -92,12 +92,40 @@ def graphlint_block() -> dict:
   count across the monitored 3-step fit + warmed serving ladder (0 or
   a hot path is recompiling), and ``graphlint_peak_hbm_bytes`` the
   largest per-program per-device memory estimate — the journaled twin
-  of the perf_notes fits ladder."""
+  of the perf_notes fits ladder.
+
+  Fused-exchange counters (design §21), counted from the graphlint
+  schedule of the multi-group fused/per-group twin programs:
+  ``exchange_collectives_fwd`` / ``_bwd`` are the fused programs'
+  collective counts, ``_fwd_pergroup`` / ``_bwd_pergroup`` the
+  unfused twins' (fused < per-group by at least the group count on a
+  multi-group plan — the pinned coalescing win), and
+  ``fused_exchange_bytes`` the summed on-wire payload of the fused
+  programs' collectives."""
   from distributed_embeddings_tpu.analysis import graphlint
   res = graphlint.run_repo(os.path.dirname(os.path.abspath(__file__)))
   don = res.meta.get('graphlint_donation', {})
   ret = res.meta.get('graphlint_retrace', {})
   hbm = res.meta.get('graphlint_hbm', {})
+  sched = res.meta.get('graphlint_schedule', {})
+
+  def _count(name):
+    return len(sched.get(name, {}).get('collectives', []))
+
+  def _bytes(name):
+    total = 0
+    for op in sched.get(name, {}).get('collectives', []):
+      try:
+        import numpy as _np
+        item = _np.dtype(op.get('dtype') or 'V0').itemsize
+      except TypeError:
+        item = 0
+      n = 1
+      for d in op.get('shape', ()):
+        n *= int(d)
+      total += n * item
+    return total
+
   return {
       'graphlint_findings': len(res.findings) + len(res.unverifiable),
       'graphlint_donation_ok': bool(don) and all(
@@ -106,6 +134,11 @@ def graphlint_block() -> dict:
                                 for v in ret.values()),
       'graphlint_peak_hbm_bytes': max(
           (v['peak'] for v in hbm.values()), default=0),
+      'exchange_collectives_fwd': _count('lookup/fused'),
+      'exchange_collectives_fwd_pergroup': _count('lookup/pergroup'),
+      'exchange_collectives_bwd': _count('bwd/fused'),
+      'exchange_collectives_bwd_pergroup': _count('bwd/pergroup'),
+      'fused_exchange_bytes': _bytes('lookup/fused') + _bytes('bwd/fused'),
   }
 
 

@@ -44,6 +44,16 @@ Passes (each a callable ``(programs) -> findings`` in ``PASSES``):
   ``device_hbm_budget`` and gated against it where a plan declares one
   (resident argument bytes must fit; the full peak — args + temps +
   unaliased outputs — rides along for the perf_notes fits ladder).
+- ``budget``     — collective-count budget (design §21): a traced
+  program may issue NO MORE collectives than its checked-in ledger
+  entry records.  The fused exchange collapsed every phase from
+  O(groups) collectives to one; without this gate that win regresses
+  silently (a per-group loop sneaks back in, the count creeps up, and
+  nothing fails).  Growth fails ``--strict`` unless the ledger is
+  refreshed (``--write-ledger``) alongside a rationale-bearing waiver
+  in ``tools/detlint_baseline.toml`` — the same waiver discipline as
+  detlint.  Counts DROPPING is not a finding (that is the
+  optimization landing); the ledger refresh records the new floor.
 """
 
 from __future__ import annotations
@@ -78,7 +88,8 @@ HOST_CALLBACK_PRIMITIVES = frozenset({
 _HOSTSYNC_EXEMPT_FRAGMENTS = ('parallel/coldtier.py', '/obs/',
                               'utils/resilience.py')
 
-GRAPH_PASS_NAMES = ('schedule', 'donation', 'retrace', 'hostsync', 'hbm')
+GRAPH_PASS_NAMES = ('schedule', 'donation', 'retrace', 'hostsync', 'hbm',
+                    'budget')
 
 
 # --------------------------------------------------------------------------
@@ -90,20 +101,38 @@ GRAPH_PASS_NAMES = ('schedule', 'donation', 'retrace', 'hostsync', 'hbm')
 class CollectiveOp:
   """One collective in a program's schedule.  ``index`` is the issue
   order inside the traced body; ``loop`` marks ops under scan/while
-  (executed per iteration)."""
+  (executed per iteration); ``dtype`` is the first operand's element
+  type (with ``shape``, the op's on-wire payload — what the bench's
+  ``fused_exchange_bytes`` sums)."""
   primitive: str
   axis: str
   shape: Tuple[int, ...]
   index: int
   loop: bool = False
+  dtype: str = ''
 
   def key(self) -> Tuple[str, str]:
     return (self.primitive, self.axis)
 
+  def nbytes(self) -> int:
+    """Payload bytes of one issue of this op (0 when the operand dtype
+    was unavailable at extraction)."""
+    import numpy as np
+    if not self.dtype or not self.shape:
+      return 0
+    try:
+      item = np.dtype(self.dtype).itemsize
+    except TypeError:
+      return 0
+    n = 1
+    for d in self.shape:
+      n *= int(d)
+    return n * item
+
   def as_dict(self) -> Dict[str, Any]:
     return {'primitive': self.primitive, 'axis': self.axis,
             'shape': list(self.shape), 'index': self.index,
-            'loop': self.loop}
+            'loop': self.loop, 'dtype': self.dtype}
 
 
 @dataclasses.dataclass
@@ -224,13 +253,15 @@ def extract_schedule(jaxpr) -> List[CollectiveOp]:
       if isinstance(ax, (tuple, list)):
         ax = ','.join(str(a) for a in ax)
       shape: Tuple[int, ...] = ()
+      dtype = ''
       for v in eqn.invars:
         aval = getattr(v, 'aval', None)
         if aval is not None and getattr(aval, 'shape', None) is not None:
           shape = tuple(int(d) for d in aval.shape)
+          dtype = str(getattr(aval, 'dtype', ''))
           break
       out.append(CollectiveOp(eqn.primitive.name, str(ax), shape,
-                              len(out), loop=in_loop))
+                              len(out), loop=in_loop, dtype=dtype))
   return out
 
 
@@ -579,6 +610,41 @@ def _hbm_pass(programs: List[Program]) -> List[Finding]:
   return findings
 
 
+@_register('budget')
+def _budget_pass(programs: List[Program]) -> List[Finding]:
+  """Collective-count budget (design §21): each traced program's live
+  collective count gated against its checked-in ledger entry."""
+  findings: List[Finding] = []
+  try:
+    with open(default_ledger_path(), encoding='utf-8') as f:
+      ledger = json.load(f)
+  except (OSError, ValueError):
+    # no checked-in ledger (fresh checkout mid-bootstrap): nothing to
+    # budget against; the freshness test owns ledger existence
+    return findings
+  for prog in programs:
+    if prog.jaxpr is None:
+      continue
+    entry = ledger.get(prog.name)
+    if entry is None:
+      continue  # new program: --write-ledger records its first budget
+    budget = len(entry.get('collectives', []))
+    live = len(prog.schedule())
+    if live > budget:
+      findings.append(Finding(
+          rule='budget/collective-count-exceeded', path=prog.name,
+          line=0, symbol='collectives',
+          message=f'traced program issues {live} collectives but its '
+          f'ledger entry budgets {budget} — a collective crept into a '
+          'pinned program (each one is a latency-bound mesh rendezvous; '
+          "the fused exchange's O(groups)->O(1) win, design §21, "
+          'regresses silently without this gate).  Remove it, or '
+          'refresh tools/graphlint_ledger.json (--tier full '
+          '--write-ledger) WITH a rationale-bearing waiver in '
+          'tools/detlint_baseline.toml'))
+  return findings
+
+
 # --------------------------------------------------------------------------
 # runner + ledger
 # --------------------------------------------------------------------------
@@ -746,6 +812,33 @@ def build_programs(tier: str = 'flagship') -> List[Program]:
                                hot_cache=hs)
   forward_program('lookup/hot', d_hot, d_hot.init(0),
                   make_ids(cfg2, batch), fetch={})
+
+  # ---- fused vs per-group exchange twins (design §21) ---------------
+  # TWO fusion groups (widths differ, so the tables cannot merge): the
+  # fused program ships both groups' buffers in ONE all_to_all per
+  # phase where the per-group twin issues one per group.  The raw
+  # ledger rows show the O(groups)->O(1) drop; the parity group pins
+  # the two programs bit-exact on the collapsed schedule (per-group
+  # consecutive same-axis runs collapse to the fused program's single
+  # entry — the invariant that survives both chunking and fusion).
+  cfg_m = [TableConfig(32, 8, 'sum'), TableConfig(40, 16, 'sum')]
+  w_m = [rng.normal(size=(c.input_dim, c.output_dim))
+         .astype(np.float32) * 0.1 for c in cfg_m]
+  cats_m = make_ids(cfg_m, batch)
+  for fused, name, bname in ((True, 'lookup/fused', 'bwd/fused'),
+                             (False, 'lookup/pergroup', 'bwd/pergroup')):
+    d_m = DistributedEmbedding(cfg_m, mesh=mesh, dp_input=True,
+                               fused_exchange=fused)
+    p_m = set_weights(d_m, w_m)
+    forward_program(name, d_m, p_m, cats_m, parity='lookup-fuse')
+    # the matching backward twin: the dedup cotangent exchange, fused
+    # vs per-group (trace-only — the bench's exchange_collectives_bwd
+    # counts read these rows)
+    outs_m, _, (gb_m, hot_m) = d_m.forward_with_residuals(p_m, cats_m)
+    bwd_m = d_m._build_backward(gb_m, hot_m)
+    traced_b = bwd_m.trace(*[jnp.ones_like(o) for o in outs_m])
+    programs.append(Program(bname, jaxpr=traced_b.jaxpr,
+                            parity='bwd-fuse'))
 
   if tier == 'full':
     d_sc = DistributedEmbedding(cfg2, mesh=mesh,

@@ -122,6 +122,11 @@ class DLRM:
     table_dtype / cold_tier / device_hbm_budget / cold_fetch_rows:
       quantized table storage and the host-DRAM cold tier, forwarded
       to ``DistributedEmbedding`` (docs/design.md §12).
+    fused_exchange: coalesce every exchange phase's per-group
+      collectives into one all_to_all per direction (docs/design.md
+      §21), forwarded to ``DistributedEmbedding``.  True (default)
+      is the fused schedule; False keeps the legacy per-group one —
+      the A/B escape hatch, bit-exact either way.
   """
   table_sizes: Sequence[int]
   embedding_dim: int = 128
@@ -141,6 +146,7 @@ class DLRM:
   cold_tier: bool = False
   device_hbm_budget: Optional[int] = None
   cold_fetch_rows: Any = None
+  fused_exchange: bool = True
 
   def __post_init__(self):
     if self.bottom_mlp_dims[-1] != self.embedding_dim:
@@ -173,7 +179,8 @@ class DLRM:
         table_dtype=self.table_dtype,
         cold_tier=self.cold_tier,
         device_hbm_budget=self.device_hbm_budget,
-        cold_fetch_rows=self.cold_fetch_rows)
+        cold_fetch_rows=self.cold_fetch_rows,
+        fused_exchange=self.fused_exchange)
 
   @property
   def num_interaction_features(self) -> int:

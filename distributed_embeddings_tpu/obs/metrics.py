@@ -125,7 +125,7 @@ REGISTERED_STATS_KEYS = frozenset({
     'merge_demux_ms', 'csr_feed',
     # ServingEngine (serving/engine.py)
     'batches_served', 'samples_served', 'batch_size', 'world_size',
-    'hot_cache', 'cold_tier', 'table_dtype',
+    'hot_cache', 'cold_tier', 'table_dtype', 'fused_exchange',
 })
 
 # Bench-artifact key schema: the keys tests/test_bench_artifact.py pins
@@ -173,6 +173,16 @@ REGISTERED_ARTIFACT_KEYS = frozenset({
     # IR-analysis gate counts (bench.graphlint_block; design §18)
     'graphlint_findings', 'graphlint_donation_ok',
     'graphlint_retraces', 'graphlint_peak_hbm_bytes',
+    # fused-exchange counters (bench.graphlint_block, design §21):
+    # collective counts of the fused vs per-group twin programs plus
+    # the fused programs' summed on-wire payload, all counted from the
+    # graphlint schedule; the traced leg/wire views ride alongside
+    # (parallel/hotcache.py fused_leg_bytes, coldtier.py
+    # cold_exchange_leg_bytes)
+    'exchange_collectives_fwd', 'exchange_collectives_fwd_pergroup',
+    'exchange_collectives_bwd', 'exchange_collectives_bwd_pergroup',
+    'fused_exchange_bytes', 'fused_leg_bytes',
+    'cold_exchange_leg_bytes',
     # artifact schema + host-pressure gauges (bench.py; design §19 —
     # the perf sentinel's comparability/noise inputs)
     'schema_version', 'available_mem_mb',

@@ -536,12 +536,22 @@ def fetch_stats(dist, fetch: ColdFetch) -> dict:
     total_bytes += n * rb
     if spec is not None:
       total_scale_bytes += n * quantization.SCALE_BYTES
+  # fused cold-exchange legs (design §21): the traced LookupPlan's
+  # cold id/row wire sizes, when the runtime has traced one — the
+  # fetched rows above feed exactly these fused buffers (the cold-tier
+  # fetch is the gather stage of the same plan)
+  cold_leg_bytes = {}
+  for lp in getattr(dist, '_lookup_plans', {}).values():
+    for leg in lp.legs:
+      if 'cold' in leg.name or leg.name.startswith('dcn/'):
+        cold_leg_bytes[f'{lp.path}:{leg.name}'] = int(leg.nbytes)
   return {
       'cold_tier_fetch_rows': int(total_rows),
       'cold_tier_fetch_bytes': int(total_bytes),
       'cold_tier_fetch_scale_bytes': int(total_scale_bytes),
       'cold_tier_fetch_rows_per_group': per_group_rows,
       'cold_tier_row_bytes_per_group': per_group_row_bytes,
+      'cold_exchange_leg_bytes': cold_leg_bytes,
   }
 
 

@@ -608,9 +608,21 @@ def measure_exchange_counters(dist, cats,
   obs_metrics.set_gauge('exchange.ici_rows', float(sent_on))
   obs_metrics.set_gauge('exchange.dcn_dedup_ratio', float(dedup_ratio))
 
+  # fused-exchange wire view (design §21): when the runtime has traced
+  # a LookupPlan for this layer, report each recorded leg's on-wire
+  # byte size so the counter artifact names the fused buffers the row
+  # counts above travel in (empty before any traced launch)
+  fused_leg_bytes = {}
+  for lp in getattr(dist, '_lookup_plans', {}).values():
+    for leg in lp.legs:
+      # most recent trace of each (path, leg) wins: re-traces at a new
+      # batch signature describe the same wire at the new shape
+      fused_leg_bytes[f'{lp.path}:{leg.name}'] = int(leg.nbytes)
+
   return {
       'alltoall_rows_sent_off': int(sent_off),
       'alltoall_rows_sent': int(sent_on),
+      'fused_leg_bytes': fused_leg_bytes,
       'unique_cold_rows': int(sent_on),
       'hot_hit_rate': round(total_hot / total_valid, 4) if total_valid
                       else 0.0,

@@ -1412,3 +1412,181 @@ def price_exchange(plan: 'ShardingPlan', global_batch: int,
     model.journal(**out)
   out['dcn_ici_ratio'] = model.dcn_ici_ratio
   return out
+
+
+# --------------------------------------------------------------------------
+# LookupPlan IR: the plan-driven lookup pipeline (docs/design.md §21)
+# --------------------------------------------------------------------------
+
+# The one stage sequence every lookup/train path runs.  Backends override
+# individual stages (LOOKUP_BACKEND_STAGES); none of them forks the
+# pipeline itself, so cross-group optimizations harvested here — the
+# fused exchange first — apply to every backend at once.
+LOOKUP_STAGES = ('hot_split', 'route', 'exchange', 'gather', 'combine',
+                 'apply')
+
+# Which stage each backend overrides (design §21 stage contract; the
+# other stages are the shared default implementation).  Doc/serving
+# introspection surface — the runtime dispatch reads the plan, not this
+# table.
+LOOKUP_BACKEND_STAGES: Dict[str, Dict[str, str]] = {
+    'xla': {'gather': 'dist_embedding._fused_lookup (gather+segment-sum)'},
+    'pallas': {'gather': 'ops.pallas_lookup.fused_lookup'},
+    'sparsecore': {
+        'gather': 'parallel.sparsecore (static-CSR custom call/emulation)'},
+    'segwalk': {'apply': 'ops.pallas_segwalk (fused table walk)'},
+    'hot_cache': {
+        'hot_split': 'dist_embedding._hot_membership (design §10): hot '
+                     'ids leave the exchange, cold ids sort-unique'},
+    'cold_tier': {
+        'gather': 'dist_embedding._tiered_gather over the host-DRAM '
+                  'tail fetch (parallel/coldtier, design §12)'},
+    'hierarchical': {
+        'exchange': 'dist_embedding._hier_fetch_unique: within-slice '
+                    'dedup, then the fused cross-slice DCN pair '
+                    '(design §20)'},
+    'serving': {'apply': '(absent — compile_lookup traces the forward '
+                         'alone, design §14)'},
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Segment:
+  """One subgroup buffer's slice of a fused exchange leg.
+
+  ``offset``/``size`` count flat elements PER LEADING-AXIS ROW: the
+  leading (device) axis of every exchanged buffer is the all_to_all
+  split/concat axis and never fuses, so the fused buffer is
+  ``[lead, total]`` and this segment is ``fused[:, offset:offset+size]``
+  reshaped back to ``shape``."""
+  label: str
+  offset: int
+  size: int
+  shape: Tuple[int, ...]
+  dtype: str
+
+  def as_dict(self) -> Dict[str, Any]:
+    return {'label': self.label, 'offset': self.offset, 'size': self.size,
+            'shape': list(self.shape), 'dtype': self.dtype}
+
+
+@dataclasses.dataclass(frozen=True)
+class LegLayout:
+  """The offset table of ONE fused collective: every segment shares the
+  leg's dtype (mixed-dtype phases fuse into one leg per dtype class —
+  id legs are int32, row legs the compute dtype, so a phase is almost
+  always exactly one leg)."""
+  name: str
+  axis: str            # mesh axis the collective rides ('data'/'dcn')
+  dtype: str
+  lead: int            # leading (split/concat) dim — never fused
+  segments: Tuple[Segment, ...]
+
+  @property
+  def total(self) -> int:
+    """Flat elements per leading row of the fused buffer."""
+    return sum(s.size for s in self.segments)
+
+  @property
+  def nbytes(self) -> int:
+    return self.lead * self.total * np.dtype(self.dtype).itemsize
+
+  def as_dict(self) -> Dict[str, Any]:
+    return {'name': self.name, 'axis': self.axis, 'dtype': self.dtype,
+            'lead': self.lead, 'total': self.total, 'nbytes': self.nbytes,
+            'segments': [s.as_dict() for s in self.segments]}
+
+
+def fuse_layout(name: str, entries: Sequence[Tuple[str, Sequence[int],
+                                                   Any]],
+                axis: str = 'data') -> List[LegLayout]:
+  """The ONE fused-buffer offset rule (design §21): group ``(label,
+  shape, dtype)`` entries by dtype class (first-appearance order) and
+  lay each class out contiguously in entry order.
+
+  Per-entry flat size is ``prod(shape[1:])`` — the leading axis is the
+  collective's split/concat axis and stays un-fused.  Everything that
+  concatenates a routed buffer into a fused exchange (runtime,
+  LookupPlan ledger, bench byte accounting) derives offsets from here,
+  so they can never disagree.
+  """
+  by_dtype: Dict[str, List[Tuple[str, Tuple[int, ...]]]] = {}
+  leads: Dict[str, int] = {}
+  for label, shape, dtype in entries:
+    shape = tuple(int(d) for d in shape)
+    dt = str(np.dtype(dtype))
+    by_dtype.setdefault(dt, []).append((label, shape))
+    lead = leads.setdefault(dt, shape[0])
+    if shape[0] != lead:
+      raise ValueError(
+          f'fused leg {name!r}: leading (split) dims disagree '
+          f'({shape[0]} vs {lead} at {label!r}) — every buffer of one '
+          'exchange phase must split over the same device axis')
+  legs: List[LegLayout] = []
+  for dt, items in by_dtype.items():
+    segs: List[Segment] = []
+    off = 0
+    for label, shape in items:
+      size = int(np.prod(shape[1:], dtype=np.int64)) if len(shape) > 1 else 1
+      segs.append(Segment(label=label, offset=off, size=size,
+                          shape=shape, dtype=dt))
+      off += size
+    suffix = '' if len(by_dtype) == 1 else f'/{dt}'
+    legs.append(LegLayout(name=name + suffix, axis=axis, dtype=dt,
+                          lead=leads[dt], segments=tuple(segs)))
+  return legs
+
+
+@dataclasses.dataclass
+class LookupPlan:
+  """The traced-pipeline IR of one ``(path, global_batch, hotness)``
+  signature (docs/design.md §21).
+
+  Built WHILE the runtime traces the program: each exchange phase
+  records the ``LegLayout`` it fused (or the per-group legs it issued,
+  under ``fused_exchange=False``), so the plan is the ground truth of
+  what the program's collectives carry — what bench's
+  ``exchange_collectives_*``/``fused_exchange_bytes`` artifacts count
+  and what the graphlint budget pass prices programs against.
+
+  ``stages`` is the §21 stage contract (``LOOKUP_STAGES``); backends
+  override single stages (``LOOKUP_BACKEND_STAGES``), never the
+  pipeline shape.
+  """
+  path: str                      # 'dp' | 'mp' | 'hot' | 'bwd' | 'bwd_hot'
+  global_batch: int
+  hotness: Tuple[int, ...]
+  fused: bool
+  chunks: int = 1
+  stages: Tuple[str, ...] = LOOKUP_STAGES
+  legs: List[LegLayout] = dataclasses.field(default_factory=list)
+
+  def record(self, legs: Sequence[LegLayout]) -> None:
+    self.legs.extend(legs)
+
+  def leg(self, name: str) -> LegLayout:
+    for leg in self.legs:
+      if leg.name == name or leg.name.startswith(name + '/'):
+        return leg
+    raise KeyError(f'LookupPlan({self.path}) has no leg {name!r}; '
+                   f'recorded: {[l.name for l in self.legs]}')
+
+  def collective_count(self, axis: Optional[str] = None) -> int:
+    """Collectives this plan's exchange phases issue (one per recorded
+    leg) — the O(groups) -> O(1) drop the fused exchange harvests shows
+    up directly here."""
+    return sum(1 for l in self.legs if axis is None or l.axis == axis)
+
+  def fused_bytes(self) -> int:
+    """Total bytes crossing the interconnect through recorded legs."""
+    return sum(l.nbytes for l in self.legs)
+
+  def as_dict(self) -> Dict[str, Any]:
+    return {
+        'path': self.path, 'global_batch': self.global_batch,
+        'hotness': list(self.hotness), 'fused': self.fused,
+        'chunks': self.chunks, 'stages': list(self.stages),
+        'collectives': self.collective_count(),
+        'fused_bytes': self.fused_bytes(),
+        'legs': [l.as_dict() for l in self.legs],
+    }

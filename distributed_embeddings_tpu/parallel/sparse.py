@@ -1649,9 +1649,19 @@ def make_hybrid_train_step(dist: DistributedEmbedding,
     }
     dense_opt_state, emb_opt_state = state.opt_state
 
-    emb_outs, residuals, (global_batch, hotness) = (
-        dist.forward_with_residuals(emb_params, cats,
-                                    cold_fetch=cold_fetch))
+    hot_on = bool(getattr(dist, 'hot_enabled', False))
+    if hot_on:
+      # with_routing: carry the forward's sort-unique inverse
+      # permutations (routing products, design §21) so the backward
+      # reuses them instead of re-sorting
+      emb_outs, residuals, routing, (global_batch, hotness) = (
+          dist.forward_with_residuals(emb_params, cats,
+                                      cold_fetch=cold_fetch,
+                                      with_routing=True))
+    else:
+      emb_outs, residuals, (global_batch, hotness) = (
+          dist.forward_with_residuals(emb_params, cats,
+                                      cold_fetch=cold_fetch))
 
     loss, pull = jax.vjp(
         lambda dp, eo: head_loss_fn(dp, eo, batch), dense_params,
@@ -1663,11 +1673,11 @@ def make_hybrid_train_step(dist: DistributedEmbedding,
     new_dense = jax.tree.map(lambda p, u: p + u.astype(p.dtype),
                              dense_params, updates)
 
-    if getattr(dist, 'hot_enabled', False):
-      # hot-cache layers: the backward rebuilds the unique cold
-      # streams from the raw inputs, divides mean cotangents
-      # internally, and returns the replicated hot-row grad buffers
-      # alongside the deduplicated per-subgroup streams
+    if hot_on:
+      # hot-cache layers: the backward consumes the forward's routing
+      # products (no re-sort), divides mean cotangents internally, and
+      # returns the replicated hot-row grad buffers alongside the
+      # deduplicated per-subgroup streams
       cats_dense = [
           x.to_padded_dense(dist._ragged_cap(x))
           if isinstance(x, RaggedBatch) else x for x in cats
@@ -1675,7 +1685,8 @@ def make_hybrid_train_step(dist: DistributedEmbedding,
       gsubs, hot_grads = dist.backward_to_mp(
           list(d_emb), global_batch, hotness, cats=cats_dense,
           with_sq=bool(getattr(emb_optimizer, 'needs_sq', False)),
-          with_touch=bool(getattr(emb_optimizer, 'needs_touch', False)))
+          with_touch=bool(getattr(emb_optimizer, 'needs_touch', False)),
+          routing=routing)
       lr = (lr_schedule(state.step) if lr_schedule is not None
             else emb_optimizer.learning_rate)
       if tier_on:

@@ -115,6 +115,11 @@ class ServingEngine:
       (``verify_tier_digests``) and the tier is frozen, so damaged
       host rows refuse before reaching the device and nothing can
       write back.
+    fused_exchange: ship all groups' buffers through ONE fused
+      collective per exchange phase (design §21; default on) — the
+      serving ``compile_lookup`` program is a stage implementation
+      over the same ``LookupPlan`` as training, so ``lookup_plan()``
+      exposes each rung's traced fused schedule.
     compute_dtype / lookup_impl / strategy / column_slice_threshold /
       row_slice: as in ``DistributedEmbedding``.
 
@@ -139,6 +144,7 @@ class ServingEngine:
                cold_tier: bool = False,
                device_hbm_budget: Optional[int] = None,
                cold_fetch_rows=None,
+               fused_exchange: bool = True,
                verify_tier_digests: bool = True,
                bundle_meta: Optional[dict] = None):
     weights = list(weights)
@@ -159,7 +165,8 @@ class ServingEngine:
         table_dtype=table_dtype,
         cold_tier=cold_tier,
         device_hbm_budget=device_hbm_budget,
-        cold_fetch_rows=cold_fetch_rows)
+        cold_fetch_rows=cold_fetch_rows,
+        fused_exchange=fused_exchange)
     denom = self.dist.world_size * self.dist.num_slices
     batch_size = int(batch_size)
     if batch_size < 1 or batch_size % denom:
@@ -393,6 +400,15 @@ class ServingEngine:
         self.batch_size if bucket is None else int(bucket),
         self.hotness)
 
+  def lookup_plan(self, bucket: Optional[int] = None):
+    """The traced ``LookupPlan`` of one rung's compiled forward
+    (design §21): the fused exchange legs, their per-group offset
+    tables and on-wire bytes — what the graphlint ledger's serve
+    entries are the compiled mirror of.  Rungs trace on first launch
+    (``warmup``), so call after warming."""
+    return self.dist.lookup_plan(
+        global_batch=self.batch_size if bucket is None else int(bucket))
+
   def stats(self) -> dict:
     with self._lock:
       launched = self._rows_launched
@@ -409,6 +425,7 @@ class ServingEngine:
           'world_size': self.dist.world_size,
           'hot_cache': bool(self.dist.hot_enabled),
           'cold_tier': self.dist.cold_tier is not None,
+          'fused_exchange': bool(self.dist.fused_exchange),
           'table_dtype': (self.dist.quant.name
                           if self.dist.quant else None),
       }

@@ -106,6 +106,20 @@ if [ "$BUDGET" = 1 ]; then
     --overlap_chunks 4 \
     --max_steps 40
 
+  # cheap fused-exchange A/B (design §21): the plain --max_steps 40
+  # row above is the ON arm (fused_exchange defaults on — one
+  # coalesced all_to_all per direction); this arm reverts to the
+  # legacy one-collective-per-group schedule — the steady-state
+  # samples/s pair prices the per-collective launch/rendezvous
+  # overhead the fusion removes (bit-exact either way)
+  python examples/dlrm/main.py \
+    --dataset_path "$DATA" \
+    --batch_size "$BATCH" \
+    --dp_input \
+    --fast_compile \
+    --no-fused_exchange \
+    --max_steps 40
+
   # cheap quantized-storage A/B (design §12): int8 rows + per-row f32
   # scales, 4x less table HBM — the plain --max_steps 40 row above is
   # the f32 off arm; compare steady-state samples/s AND the printed
@@ -183,6 +197,20 @@ python examples/dlrm/main.py \
   --batch_size "$BATCH" \
   --dp_input \
   --overlap_chunks 4 \
+  --max_steps 40
+
+# fused-exchange A/B (design §21): the plain --max_steps 40 row above
+# is the ON arm (fused_exchange defaults on — exchange collectives
+# independent of the fusion-group count); the off arm issues one
+# all_to_all per group per direction, the pre-§21 schedule — the
+# steady-state samples/s pair is the chip measurement of the
+# per-collective overhead the bench's exchange_collectives_* gap
+# predicts (bit-exact either way)
+python examples/dlrm/main.py \
+  --dataset_path "$DATA" \
+  --batch_size "$BATCH" \
+  --dp_input \
+  --no-fused_exchange \
   --max_steps 40
 
 # quantized-storage A/B (design §12): int8 rows + per-row f32 scales

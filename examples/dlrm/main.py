@@ -56,6 +56,14 @@ def parse_args():
                       'collective against compute (docs/design.md §11). '
                       '1 = the monolithic program; > 1 requires '
                       '--dp_input and --trainer sparse')
+  parser.add_argument('--fused_exchange', default=True,
+                      action=argparse.BooleanOptionalAction,
+                      help='coalesce every exchange phase into one '
+                      'all_to_all per direction via the traced '
+                      'LookupPlan offsets (docs/design.md §21). '
+                      'Default on; --no-fused_exchange keeps the '
+                      'legacy one-collective-per-group schedule '
+                      '(bit-exact either way — the A/B lever)')
   parser.add_argument('--hot_coverage', type=float, default=0.8,
                       help='per-table occurrence-coverage target for the '
                       'hot set calibration')
@@ -320,6 +328,7 @@ def main():
                                        or args.param_dtype),
                hot_cache=hot_sets,
                overlap_chunks=args.overlap_chunks,
+               fused_exchange=args.fused_exchange,
                table_dtype=(None if args.table_dtype == 'none'
                             else args.table_dtype),
                cold_tier=args.cold_tier_budget_mb is not None,

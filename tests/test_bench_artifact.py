@@ -499,6 +499,29 @@ def test_graphlint_artifact_keys(bench):
   assert block['graphlint_donation_ok'] is True, block
   assert block['graphlint_retraces'] == 0, block
   assert block['graphlint_peak_hbm_bytes'] > 0, block
+  # fused-exchange counters (ISSUE 17 / design §21), counted from the
+  # graphlint schedule of the two-group fused/per-group twins: the
+  # fused program must beat its per-group twin by AT LEAST the group
+  # count in each direction (two groups -> one collective saved per
+  # phase per direction), and the fused on-wire payload is journaled
+  for key in ('exchange_collectives_fwd', 'exchange_collectives_bwd',
+              'exchange_collectives_fwd_pergroup',
+              'exchange_collectives_bwd_pergroup',
+              'fused_exchange_bytes'):
+    assert key in block, key
+  groups = 2  # the twin programs' table count (distinct widths)
+  fused = (block['exchange_collectives_fwd']
+           + block['exchange_collectives_bwd'])
+  pergroup = (block['exchange_collectives_fwd_pergroup']
+              + block['exchange_collectives_bwd_pergroup'])
+  assert fused + groups <= pergroup, block
+  assert (block['exchange_collectives_fwd']
+          < block['exchange_collectives_fwd_pergroup']), block
+  assert (block['exchange_collectives_bwd']
+          < block['exchange_collectives_bwd_pergroup']), block
+  assert block['exchange_collectives_fwd'] == 2, block   # ids out, rows back
+  assert block['exchange_collectives_bwd'] == 1, block   # one cotangent leg
+  assert block['fused_exchange_bytes'] > 0, block
 
 
 def test_artifact_keys_registered():

@@ -272,3 +272,70 @@ print(f'MP-OK rank={rank}')
                     reason='multi-process test disabled')
 def test_two_process_hier_exchange(tmp_path):
   _run_world(WORKER_HIER, 2, 4, timeout=600)
+
+
+# Same 2-process x 4-local-device (2 slices x 4 chips) topology, now
+# comparing fused_exchange=True vs =False hierarchical twins: the fused
+# DCN exchange (one coalesced cross-slice all_to_all per direction,
+# design §21) genuinely crosses the process boundary, so its offset
+# bookkeeping is exercised over real non-addressable shards.  Contract:
+# bit-exact per addressable output shard, and the fused twin's plan
+# records the coalesced 'dcn/ids'/'dcn/rows' legs.
+WORKER_FUSED = r'''
+import os, sys
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+import jax.numpy as jnp
+from distributed_embeddings_tpu.parallel import (DistributedEmbedding,
+                                                 TableConfig, create_mesh,
+                                                 init_distributed,
+                                                 make_global_batch)
+
+coord, pid, nprocs = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+rank = init_distributed(coordinator_address=coord, num_processes=nprocs,
+                        process_id=pid)
+assert len(jax.devices()) == 8
+
+mesh = create_mesh((2, 4))   # ('dcn', 'data'): process boundary == slice
+configs = [TableConfig(40, 8, 'sum'), TableConfig(24, 8, 'sum'),
+           TableConfig(64, 4, 'mean')]
+fused = DistributedEmbedding(configs, mesh=mesh, dcn_sharding=True,
+                             fused_exchange=True)
+perg = DistributedEmbedding(configs, mesh=mesh, dcn_sharding=True,
+                            fused_exchange=False)
+key = jax.random.PRNGKey(0)
+pf = fused.init(key)    # deterministic: same logical rows both twins
+pp = perg.init(key)
+
+GB = 16
+rng = np.random.default_rng(0)  # same seed everywhere
+ids = [rng.integers(0, c.input_dim, size=(GB, 3)).astype(np.int32)
+       for c in configs]
+local = GB // nprocs
+cats = list(make_global_batch(
+    mesh, *[x[pid * local:(pid + 1) * local] for x in ids]))
+
+of = fused.apply(pf, cats)
+op = perg.apply(pp, cats)
+for t in range(len(configs)):
+  want = {tuple((s.start, s.stop) for s in shard.index):
+          np.asarray(shard.data) for shard in op[t].addressable_shards}
+  for shard in of[t].addressable_shards:
+    k = tuple((s.start, s.stop) for s in shard.index)
+    np.testing.assert_array_equal(np.asarray(shard.data), want[k])
+
+lp = fused.lookup_plan(global_batch=GB)
+assert lp.fused, lp
+dcn_legs = [l.name for l in lp.legs if l.axis == fused.dcn_axis]
+assert any(n.startswith('dcn/ids') for n in dcn_legs), dcn_legs
+assert any(n.startswith('dcn/rows') for n in dcn_legs), dcn_legs
+assert perg.lookup_plan(global_batch=GB).fused is False
+print(f'MP-OK rank={rank}')
+'''
+
+
+@pytest.mark.skipif(os.environ.get('DET_SKIP_MULTIPROC') == '1',
+                    reason='multi-process test disabled')
+def test_two_process_fused_exchange_parity(tmp_path):
+  _run_world(WORKER_FUSED, 2, 4, timeout=600)

@@ -4,8 +4,8 @@
 Traces the repo's real programs (lookup dispatch paths, chunked +
 monolithic sparse train step, serving ladder rungs, cold-tier fetch)
 on a forced-CPU virtual mesh and runs the graph passes — collective
-schedule, donation/aliasing, retrace ledger, host-sync, HBM accounting
-— over their jaxprs and compiled executables.  Shares detlint's waiver
+schedule, donation/aliasing, retrace ledger, host-sync, HBM accounting,
+collective-count budget — over their jaxprs and compiled executables.  Shares detlint's waiver
 baseline (``tools/detlint_baseline.toml``) and the tools/ exit-code
 contract (``tools/_cli.py``):
 
@@ -62,8 +62,9 @@ def main(argv: Optional[List[str]] = None) -> int:
   ap = _cli.make_parser(
       'graphlint',
       description='IR-level program-analysis gate: collective-schedule, '
-      'donation/aliasing, retrace-ledger, host-sync and HBM passes over '
-      "the repo's real traced programs, with stable finding ids and the "
+      'donation/aliasing, retrace-ledger, host-sync, HBM and '
+      "collective-count-budget passes over the repo's real traced "
+      'programs, with stable finding ids and the '
       'shared rationale-bearing waiver baseline; nonzero exit on '
       'violations (pipeline-gate friendly).',
       strict_help='also fail (exit 3) on unverifiable findings, stale '
@@ -83,7 +84,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                   'paths)')
   ap.add_argument('--passes', default=None,
                   help='comma-separated pass subset (default: all of '
-                  'schedule,donation,retrace,hostsync,hbm)')
+                  'schedule,donation,retrace,hostsync,hbm,budget)')
   ap.add_argument('--write-ledger', action='store_true',
                   help='also refresh the collective-schedule ledger '
                   'the conftest deadlock watchdog dumps; the '
