@@ -142,6 +142,29 @@ def graphlint_block() -> dict:
   }
 
 
+def commlint_block(programs=None) -> dict:
+  """The journaled cross-rank protocol gate counts (design §22; keys
+  pinned by tests/test_bench_artifact.py): ``commlint_findings`` is
+  the unwaived finding count across the four passes (0 on a healthy
+  tree), ``commlint_waivers`` the active waived true-positive count
+  (the rank-variant recovery paths commsan guards at runtime), and
+  ``commlint_schedules_predicted`` how many flagship program
+  schedules the emission pass re-derived from the lookup plans and
+  matched against the checked-in ledger — the journaled twin of the
+  dryrun cross-rank stage.  Pass ``programs`` to reuse an
+  already-built graphlint catalog instead of tracing a second one."""
+  from distributed_embeddings_tpu.analysis import commlint
+  res = commlint.run_repo(os.path.dirname(os.path.abspath(__file__)),
+                          programs=programs)
+  em = res.meta.get('commlint_emission', {})
+  return {
+      'commlint_findings': len(res.findings) + len(res.unverifiable),
+      'commlint_waivers': len(res.waived),
+      'commlint_schedules_predicted': sum(
+          1 for v in em.values() if v.get('matched')),
+  }
+
+
 def pick_baseline(model: str, n_devices: int):
   """Baseline at this device count; otherwise round UP to the smallest
   published count >= ours (more devices = faster baseline = harder target,
@@ -1539,6 +1562,16 @@ def main():
   except Exception as e:
     graphlint_stats = {'graphlint_error': f'{type(e).__name__}: {e}'}
 
+  # Cross-rank protocol gate counts (design §22): commlint's four
+  # passes over this tree + the flagship ledger; the emission pass
+  # re-traces the flagship catalog (same cost class as graphlint's
+  # block).  Never fatal.
+  commlint_stats = None
+  try:
+    commlint_stats = commlint_block()
+  except Exception as e:
+    commlint_stats = {'commlint_error': f'{type(e).__name__}: {e}'}
+
   n_dev = len(devices)
   backend = devices[0].platform
   # the baselines are AT global batch 65536: a reduced-batch chip run
@@ -1636,6 +1669,8 @@ def main():
     result.update(lint_stats)
   if graphlint_stats:
     result.update(graphlint_stats)
+  if commlint_stats:
+    result.update(commlint_stats)
   if on_cpu:
     # a sweep window may have landed an on-chip line earlier this round;
     # carry it (labelled, with its own sha/timestamp) so the artifact is

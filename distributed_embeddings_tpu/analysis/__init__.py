@@ -39,13 +39,26 @@ waiver baseline and CLI contract (``python tools/graphlint.py
 --strict``).  Import it explicitly
 (``from distributed_embeddings_tpu.analysis import graphlint``): it
 pulls in jax, which this package root deliberately does not.
+
+``commlint`` (docs/design.md §22) is the third TIER: the cross-RANK
+protocol — rank-variance dataflow, plan-predicted exchange schedules
+cross-checked against the graphlint ledger, a rank-pair rendezvous
+model-check with deadlock witnesses, and recovery-path uniformity —
+again under the same baseline and CLI (``python tools/commlint.py
+--strict``; ``python tools/lintall.py --strict`` runs all three).
+Import it explicitly too (same jax caveat, via the program catalog).
+``commsan`` is its runtime sibling exactly as locksan is the
+concurrency pass's: an opt-in capture window whose per-process
+collective-sequence digests are cross-checked at audit/checkpoint
+barriers.
 """
 
 from distributed_embeddings_tpu.analysis.core import (
     Baseline, BaselineError, Finding, Result, build_context, list_passes,
     run_passes, run_repo)
+from distributed_embeddings_tpu.analysis import commsan
 from distributed_embeddings_tpu.analysis import locksan
 
 __all__ = ['Baseline', 'BaselineError', 'Finding', 'Result',
            'build_context', 'list_passes', 'run_passes', 'run_repo',
-           'locksan']
+           'commsan', 'locksan']

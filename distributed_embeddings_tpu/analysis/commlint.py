@@ -1,0 +1,681 @@
+"""commlint: cross-rank collective-protocol verification (docs/design.md
+§22).
+
+detlint (design §17) gates the SOURCE; graphlint (design §18) gates ONE
+traced program.  Neither can see the pod-scale failure class the
+ROADMAP's multi-process scale-out opens: every rank must derive the
+SAME plan and walk the SAME collective schedule, or the mesh hangs
+CPU-idle with no error — a rank-variant host decision (recovery state,
+a host-local exception, a degraded-mode branch) is all it takes.
+commlint is the third analysis tier: it verifies the protocol *across
+ranks*, reusing detlint's finding-id/waiver machinery and graphlint's
+checked-in schedule ledger.
+
+Passes (``COMM_PASS_NAMES``; findings are ``rule@path::symbol`` under
+the shared ``tools/detlint_baseline.toml`` waiver discipline):
+
+- ``rankvar``     — AST/dataflow over the runtime tree: rank-variant
+  sources (``jax.process_index``/``process_count`` values, host-local
+  exception state like ``TierIntegrityError``) must not steer a branch
+  or handler that reaches collective-bearing code.  The call graph is
+  walked to a fixpoint from the ``jax.lax`` collective call sites, so
+  "reaches a collective" means the real dispatch chain, not a name
+  list.
+- ``emission``    — symbolic schedule emission: each catalog program's
+  expected exchange sequence is derived from its LookupPlan legs alone
+  (``planner.expected_collectives`` — host-side planning math, no
+  jaxpr) and cross-checked against the checked-in
+  ``tools/graphlint_ledger.json`` rows (jaxpr extraction).  Two
+  independent derivations of one schedule: the ledger is *predicted*,
+  not just pinned.  Non-exchange collectives must match the program's
+  declared ``sync_allowance``.
+- ``rendezvous``  — model-check: a rank-pair automaton walks every
+  divergent host-path pair the anomaly policies admit (normal ×
+  rollback, rollback × rollback_skip, the serving rungs, restore with
+  differing process counts) over the ledger's per-step schedule and
+  reports the MINIMAL DIVERGING PREFIX as a deadlock witness — the
+  collective, its axis, and the host branch that caused the split.
+  Pairs are only reportable when the triggering detection is
+  rank-variant (``DETECTION_SCOPE``): a globally-reduced loss anomaly
+  fires on every rank at once and cannot split the mesh.
+- ``recovery``    — recovery-path uniformity: enumerate the design §13
+  anomaly policies straight from ``parallel/grad.py``'s AST and prove
+  each handler branch executes zero collective-bearing calls before
+  the next barrier (a policy the handler does not recognise is itself
+  a finding — enumeration drift).
+
+The runtime twin is ``analysis/commsan.py`` (the locksan pattern): the
+same protocol, checked per-process at run time via sequence digests.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from distributed_embeddings_tpu.analysis import core as lint_core
+from distributed_embeddings_tpu.analysis import graphlint
+from distributed_embeddings_tpu.analysis.core import Finding
+
+COMM_PASS_NAMES = ('rankvar', 'emission', 'rendezvous', 'recovery')
+
+# Rank-variant value sources: calls whose RESULT differs per process.
+RANK_VARIANT_SOURCES = frozenset({'process_index', 'process_count'})
+
+# Exceptions raised from HOST-LOCAL state (one rank's cold tier, one
+# rank's filesystem): a handler for one is a host path only SOME ranks
+# take.  OSError-family exceptions are deliberately excluded — they
+# guard documented best-effort host legs everywhere and the signal
+# would drown.
+HOST_LOCAL_EXCEPTIONS = frozenset({'TierIntegrityError'})
+
+# Call names that ARE a collective dispatch: the graphlint primitives
+# plus the jax.lax spellings and the repo's own exchange stage.
+_COLLECTIVE_CALLS = frozenset(graphlint.COLLECTIVE_PRIMITIVES) | {
+    'psum_scatter', '_exchange', 'shard_map'}
+
+# How each fit() anomaly detection reaches the ranks (the rendezvous
+# reachability model; the structural facts live in parallel/grad.py
+# and parallel/audit.py):
+#   - non_finite_loss / loss_spike are raised in flush() scanning the
+#     host-synced loss window — the loss is globally reduced inside the
+#     traced step, so every rank sees the same values: rank-UNIFORM.
+#   - audit_failure compares all-gathered invariant vectors (uniform)
+#     BUT StateAuditor also runs the host-local cold-tier digest check:
+#     mixed, treated as variant (the unsafe direction).
+#   - tier_integrity is `except TierIntegrityError` around the step
+#     loop — one rank's host tier, purely rank-VARIANT.
+DETECTION_SCOPE = {
+    'non_finite_loss': 'uniform',
+    'loss_spike': 'uniform',
+    'audit_failure': 'variant',
+    'tier_integrity': 'variant',
+}
+
+# The audit barrier as a schedule op: StateAuditor._device_pass issues
+# one all_gather per check output over the mesh axes.
+AUDIT_BARRIER_OP = ('all_gather', 'audit-barrier')
+
+
+# --------------------------------------------------------------------------
+# shared inputs
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class CommContext:
+  """Everything the four passes share: the AST parse (rankvar,
+  recovery), the checked-in ledger (emission, rendezvous) and — only
+  when the emission pass runs — the traced program catalog with its
+  plan snapshots."""
+  ctx: lint_core.Context
+  ledger: Dict[str, Any]
+  programs: Optional[List[graphlint.Program]] = None
+  meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+  f = node.func
+  if isinstance(f, ast.Attribute):
+    return f.attr
+  if isinstance(f, ast.Name):
+    return f.id
+  return None
+
+
+def _exc_names(node: Optional[ast.AST]) -> Set[str]:
+  """Exception class names of one ``except`` clause (tuple-aware)."""
+  if node is None:
+    return set()
+  items = node.elts if isinstance(node, ast.Tuple) else [node]
+  out: Set[str] = set()
+  for it in items:
+    if isinstance(it, ast.Name):
+      out.add(it.id)
+    elif isinstance(it, ast.Attribute):
+      out.add(it.attr)
+  return out
+
+
+def collective_bearing(ctx: lint_core.Context
+                       ) -> Dict[Tuple[str, str], str]:
+  """``(relpath, qualname) -> why`` for every runtime function from
+  which a collective dispatch is reachable.
+
+  Seeds are direct call sites of ``_COLLECTIVE_CALLS`` (nested trace
+  bodies credit their enclosing builder — a shard_map'd ``local_fn``'s
+  ``all_to_all`` makes the builder bearing, which is exactly the
+  host-side dispatch the rendezvous cares about); the relation then
+  closes over the intra-repo call graph by callee name to a fixpoint.
+  Name-matched propagation over-approximates — the waiver baseline is
+  the precision valve, as everywhere in this tier."""
+  cached = ctx.meta.get('_commlint_bearing')
+  if cached is not None:
+    return cached
+  bearing: Dict[Tuple[str, str], str] = {}
+  calls: Dict[Tuple[str, str], Set[str]] = {}
+  defs_by_name: Dict[str, List[Tuple[str, str]]] = {}
+  for mod in ctx.modules.values():
+    idx = ctx.index(mod)
+    for qual, fnode in idx.functions.items():
+      fid = (mod.relpath, qual)
+      defs_by_name.setdefault(qual.rsplit('.', 1)[-1], []).append(fid)
+      names: Set[str] = set()
+      for node in ast.walk(fnode):
+        if isinstance(node, ast.Call):
+          n = _call_name(node)
+          if n is None:
+            continue
+          if n in _COLLECTIVE_CALLS and fid not in bearing:
+            bearing[fid] = f'calls collective {n!r} directly'
+          names.add(n)
+      calls[fid] = names
+  changed = True
+  while changed:
+    changed = False
+    bearing_names = {fid[1].rsplit('.', 1)[-1]: fid
+                     for fid in bearing}
+    for fid, names in calls.items():
+      if fid in bearing:
+        continue
+      hit = next((n for n in names if n in bearing_names), None)
+      if hit is not None:
+        via = bearing_names[hit]
+        bearing[fid] = f'calls {hit!r} -> {via[0]}::{via[1]}'
+        changed = True
+  ctx.meta['_commlint_bearing'] = bearing
+  return bearing
+
+
+# --------------------------------------------------------------------------
+# passes
+# --------------------------------------------------------------------------
+
+PassFn = Callable[[CommContext], List[Finding]]
+PASSES: Dict[str, PassFn] = {}
+
+
+def _register(name: str):
+  def deco(fn: PassFn) -> PassFn:
+    PASSES[name] = fn
+    return fn
+  return deco
+
+
+@_register('rankvar')
+def _rankvar_pass(cc: CommContext) -> List[Finding]:
+  """Rank-variance dataflow: a branch steered by a rank-variant value,
+  or a handler for a host-local exception, must not reach collective
+  dispatch — the trace-divergence shape."""
+  ctx = cc.ctx
+  bearing = collective_bearing(ctx)
+  findings: List[Finding] = []
+  summary: Dict[str, int] = {'sources': 0, 'regions': 0}
+
+  def sink_calls(region_nodes: Sequence[ast.AST]) -> List[Tuple[str, int]]:
+    out = []
+    for stmt in region_nodes:
+      for node in ast.walk(stmt):
+        if isinstance(node, ast.Call):
+          n = _call_name(node)
+          if n is None:
+            continue
+          for fid in bearing:
+            if fid[1].rsplit('.', 1)[-1] == n:
+              out.append((n, node.lineno))
+              break
+    return out
+
+  for mod in ctx.modules.values():
+    idx = ctx.index(mod)
+    for qual, fnode in idx.functions.items():
+      fid = (mod.relpath, qual)
+      tainted: Set[str] = set()
+      for node in lint_core.walk_in_scope(fnode):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+          if _call_name(node.value) in RANK_VARIANT_SOURCES:
+            summary['sources'] += 1
+            tainted.update(t.id for t in node.targets
+                           if isinstance(t, ast.Name))
+      branch_ord = 0
+      for node in lint_core.walk_in_scope(fnode):
+        if isinstance(node, ast.If):
+          test_names = {n.id for n in ast.walk(node.test)
+                        if isinstance(n, ast.Name)}
+          test_calls = {_call_name(c) for c in ast.walk(node.test)
+                        if isinstance(c, ast.Call)}
+          src = sorted((test_names & tainted)
+                       | (test_calls & RANK_VARIANT_SOURCES))
+          if not src:
+            continue
+          branch_ord += 1
+          summary['regions'] += 1
+          for name, line in sink_calls(node.body + node.orelse):
+            findings.append(Finding(
+                rule='rankvar/rank-variant-branch', path=mod.relpath,
+                line=line, symbol=f'{qual}:{src[0]}#{branch_ord}',
+                message=f'branch on rank-variant value {src[0]!r} '
+                f'reaches collective-bearing call {name!r} — ranks '
+                'taking different arms issue different collective '
+                'sequences and the mesh wedges at the first '
+                'rendezvous only some ranks enter (design §22); '
+                'make the predicate mesh-uniform (reduce it across '
+                'the mesh first) or hoist the dispatch out of the '
+                'branch'))
+        elif isinstance(node, ast.ExceptHandler):
+          hit = sorted(_exc_names(node.type) & HOST_LOCAL_EXCEPTIONS)
+          if not hit:
+            continue
+          summary['regions'] += 1
+          if fid in bearing:
+            findings.append(Finding(
+                rule='rankvar/host-local-except-in-collective-path',
+                path=mod.relpath, line=node.lineno,
+                symbol=f'{qual}:{hit[0]}',
+                message=f'`except {hit[0]}` inside collective-bearing '
+                f'{qual} ({bearing[fid]}) — this exception is raised '
+                'from host-local state, so ONE rank takes the handler '
+                'while its peers continue into the next collective: '
+                'the rank-variant host path the rendezvous model-check '
+                'simulates (design §22).  Reduce the detection across '
+                'the mesh before acting on it, or cover the window '
+                'with a commsan barrier check'))
+          for name, line in sink_calls(list(node.body)):
+            findings.append(Finding(
+                rule='rankvar/rank-variant-dispatch', path=mod.relpath,
+                line=line, symbol=f'{qual}:{hit[0]}:{name}',
+                message=f'host-local `except {hit[0]}` handler calls '
+                f'collective-bearing {name!r} — a dispatch only the '
+                'failing rank executes; its peers are not in this '
+                'program and the rendezvous hangs (design §22)'))
+  cc.meta['commlint_rankvar'] = summary
+  return findings
+
+
+@_register('emission')
+def _emission_pass(cc: CommContext) -> List[Finding]:
+  """Symbolic schedule emission vs the checked-in ledger: the plan's
+  predicted exchange rows must equal the extracted ``all_to_all`` rows
+  exactly (order, axis, dtype, shape); any other extracted collective
+  must be covered by the program's declared ``sync_allowance``."""
+  findings: List[Finding] = []
+  emission_meta: Dict[str, Any] = {}
+  if cc.programs is None:
+    findings.append(Finding(
+        rule='emission/catalog-unavailable', path='<catalog>', line=0,
+        symbol='programs',
+        message='emission pass requested but no traced program catalog '
+        'was supplied/built — the plan-vs-ledger prediction cannot run',
+        verifiable=False))
+    return findings
+  for prog in cc.programs:
+    if prog.plan_expect is None:
+      continue
+    entry = cc.ledger.get(prog.name)
+    if entry is None:
+      # new program: the graphlint ledger-freshness gate owns entry
+      # existence; nothing to predict against yet
+      emission_meta[prog.name] = {'predicted': len(prog.plan_expect),
+                                  'ledger': None}
+      continue
+    rows = entry.get('collectives', [])
+    pred = prog.plan_expect
+    allowance = set(prog.sync_allowance)
+    matched = True
+    allowed = 0
+    pi = 0
+    # greedy alignment in program order: each ledger row either matches
+    # the NEXT predicted leg exactly, or must be covered by the
+    # declared sync allowance (apply-stage grad syncs the plan records
+    # no leg for); leftovers on either side are findings
+    for ri, op in enumerate(rows):
+      prim, ax = op.get('primitive'), op.get('axis')
+      if prim == 'all_to_all' and pi < len(pred):
+        p = pred[pi]
+        if (p['axis'], p['dtype'], [int(d) for d in p['shape']]) == \
+            (ax, op['dtype'], [int(d) for d in op['shape']]):
+          pi += 1
+          continue
+      if (prim, ax) in allowance:
+        allowed += 1
+        continue
+      matched = False
+      if prim != 'all_to_all':
+        findings.append(Finding(
+            rule='emission/unpredicted-collective', path=prog.name,
+            line=0, symbol=f'{prim}@{ax}#{ri}',
+            message=f'ledger pins a {prim} on axis {ax!r} that is '
+            "neither a plan leg nor in the program's declared sync "
+            'allowance — an undeclared rendezvous point no rank-level '
+            'reasoning covers (declare it in the catalog, or remove '
+            'it)'))
+      elif pi < len(pred):
+        p = pred[pi]
+        pi += 1
+        findings.append(Finding(
+            rule='emission/schedule-mismatch', path=prog.name, line=0,
+            symbol=f'a2a#{ri}',
+            message=f"plan leg {p['leg']!r} predicts all_to_all #{ri} "
+            f"as {p['dtype']} {p['shape']} @ {p['axis']} but the "
+            f"ledger row is {op['dtype']} {op['shape']} @ {ax} — "
+            'the plan-side offset math and the traced program disagree '
+            'about what this exchange carries (design §22); one of the '
+            'two derivations is wrong'))
+      else:
+        findings.append(Finding(
+            rule='emission/unpredicted-exchange', path=prog.name,
+            line=0, symbol=f'a2a#{ri}',
+            message=f'ledger pins all_to_all #{ri} '
+            f"({op['dtype']} {op['shape']} @ {ax}) but the LookupPlan "
+            'emitted no leg for it — an exchange exists in the traced '
+            'program that the plan does not know about, so ranks '
+            'cannot agree on it from the plan alone (design §22)'))
+    for p in pred[pi:]:
+      matched = False
+      findings.append(Finding(
+          rule='emission/missing-exchange', path=prog.name, line=0,
+          symbol=f"leg:{p['leg']}",
+          message=f"plan leg {p['leg']!r} predicts an all_to_all "
+          f"({p['dtype']} {p['shape']} @ {p['axis']}) the ledger "
+          'never pins — the plan promises a collective the traced '
+          'program never issues'))
+    emission_meta[prog.name] = {'predicted': len(pred),
+                                'ledger': len(rows),
+                                'allowed_sync': allowed,
+                                'matched': matched}
+  cc.meta['commlint_emission'] = emission_meta
+  return findings
+
+
+# ---- rendezvous machinery (also the test surface) ------------------------
+
+
+def divergence_witness(seq_a: Sequence[Tuple[str, str]],
+                       seq_b: Sequence[Tuple[str, str]],
+                       pair: str, branch: str
+                       ) -> Optional[Dict[str, Any]]:
+  """Simulate one rank pair walking two op sequences.  Returns None
+  when they rendezvous identically; otherwise the deadlock witness:
+  the MINIMAL diverging prefix (the longest common prefix plus the
+  first disagreeing op), the diverging index, both ranks' ops there
+  (``<exit>`` when one rank's sequence simply ends — its peer then
+  waits forever), and the causing host branch."""
+  n = min(len(seq_a), len(seq_b))
+  idx = next((i for i in range(n) if seq_a[i] != seq_b[i]), None)
+  if idx is None:
+    if len(seq_a) == len(seq_b):
+      return None
+    idx = n
+  a = f'{seq_a[idx][0]}@{seq_a[idx][1]}' if idx < len(seq_a) else '<exit>'
+  b = f'{seq_b[idx][0]}@{seq_b[idx][1]}' if idx < len(seq_b) else '<exit>'
+  return {
+      'pair': pair, 'branch': branch, 'index': idx,
+      'prefix': [list(op) for op in seq_a[:idx]],
+      'lhs': a, 'rhs': b,
+  }
+
+
+def policy_sequences(step_ops: Sequence[Tuple[str, str]],
+                     detect_step: int, window: int
+                     ) -> Dict[str, List[Tuple[str, str]]]:
+  """Per-policy host-path op sequences for ONE audit window of
+  ``window`` steps with a detection at ``detect_step`` (1-based,
+  ``<= window``), ending at the audit barrier.
+
+  The normal path runs every step then the barrier.  ``terminate``
+  exits at the detection.  ``rollback``/``rollback_skip`` restore
+  (zero collectives), then REPLAY the window from the rollback target
+  (step 0 here — the worst case) before reaching the barrier; the two
+  differ only in which input batches they read, which is invisible to
+  the schedule, so their sequences are identical by construction."""
+  step = list(step_ops)
+  normal = step * window + [AUDIT_BARRIER_OP]
+  replay = step * detect_step + step * window + [AUDIT_BARRIER_OP]
+  return {
+      'normal': normal,
+      'terminate': step * detect_step,
+      'rollback': replay,
+      'rollback_skip': list(replay),
+  }
+
+
+@_register('rendezvous')
+def _rendezvous_pass(cc: CommContext) -> List[Finding]:
+  """Rank-pair model-check over divergent host paths, reporting the
+  minimal diverging prefix as a deadlock witness."""
+  findings: List[Finding] = []
+  verdicts: Dict[str, Any] = {}
+  # per-step schedule from the checked-in train-step ledger entry
+  train = cc.ledger.get('train/monolithic') or next(
+      (v for k, v in sorted(cc.ledger.items())
+       if k.startswith('train/')), None)
+  if train is not None:
+    step_ops = [(op['primitive'], op['axis'])
+                for op in train.get('collectives', [])]
+    seqs = policy_sequences(step_ops, detect_step=2, window=3)
+    variant = sorted(k for k, v in DETECTION_SCOPE.items()
+                     if v == 'variant')
+    for policy in ('terminate', 'rollback', 'rollback_skip'):
+      wit = divergence_witness(
+          seqs['normal'], seqs[policy],
+          pair=f'normal x {policy}',
+          branch=f"parallel/grad.py fit: host-local detection "
+          f"({'/'.join(variant)}) -> handle_anomaly({policy!r})")
+      key = f'normal x {policy}'
+      if wit is None:
+        verdicts[key] = 'identical'
+        continue
+      verdicts[key] = wit
+      findings.append(Finding(
+          rule='rendezvous/divergent-pair', path='parallel/grad.py',
+          line=0, symbol=f'fit:normal x {policy}',
+          message=f'rank pair (normal, {policy}) deadlocks when a '
+          f'rank-variant detection ({"/".join(variant)}) fires on one '
+          f'rank only: after a common prefix of {wit["index"]} '
+          f'collective(s), the normal rank issues {wit["lhs"]} while '
+          f'the {policy} rank issues {wit["rhs"]} — minimal diverging '
+          f'prefix at schedule position {wit["index"]}, caused by '
+          f'{wit["branch"]}.  Until recovery is mesh-coordinated '
+          '(the open multi-host ROADMAP item), commsan is the runtime '
+          'guard: its barrier check turns this hang into a digest '
+          'mismatch'))
+    # rollback vs rollback_skip: same schedule by construction (they
+    # differ only in input position) — prove it, don't assume it
+    wit = divergence_witness(seqs['rollback'], seqs['rollback_skip'],
+                             pair='rollback x rollback_skip',
+                             branch='fit: skip_window input '
+                             'fast-forward')
+    verdicts['rollback x rollback_skip'] = wit or 'identical'
+    if wit is not None:
+      findings.append(Finding(
+          rule='rendezvous/divergent-pair', path='parallel/grad.py',
+          line=0, symbol='fit:rollback x rollback_skip',
+          message='rollback and rollback_skip walk different '
+          f'schedules: {wit}'))
+  # serving ladder: degraded (smaller rung / cold fetch) vs normal —
+  # safe iff every rung pair collapses to one schedule
+  rungs = {k: [(op['primitive'], op['axis'])
+               for op in v.get('collectives', [])]
+           for k, v in sorted(cc.ledger.items())
+           if k.startswith('serve/') and v.get('collectives')}
+
+  def collapse(ops):
+    out = []
+    for op in ops:
+      if not out or out[-1] != op:
+        out.append(op)
+    return out
+
+  names = sorted(rungs)
+  for i, a in enumerate(names):
+    for b in names[i + 1:]:
+      wit = divergence_witness(collapse(rungs[a]), collapse(rungs[b]),
+                               pair=f'{a} x {b}',
+                               branch='serving: degraded rung vs '
+                               'normal rung dispatch')
+      verdicts[f'{a} x {b}'] = wit or 'identical'
+      if wit is not None:
+        findings.append(Finding(
+            rule='rendezvous/divergent-pair', path=a, line=0,
+            symbol=f'{a} x {b}',
+            message=f'serving host paths {a} and {b} diverge: after '
+            f'{wit["index"]} collapsed collective(s), {wit["lhs"]} vs '
+            f'{wit["rhs"]} ({wit["branch"]}) — a degraded rank wedges '
+            'against a normal one at that position'))
+  # restore with differing process counts: the restore path itself is
+  # zero-collective (host-side reshard) — both sequences empty
+  verdicts['restore(n) x restore(m)'] = 'identical'
+  cc.meta['commlint_rendezvous'] = verdicts
+  return findings
+
+
+@_register('recovery')
+def _recovery_pass(cc: CommContext) -> List[Finding]:
+  """Recovery-path uniformity: every anomaly policy's handler branch
+  must execute zero collective-bearing calls before the next barrier
+  (its collective footprint up to the barrier must be empty, because a
+  handler runs on an arbitrary SUBSET of ranks)."""
+  ctx = cc.ctx
+  bearing = collective_bearing(ctx)
+  findings: List[Finding] = []
+  grad = ctx.modules.get(os.path.join('distributed_embeddings_tpu',
+                                      'parallel', 'grad.py').replace(
+                                          os.sep, '/'))
+  if grad is None:
+    grad = next((m for rel, m in ctx.modules.items()
+                 if rel.endswith('parallel/grad.py')), None)
+  if grad is None:
+    cc.meta['commlint_recovery'] = {}
+    return findings
+  # the policy enumeration, straight from the module AST
+  policies: List[str] = []
+  for node in grad.tree.body:
+    if isinstance(node, ast.Assign) and any(
+        isinstance(t, ast.Name) and t.id == 'ANOMALY_POLICIES'
+        for t in node.targets):
+      policies = [c.value for c in ast.walk(node.value)
+                  if isinstance(c, ast.Constant)
+                  and isinstance(c.value, str)]
+  idx = ctx.index(grad)
+  handler_qual = next((q for q in idx.functions
+                       if q.rsplit('.', 1)[-1] == 'handle_anomaly'),
+                      None)
+  recovery_meta: Dict[str, str] = {}
+  if handler_qual is None:
+    findings.append(Finding(
+        rule='recovery/handler-missing', path=grad.relpath, line=0,
+        symbol='handle_anomaly',
+        message='no handle_anomaly function found in parallel/grad.py '
+        '— the recovery-path uniformity proof has nothing to walk '
+        '(the anomaly state machine moved; update commlint)',
+        verifiable=False))
+    cc.meta['commlint_recovery'] = recovery_meta
+    return findings
+  hnode = idx.functions[handler_qual]
+  compared: Set[str] = {c.value for c in ast.walk(hnode)
+                        if isinstance(c, ast.Constant)
+                        and isinstance(c.value, str)}
+  collective_calls: List[Tuple[str, int, str]] = []
+  for node in lint_core.walk_in_scope(hnode):
+    if isinstance(node, ast.Call):
+      n = _call_name(node)
+      if n is None:
+        continue
+      for fid in bearing:
+        if fid[1].rsplit('.', 1)[-1] == n:
+          collective_calls.append((n, node.lineno, bearing[fid]))
+          break
+  for name, line, why in collective_calls:
+    findings.append(Finding(
+        rule='recovery/collective-in-recovery-path', path=grad.relpath,
+        line=line, symbol=f'{handler_qual}:{name}',
+        message=f'anomaly handler calls collective-bearing {name!r} '
+        f'({why}) — the handler runs on the subset of ranks that '
+        'detected the anomaly, so this dispatch has no peers and '
+        'hangs (design §22); recovery work before the next barrier '
+        'must be host-local'))
+  for policy in policies:
+    if policy is None:
+      continue
+    if policy not in compared:
+      findings.append(Finding(
+          rule='recovery/unhandled-policy', path=grad.relpath, line=0,
+          symbol=f'{handler_qual}:{policy}',
+          message=f'anomaly policy {policy!r} is registered in '
+          'ANOMALY_POLICIES but never compared against inside the '
+          'handler — an unreachable recovery path is unverifiable '
+          'drift between the registry and the state machine'))
+      recovery_meta[policy] = 'unhandled'
+    else:
+      recovery_meta[policy] = ('collective-bearing'
+                               if collective_calls else
+                               'zero-collectives')
+  cc.meta['commlint_recovery'] = recovery_meta
+  return findings
+
+
+# --------------------------------------------------------------------------
+# runners
+# --------------------------------------------------------------------------
+
+
+def default_ledger(root: Optional[str] = None) -> Dict[str, Any]:
+  try:
+    with open(graphlint.default_ledger_path(root),
+              encoding='utf-8') as f:
+      return json.load(f)
+  except (OSError, ValueError):
+    return {}
+
+
+def run_passes(root: str, passes: Optional[List[str]] = None,
+               baseline: Optional[lint_core.Baseline] = None,
+               programs: Optional[List[graphlint.Program]] = None,
+               ledger: Optional[Dict[str, Any]] = None,
+               tier: str = 'flagship',
+               context: Optional[lint_core.Context] = None
+               ) -> lint_core.Result:
+  """Run the requested commlint passes (default: all four) over one
+  tree.  The traced catalog is built (with its plan snapshots) only
+  when the emission pass actually runs and no ``programs`` were
+  handed in — the AST/model passes never import jax."""
+  names = list(COMM_PASS_NAMES) if passes is None else list(passes)
+  for name in names:
+    if name not in PASSES:
+      raise ValueError(f'unknown commlint pass {name!r}; available: '
+                       f'{sorted(PASSES)}')
+  ctx = context if context is not None else lint_core.build_context(root)
+  if ledger is None:
+    ledger = default_ledger(root)
+  if programs is None and 'emission' in names:
+    programs = graphlint.build_programs(tier=tier)
+  cc = CommContext(ctx=ctx, ledger=ledger, programs=programs)
+  findings: List[Finding] = []
+  for name in names:
+    findings.extend(PASSES[name](cc))
+  cc.meta.setdefault(
+      'commlint_programs',
+      sorted(p.name for p in programs or [] if p.plan_expect is not None))
+  return lint_core.apply_baseline(findings, baseline, set(names),
+                                  cc.meta)
+
+
+def run_repo(root: Optional[str] = None,
+             passes: Optional[List[str]] = None,
+             programs: Optional[List[graphlint.Program]] = None,
+             tier: str = 'flagship') -> lint_core.Result:
+  """The one-call CI entry: all four passes over the live tree under
+  the shared checked-in baseline — what ``tools/commlint.py``,
+  ``tools/lintall.py``, ``bench.py``'s journaled ``commlint_findings``
+  count, the dryrun lint stage and tier-1's ``tests/test_commlint.py``
+  all share."""
+  root = root or lint_core.default_root()
+  baseline = lint_core.Baseline.load(
+      lint_core.default_baseline_path(root))
+  return run_passes(root, passes=passes, baseline=baseline,
+                    programs=programs, tier=tier)

@@ -1,0 +1,212 @@
+"""commsan: the runtime rendezvous sanitizer (docs/design.md §22).
+
+commlint proves cross-rank schedule properties STATICALLY — the plan
+predicts the ledger, the rank-pair automaton names divergent prefixes.
+commsan is its runtime twin, exactly as locksan twins the concurrency
+pass (design §17): an opt-in capture window during which instrumented
+dispatch sites (``dist_embedding._exchange`` at trace time, the
+``fit`` step loop and its rollback branches, the audit and checkpoint
+barriers) append to a per-process sequence whose rolling sha256 digest
+is cross-checked against every peer at each barrier through the
+``jax.distributed`` KV store.  A rank that walked a different host
+path — rolled back while its peers trained on, took the degraded
+serving branch, replayed a skipped window — carries a different digest,
+and the NEXT barrier raises ``CommSequenceError`` naming both digests
+and this rank's sequence tail instead of wedging the mesh CPU-idle.
+
+The check is host-side (KV store, no device collective), so it works
+on every backend — including the forced-CPU test world where device
+collectives across processes do not exist.  Out of a capture window
+every hook is a single ``is None`` test: the disabled path costs
+nothing, the design §15 discipline.
+
+The digest is computed over the same plan-level dispatch names
+commlint's emission pass predicts from (``trace:<leg phase>``,
+``fit/step``, ``audit/run`` ...), so the static and runtime verdicts
+describe one protocol and can never diverge on what a "schedule
+position" means.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import threading
+
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from distributed_embeddings_tpu.utils import resilience
+
+
+class CommSequenceError(RuntimeError):
+  """Per-process collective-sequence digests disagreed at a barrier:
+  some rank walked a divergent host path.  The message is the witness —
+  barrier tag, this rank's digest/record count, every disagreeing
+  peer's, and this rank's sequence tail naming the dispatch sites that
+  led into the barrier."""
+
+
+class Capture:
+  """One capture window's per-process dispatch journal.
+
+  ``record`` appends ``(site, detail)`` and folds it into a rolling
+  sha256; ``barrier_check`` publishes ``count:digest`` under a
+  per-barrier KV key and compares every peer's.  Thread-safe (the
+  serving batcher dispatches from worker threads)."""
+
+  def __init__(self, label: str, timeout_s: float = 30.0):
+    self.label = label
+    self.timeout_s = timeout_s
+    self.records: List[Tuple[str, str]] = []
+    self.checks = 0
+    self.mismatches: List[str] = []
+    self._lock = threading.Lock()
+    self._sha = hashlib.sha256()
+
+  def record(self, site: str, **info: Any) -> None:
+    detail = ','.join(f'{k}={info[k]}' for k in sorted(info))
+    with self._lock:
+      self.records.append((site, detail))
+      self._sha.update(f'{site}|{detail}\n'.encode('utf-8'))
+
+  def digest(self) -> Tuple[str, int]:
+    """``(hex digest, record count)`` of the sequence so far."""
+    with self._lock:
+      return self._sha.hexdigest()[:16], len(self.records)
+
+  def tail(self, n: int = 6) -> str:
+    with self._lock:
+      recs = self.records[-n:]
+    return ' -> '.join(f'{s}[{d}]' if d else s for s, d in recs) \
+        or '<empty>'
+
+  def barrier_check(self, tag: str) -> None:
+    """Cross-process digest comparison at a named, rank-uniform
+    barrier (audit cadence, checkpoint save).  Journals this rank's
+    digest (``commsan_digest``); on disagreement journals
+    ``commsan_mismatch`` and raises ``CommSequenceError`` with the
+    witness.  A peer that never reaches the barrier key inside the
+    timeout is reported as a mismatch too — a report beats a wedge."""
+    self.checks += 1
+    digest, count = self.digest()
+    resilience.journal('commsan_digest', label=self.label, tag=str(tag),
+                       check=self.checks, digest=digest, records=count)
+    world, rank, client = _world()
+    if world <= 1 or client is None:
+      return
+    mine = f'{count}:{digest}'
+    key = f'commsan/{self.label}/{tag}/{self.checks}'
+    client.key_value_set(f'{key}/{rank}', mine)
+    peers: Dict[int, str] = {}
+    for r in range(world):
+      if r == rank:
+        continue
+      try:
+        peers[r] = client.blocking_key_value_get(
+            f'{key}/{r}', int(self.timeout_s * 1000))
+      except Exception as e:  # timeout/absence IS the divergence signal
+        peers[r] = f'<no digest within {self.timeout_s:g}s: ' \
+            f'{type(e).__name__}>'
+    bad = {r: v for r, v in peers.items() if v != mine}
+    if not bad:
+      return
+    witness = (
+        f'commsan: collective-sequence digest mismatch at barrier '
+        f'{tag!r} (check #{self.checks}, capture {self.label!r}): '
+        f'rank {rank} has {mine} but '
+        + ', '.join(f'rank {r} has {v}' for r, v in sorted(bad.items()))
+        + f'; rank {rank} tail: {self.tail()}')
+    self.mismatches.append(witness)
+    resilience.journal('commsan_mismatch', label=self.label,
+                       tag=str(tag), rank=rank, digest=mine,
+                       peers={str(r): v for r, v in sorted(bad.items())})
+    raise CommSequenceError(witness)
+
+  def report(self) -> str:
+    """Human-readable dump — what the conftest hang alarm prints so a
+    wedged rendezvous is attributable to a schedule position."""
+    digest, count = self.digest()
+    world, rank, _ = _world()
+    lines = [f'commsan capture {self.label!r} (rank {rank}/{world}): '
+             f'{count} record(s), digest {digest}, '
+             f'{self.checks} barrier check(s), '
+             f'{len(self.mismatches)} mismatch(es)']
+    with self._lock:
+      recs = self.records[-12:]
+    for site, detail in recs:
+      lines.append(f'  {site}' + (f'  [{detail}]' if detail else ''))
+    lines.extend(f'  MISMATCH: {m}' for m in self.mismatches)
+    return '\n'.join(lines)
+
+
+def _world() -> Tuple[int, int, Any]:
+  """``(process_count, process_index, kv client)`` — the client only
+  when a multi-process world is initialized; (1, 0, None) in every
+  single-process or jax-less context."""
+  try:
+    import jax
+    world = jax.process_count()
+    if world <= 1:
+      return 1, 0, None
+    # the KV client's home moved across jax versions: the public
+    # jax.distributed.global_state (newer) vs jax._src.distributed
+    # (0.4.x, where only initialize/shutdown are re-exported)
+    state = getattr(jax.distributed, 'global_state', None)
+    if state is None:
+      from jax._src import distributed as _dist
+      state = _dist.global_state
+    return world, jax.process_index(), state.client
+  except Exception:
+    return 1, 0, None
+
+
+# ---------------------------------------------------------------------------
+# module-level window: the hooks the runtime calls
+# ---------------------------------------------------------------------------
+
+_active: Optional[Capture] = None
+
+
+def active() -> Optional[Capture]:
+  return _active
+
+
+def record(site: str, **info: Any) -> None:
+  """Instrumented-site hook: a no-op (one ``is None`` test) outside a
+  capture window."""
+  cap = _active
+  if cap is not None:
+    cap.record(site, **info)
+
+
+def barrier_check(tag: str) -> None:
+  """Barrier hook (audit / checkpoint): a no-op outside a window."""
+  cap = _active
+  if cap is not None:
+    cap.barrier_check(tag)
+
+
+@contextlib.contextmanager
+def capture(label: str, timeout_s: float = 30.0) -> Iterator[Capture]:
+  """Arm the sanitizer for a window::
+
+      with commsan.capture('fit') as cap:
+          fit(...)
+      print(cap.report())
+
+  Nested windows restore the outer capture on exit."""
+  global _active
+  prev = _active
+  cap = Capture(label, timeout_s=timeout_s)
+  _active = cap
+  try:
+    yield cap
+  finally:
+    _active = prev
+
+
+def report_active() -> Optional[str]:
+  """The active window's ``report()``, or None — what the conftest
+  420 s alarm dumps alongside the collective ledger."""
+  cap = _active
+  return cap.report() if cap is not None else None

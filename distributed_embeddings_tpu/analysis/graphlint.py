@@ -171,6 +171,14 @@ class Program:
   retrace: Optional[RetraceRecord] = None
   hostsync: Optional[HostSyncRecord] = None
   note: str = ''
+  # commlint inputs (design §22): the plan-derived EXPECTED exchange
+  # schedule (``planner.expected_collectives`` over the LookupPlans the
+  # trace populated — fwd legs then bwd legs for train steps) and the
+  # non-exchange collectives the program is ALLOWED to issue besides
+  # them (apply-stage sync the plan does not record, e.g. the
+  # dcn-replicated grad all_gather) as (primitive, axis) pairs
+  plan_expect: Optional[List[Dict[str, Any]]] = None
+  sync_allowance: Tuple[Tuple[str, str], ...] = ()
   # memoized derived facts: the HLO alias parse (a full as_text dump)
   # and the jaxpr walk are each needed by a pass AND the meta ledger —
   # computed once per program, not once per consumer
@@ -769,6 +777,7 @@ def build_programs(tier: str = 'flagship') -> List[Program]:
       hotcache, init_hybrid_train_state, make_hybrid_train_step,
       set_weights)
   from distributed_embeddings_tpu.parallel import dist_embedding as de
+  from distributed_embeddings_tpu.parallel import planner as planner_mod
 
   programs: List[Program] = []
   devs = jax.devices()[:8]
@@ -784,6 +793,23 @@ def build_programs(tier: str = 'flagship') -> List[Program]:
     return [jnp.asarray(rng.integers(0, c.input_dim, size=(n,))
                         .astype(np.int32)) for c in configs]
 
+  def plan_expectation(dist, paths=(None,), global_batch=None):
+    """The plan-predicted exchange schedule for the program a trace
+    just populated: ``planner.expected_collectives`` over the
+    most-recent ``LookupPlan`` per requested path (``None`` = the most
+    recent plan of any path — correct immediately after the trace that
+    built it; the serving ladder shares one engine across rungs, so
+    rung programs pin ``global_batch`` to select THEIR signature's
+    plan).  ``None`` when a requested plan was never built."""
+    ops: List[Dict[str, Any]] = []
+    for path in paths:
+      try:
+        plan = dist.lookup_plan(global_batch=global_batch, path=path)
+      except KeyError:
+        return None
+      ops.extend(planner_mod.expected_collectives(plan))
+    return ops
+
   def forward_program(name, dist, params, cats, parity=None,
                       fetch=None, compile_ok=True, note=''):
     hot = tuple([1] * len(cats))
@@ -798,6 +824,8 @@ def build_programs(tier: str = 'flagship') -> List[Program]:
         name, jaxpr=traced.jaxpr, compiled=compiled, parity=parity,
         hbm_budget=dist.plan.device_hbm_budget,
         resident_state_bytes=measure_resident_bytes(params),
+        plan_expect=plan_expectation(
+            dist, global_batch=int(cats[0].shape[0])),
         note=note))
     return programs[-1]
 
@@ -838,7 +866,8 @@ def build_programs(tier: str = 'flagship') -> List[Program]:
     bwd_m = d_m._build_backward(gb_m, hot_m)
     traced_b = bwd_m.trace(*[jnp.ones_like(o) for o in outs_m])
     programs.append(Program(bname, jaxpr=traced_b.jaxpr,
-                            parity='bwd-fuse'))
+                            parity='bwd-fuse',
+                            plan_expect=plan_expectation(d_m, ('bwd',))))
 
   if tier == 'full':
     d_sc = DistributedEmbedding(cfg2, mesh=mesh,
@@ -894,7 +923,8 @@ def build_programs(tier: str = 'flagship') -> List[Program]:
                    hbm_budget=dist.plan.device_hbm_budget,
                    resident_state_bytes=measure_resident_bytes(
                        (state.params['embedding'],
-                        state.opt_state[1])))
+                        state.opt_state[1])),
+                   plan_expect=plan_expectation(dist, ('dp', 'bwd')))
     if chunks == 1:
       # the 3-step-fit retrace + host-sync proof rides on the
       # monolithic step: execute the AOT executable (no second trace),
@@ -958,7 +988,16 @@ def build_programs(tier: str = 'flagship') -> List[Program]:
                      hbm_budget=dist.plan.device_hbm_budget,
                      resident_state_bytes=measure_resident_bytes(
                          (state.params['embedding'],
-                          state.opt_state[1])))
+                          state.opt_state[1])),
+                     plan_expect=plan_expectation(dist, ('dp', 'bwd')),
+                     # the apply stage syncs grads across slices with a
+                     # collective the plan records no leg for — the
+                     # sharded arm's per-group DCN update all_to_all
+                     # (sparse.py hierarchical update exchange), the
+                     # flat twin's replicated-grad all_gather.  A
+                     # DECLARED allowance, not an unpredicted collective
+                     sync_allowance=((('all_to_all', 'dcn'),) if shard
+                                     else (('all_gather', 'dcn'),)))
       if shard:
         c0 = dist.compile_count
         sigs = []

@@ -173,6 +173,13 @@ REGISTERED_ARTIFACT_KEYS = frozenset({
     # IR-analysis gate counts (bench.graphlint_block; design §18)
     'graphlint_findings', 'graphlint_donation_ok',
     'graphlint_retraces', 'graphlint_peak_hbm_bytes',
+    # cross-rank protocol gate counts (bench.commlint_block; design
+    # §22): unwaived findings (0 on a healthy tree), the active waived
+    # true-positive count, and how many program schedules the emission
+    # pass PREDICTED from the plans — a drop below the catalog size
+    # means a plan/ledger divergence rode in under an allowance
+    'commlint_findings', 'commlint_waivers',
+    'commlint_schedules_predicted',
     # fused-exchange counters (bench.graphlint_block, design §21):
     # collective counts of the fused vs per-group twin programs plus
     # the fused programs' summed on-wire payload, all counted from the

@@ -46,6 +46,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from distributed_embeddings_tpu.analysis import commsan
 from distributed_embeddings_tpu.obs import metrics as obs_metrics
 from distributed_embeddings_tpu.obs import trace as obs_trace
 from distributed_embeddings_tpu.parallel.quantization import (
@@ -555,6 +556,11 @@ class StateAuditor:
     tier its digest sweep.  Journals and returns the findings."""
     import jax
     self.audits += 1
+    # the audit IS a rendezvous (the device pass all_gathers): fold it
+    # into the commsan sequence and cross-check digests here — every
+    # rank reaches this cadence point or the mesh was already split
+    # (design §22)
+    commsan.record('audit/run', audit=self.audits)
     t0 = time.perf_counter()
     findings: List[AuditFinding] = []
     leaves = self._collect_leaves(params, opt_state)
@@ -610,6 +616,7 @@ class StateAuditor:
     obs_metrics.observe('audit.call_ms', call_ms)
     if findings:
       obs_metrics.inc('audit.findings', len(findings))
+    commsan.barrier_check(f'audit:{self.audits}')
     return findings
 
   def check_state(self, state, step: Optional[int] = None

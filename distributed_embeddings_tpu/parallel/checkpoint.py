@@ -36,6 +36,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 import dataclasses
 
+from distributed_embeddings_tpu.analysis import commsan
 from distributed_embeddings_tpu.obs import metrics as obs_metrics
 from distributed_embeddings_tpu.obs import trace as obs_trace
 from distributed_embeddings_tpu.parallel import quantization
@@ -1223,6 +1224,12 @@ def save_train_npz(path: str,
                        path=os.path.basename(path))
   obs_metrics.inc('ckpt.saves')
   obs_metrics.observe('ckpt.save_ms', save_ms)
+  # the periodic save is a natural rank-uniform barrier: cross-check
+  # the commsan sequence digests here too (design §22)
+  step = int(np.asarray(extras['step'])) if extras and 'step' in extras \
+      else None
+  commsan.record('ckpt/save', step=step)
+  commsan.barrier_check(f'ckpt:{step}')
 
 
 def _save_train_npz(path, weights, table_states, extras, plan):
@@ -1378,6 +1385,10 @@ def restore_train_state(dist: DistributedEmbedding, state, source: str,
                        source=os.path.basename(source))
   obs_metrics.inc('ckpt.restores')
   obs_metrics.observe('ckpt.restore_ms', restore_ms)
+  # record WITHOUT a barrier check: a restore can legitimately run on
+  # one rank only (the rollback path) — the divergence it introduces is
+  # what the NEXT barrier's digest comparison detects
+  commsan.record('ckpt/restore', source=os.path.basename(source))
   return out
 
 

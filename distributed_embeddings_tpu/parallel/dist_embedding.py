@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_embeddings_tpu.analysis import commsan
 from distributed_embeddings_tpu.obs import trace as obs_trace
 from distributed_embeddings_tpu.ops.ragged import RaggedBatch
 from distributed_embeddings_tpu.parallel import mesh as mesh_lib
@@ -1390,6 +1391,10 @@ class DistributedEmbedding:
         out[i] = jax.lax.all_to_all(b, axis, 0, 0)
     if plan is not None:
       plan.record(legs)
+    # trace-time rendezvous journal (commsan, design §22): the legs a
+    # rank plans to dispatch, folded into its sequence digest — pure
+    # host-side bookkeeping, a no-op outside a capture window
+    commsan.record(f'trace:{name}', axis=axis, legs=len(legs))
     return out
 
   def lookup_plan(self, global_batch: Optional[int] = None,

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_embeddings_tpu.analysis import commsan
 from distributed_embeddings_tpu.obs import metrics as obs_metrics
 from distributed_embeddings_tpu.obs import trace as obs_trace
 from distributed_embeddings_tpu.parallel import mesh as mesh_lib
@@ -437,12 +438,16 @@ def fit(step_fn: Callable,
     resilience.journal('rollback', anomaly=a.kind, detect_step=a.step,
                        at_step=detect_at, to_step=to_step, path=path,
                        attempt=rollbacks, policy=on_anomaly)
+    commsan.record('fit/rollback', anomaly=a.kind, to_step=to_step,
+                   attempt=rollbacks)
     if on_anomaly == 'rollback_skip' and detect_at > to_step:
       # fast-forward past the offending window: batches (to_step,
       # detect_at] never replay (poison data would re-trigger)
       resilience.journal('skip_window', from_step=to_step,
                          to_step=detect_at,
                          batches=detect_at - to_step)
+      commsan.record('fit/skip_window', from_step=to_step,
+                     to_step=detect_at)
       it = iter(data_factory(detect_at))
     else:
       it = iter(data_factory(to_step))
@@ -474,6 +479,7 @@ def fit(step_fn: Callable,
             else:
               state, loss = step_fn(state, *args)
           obs_metrics.inc('train.steps')
+          commsan.record('fit/step', step=i + 1)
           window.append(loss)
           i += 1
           if auditor is not None and i % auditor.every == 0:

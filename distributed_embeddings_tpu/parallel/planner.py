@@ -44,6 +44,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import json
+import re
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -1535,6 +1536,40 @@ def fuse_layout(name: str, entries: Sequence[Tuple[str, Sequence[int],
     legs.append(LegLayout(name=name + suffix, axis=axis, dtype=dt,
                           lead=leads[dt], segments=tuple(segs)))
   return legs
+
+
+# Unfused legs are recorded under a ``/g<i>`` suffix (one per live
+# buffer — dist_embedding._exchange's per-group branch); fused legs keep
+# the bare phase name (plus a ``/{dtype}`` class suffix when one phase
+# mixes dtypes).  expected_collectives keys its shape rule off this.
+_UNFUSED_LEG_RE = re.compile(r'/g\d+$')
+
+
+def expected_collectives(plan: 'LookupPlan') -> List[Dict[str, Any]]:
+  """The collective sequence a rank MUST issue to execute ``plan`` —
+  derived purely from the recorded ``LegLayout``s, never from a jaxpr
+  (docs/design.md §22).
+
+  One op per leg, in recorded (= issue) order.  The shape rule mirrors
+  ``dist_embedding._exchange`` exactly: a fused leg ships the
+  ``[lead, total]`` concatenation of its segments' per-row flats; an
+  unfused (``/g<i>``) leg ships its single buffer at natural shape.
+  Because legs come from host-side planning math (``fuse_layout``)
+  while the graphlint ledger rows come from jaxpr extraction, the two
+  are independent derivations of the same schedule — commlint's
+  emission pass cross-checks them, making the checked-in ledger
+  *predicted* rather than merely pinned.
+  """
+  ops: List[Dict[str, Any]] = []
+  for leg in plan.legs:
+    if len(leg.segments) == 1 and _UNFUSED_LEG_RE.search(leg.name):
+      shape = tuple(leg.segments[0].shape)
+    else:
+      shape = (leg.lead, leg.total)
+    ops.append({'primitive': 'all_to_all', 'axis': leg.axis,
+                'dtype': leg.dtype, 'shape': [int(d) for d in shape],
+                'leg': leg.name})
+  return ops
 
 
 @dataclasses.dataclass

@@ -78,6 +78,10 @@ REGISTERED_EVENTS = frozenset({
     # ExchangeCostModel, design §20): one event per planning run with
     # the priced per-axis exchange bytes and the DCN:ICI ratio used
     'exchange_cost_model',
+    # runtime rendezvous sanitizer (analysis/commsan.py, design §22):
+    # one digest event per barrier check inside a capture window, one
+    # mismatch event per divergence witness raised at a barrier
+    'commsan_digest', 'commsan_mismatch',
 })
 
 _lock = threading.Lock()

@@ -33,16 +33,13 @@ fi
 # main.py prints which)
 make -C distributed_embeddings_tpu/cc >/dev/null 2>&1 || true
 
-# static-analysis gate (design §17): a chip window is too expensive to
-# burn on a tree that fails the standing detlint invariants — fail
-# fast (set -eu) before any data generation or compile work
-python tools/detlint.py --strict
-
-# IR-analysis gate (design §18): trace the real programs on a forced
-# 8-device CPU mesh and verify the collective schedules, train-state
-# donation/aliasing, zero-retrace and host-sync contracts — the other
-# class of regression a chip window must not burn time discovering
-python tools/graphlint.py --strict
+# the lint gate, all three analysis tiers in one fail-fast line
+# (design §17/§18/§22): detlint's AST invariants, graphlint's traced
+# collective-schedule/donation/retrace/host-sync contracts on a forced
+# 8-device CPU mesh, and commlint's cross-rank protocol (plan-predicted
+# schedules vs the checked-in ledger, rendezvous model-check) — a chip
+# window is too expensive to burn on a tree that fails any of them
+python tools/lintall.py --strict
 
 # perf sentinel (design §19): before burning a chip window, gate on the
 # longitudinal record — the newest journaled bench artifact must sit

@@ -73,6 +73,7 @@ def _hang_alarm(request):
     resilience.journal('test_alarm_fired', test=request.node.nodeid,
                        timeout_s=timeout_s)
     _dump_collective_ledger(request.node.nodeid)
+    _dump_commsan_journal(request.node.nodeid)
 
   timer = threading.Timer(timeout_s, fire)
   timer.daemon = True
@@ -136,3 +137,25 @@ def _dump_collective_ledger(nodeid):
           '--tier full --write-ledger refreshes) ===', file=sys.stderr)
   except Exception as e:  # noqa: BLE001 — diagnostics stay best-effort
     print(f'collective-ledger dump failed: {e!r}', file=sys.stderr)
+
+
+def _dump_commsan_journal(nodeid):
+  """If the wedged test had a commsan capture window armed (design
+  §22), print this process's recorded collective-site sequence — the
+  runtime twin of the static ledger above, so a cross-rank wedge is
+  attributable to the LAST site this rank actually reached, not just
+  to a program's expected schedule.  Best-effort, same contract as
+  the ledger dump."""
+  import sys
+  try:
+    from distributed_embeddings_tpu.analysis import commsan
+    rep = commsan.report_active()
+    if rep is None:
+      return
+    print(f'\n=== commsan sequence journal (test alarm: {nodeid}) ===',
+          file=sys.stderr)
+    print(rep, file=sys.stderr)
+    print('=== the last site above is where this rank stopped '
+          'recording; compare digests across ranks ===', file=sys.stderr)
+  except Exception as e:  # noqa: BLE001 — diagnostics stay best-effort
+    print(f'commsan journal dump failed: {e!r}', file=sys.stderr)

@@ -524,6 +524,31 @@ def test_graphlint_artifact_keys(bench):
   assert block['fused_exchange_bytes'] > 0, block
 
 
+def test_commlint_artifact_keys(bench):
+  """The ISSUE-18 journaled proof: the bench artifact carries the
+  cross-rank protocol gate counts (design §22) — commlint_findings is
+  0 on a healthy tree (the SAME gate tier-1's test_commlint.py
+  enforces), commlint_waivers equals the checked-in commlint-owned
+  waiver count (the rank-variant recovery paths commsan guards at
+  runtime), and commlint_schedules_predicted counts the flagship
+  programs whose collective schedule was re-derived from the lookup
+  plans and matched against the ledger — the full-catalog 15/15 pin
+  lives in test_commlint.py; here the journaled count must be live."""
+  from distributed_embeddings_tpu.analysis import Baseline, core
+  from distributed_embeddings_tpu.analysis import commlint
+  block = bench.commlint_block()
+  for key in ('commlint_findings', 'commlint_waivers',
+              'commlint_schedules_predicted'):
+    assert key in block, key
+  assert block['commlint_findings'] == 0, block
+  base = Baseline.load(core.default_baseline_path())
+  commlint_owned = [w for w in base.waivers
+                    if w['id'].split('/', 1)[0]
+                    in commlint.COMM_PASS_NAMES]
+  assert block['commlint_waivers'] == len(commlint_owned), block
+  assert block['commlint_schedules_predicted'] > 0, block
+
+
 def test_artifact_keys_registered():
   """Every artifact key THIS test file pins is in
   obs.metrics.REGISTERED_ARTIFACT_KEYS — the registry the detlint

@@ -1,0 +1,633 @@
+"""commlint cross-rank protocol layer + commsan runtime twin
+(docs/design.md §22).
+
+The load-bearing claims pinned here:
+
+- the live-tree gate: all four passes analyze CLEAN under the shared
+  baseline — the tier-1 wiring of ``python tools/commlint.py
+  --strict``;
+- the acceptance proof rides the same run: the emission pass PREDICTS
+  the checked-in ``tools/graphlint_ledger.json`` schedule for every
+  flagship program with a plan snapshot — two independent derivations
+  (host-side planning math vs jaxpr extraction) of one protocol;
+- the six waived true positives (the rank-variant recovery paths) are
+  re-derived exactly when the baseline is lifted — the waivers cover
+  REAL findings, not noise;
+- the seeded rollback_skip divergence produces a static deadlock
+  witness with the minimal diverging prefix (the runtime twin of the
+  same seed lives in test_multiprocess.py's commsan drill);
+- one seeded true-positive fixture per pass (rank-variant branch,
+  host-local handler, schedule mismatch, missing/unpredicted
+  exchange, collective-bearing recovery, enumeration drift), each
+  with a clean twin;
+- commsan: record/digest/tail mechanics, the single-process no-op
+  contract, journaled digests, and a faked two-rank KV world whose
+  digest split raises ``CommSequenceError`` with the witness instead
+  of wedging;
+- the CLI refuses a rationale-less baseline fast (exit 2) and the
+  lintall meta-runner merges the tiers under one exit contract.
+
+The module-scoped flagship fixture keeps tier-1 to ONE catalog build;
+the ``--tier full`` 15/15 prediction pin is ``-m slow``.
+"""
+
+import importlib.util
+import pathlib
+import textwrap
+
+import pytest
+
+from distributed_embeddings_tpu.analysis import commlint, commsan
+from distributed_embeddings_tpu.analysis import core as lint_core
+from distributed_embeddings_tpu.analysis import graphlint
+from distributed_embeddings_tpu.utils import resilience
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+# the six rank-variant recovery-path true positives the baseline
+# waives with rationale (re-dated, not silenced: commsan is the
+# runtime guard until recovery is mesh-coordinated)
+WAIVED_TRUE_POSITIVES = {
+    'rankvar/host-local-except-in-collective-path'
+    '@distributed_embeddings_tpu/parallel/grad.py::fit:TierIntegrityError',
+    'rankvar/rank-variant-dispatch@distributed_embeddings_tpu/parallel/'
+    'grad.py::fit:TierIntegrityError:handle_anomaly',
+    'recovery/collective-in-recovery-path@distributed_embeddings_tpu/'
+    'parallel/grad.py::fit.handle_anomaly:restore_train_state',
+    'rendezvous/divergent-pair@parallel/grad.py::fit:normal x rollback',
+    'rendezvous/divergent-pair@parallel/grad.py::fit:normal x '
+    'rollback_skip',
+    'rendezvous/divergent-pair@parallel/grad.py::fit:normal x terminate',
+}
+
+
+def _commlint_cli():
+  spec = importlib.util.spec_from_file_location(
+      'commlint_cli_for_test', str(ROOT / 'tools' / 'commlint.py'))
+  mod = importlib.util.module_from_spec(spec)
+  spec.loader.exec_module(mod)
+  return mod
+
+
+def _lintall_cli():
+  spec = importlib.util.spec_from_file_location(
+      'lintall_cli_for_test', str(ROOT / 'tools' / 'lintall.py'))
+  mod = importlib.util.module_from_spec(spec)
+  spec.loader.exec_module(mod)
+  return mod
+
+
+def _fixture_tree(tmp_path, files):
+  """A mini runtime tree commlint can walk: {relpath: source}."""
+  for rel, src in files.items():
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+  return str(tmp_path)
+
+
+def _rules(res):
+  return {f.rule for f in res.findings} | {f.rule
+                                           for f in res.unverifiable}
+
+
+@pytest.fixture(scope='module')
+def flagship():
+  """ONE flagship catalog build for the whole module (the same
+  fixture shape as test_graphlint.py) — the plan snapshots commlint's
+  emission pass predicts from ride on these Program objects."""
+  return graphlint.build_programs(tier='flagship')
+
+
+@pytest.fixture(scope='module')
+def live(flagship):
+  baseline = lint_core.Baseline.load(
+      str(ROOT / 'tools' / 'detlint_baseline.toml'))
+  return commlint.run_passes(str(ROOT), baseline=baseline,
+                             programs=flagship)
+
+
+# --------------------------------------------------------------------------
+# the live-tree gate + the emission acceptance proof
+# --------------------------------------------------------------------------
+
+
+def test_live_tree_commlint_strict_clean(live):
+  """The acceptance pin: zero unwaived findings, zero unverifiable,
+  zero stale/expired waivers over the live tree + flagship catalog —
+  exactly what ``python tools/commlint.py --strict`` exits 0 on."""
+  assert not live.findings, [f.brief() for f in live.findings]
+  assert not live.unverifiable, [f.brief() for f in live.unverifiable]
+  assert not live.stale_waivers, live.stale_waivers
+  assert not live.expired_waivers, live.expired_waivers
+
+
+def test_waived_ids_are_exactly_the_known_true_positives(live):
+  """The baseline covers REAL findings — exactly the six rank-variant
+  recovery paths, nothing more (a seventh waived id means a new
+  protocol violation rode in under the waiver file)."""
+  assert {f.id for f in live.waived} == WAIVED_TRUE_POSITIVES
+
+
+def test_lifting_the_baseline_rederives_the_true_positives():
+  """Without the baseline the six true positives come back VERBATIM
+  (stable finding ids — the waiver survival contract), and the
+  summaries carry the structural facts: one rank-variant source, three
+  regions, every anomaly policy collective-bearing."""
+  res = commlint.run_passes(str(ROOT),
+                            passes=['rankvar', 'rendezvous', 'recovery'])
+  assert {f.id for f in res.findings} == WAIVED_TRUE_POSITIVES
+  assert not res.unverifiable
+  assert res.meta['commlint_rankvar'] == {'sources': 1, 'regions': 3}
+  assert set(res.meta['commlint_recovery']) == {
+      'terminate', 'rollback', 'rollback_skip'}
+  assert all(v == 'collective-bearing'
+             for v in res.meta['commlint_recovery'].values())
+
+
+def test_emission_predicts_ledger_for_every_flagship_program(live):
+  """The tentpole acceptance criterion: every flagship program with a
+  plan snapshot has its ledger schedule PREDICTED by
+  ``planner.expected_collectives`` — matched row for row, with any
+  apply-stage sync absorbed only by a declared allowance."""
+  em = live.meta['commlint_emission']
+  assert em, 'emission pass produced no per-program meta'
+  unpredicted = {k: v for k, v in em.items() if not v.get('matched')}
+  assert not unpredicted, unpredicted
+  # every prediction ran against a real ledger entry (None would mean
+  # the ledger is missing a catalog program — graphlint's freshness
+  # gate owns that, but the prediction must not silently skip)
+  assert all(v['ledger'] is not None for v in em.values()), em
+  assert sorted(em) == live.meta['commlint_programs']
+
+
+@pytest.mark.slow
+def test_emission_predicts_full_tier_ledger():
+  """The full-catalog pin: every dispatch path (sparsecore + pallas
+  included) predicted, 15+ programs, zero unwaived findings."""
+  baseline = lint_core.Baseline.load(
+      str(ROOT / 'tools' / 'detlint_baseline.toml'))
+  res = commlint.run_passes(str(ROOT), baseline=baseline, tier='full')
+  assert not res.findings, [f.brief() for f in res.findings]
+  em = res.meta['commlint_emission']
+  assert len(em) >= 15, sorted(em)
+  assert all(v['matched'] for v in em.values()), em
+
+
+def test_rendezvous_verdicts_on_live_ledger(live):
+  """The model-check's live verdicts: the three rank-variant policies
+  diverge from normal (witnesses), rollback vs rollback_skip and every
+  serving rung pair are proven identical — the safe-by-construction
+  pairs are PROVEN, not assumed."""
+  rv = live.meta['commlint_rendezvous']
+  for policy in ('terminate', 'rollback', 'rollback_skip'):
+    wit = rv[f'normal x {policy}']
+    assert isinstance(wit, dict), (policy, wit)
+    assert wit['index'] >= 1 and wit['lhs'] != wit['rhs'], wit
+  assert rv['rollback x rollback_skip'] == 'identical'
+  assert rv['restore(n) x restore(m)'] == 'identical'
+  serve_pairs = [k for k in rv if k.startswith('serve/')]
+  assert serve_pairs, rv
+  assert all(rv[k] == 'identical' for k in serve_pairs), rv
+
+
+# --------------------------------------------------------------------------
+# the rendezvous model itself: the seeded rollback_skip deadlock witness
+# --------------------------------------------------------------------------
+
+
+def test_seeded_rollback_skip_divergence_witness():
+  """The static half of the ISSUE-18 seeded divergence: one rank down
+  rollback_skip, its peer normal — the witness names the MINIMAL
+  diverging prefix (the full common window) and the exact op pair: the
+  normal rank is at the audit barrier while the replaying rank
+  re-issues the data exchange.  The runtime half (commsan catching the
+  same split as a digest mismatch) is test_multiprocess.py's drill."""
+  step = [('all_to_all', 'data'), ('all_to_all', 'data')]
+  seqs = commlint.policy_sequences(step, detect_step=2, window=3)
+  wit = commlint.divergence_witness(
+      seqs['normal'], seqs['rollback_skip'],
+      pair='normal x rollback_skip', branch='seeded drill')
+  assert wit is not None
+  assert wit['index'] == 3 * len(step)  # the whole common window
+  assert wit['lhs'] == 'all_gather@audit-barrier'
+  assert wit['rhs'] == 'all_to_all@data'
+  assert len(wit['prefix']) == wit['index']
+  # terminate: the rank simply exits — its peer waits forever
+  wit = commlint.divergence_witness(
+      seqs['normal'], seqs['terminate'],
+      pair='normal x terminate', branch='seeded drill')
+  assert wit['index'] == 2 * len(step)
+  assert wit['rhs'] == '<exit>'
+  # rollback vs rollback_skip: identical by construction — proven
+  assert commlint.divergence_witness(
+      seqs['rollback'], seqs['rollback_skip'], pair='p',
+      branch='b') is None
+  # and two genuinely identical sequences are no witness at all
+  assert commlint.divergence_witness(
+      seqs['normal'], list(seqs['normal']), pair='p', branch='b') is None
+
+
+# --------------------------------------------------------------------------
+# seeded true-positive fixtures (one per pass) + clean twins
+# --------------------------------------------------------------------------
+
+
+def test_fixture_rank_variant_branch(tmp_path):
+  root = _fixture_tree(tmp_path, {
+      'distributed_embeddings_tpu/x.py': """
+          import jax
+
+          def talk(x):
+            return jax.lax.all_to_all(x, 'data', 0, 0)
+
+          def bad(x):
+            rank = jax.process_index()
+            if rank == 0:
+              return talk(x)          # only rank 0 dispatches
+            return x
+
+          def clean_no_collective(x):
+            rank = jax.process_index()
+            if rank == 0:
+              return x + 1            # host-local work is fine
+            return x
+
+          def clean_uniform_branch(x, flag):
+            if flag:                  # mesh-uniform predicate
+              return talk(x)
+            return x
+          """})
+  res = commlint.run_passes(root, passes=['rankvar'])
+  hits = [f for f in res.findings
+          if f.rule == 'rankvar/rank-variant-branch']
+  assert len(hits) == 1, [f.brief() for f in res.findings]
+  assert hits[0].symbol == 'bad:rank#1'
+  assert 'talk' in hits[0].message
+  assert not any('clean' in f.symbol for f in res.findings)
+
+
+def test_fixture_host_local_handler(tmp_path):
+  root = _fixture_tree(tmp_path, {
+      'distributed_embeddings_tpu/x.py': """
+          import jax
+
+          def talk(x):
+            return jax.lax.all_gather(x, 'data')
+
+          def bad(x):
+            try:
+              return talk(x)
+            except TierIntegrityError:
+              return talk(x)          # dispatch only the failer runs
+
+          def clean(x):
+            try:
+              return talk(x)
+            except OSError:           # best-effort host leg: excluded
+              return x
+          """})
+  res = commlint.run_passes(root, passes=['rankvar'])
+  ids = {f.id for f in res.findings}
+  assert ('rankvar/host-local-except-in-collective-path'
+          '@distributed_embeddings_tpu/x.py::bad:TierIntegrityError'
+          in ids), ids
+  assert ('rankvar/rank-variant-dispatch@distributed_embeddings_tpu/'
+          'x.py::bad:TierIntegrityError:talk' in ids), ids
+  assert not any('::clean' in i for i in ids), ids
+
+
+def test_fixture_recovery_pass(tmp_path):
+  """A collective-bearing handler branch AND a registered-but-never-
+  compared policy both fire; the clean twin (host-local handler, every
+  policy compared) does not."""
+  root = _fixture_tree(tmp_path, {
+      'distributed_embeddings_tpu/parallel/grad.py': """
+          import jax
+
+          ANOMALY_POLICIES = ('terminate', 'rollback', 'spin')
+
+          def sync(x):
+            return jax.lax.all_gather(x, 'data')
+
+          def handle_anomaly(policy, x):
+            if policy == 'terminate':
+              return None
+            if policy == 'rollback':
+              return sync(x)          # only the detecting rank runs this
+            return x
+          """})
+  res = commlint.run_passes(root, passes=['recovery'])
+  rules = _rules(res)
+  assert 'recovery/collective-in-recovery-path' in rules
+  assert 'recovery/unhandled-policy' in rules
+  ids = {f.id for f in res.findings}
+  assert any(i.endswith('::handle_anomaly:sync') for i in ids), ids
+  assert any(i.endswith('::handle_anomaly:spin') for i in ids), ids
+  assert res.meta['commlint_recovery']['spin'] == 'unhandled'
+
+  clean = _fixture_tree(tmp_path / 'clean', {
+      'distributed_embeddings_tpu/parallel/grad.py': """
+          ANOMALY_POLICIES = ('terminate', 'rollback')
+
+          def handle_anomaly(policy, x):
+            if policy == 'terminate':
+              return None
+            if policy == 'rollback':
+              return x - 1            # host-local restore
+            return x
+          """})
+  res = commlint.run_passes(clean, passes=['recovery'])
+  assert not res.findings, [f.brief() for f in res.findings]
+  assert res.meta['commlint_recovery'] == {
+      'terminate': 'zero-collectives', 'rollback': 'zero-collectives'}
+
+
+def _emit_prog(name, plan_expect, sync_allowance=()):
+  return graphlint.Program(name, plan_expect=plan_expect,
+                           sync_allowance=sync_allowance)
+
+
+def _a2a(shape, dtype='int32', axis='data'):
+  return {'primitive': 'all_to_all', 'axis': axis, 'dtype': dtype,
+          'shape': list(shape), 'leg': 'ids'}
+
+
+def test_fixture_emission_mismatch_and_leftovers():
+  """The three emission failure shapes: a shape mismatch between plan
+  and ledger, a ledger exchange the plan never predicted, and a
+  predicted exchange the ledger never pins."""
+  ledger = {
+      'fixture/mismatch': {'collectives': [
+          {'primitive': 'all_to_all', 'axis': 'data', 'dtype': 'int32',
+           'shape': [4, 2]}]},
+      'fixture/extra': {'collectives': [
+          {'primitive': 'all_to_all', 'axis': 'data', 'dtype': 'int32',
+           'shape': [4, 1]},
+          {'primitive': 'all_to_all', 'axis': 'data', 'dtype': 'f32',
+           'shape': [4, 8]}]},
+      'fixture/missing': {'collectives': []},
+  }
+  programs = [
+      _emit_prog('fixture/mismatch', [_a2a([4, 1])]),
+      _emit_prog('fixture/extra', [_a2a([4, 1])]),
+      _emit_prog('fixture/missing', [_a2a([4, 1])]),
+  ]
+  res = commlint.run_passes(str(ROOT), passes=['emission'],
+                            programs=programs, ledger=ledger)
+  by_rule = {}
+  for f in res.findings:
+    by_rule.setdefault(f.rule, []).append(f)
+  assert [f.path for f in by_rule['emission/schedule-mismatch']] == \
+      ['fixture/mismatch']
+  assert [f.path for f in by_rule['emission/unpredicted-exchange']] == \
+      ['fixture/extra']
+  assert [f.path for f in by_rule['emission/missing-exchange']] == \
+      ['fixture/missing']
+  em = res.meta['commlint_emission']
+  assert not any(v['matched'] for v in em.values()), em
+
+
+def test_fixture_emission_sync_allowance():
+  """A non-exchange collective is a finding UNLESS the program
+  declares it — and the declaration is per (primitive, axis), not a
+  blanket pass."""
+  ledger = {'fixture/sync': {'collectives': [
+      {'primitive': 'all_to_all', 'axis': 'data', 'dtype': 'int32',
+       'shape': [4, 1]},
+      {'primitive': 'all_gather', 'axis': 'dcn', 'dtype': 'f32',
+       'shape': [8, 5]}]}}
+  progs = [_emit_prog('fixture/sync', [_a2a([4, 1])])]
+  res = commlint.run_passes(str(ROOT), passes=['emission'],
+                            programs=progs, ledger=ledger)
+  assert _rules(res) == {'emission/unpredicted-collective'}
+
+  allowed = [_emit_prog('fixture/sync', [_a2a([4, 1])],
+                        sync_allowance=(('all_gather', 'dcn'),))]
+  res = commlint.run_passes(str(ROOT), passes=['emission'],
+                            programs=allowed, ledger=ledger)
+  assert not res.findings, [f.brief() for f in res.findings]
+  em = res.meta['commlint_emission']['fixture/sync']
+  assert em == {'predicted': 1, 'ledger': 2, 'allowed_sync': 1,
+                'matched': True}
+
+
+def test_emission_without_catalog_is_unverifiable():
+  """emission with no catalog at all: an UNVERIFIABLE finding
+  (strict-visible), never a silent pass; an EMPTY supplied catalog
+  predicts nothing and says so in meta."""
+  ctx = lint_core.build_context(str(ROOT))
+  cc = commlint.CommContext(ctx=ctx, ledger={}, programs=None)
+  findings = commlint.PASSES['emission'](cc)
+  assert [f.rule for f in findings] == ['emission/catalog-unavailable']
+  assert not findings[0].verifiable
+  res = commlint.run_passes(str(ROOT), passes=['emission'],
+                            programs=[], ledger={})
+  assert not res.findings
+  assert res.meta['commlint_emission'] == {}
+  assert res.meta['commlint_programs'] == []
+
+
+# --------------------------------------------------------------------------
+# commsan: the runtime twin
+# --------------------------------------------------------------------------
+
+
+def test_commsan_record_digest_tail():
+  resilience.clear_recent()
+  with commsan.capture('t') as cap:
+    d0, c0 = cap.digest()
+    assert c0 == 0
+    commsan.record('fit/step', step=1)
+    commsan.record('trace:dcn/ids/fwd', axis='dcn', legs=2)
+    d1, c1 = cap.digest()
+    assert c1 == 2 and d1 != d0
+    assert 'fit/step[step=1]' in cap.tail()
+    assert 'trace:dcn/ids/fwd' in cap.tail()
+    # detail keys are sorted: the digest is order-insensitive in kwargs
+    assert cap.records[1][1] == 'axis=dcn,legs=2'
+  # outside the window the hooks are no-ops, not errors
+  assert commsan.active() is None
+  commsan.record('fit/step', step=99)
+  commsan.barrier_check('audit:1')
+  assert commsan.report_active() is None
+
+
+def test_commsan_single_process_barrier_journals_and_passes():
+  """world == 1: the barrier journals this process's digest (the
+  longitudinal record) and returns — no KV store, no error."""
+  resilience.clear_recent()
+  with commsan.capture('solo') as cap:
+    commsan.record('fit/step', step=1)
+    commsan.barrier_check('audit:1')
+    assert cap.checks == 1 and not cap.mismatches
+  ev = resilience.recent('commsan_digest')
+  assert len(ev) == 1
+  assert ev[0]['label'] == 'solo' and ev[0]['tag'] == 'audit:1'
+  assert ev[0]['records'] == 1
+
+
+class _FakeKV:
+  """A two-rank KV store: rank 1's digests are scripted."""
+
+  def __init__(self, peer_value=None, peer_raises=False):
+    self.store = {}
+    self.peer_value = peer_value
+    self.peer_raises = peer_raises
+
+  def key_value_set(self, key, value):
+    self.store[key] = value
+
+  def blocking_key_value_get(self, key, timeout_ms):
+    if self.peer_raises:
+      raise TimeoutError('peer never published')
+    return self.peer_value
+
+
+def test_commsan_two_rank_digest_mismatch_raises_witness(monkeypatch):
+  """The faked two-rank world: a diverging peer digest raises
+  CommSequenceError whose witness names the tag, both digests and this
+  rank's sequence tail — and journals commsan_mismatch.  (The REAL
+  two-process version of this is test_multiprocess.py's drill.)"""
+  resilience.clear_recent()
+  kv = _FakeKV(peer_value='7:deadbeefdeadbeef')
+  monkeypatch.setattr(commsan, '_world', lambda: (2, 0, kv))
+  with commsan.capture('drill') as cap:
+    commsan.record('fit/step', step=1)
+    with pytest.raises(commsan.CommSequenceError) as ei:
+      commsan.barrier_check('audit:1')
+    wit = str(ei.value)
+    assert "digest mismatch at barrier 'audit:1'" in wit
+    assert 'rank 1 has 7:deadbeefdeadbeef' in wit
+    assert 'fit/step[step=1]' in wit          # the tail is named
+    assert cap.mismatches == [wit]
+    # this rank PUBLISHED its digest before comparing: the peer can
+    # produce the symmetric witness instead of timing out
+    assert list(kv.store) == ['commsan/drill/audit:1/1/0']
+  ev = resilience.recent('commsan_mismatch')
+  assert len(ev) == 1 and ev[0]['peers'] == {'1': '7:deadbeefdeadbeef'}
+
+
+def test_commsan_peer_timeout_is_reported_not_wedged(monkeypatch):
+  """A peer that never reaches the barrier is a MISMATCH report (the
+  whole point: a witness beats a CPU-idle wedge)."""
+  kv = _FakeKV(peer_raises=True)
+  monkeypatch.setattr(commsan, '_world', lambda: (2, 0, kv))
+  with commsan.capture('drill', timeout_s=0.01):
+    commsan.record('fit/step', step=1)
+    with pytest.raises(commsan.CommSequenceError) as ei:
+      commsan.barrier_check('ckpt:5')
+    assert 'no digest within' in str(ei.value)
+
+
+def test_commsan_matching_peer_passes(monkeypatch):
+  kv = _FakeKV()
+  monkeypatch.setattr(commsan, '_world', lambda: (2, 0, kv))
+  with commsan.capture('drill') as cap:
+    commsan.record('fit/step', step=1)
+    kv.peer_value = f'{cap.digest()[1]}:{cap.digest()[0]}'
+    commsan.barrier_check('audit:1')
+    assert cap.checks == 1 and not cap.mismatches
+
+
+def test_commsan_nested_capture_restores_outer():
+  with commsan.capture('outer') as outer:
+    with commsan.capture('inner') as inner:
+      commsan.record('fit/step', step=1)
+      assert commsan.active() is inner
+    assert commsan.active() is outer
+    assert outer.digest()[1] == 0 and inner.digest()[1] == 1
+  assert commsan.active() is None
+
+
+def test_commsan_report_names_the_schedule_position():
+  with commsan.capture('fit'):
+    commsan.record('trace:data/ids/fwd', axis='data', legs=1)
+    commsan.record('audit/run', audit=1)
+    rep = commsan.report_active()
+  assert "commsan capture 'fit'" in rep
+  assert 'trace:data/ids/fwd' in rep and 'audit/run' in rep
+  assert '2 record(s)' in rep
+
+
+def test_commsan_events_are_registered():
+  """The journal events commsan emits are registered day-one — the
+  detlint registry pass enforces the producer side; this pins the
+  registry side."""
+  assert 'commsan_digest' in resilience.REGISTERED_EVENTS
+  assert 'commsan_mismatch' in resilience.REGISTERED_EVENTS
+
+
+# --------------------------------------------------------------------------
+# CLI + meta-runner contracts
+# --------------------------------------------------------------------------
+
+
+def test_cli_refuses_rationale_less_baseline_fast(tmp_path):
+  bad = tmp_path / 'bad.toml'
+  bad.write_text('[[waiver]]\nid = "rankvar/x@y::z"\n')
+  assert _commlint_cli().main(['--baseline', str(bad),
+                               '--passes', 'rankvar']) == 2
+
+
+def test_cli_model_passes_exit_codes(tmp_path):
+  """The jax-free subset: exit 0 under the live baseline, exit 1 when
+  the baseline is absent (the six true positives unwaived), exit 3
+  under --strict with an expired waiver."""
+  cli = _commlint_cli()
+  passes = ['--passes', 'rankvar,rendezvous,recovery']
+  assert cli.main(passes) == 0
+  empty = tmp_path / 'empty.toml'
+  empty.write_text('')
+  assert cli.main(['--baseline', str(empty)] + passes) == 1
+  expired = tmp_path / 'expired.toml'
+  expired.write_text(textwrap.dedent('''
+      [[waiver]]
+      id = "rankvar/host-local-except-in-collective-path@distributed_embeddings_tpu/parallel/grad.py::fit:TierIntegrityError"
+      rationale = "fixture: expired waiver"
+      expires = "2020-01-01"
+
+      [[waiver]]
+      id = "rankvar/rank-variant-dispatch@distributed_embeddings_tpu/parallel/grad.py::fit:TierIntegrityError:handle_anomaly"
+      rationale = "fixture: still-valid waiver"
+      expires = "2099-01-01"
+  '''))
+  assert cli.main(['--baseline', str(expired),
+                   '--passes', 'rankvar']) == 0
+  assert cli.main(['--baseline', str(expired), '--strict',
+                   '--passes', 'rankvar']) == 3
+
+
+def test_lintall_rejects_unknown_tool_and_runs_subset():
+  cli = _lintall_cli()
+  assert cli.main(['--only', 'nosuchtool']) == 2
+  # the detlint-only subset exercises the merged-runner plumbing
+  # without a catalog build; the live tree is clean under the baseline
+  assert cli.main(['--only', 'detlint']) == 0
+
+
+def test_lintall_run_all_shares_the_program_catalog(flagship,
+                                                    monkeypatch):
+  """run_all hands graphlint's freshly built catalog to commlint: ONE
+  build serves both traced tiers.  Asserted by counting builds (the
+  module fixture stands in for the trace) and by commlint's emission
+  meta naming exactly the shared catalog's plan-bearing programs."""
+  lintall = _lintall_cli()
+  baseline = lint_core.Baseline.load(
+      str(ROOT / 'tools' / 'detlint_baseline.toml'))
+  builds = []
+
+  def fake_build(tier='flagship'):
+    builds.append(tier)
+    return flagship
+
+  monkeypatch.setattr(graphlint, 'build_programs', fake_build)
+  out = lintall.run_all(str(ROOT), baseline,
+                        only=['graphlint', 'commlint'])
+  assert builds == ['flagship']
+  for tool in ('graphlint', 'commlint'):
+    res = out[tool]
+    assert not isinstance(res, Exception), (tool, res)
+    assert not res.findings, (tool, [f.brief() for f in res.findings])
+  want = sorted(p.name for p in flagship if p.plan_expect is not None)
+  assert sorted(out['commlint'].meta['commlint_emission']) == want

@@ -339,3 +339,67 @@ print(f'MP-OK rank={rank}')
                     reason='multi-process test disabled')
 def test_two_process_fused_exchange_parity(tmp_path):
   _run_world(WORKER_FUSED, 2, 4, timeout=600)
+
+
+# The ISSUE-18 seeded-divergence drill (design §22): two real
+# jax.distributed processes arm a commsan capture window, walk an
+# identical two-step prefix (the first barrier must AGREE through the
+# KV store), then rank 1 is forced down the rollback_skip host path —
+# the exact rank-variant branch commlint's rendezvous pass flags as a
+# waived true positive — while rank 0 trains on.  The next barrier
+# must catch the digest split and raise CommSequenceError with the
+# witness (both digests + the diverging rank's sequence tail) and
+# journal commsan_mismatch, instead of wedging the mesh CPU-idle the
+# way the un-sanitized deadlock would.  Unlike the workers above this
+# drill runs NO device collective — the sanitizer is pure KV-store
+# host traffic, which is the point: it works on every backend,
+# including this one.
+WORKER_COMMSAN = r'''
+import os, sys
+import jax
+jax.config.update('jax_platforms', 'cpu')
+from distributed_embeddings_tpu.analysis import commsan
+from distributed_embeddings_tpu.parallel import init_distributed
+from distributed_embeddings_tpu.utils import resilience
+
+coord, pid, nprocs = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+rank = init_distributed(coordinator_address=coord, num_processes=nprocs,
+                        process_id=pid)
+assert rank == pid == jax.process_index()
+assert jax.process_count() == nprocs
+
+with commsan.capture('drill', timeout_s=60.0) as cap:
+  # rank-uniform prefix: the first barrier must AGREE cross-process
+  commsan.record('fit/step', step=1)
+  commsan.record('fit/step', step=2)
+  commsan.barrier_check('audit:1')
+  assert not cap.mismatches, cap.mismatches
+
+  # seeded divergence: rank 1 walks rollback_skip, rank 0 trains on
+  if rank == 1:
+    commsan.record('fit/rollback', anomaly='loss_spike', to_step=2,
+                   attempt=1)
+    commsan.record('fit/skip_window', from_step=2, to_step=3)
+  for s in (3, 4, 5):
+    commsan.record('fit/step', step=s)
+  try:
+    commsan.barrier_check('audit:2')
+  except commsan.CommSequenceError as e:
+    wit = str(e)
+  else:
+    raise AssertionError('divergent digests passed the barrier')
+  assert 'digest mismatch' in wit, wit
+  assert "'audit:2'" in wit, wit
+  assert 'fit/step' in wit, wit          # the sequence tail is named
+  assert cap.mismatches, 'witness must be retained on the capture'
+  assert resilience.recent('commsan_mismatch'), 'mismatch must journal'
+  assert resilience.recent('commsan_digest'), 'digests must journal'
+
+print(f'MP-OK rank={rank}')
+'''
+
+
+@pytest.mark.skipif(os.environ.get('DET_SKIP_MULTIPROC') == '1',
+                    reason='multi-process test disabled')
+def test_two_process_commsan_divergence_drill(tmp_path):
+  _run_world(WORKER_COMMSAN, 2, 4, timeout=300)

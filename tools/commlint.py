@@ -1,0 +1,128 @@
+#!/usr/bin/env python3
+"""commlint CLI: the cross-rank collective-protocol gate
+(docs/design.md §22).
+
+Verifies the protocol ACROSS ranks where detlint reads the source and
+graphlint reads one traced program: rank-variance dataflow over the
+runtime tree, plan-predicted exchange schedules cross-checked against
+the checked-in ``tools/graphlint_ledger.json``, a rank-pair rendezvous
+model-check with minimal-diverging-prefix deadlock witnesses, and
+recovery-path uniformity over the anomaly policies.  Shares detlint's
+waiver baseline (``tools/detlint_baseline.toml``) and the tools/
+exit-code contract (``tools/_cli.py``):
+
+  exit 0  clean (every finding waived with rationale)
+  exit 1  unwaived verifiable findings
+  exit 2  malformed baseline, or a catalog program that no longer
+          traces
+  exit 3  --strict only: unverifiable findings, stale or expired
+          waivers
+
+    python tools/commlint.py                  # report (all passes)
+    python tools/commlint.py --strict         # the CI gate
+    python tools/commlint.py --json           # machine-readable
+    python tools/commlint.py --passes rankvar,rendezvous  # jax-free
+    python tools/commlint.py --tier full      # emission over every
+                                              # dispatch path
+
+The emission pass builds the traced program catalog (and therefore
+imports jax on the forced-CPU virtual mesh); the other three passes
+are AST/model-only and never touch jax — ``--passes`` without
+``emission`` runs in milliseconds.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from typing import List, Optional
+
+# Same forced-CPU virtual-mesh preamble as tools/graphlint.py and
+# tests/conftest.py — pinned before any jax import, thread flags
+# guarded independently (see the comment there).
+_N_DEVICES = int(os.environ.get('DET_GRAPHLINT_DEVICES', '8'))
+_flags = os.environ.get('XLA_FLAGS', '')
+if 'xla_force_host_platform_device_count' not in _flags:
+  _flags += f' --xla_force_host_platform_device_count={_N_DEVICES}'
+if 'intra_op_parallelism_threads' not in _flags:
+  _flags += (' --xla_cpu_multi_thread_eigen=false'
+             ' intra_op_parallelism_threads=1')
+os.environ['XLA_FLAGS'] = _flags
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import _cli  # noqa: E402
+
+from distributed_embeddings_tpu.analysis import core as lint_core  # noqa: E402
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+  ap = _cli.make_parser(
+      'commlint',
+      description='cross-rank collective-protocol gate: rank-variance '
+      'dataflow, plan-predicted exchange schedules vs the checked-in '
+      'ledger, rank-pair rendezvous model-check with deadlock '
+      'witnesses, and recovery-path uniformity — stable finding ids '
+      'under the shared rationale-bearing waiver baseline; nonzero '
+      'exit on violations (pipeline-gate friendly).',
+      strict_help='also fail (exit 3) on unverifiable findings, stale '
+      'waivers and expired waivers')
+  ap.add_argument('--root', default=None,
+                  help='tree to analyze; also the baseline and ledger '
+                  'root (default: this checkout)')
+  ap.add_argument('--baseline', default=None,
+                  help='waiver file (default: the shared tools/'
+                  'detlint_baseline.toml under the root)')
+  ap.add_argument('--tier', default='flagship',
+                  choices=['flagship', 'full'],
+                  help='program catalog for the emission pass: '
+                  'flagship (the tier-1/CI set) or full (adds the '
+                  'sparsecore + pallas dispatch paths)')
+  ap.add_argument('--passes', default=None,
+                  help='comma-separated pass subset (default: all of '
+                  'rankvar,emission,rendezvous,recovery)')
+  args = ap.parse_args(argv)
+  root = os.path.abspath(args.root or lint_core.default_root())
+  baseline_path = args.baseline or lint_core.default_baseline_path(root)
+  passes = ([p for p in args.passes.split(',') if p]
+            if args.passes else None)
+  # baseline malformedness fails FAST (exit 2) — before any tracing
+  try:
+    baseline = lint_core.Baseline.load(baseline_path)
+  except lint_core.BaselineError as e:
+    return _cli.fail('commlint', 'MALFORMED', e)
+
+  from distributed_embeddings_tpu.analysis import commlint
+  try:
+    res = commlint.run_passes(root, passes=passes, baseline=baseline,
+                              tier=args.tier)
+  except (lint_core.BaselineError, RuntimeError, ValueError) as e:
+    return _cli.fail('commlint', 'MALFORMED', e)
+
+  def text() -> str:
+    lines = [f.brief() for f in res.findings + res.unverifiable]
+    c = res.counts
+    emission = res.meta.get('commlint_emission', {})
+    predicted = sum(1 for v in emission.values() if v.get('matched'))
+    tail = (f'{predicted}/{len(emission)} program schedule(s) '
+            'predicted from plans' if emission
+            else 'model passes only')
+    lines.append(
+        f"commlint: {c['findings']} finding(s), "
+        f"{c['unverifiable']} unverifiable, {c['waived']} waived, "
+        f"{c['stale_waivers']} stale, {c['expired_waivers']} expired "
+        f'waiver(s) [{tail}]')
+    return '\n'.join(lines)
+
+  _cli.emit(_cli.lint_payload(res, root=root, tier=args.tier,
+                              meta=res.meta),
+            args.json, text)
+  return _cli.finish_lint('commlint', res, args.strict)
+
+
+if __name__ == '__main__':
+  sys.exit(main())

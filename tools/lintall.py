@@ -1,0 +1,165 @@
+#!/usr/bin/env python3
+"""lintall: the one-line lint gate — all three analysis tiers
+(docs/design.md §17, §18, §22).
+
+Runs detlint (AST), graphlint (traced-program IR) and commlint
+(cross-rank protocol) in-process, in that order, over one checkout and
+one shared waiver baseline, merging the three ``--json`` payloads and
+exiting with the WORST of the three contract codes (``tools/_cli.py``)
+— so a pipeline needs exactly one fail-fast line:
+
+    python tools/lintall.py --strict
+
+instead of three, and the three tiers can never drift apart on
+baseline path, tier selection or exit semantics.  ``--only`` narrows
+to a subset (e.g. ``--only detlint,commlint`` skips the traced
+catalog while iterating on source-level findings).
+
+  exit 0  every tier clean
+  exit 1  unwaived findings in any tier
+  exit 2  malformed baseline / untraceable catalog in any tier
+  exit 3  --strict escalations only
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from typing import Dict, List, Optional
+
+# graphlint's and commlint's catalogs trace shard_map programs over an
+# N-device forced-CPU mesh; the same preamble as tools/graphlint.py,
+# pinned before any jax import (see the comment there).
+_N_DEVICES = int(os.environ.get('DET_GRAPHLINT_DEVICES', '8'))
+_flags = os.environ.get('XLA_FLAGS', '')
+if 'xla_force_host_platform_device_count' not in _flags:
+  _flags += f' --xla_force_host_platform_device_count={_N_DEVICES}'
+if 'intra_op_parallelism_threads' not in _flags:
+  _flags += (' --xla_cpu_multi_thread_eigen=false'
+             ' intra_op_parallelism_threads=1')
+os.environ['XLA_FLAGS'] = _flags
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import _cli  # noqa: E402
+
+from distributed_embeddings_tpu.analysis import core as lint_core  # noqa: E402
+
+TOOLS = ('detlint', 'graphlint', 'commlint')
+
+
+def run_all(root: str, baseline: 'lint_core.Baseline',
+            tier: str = 'flagship',
+            only: Optional[List[str]] = None) -> Dict[str, object]:
+  """Run the requested tiers in order and return
+  ``{tool: Result-or-exception}`` — the shared engine behind this CLI
+  and the dryrun lint stage, so both gate on identical facts."""
+  out: Dict[str, object] = {}
+  wanted = list(only) if only else list(TOOLS)
+  if 'detlint' in wanted:
+    try:
+      out['detlint'] = lint_core.run_passes(root, baseline=baseline)
+    except (RuntimeError, ValueError) as e:
+      out['detlint'] = e
+  if 'graphlint' in wanted:
+    from distributed_embeddings_tpu.analysis import graphlint
+    try:
+      programs = graphlint.build_programs(tier=tier)
+      out['graphlint'] = graphlint.run_programs(programs,
+                                                baseline=baseline)
+    except (RuntimeError, ValueError) as e:
+      out['graphlint'] = e
+      programs = None
+  else:
+    programs = None
+  if 'commlint' in wanted:
+    from distributed_embeddings_tpu.analysis import commlint
+    try:
+      # reuse graphlint's catalog when it was just built — the plan
+      # snapshots ride on the same Program objects, so commlint's
+      # emission pass costs no second trace
+      out['commlint'] = commlint.run_passes(
+          root, baseline=baseline, programs=programs, tier=tier)
+    except (RuntimeError, ValueError) as e:
+      out['commlint'] = e
+  return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+  ap = _cli.make_parser(
+      'lintall',
+      description='run detlint + graphlint + commlint over one '
+      'checkout and one waiver baseline, merged output, worst exit '
+      'code — the single pipeline lint gate.',
+      strict_help='also fail (exit 3) on unverifiable findings, stale '
+      'waivers and expired waivers, in any tier')
+  ap.add_argument('--root', default=None,
+                  help='repo root (default: this checkout)')
+  ap.add_argument('--baseline', default=None,
+                  help='waiver file (default: the shared tools/'
+                  'detlint_baseline.toml under the root)')
+  ap.add_argument('--tier', default='flagship',
+                  choices=['flagship', 'full'],
+                  help='program catalog for the traced tiers')
+  ap.add_argument('--only', default=None,
+                  help='comma-separated tool subset (default: '
+                  'detlint,graphlint,commlint)')
+  args = ap.parse_args(argv)
+  root = os.path.abspath(args.root or lint_core.default_root())
+  baseline_path = args.baseline or lint_core.default_baseline_path(root)
+  only = ([t for t in args.only.split(',') if t]
+          if args.only else None)
+  for t in only or []:
+    if t not in TOOLS:
+      return _cli.fail('lintall', 'MALFORMED',
+                       f'unknown tool {t!r}; available: {TOOLS}')
+  # one baseline load, one fast fail, three consumers
+  try:
+    baseline = lint_core.Baseline.load(baseline_path)
+  except lint_core.BaselineError as e:
+    return _cli.fail('lintall', 'MALFORMED', e)
+
+  results = run_all(root, baseline, tier=args.tier, only=only)
+
+  worst = _cli.EXIT_OK
+  payload: Dict[str, object] = {'root': root, 'tier': args.tier}
+  lines: List[str] = []
+  for tool in TOOLS:
+    if tool not in results:
+      continue
+    res = results[tool]
+    if isinstance(res, Exception):
+      worst = max(worst, _cli.fail(tool, 'MALFORMED', res))
+      payload[tool] = {'error': str(res)}
+      continue
+    payload[tool] = _cli.lint_payload(res, meta=res.meta)
+    lines.extend(f.brief() for f in res.findings + res.unverifiable)
+    c = res.counts
+    lines.append(
+        f"{tool}: {c['findings']} finding(s), {c['unverifiable']} "
+        f"unverifiable, {c['waived']} waived, {c['stale_waivers']} "
+        f"stale, {c['expired_waivers']} expired waiver(s)")
+    code = _cli.EXIT_OK
+    if res.findings:
+      code = _cli.EXIT_FINDINGS
+    elif args.strict and (res.unverifiable or res.stale_waivers
+                          or res.expired_waivers):
+      code = _cli.EXIT_STRICT
+    worst = max(worst, code)
+
+  _cli.emit(payload, args.json, lambda: '\n'.join(lines))
+  if worst == _cli.EXIT_FINDINGS:
+    return _cli.fail('lintall', 'FINDINGS', 'unwaived finding(s) — '
+                     'see the per-tool lines above')
+  if worst == _cli.EXIT_STRICT:
+    return _cli.fail('lintall', 'STRICT', 'strict escalation(s) — '
+                     'see the per-tool lines above')
+  return worst
+
+
+if __name__ == '__main__':
+  sys.exit(main())
