@@ -512,6 +512,31 @@ def main():
   parser.add_argument('--serve_hot_budget_mb', type=float, default=256.0,
                       help='per-device replication budget for the '
                       'serving hot rows')
+  parser.add_argument('--serve_overload', action=argparse.BooleanOptionalAction,
+                      default=None,
+                      help='overload arm of the serving phase (design '
+                      '§23): drive a ServingEnginePool past capacity '
+                      'with a mixed-priority open-loop burst and '
+                      'journal the serve_over_* block (per-class '
+                      'p50/p99/p99.9, shed ledger by class+reason, '
+                      'degraded-mode enters/exits, failover drill when '
+                      '--serve_replicas > 1).  Default: rides --serve')
+  parser.add_argument('--serve_overload_qps', type=float, default=None,
+                      help='paced offered load for the overload arm '
+                      '(requests/s, open-loop); default None = one '
+                      'unpaced burst — the worst case')
+  parser.add_argument('--serve_deadline_ms', type=float, default=50.0,
+                      help='per-request deadline in the overload arm; '
+                      'requests past it at dispatch shed, never execute')
+  parser.add_argument('--serve_priority_mix', type=float, default=0.5,
+                      help='high-priority fraction of overload traffic '
+                      '(deterministic error-diffusion interleave)')
+  parser.add_argument('--serve_replicas', type=int, default=2,
+                      help='replica engines behind the overload pool; '
+                      '>1 arms the mid-stream failover drill '
+                      '(replica 0 quarantined halfway through the '
+                      'burst, its in-flight work retried bit-exact on '
+                      'the survivors)')
   parser.add_argument('--obs', action=argparse.BooleanOptionalAction,
                       default=None,
                       help='observability A/B (obs/, design §15): '
@@ -1471,6 +1496,39 @@ def main():
                   list(dist0.plan.input_table_map), requests)
               if serve_hot else None),
       })
+      # Overload arm (design §23): the same frozen tables behind a
+      # ServingEnginePool driven open-loop past capacity — per-class
+      # latency under pressure, the shed ledger, the degraded-mode
+      # watermark crossings and (replicas > 1) a mid-burst failover
+      # drill.  Never fatal, independently of the three-arm block.
+      use_overload = args.serve_overload
+      if use_overload is None:
+        use_overload = True
+      if use_overload:
+        try:
+          replicas = max(1, int(args.serve_replicas))
+          pool_engines = [engine]
+          for _ in range(replicas - 1):
+            pool_engines.append(serving_lib.ServingEngine(
+                dist0.table_configs, bundle_tables, batch_size=sv_batch,
+                mesh=mesh,
+                input_table_map=list(dist0.plan.input_table_map),
+                hotness=[1 if np.asarray(c).ndim == 1 else
+                         np.asarray(c).shape[1] for c in cats0],
+                buckets=sv_buckets,
+                hot_sets=serve_hot))
+          serve_stats.update(serving_lib.measure_overload(
+              pool_engines, requests,
+              max_delay_ms=args.serve_max_delay_ms,
+              deadline_ms=args.serve_deadline_ms,
+              priority_mix=args.serve_priority_mix,
+              offered_qps=args.serve_overload_qps,
+              failover_after=(len(requests) // 2
+                              if replicas > 1 else None)))
+          del pool_engines
+        except Exception as e:
+          serve_stats['serving_overload_error'] = (
+              f'{type(e).__name__}: {e}')
       del engine, bundle_tables
     except Exception as e:
       serve_stats = {'serving_error': f'{type(e).__name__}: {e}'}

@@ -82,6 +82,16 @@ METRIC_TYPES: Dict[str, str] = {
     # pipelined dispatch stages (design §16)
     'serve.merge_ms': 'histogram',
     'serve.demux_ms': 'histogram',
+    # SLO-aware overload layer (serving/batcher.py + serving/pool.py,
+    # design §23): per-class latency histograms, shed/degraded/failover
+    # counters and the pool's routing-depth gauge
+    'serve.latency_high_ms': 'histogram',
+    'serve.latency_low_ms': 'histogram',
+    'serve.shed': 'counter',
+    'serve.degraded': 'counter',
+    'serve.failover': 'counter',
+    'serve.failover_ms': 'histogram',
+    'serve.pool_depth': 'gauge',
     'engine.lookups': 'counter',
     'engine.samples': 'counter',
     # bucket-ladder padding accounting (design §16): rows the compiled
@@ -123,6 +133,15 @@ REGISTERED_STATS_KEYS = frozenset({
     'p50_ms', 'p99_ms', 'bucket_ladder', 'buckets', 'bucket_launches',
     'rows_launched', 'pad_rows', 'pad_waste_pct', 'pipeline',
     'merge_demux_ms', 'csr_feed',
+    # SLO-aware admission + replica pool (serving/batcher.py,
+    # serving/pool.py; design §23): the per-class ledger, the
+    # per-reason shed block and the pool's failover/degraded counters
+    'p999_ms', 'classes', 'shed', 'admitted', 'served', 'depth',
+    'low_queue_depth', 'high', 'low', 'deadline', 'queue_full',
+    'closed', 'replicas', 'live_replicas', 'quarantined', 'failovers',
+    'retried', 'degraded', 'degraded_served', 'degraded_enters',
+    'degraded_exits', 'degraded_drop_pct', 'watermark_high',
+    'watermark_low',
     # ServingEngine (serving/engine.py)
     'batches_served', 'samples_served', 'batch_size', 'world_size',
     'hot_cache', 'cold_tier', 'table_dtype', 'fused_exchange',
@@ -163,7 +182,21 @@ REGISTERED_ARTIFACT_KEYS = frozenset({
     'serve_mono_batches', 'serve_mono_batch_fill',
     'serve_mono_pad_waste_pct', 'serve_nobatch_p50_ms',
     'serve_nobatch_p99_ms', 'serve_nobatch_qps',
-    'serve_nobatch_pad_waste_pct',
+    'serve_nobatch_pad_waste_pct', 'serve_p999_ms',
+    # overload arm (serving/bench.py measure_overload; design §23):
+    # per-class latency tails, shed accounting, degraded-mode serves
+    # and the failover drill counters the perf sentinel guards
+    'serve_over_requests', 'serve_over_served', 'serve_over_shed',
+    'serve_over_shed_rate', 'serve_over_offered_qps', 'serve_over_qps',
+    'serve_over_deadline_ms', 'serve_over_priority_mix',
+    'serve_over_replicas', 'serve_over_high_p50_ms',
+    'serve_over_high_p99_ms', 'serve_over_high_p999_ms',
+    'serve_over_low_p50_ms', 'serve_over_low_p99_ms',
+    'serve_over_low_p999_ms', 'serve_over_high_shed',
+    'serve_over_low_shed', 'serve_over_shed_deadline',
+    'serve_over_shed_queue_full', 'serve_over_degraded_served',
+    'serve_over_degraded_enters', 'serve_over_degraded_exits',
+    'serve_over_failovers', 'serve_over_quarantined',
     # observability block (bench.obs_block)
     'obs_trace', 'obs_trace_path', 'obs_trace_events', 'obs_off_ms',
     'obs_on_ms', 'obs_window_delta_pct', 'obs_metrics_digest',

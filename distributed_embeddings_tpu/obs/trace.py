@@ -80,6 +80,10 @@ REGISTERED_SPANS = frozenset({
     # the pipeline is on, nested under serve/dispatch when serial
     'serve/submit', 'serve/enqueue', 'serve/dispatch', 'serve/merge',
     'serve/lookup', 'serve/execute', 'serve/demux',
+    # SLO-aware overload layer (serving/batcher.py + serving/pool.py,
+    # design §23): a shed request's queue residency, a degraded
+    # hot-only low-priority serve, and a failover retry's resubmit leg
+    'serve/shed', 'serve/degraded', 'serve/failover',
     # device-time attribution lane (obs/devprof.py, design §19): each
     # phase of the step measured as an individually synced sub-program
     # and emitted as an X event on the dedicated 'device' track
@@ -100,7 +104,7 @@ REGISTERED_SPANS = frozenset({
 # the devprof lane (design §19), everything else is measured host work.
 SPAN_CATEGORIES: Dict[str, str] = {
     'feed/wait': 'wait', 'coldtier/wait': 'wait', 'train/sync': 'wait',
-    'serve/enqueue': 'wait',
+    'serve/enqueue': 'wait', 'serve/shed': 'wait',
     'fwd/exchange': 'trace', 'fwd/lookup_combine': 'trace',
     'bwd/exchange': 'trace', 'apply/update': 'trace',
     'dev/fwd/exchange': 'device', 'dev/fwd/lookup_combine': 'device',

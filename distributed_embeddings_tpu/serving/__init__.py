@@ -13,6 +13,13 @@ padded static device batches at the smallest fitting ladder rung, with
 pipelined merge -> execute -> demux dispatch, per-request demux and
 p50/p99 latency accounting (``bench.py`` — the three-arm block
 bench.py journals in the standard artifact).
+
+The SLO-aware overload layer (design §23) rides on top: ``submit``
+takes ``priority=``/``deadline_ms=`` with typed sheds
+(``RequestSheddedError``), a ``ServingEnginePool`` (``pool.py``)
+routes across replica engines with quarantine/failover and a
+journaled hot-cache-only degraded mode, and ``measure_overload``
+(``bench.py``) drives the offered-load > capacity proof arm.
 """
 
 from distributed_embeddings_tpu.serving.export import (
@@ -26,11 +33,19 @@ from distributed_embeddings_tpu.serving.engine import (
     default_bucket_ladder,
 )
 from distributed_embeddings_tpu.serving.batcher import (
+    PRIORITIES,
+    DeadlineExceededError,
     DynamicBatcher,
+    ReplicaLostError,
+    RequestSheddedError,
     ServeFuture,
+)
+from distributed_embeddings_tpu.serving.pool import (
+    ServingEnginePool,
 )
 from distributed_embeddings_tpu.serving.bench import (
     hot_hit_rate,
+    measure_overload,
     measure_serving,
     split_requests,
 )

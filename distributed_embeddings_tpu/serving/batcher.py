@@ -25,6 +25,31 @@ Admission rules (all pinned in tests/test_serving.py):
   lookup+combine is independent of batch composition AND of the
   launched rung, so batching (and rung selection) is pure scheduling.
 
+SLO-aware admission under overload (docs/design.md §23): ``submit``
+takes ``priority=`` (``'high'`` | ``'low'``, default high — existing
+callers are unchanged) and ``deadline_ms=``.  The two classes share the
+one physical arrival queue (preserving the zero-idle-wakeup contract:
+an idle dispatcher parks in ONE untimed blocking get), but admission
+and dispatch treat them differently:
+
+- LOW-priority requests are bounded separately (``low_queue_depth``,
+  default half the queue) and SHED at admission when their class is
+  full — the future resolves with ``RequestSheddedError``
+  (``reason='queue_full'``) instead of blocking the submitter.
+  HIGH-priority requests keep the blocking-put backpressure (the
+  bounded queue IS the admission throttle; see the baseline waiver).
+- a request whose ``deadline_ms`` has already passed when the
+  dispatcher would merge it is shed AT DISPATCH (``reason='deadline'``)
+  — dead work never reaches the device;
+- the dispatcher drains arrivals into per-class ready queues and fills
+  each batch HIGH-first, so under overload the high class rides every
+  launch while the low class absorbs the shedding;
+- every shed resolves its future (a shed caller is never stranded),
+  counts per class/reason in ``stats()``, increments the
+  ``serve.shed`` metric and journals a throttled ``serve_shed``
+  resilience event; ``close()`` journals the final per-class
+  admit/shed counters (``serve_admission``).
+
 Pipelined dispatch (``pipeline=True``, the default; design §16): the
 merge -> execute -> demux stages double-buffer across three threads the
 way ``CsrFeed`` hides the host CSR build — the dispatcher merges batch
@@ -58,16 +83,45 @@ jitted lookup recomputes the same content via the traced twin.
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 import time
 
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 import numpy as np
 
 from distributed_embeddings_tpu.obs import metrics as obs_metrics
 from distributed_embeddings_tpu.obs import trace as obs_trace
+from distributed_embeddings_tpu.utils import resilience
+
+# admission classes, dispatch-preference order (docs/design.md §23)
+PRIORITIES = ('high', 'low')
+
+
+class RequestSheddedError(RuntimeError):
+  """The request was SHED by overload policy — a deliberate admission
+  decision, not a wedge: ``reason`` is ``'queue_full'`` (low-priority
+  class bound hit at submit), ``'deadline'`` (``deadline_ms`` expired
+  before dispatch) or ``'closed'`` (batcher/pool shut down before the
+  request launched).  Subclasses ``RuntimeError`` so pre-existing
+  broad handlers keep working."""
+
+  def __init__(self, message: str, reason: str = 'closed'):
+    super().__init__(message)
+    self.reason = reason
+
+
+class DeadlineExceededError(TimeoutError):
+  """``ServeFuture.result(timeout)`` gave up WAITING — distinct from a
+  shed (the request may still resolve later).  Subclasses
+  ``TimeoutError`` so pre-existing handlers keep working."""
+
+
+class ReplicaLostError(RuntimeError):
+  """Every replica in a ``ServingEnginePool`` is quarantined — the
+  request cannot be retried anywhere (docs/design.md §23)."""
 
 
 class ServeFuture:
@@ -78,35 +132,63 @@ class ServeFuture:
     self._out: Optional[List[np.ndarray]] = None
     self._err: Optional[BaseException] = None
     self.latency_ms: Optional[float] = None
+    # completion subscribers (the replica pool's failover chain); the
+    # tiny lock only orders subscribe vs resolve — callbacks always run
+    # OUTSIDE it, so no foreign lock is ever taken under it
+    self._cb_lock = threading.Lock()
+    self._cbs: List[Callable[['ServeFuture'], None]] = []
 
   def _resolve(self, out=None, err=None, latency_ms=None):
     self._out = out
     self._err = err
     self.latency_ms = latency_ms
-    self._ev.set()
+    with self._cb_lock:
+      self._ev.set()
+      cbs, self._cbs = self._cbs, []
+    for cb in cbs:
+      cb(self)
+
+  def _subscribe(self, cb: Callable[['ServeFuture'], None]):
+    """Run ``cb(self)`` once resolved (immediately if already done) —
+    on the RESOLVING thread; keep it non-blocking."""
+    with self._cb_lock:
+      if not self._ev.is_set():
+        self._cbs.append(cb)
+        return
+    cb(self)
+
+  def error(self) -> Optional[BaseException]:
+    """The resolution error, if resolved with one (None otherwise)."""
+    return self._err if self._ev.is_set() else None
 
   def done(self) -> bool:
     return self._ev.is_set()
 
   def result(self, timeout: Optional[float] = None) -> List[np.ndarray]:
     """Per-input ``[n, output_dim]`` activations; raises the serving
-    error (or ``TimeoutError``) instead of returning partial data."""
+    error (``RequestSheddedError`` when overload policy shed the
+    request, ``DeadlineExceededError`` when the WAIT here expired)
+    instead of returning partial data."""
     if not self._ev.wait(timeout):
-      raise TimeoutError('serving request not resolved within '
-                         f'{timeout}s')
+      raise DeadlineExceededError('serving request not resolved within '
+                                  f'{timeout}s')
     if self._err is not None:
       raise self._err
     return self._out
 
 
 class _Slot:
-  __slots__ = ('cats', 'n', 'future', 't0', 't0p')
+  __slots__ = ('cats', 'n', 'future', 't0', 't0p', 'priority',
+               'deadline')
 
-  def __init__(self, cats, n, t0):
+  def __init__(self, cats, n, t0, priority='high', deadline=None):
     self.cats = cats
     self.n = n
     self.future = ServeFuture()
     self.t0 = t0
+    self.priority = priority
+    # absolute monotonic shed deadline (None: never sheds on age)
+    self.deadline = deadline
     # queue-residency start on the TRACE clock (the 'serve/enqueue'
     # async span the dispatcher closes); 0.0 when tracing is off
     self.t0p = obs_trace.now() if obs_trace.enabled() else 0.0
@@ -127,7 +209,11 @@ class DynamicBatcher:
     max_batch: samples per launched batch (default and upper bound: the
       engine's ``batch_size`` — the padded remainder is sentinel rows).
     queue_depth: bound on queued requests (backpressure: ``submit``
-      blocks when full).
+      blocks when full — the HIGH class; see ``low_queue_depth``).
+    low_queue_depth: bound on queued LOW-priority requests (default
+      half of ``queue_depth``).  A low submit past the bound SHEDS —
+      its future resolves with ``RequestSheddedError('queue_full')``
+      instead of blocking the caller (docs/design.md §23).
     pipeline: double-buffer merge/execute/demux across stage threads
       (design §16; default on).  ``False`` runs the three stages
       serially on the dispatcher thread — the pre-ladder monolithic
@@ -145,7 +231,8 @@ class DynamicBatcher:
                max_batch: Optional[int] = None, queue_depth: int = 256,
                csr_feed: bool = False,
                csr_feed_kwargs: Optional[dict] = None,
-               pipeline: bool = True, bucket_ladder: bool = True):
+               pipeline: bool = True, bucket_ladder: bool = True,
+               low_queue_depth: Optional[int] = None):
     self.engine = engine
     self.max_batch = int(max_batch if max_batch is not None
                          else engine.batch_size)
@@ -155,8 +242,22 @@ class DynamicBatcher:
           f' = {engine.batch_size}]')
     self.max_delay_ms = float(max_delay_ms)
     self._q: queue.Queue = queue.Queue(maxsize=max(1, int(queue_depth)))
+    self.low_queue_depth = int(low_queue_depth
+                               if low_queue_depth is not None
+                               else max(1, int(queue_depth) // 2))
     self._closed = threading.Event()
     self._lock = threading.Lock()
+    # per-class admission/outcome accounting (docs/design.md §23); the
+    # ready deques are dispatcher-owned between launches but swept by
+    # close() after the join, so they live on the instance
+    self._depth = {p: 0 for p in PRIORITIES}
+    self._admitted = {p: 0 for p in PRIORITIES}
+    self._served = {p: 0 for p in PRIORITIES}
+    self._shed_class = {p: 0 for p in PRIORITIES}
+    self._shed_reason = {'queue_full': 0, 'deadline': 0, 'closed': 0}
+    self._lat_class = {p: obs_metrics.LatencyWindow()
+                       for p in PRIORITIES}
+    self._ready = {p: collections.deque() for p in PRIORITIES}
     # admission lock: makes submit's {closed-check, enqueue} atomic
     # against close's {set-closed} — a put racing past the flag would
     # land after close's final sweep and strand its future forever.
@@ -217,18 +318,28 @@ class DynamicBatcher:
 
   # ----------------------------------------------------------- submission
 
-  def submit(self, cats) -> ServeFuture:
+  def submit(self, cats, priority: str = 'high',
+             deadline_ms: Optional[float] = None) -> ServeFuture:
     """Enqueue one request (per-input id arrays for ``n`` samples);
-    returns its ``ServeFuture``.  Admission-policy refusals raise HERE,
-    synchronously, so the caller can repair the request."""
+    returns its ``ServeFuture``.  MALFORMED requests raise HERE,
+    synchronously, so the caller can repair them; OVERLOAD sheds (a
+    full low-priority class, an expired ``deadline_ms``) resolve the
+    returned future with ``RequestSheddedError`` instead — shedding is
+    a normal outcome the caller observes through ``result()``."""
     with obs_trace.span('serve/submit'):
-      fut = self._submit(cats)
+      fut = self._submit(cats, priority, deadline_ms)
     obs_metrics.inc('serve.submitted')
     return fut
 
-  def _submit(self, cats) -> ServeFuture:
+  def _submit(self, cats, priority: str = 'high',
+              deadline_ms: Optional[float] = None) -> ServeFuture:
     if self._closed.is_set():
       raise RuntimeError('batcher is closed')
+    if priority not in PRIORITIES:
+      raise ValueError(f'priority {priority!r} must be one of '
+                       f'{PRIORITIES}')
+    if deadline_ms is not None and deadline_ms <= 0:
+      raise ValueError(f'deadline_ms must be positive, got {deadline_ms}')
     cats = [np.asarray(x) for x in cats]
     if len(cats) != self.engine.dist.num_inputs:
       raise ValueError(f'expected {self.engine.dist.num_inputs} inputs, '
@@ -252,9 +363,11 @@ class DynamicBatcher:
           'split the request, or build the batcher/engine with a '
           'larger batch (requests are never silently split)')
     t0 = time.monotonic()
-    slot = _Slot(cats, n, t0)
+    deadline = t0 + deadline_ms / 1000.0 if deadline_ms else None
+    slot = _Slot(cats, n, t0, priority=priority, deadline=deadline)
     with self._lock:
       self._submitted += 1
+      self._admitted[priority] += 1
     if n == 0:
       # empty request: resolves immediately, occupies no batch space
       slot.future._resolve(
@@ -263,23 +376,95 @@ class DynamicBatcher:
           latency_ms=0.0)
       with self._lock:
         self._completed += 1
+        self._served[priority] += 1
       return slot.future
+    if priority == 'low':
+      # the low class is bounded on its own: past the bound the
+      # request SHEDS here instead of blocking the submitter — the
+      # overload throttle the high class's blocking put deliberately
+      # is NOT (docs/design.md §23)
+      with self._lock:
+        full = self._depth['low'] >= self.low_queue_depth
+        if not full:
+          self._depth['low'] += 1
+      if full:
+        self._shed(slot, 'queue_full', dec_depth=False)
+        return slot.future
+    else:
+      with self._lock:
+        self._depth['high'] += 1
     # atomic with close()'s flag-set (see _submit_lock): every slot
     # that enqueues here is guaranteed a consumer — the live
     # dispatcher, its exit drain, or close()'s final sweep
     with self._submit_lock:
       if self._closed.is_set():
+        with self._lock:
+          self._depth[priority] -= 1
         raise RuntimeError('batcher is closed')
       self._q.put(slot)
     return slot.future
 
+  # throttle the per-shed journal line: under a sustained overload the
+  # journal must show the shedding without itself becoming the load
+  _SHED_JOURNAL_EVERY = 64
+
+  def _shed(self, slot: _Slot, reason: str, dec_depth: bool = True):
+    """Resolve one slot as SHED: typed error, per-class/per-reason
+    counters, the ``serve.shed`` metric, a throttled ``serve_shed``
+    journal event and (when tracing) a ``serve/shed`` span covering
+    the request's queue residency.  ``dec_depth=False`` for sheds of
+    slots that never entered the queue (the queue_full refusal)."""
+    with self._lock:
+      if dec_depth:
+        self._depth[slot.priority] -= 1
+      self._shed_class[slot.priority] += 1
+      self._shed_reason[reason] += 1
+      n_class = self._shed_class[slot.priority]
+      shed_total = sum(self._shed_class.values())
+      admitted = dict(self._admitted)
+    if n_class == 1 or n_class % self._SHED_JOURNAL_EVERY == 0:
+      resilience.journal('serve_shed', priority=slot.priority,
+                         reason=reason, shed_class=n_class,
+                         shed_total=shed_total, admitted=admitted)
+    obs_metrics.inc('serve.shed')
+    if obs_trace.enabled() and slot.t0p:
+      t1 = obs_trace.now()
+      obs_trace.complete('serve/shed', slot.t0p,
+                         max(0.0, t1 - slot.t0p),
+                         priority=slot.priority, reason=reason,
+                         samples=slot.n)
+    if reason == 'closed':
+      msg = 'batcher closed before the request was served'
+    else:
+      msg = (f'request shed ({reason}): {slot.priority}-priority '
+             'admission policy under overload — retry later, raise '
+             'the deadline, or submit at high priority '
+             '(docs/design.md §23)')
+    slot.future._resolve(err=RequestSheddedError(msg, reason=reason))
+
   # ------------------------------------------------------------- dispatch
 
+  def _pop_ready(self) -> Optional[_Slot]:
+    """Next dispatchable slot, HIGH class first; expired slots are
+    shed here — at dispatch, before any merge work — so dead work
+    never reaches the device (docs/design.md §23)."""
+    now = time.monotonic()
+    for p in PRIORITIES:
+      dq = self._ready[p]
+      while dq:
+        slot = dq.popleft()
+        if slot.deadline is not None and now > slot.deadline:
+          self._shed(slot, 'deadline')
+          continue
+        return slot
+    return None
+
+  def _push_ready(self, slot: _Slot):
+    self._ready[slot.priority].append(slot)
+
   def _dispatch_loop(self):
-    pending: Optional[_Slot] = None
     while True:
-      first = pending
-      pending = None
+      first = self._pop_ready()
       if first is None:
         if self._closed.is_set():
           break
@@ -287,32 +472,43 @@ class DynamicBatcher:
         # zero scheduled wakeups (no 50 ms polling; pinned in
         # tests/test_serving.py).  close() guarantees the _CLOSE
         # sentinel lands, so this get always wakes on shutdown.
-        first = self._q.get()
-        if first is _CLOSE:
+        got = self._q.get()
+        if got is _CLOSE:
           break
+        self._push_ready(got)
+        continue
       batch = [first]
       n = first.n
       deadline = first.t0 + self.max_delay_ms / 1000.0
       while n < self.max_batch:
-        wait = deadline - time.monotonic()
-        try:
-          # past the deadline the batch must not WAIT any longer — but
-          # requests already queued (a backlog built while the previous
-          # batch executed) still merge in, non-blockingly: under load
-          # the batch fills from the backlog instead of launching
-          # singletons
-          nxt = (self._q.get(timeout=wait) if wait > 0
-                 else self._q.get_nowait())
-        except queue.Empty:
-          break
-        if nxt is _CLOSE:
-          self._closed.set()
-          break
+        nxt = self._pop_ready()
+        if nxt is None:
+          wait = deadline - time.monotonic()
+          try:
+            # past the deadline the batch must not WAIT any longer —
+            # but requests already queued (a backlog built while the
+            # previous batch executed) still merge in, non-blockingly:
+            # under load the batch fills from the backlog instead of
+            # launching singletons
+            got = (self._q.get(timeout=wait) if wait > 0
+                   else self._q.get_nowait())
+          except queue.Empty:
+            break
+          if got is _CLOSE:
+            self._closed.set()
+            break
+          self._push_ready(got)
+          continue
         if n + nxt.n > self.max_batch:
-          pending = nxt  # does not fit: rides the NEXT batch, unsplit
+          # does not fit: rides the NEXT batch, unsplit — back to the
+          # FRONT of its class so arrival order within a class holds
+          self._ready[nxt.priority].appendleft(nxt)
           break
         batch.append(nxt)
         n += nxt.n
+      with self._lock:
+        for slot in batch:
+          self._depth[slot.priority] -= 1
       if obs_trace.enabled():
         # close each merged request's queue-residency interval: an
         # ASYNC span (b/e pair) because neighbours overlap arbitrarily
@@ -336,8 +532,11 @@ class DynamicBatcher:
         for slot in batch:
           if not slot.future.done():
             slot.future._resolve(err=e)
-    # drain: fail anything still queued after close
-    leftovers = [pending] if pending is not None else []
+    # drain: fail anything still ready or queued after close
+    leftovers = []
+    for p in PRIORITIES:
+      while self._ready[p]:
+        leftovers.append(self._ready[p].popleft())
     while True:
       try:
         s = self._q.get_nowait()
@@ -346,8 +545,7 @@ class DynamicBatcher:
       if s is not _CLOSE:
         leftovers.append(s)
     for s in leftovers:
-      s.future._resolve(err=RuntimeError('batcher closed before the '
-                                         'request was served'))
+      self._shed(s, 'closed')
     if self._queue_source is not None:
       self._queue_source.close()
 
@@ -462,17 +660,28 @@ class DynamicBatcher:
     while True:
       t0 = time.perf_counter()
       item = self._exec_q.get()
-      wait_ms = (time.perf_counter() - t0) * 1000.0
-      if item is None:
-        # forward shutdown downstream, FIFO — via the liveness-checked
-        # bounded hand-off (a dead demuxer must not wedge this thread
-        # on the full queue; detlint concurrency/untimed-put-bounded)
-        self._put_stage(self._demux_q, None, self._demuxer, [])
-        return
-      merged, batch, n, merge_ms = item
-      with self._lock:
-        self._pipe.add_blocked(min(wait_ms, merge_ms))
-      self._execute(merged, batch, n)
+      try:
+        wait_ms = (time.perf_counter() - t0) * 1000.0
+        if item is None:
+          # forward shutdown downstream, FIFO — via the liveness-checked
+          # bounded hand-off (a dead demuxer must not wedge this thread
+          # on the full queue; detlint concurrency/untimed-put-bounded)
+          self._put_stage(self._demux_q, None, self._demuxer, [])
+          return
+        merged, batch, n, merge_ms = item
+        with self._lock:
+          self._pipe.add_blocked(min(wait_ms, merge_ms))
+        self._execute(merged, batch, n)
+      except BaseException as e:
+        # an injected kill (faultinject) can land between the dequeue
+        # and _execute's own guard: the dequeued batch must still fail
+        # loudly — an unresolved future is a lost request, and the
+        # pool's failover contract needs the error to surface
+        if item is not None:
+          for slot in item[1]:
+            if not slot.future.done():
+              slot.future._resolve(err=e)
+        raise
 
   def _demux_loop(self):
     """Stage 3 thread: host demux in FIFO launch order (a single
@@ -535,6 +744,9 @@ class DynamicBatcher:
       self._fill_sum += n / self.max_batch
       self._completed += len(batch)
       self._latencies.extend(lats)
+      for slot, lat in zip(batch, lats):
+        self._served[slot.priority] += 1
+        self._lat_class[slot.priority].record(lat)
       self._rows_launched += bucket
       self._pad_rows += bucket - n
       self._bucket_launches[bucket] = \
@@ -546,8 +758,12 @@ class DynamicBatcher:
     obs_metrics.inc('serve.completed', len(batch))
     obs_metrics.set_gauge('serve.batch_fill', n / self.max_batch)
     obs_metrics.observe('serve.demux_ms', demux_ms)
-    for lat in lats:
+    for slot, lat in zip(batch, lats):
       obs_metrics.observe('serve.latency_ms', lat)
+      if slot.priority == 'high':
+        obs_metrics.observe('serve.latency_high_ms', lat)
+      else:
+        obs_metrics.observe('serve.latency_low_ms', lat)
     for slot, out, lat in zip(batch, outs, lats):
       slot.future._resolve(out=out, latency_ms=lat)
     obs_trace.end(tok)
@@ -571,8 +787,9 @@ class DynamicBatcher:
     with self._lock:
       stranded, self._inflight = self._inflight, []
     for slot in stranded:
-      slot.future._resolve(err=RuntimeError(
-          'batcher closed before the request was served'))
+      slot.future._resolve(err=RequestSheddedError(
+          'batcher closed before the request was served',
+          reason='closed'))
 
   # ----------------------------------------------------------- lifecycle
 
@@ -615,6 +832,29 @@ class DynamicBatcher:
       self._executor.join(timeout=30.0)
       self._put_sentinel(self._demux_q, None, self._demuxer)
       self._demuxer.join(timeout=30.0)
+      # a KILLED stage (the pool's quarantine drill) leaves batches in
+      # its queue that no thread will ever drain: demux-stage items
+      # already executed — finish them here; executor-stage items never
+      # launched — shed them.  Only once the stage thread is provably
+      # gone (a merely wedged thread still owns its queue).
+      if not self._demuxer.is_alive():
+        while True:
+          try:
+            it = self._demux_q.get_nowait()
+          except queue.Empty:
+            break
+          if it is not None:
+            self._demux(*it)
+      if not self._executor.is_alive():
+        while True:
+          try:
+            it = self._exec_q.get_nowait()
+          except queue.Empty:
+            break
+          if it is not None:
+            for s in it[1]:
+              if not s.future.done():
+                self._shed(s, 'closed', dec_depth=False)
     # nothing can enqueue past this point (the _submit_lock pairing in
     # submit re-checks the flag before its put): one final sweep and
     # no future is ever stranded unresolved
@@ -624,14 +864,28 @@ class DynamicBatcher:
       except queue.Empty:
         break
       if s is not _CLOSE:
-        s.future._resolve(err=RuntimeError(
-            'batcher closed before the request was served'))
+        self._shed(s, 'closed')
+    # the dispatcher owns the ready deques while alive; after its join
+    # (or its death) this sweep is the only consumer left
+    for p in PRIORITIES:
+      while self._ready[p]:
+        self._shed(self._ready[p].popleft(), 'closed')
     if self._queue_source is not None:
       self._queue_source.close()
     if self._consumer is not None:
       self._consumer.join(timeout=30.0)
     if self._feed is not None:
       self._feed.close()
+    with self._lock:
+      admitted = dict(self._admitted)
+      served = dict(self._served)
+      shed_class = dict(self._shed_class)
+      shed_reason = dict(self._shed_reason)
+    # the per-class admission ledger, journaled once at shutdown so an
+    # unattended overload leaves evidence (docs/design.md §23)
+    resilience.journal('serve_admission', admitted=admitted,
+                       served=served, shed=shed_class,
+                       shed_reason=shed_reason)
 
   def __enter__(self):
     return self
@@ -642,9 +896,32 @@ class DynamicBatcher:
 
   # --------------------------------------------------------------- stats
 
+  def _class_stats(self) -> dict:
+    """Per-admission-class block of ``stats()`` (caller holds
+    ``_lock``): admitted/served/shed/depth counters plus the class's
+    own latency percentiles (every key is in
+    ``obs.metrics.REGISTERED_STATS_KEYS``)."""
+    out = {}
+    for p in PRIORITIES:
+      w = self._lat_class[p]
+      cp50, cp99, cp999 = (w.percentile(50), w.percentile(99),
+                           w.percentile(99.9))
+      out[p] = {
+          'admitted': self._admitted[p],
+          'served': self._served[p],
+          'shed': self._shed_class[p],
+          'depth': self._depth[p],
+          'p50_ms': round(cp50, 3) if cp50 is not None else None,
+          'p99_ms': round(cp99, 3) if cp99 is not None else None,
+          'p999_ms': round(cp999, 3) if cp999 is not None else None,
+      }
+    return out
+
   def stats(self) -> dict:
-    """Latency / fill accounting: ``p50_ms``/``p99_ms`` over resolved
-    request latencies (submit -> demux), mean ``batch_fill`` (samples /
+    """Latency / fill accounting: ``p50_ms``/``p99_ms``/``p999_ms``
+    over resolved request latencies (submit -> demux), the per-class
+    admission ledger (``classes`` + the per-reason ``shed`` block;
+    docs/design.md §23), mean ``batch_fill`` (samples /
     ``max_batch``), the bucket-ladder padding accounting
     (``rows_launched``/``pad_rows``/``pad_waste_pct`` +
     ``bucket_launches`` per rung), the ``pipeline`` overlap block when
@@ -653,7 +930,9 @@ class DynamicBatcher:
     with self._lock:
       p50 = self._latencies.percentile(50)
       p99 = self._latencies.percentile(99)
+      p999 = self._latencies.percentile(99.9)
       launched = self._rows_launched
+      classes = self._class_stats()
       out = {
           'submitted': self._submitted,
           'completed': self._completed,
@@ -664,6 +943,10 @@ class DynamicBatcher:
                          if self._batches else None),
           'p50_ms': round(p50, 3) if p50 is not None else None,
           'p99_ms': round(p99, 3) if p99 is not None else None,
+          'p999_ms': round(p999, 3) if p999 is not None else None,
+          'classes': classes,
+          'shed': dict(self._shed_reason),
+          'low_queue_depth': self.low_queue_depth,
           'bucket_ladder': self.bucket_ladder,
           'buckets': (list(self.engine.buckets) if self.bucket_ladder
                       else [self.engine.batch_size]),

@@ -38,7 +38,9 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from distributed_embeddings_tpu.parallel import hotcache
-from distributed_embeddings_tpu.serving.batcher import DynamicBatcher
+from distributed_embeddings_tpu.serving.batcher import (
+    DynamicBatcher, ReplicaLostError, RequestSheddedError)
+from distributed_embeddings_tpu.serving.pool import ServingEnginePool
 
 
 def split_requests(cats, sizes: Sequence[int] = (1, 2, 4, 8),
@@ -180,6 +182,7 @@ def measure_serving(engine, requests, *, max_delay_ms: float = 2.0,
       'serve_concurrency': int(concurrency),
       'serve_p50_ms': st['p50_ms'],
       'serve_p99_ms': st['p99_ms'],
+      'serve_p999_ms': st['p999_ms'],
       'serve_qps': round(len(requests) / max(wall_on, 1e-9), 2),
       'serve_batches': st['batches'],
       'serve_batch_fill': st['batch_fill'],
@@ -203,4 +206,113 @@ def measure_serving(engine, requests, *, max_delay_ms: float = 2.0,
       'serve_nobatch_pad_waste_pct': (
           round(100.0 * (nb_launched - nb_samples) / nb_launched, 3)
           if nb_launched else None),
+  }
+
+
+def measure_overload(engines, requests, *,
+                     max_delay_ms: float = 2.0,
+                     deadline_ms: float = 50.0,
+                     priority_mix: float = 0.5,
+                     queue_depth: int = 32,
+                     low_queue_depth: Optional[int] = None,
+                     offered_qps: Optional[float] = None,
+                     degrade_high_watermark: Optional[int] = None,
+                     degrade_low_watermark: Optional[int] = None,
+                     degrade_patience: int = 2,
+                     failover_after: Optional[int] = None,
+                     wait_timeout_s: float = 300.0) -> Dict:
+  """The overload proof arm (docs/design.md §23): drive a
+  ``ServingEnginePool`` past capacity and journal what the SLO layer
+  did about it.
+
+  Requests are submitted open-loop (a burst when ``offered_qps`` is
+  None, else paced at that rate — the offered load is NOT throttled by
+  completions, which is what makes it an overload) with a
+  deterministic high/low interleave (``priority_mix`` = high fraction,
+  error-diffusion so any prefix carries the mix).  Every request
+  carries ``deadline_ms``; low-priority admission is bounded at
+  ``low_queue_depth``.  ``failover_after`` quarantines replica 0 after
+  that many submissions — the pool's retry path must then resolve the
+  victims on survivors.  EVERY future is awaited: a request may be
+  served or shed, but never lost — an unresolved future here is a bug,
+  not an overload outcome.
+
+  Returns the ``serve_over_*`` artifact block (per-class latency
+  percentiles, shed ledger by class and reason, degraded-mode
+  enters/exits, failover counts)."""
+  engines = list(engines)
+  requests = list(requests)
+  if not requests:
+    raise ValueError('measure_overload needs at least one request')
+  if not 0.0 <= priority_mix <= 1.0:
+    raise ValueError(f'priority_mix must be in [0, 1], got {priority_mix}')
+  for e in engines:
+    e.warmup()
+  pool = ServingEnginePool(
+      engines, max_delay_ms=max_delay_ms, queue_depth=queue_depth,
+      low_queue_depth=low_queue_depth,
+      degrade_high_watermark=degrade_high_watermark,
+      degrade_low_watermark=degrade_low_watermark,
+      degrade_patience=degrade_patience)
+  futures = []
+  period = (1.0 / offered_qps) if offered_qps else 0.0
+  acc = 0.0  # error-diffusion accumulator for the priority interleave
+  t0 = time.monotonic()
+  try:
+    for i, r in enumerate(requests):
+      if failover_after is not None and i == failover_after:
+        pool.fail_replica(0, error=RuntimeError(
+            'measure_overload failover drill'))
+      acc += priority_mix
+      if acc >= 1.0 - 1e-9:
+        acc -= 1.0
+        prio = 'high'
+      else:
+        prio = 'low'
+      futures.append(pool.submit(r, priority=prio, deadline_ms=deadline_ms))
+      if period:
+        target = t0 + (i + 1) * period
+        lag = target - time.monotonic()
+        if lag > 0:
+          time.sleep(lag)
+    submit_wall = time.monotonic() - t0
+    for f in futures:
+      try:
+        f.result(timeout=wait_timeout_s)
+      except (RequestSheddedError, ReplicaLostError):
+        pass  # a typed shed IS a resolved outcome; anything else raises
+    wall = time.monotonic() - t0
+    st = pool.stats()
+  finally:
+    pool.close()
+  cls = st['classes']
+  served = sum(cls[p]['served'] for p in cls)
+  shed = sum(st['shed'].values())
+  return {
+      'serve_over_requests': len(requests),
+      'serve_over_served': served,
+      'serve_over_shed': shed,
+      'serve_over_shed_rate': round(shed / max(len(requests), 1), 4),
+      'serve_over_offered_qps': (
+          round(offered_qps, 2) if offered_qps
+          else round(len(requests) / max(submit_wall, 1e-9), 2)),
+      'serve_over_qps': round(served / max(wall, 1e-9), 2),
+      'serve_over_deadline_ms': deadline_ms,
+      'serve_over_priority_mix': priority_mix,
+      'serve_over_replicas': len(engines),
+      'serve_over_high_p50_ms': cls['high']['p50_ms'],
+      'serve_over_high_p99_ms': cls['high']['p99_ms'],
+      'serve_over_high_p999_ms': cls['high']['p999_ms'],
+      'serve_over_low_p50_ms': cls['low']['p50_ms'],
+      'serve_over_low_p99_ms': cls['low']['p99_ms'],
+      'serve_over_low_p999_ms': cls['low']['p999_ms'],
+      'serve_over_high_shed': cls['high']['shed'],
+      'serve_over_low_shed': cls['low']['shed'],
+      'serve_over_shed_deadline': st['shed']['deadline'],
+      'serve_over_shed_queue_full': st['shed']['queue_full'],
+      'serve_over_degraded_served': st['degraded_served'],
+      'serve_over_degraded_enters': st['degraded_enters'],
+      'serve_over_degraded_exits': st['degraded_exits'],
+      'serve_over_failovers': st['failovers'],
+      'serve_over_quarantined': st['quarantined'],
   }

@@ -219,6 +219,11 @@ class ServingEngine:
     self._rows_launched = 0
     self._pad_rows = 0
     self._bucket_launches = {b: 0 for b in self.buckets}
+    # the serving hot sets, kept for the degraded-mode hot-only filter
+    # (design §23); per-table membership masks build lazily on first
+    # degraded serve — an engine that never degrades pays nothing
+    self._hot_sets = dict(hot_sets) if hot_sets else {}
+    self._hot_members: dict = {}
 
   @classmethod
   def from_bundle(cls, path: str, *, table_configs=None, **kwargs
@@ -238,6 +243,50 @@ class ServingEngine:
     return cls(configs, weights, bundle_meta=meta, **kwargs)
 
   # ---------------------------------------------------------------- lookup
+
+  def hot_only_filter(self, cats):
+    """Degraded-mode accuracy filter (docs/design.md §23): mask every
+    id OUTSIDE the serving hot sets to the ``-1`` pad sentinel, so the
+    request serves entirely from the replicated hot cache — no cold
+    exchange, no cold-tier fetch — at an EXPLICIT accuracy cost (a
+    dropped id contributes nothing to its sample's combine, exactly
+    like a pad slot).  Returns ``(filtered, dropped, total)``:
+    the filtered per-input arrays plus the dropped/total valid-id
+    counts the caller journals.  Inputs whose table has no hot set
+    (or an engine built without ``hot_sets``) pass through unfiltered
+    — the pool only degrades when ``hot_filter_available``."""
+    out = []
+    dropped = 0
+    total = 0
+    for i, c in enumerate(cats):
+      c = np.asarray(c)
+      valid = c >= 0
+      n_valid = int(valid.sum())
+      total += n_valid
+      tid = int(self.dist.plan.input_table_map[i])
+      hs = self._hot_sets.get(tid)
+      if hs is None or n_valid == 0:
+        out.append(c)
+        continue
+      member = self._hot_members.get(tid)
+      if member is None:
+        rows = int(self.dist.table_configs[tid].input_dim)
+        member = np.zeros(rows, bool)
+        ids = np.asarray(getattr(hs, 'ids', hs), np.int64)
+        member[ids[(ids >= 0) & (ids < rows)]] = True
+        self._hot_members[tid] = member
+      keep = np.zeros(c.shape, bool)
+      idx = np.clip(c[valid].astype(np.int64), 0, member.size - 1)
+      keep[valid] = member[idx]
+      dropped += n_valid - int(keep.sum())
+      out.append(np.where(keep, c, -1).astype(c.dtype))
+    return out, dropped, total
+
+  @property
+  def hot_filter_available(self) -> bool:
+    """True when this engine can serve degraded hot-only traffic (it
+    was built with serving hot sets; design §23)."""
+    return bool(self._hot_sets)
 
   def bucket_for(self, n: int) -> int:
     """The SMALLEST ladder rung holding ``n`` samples (design §16) —

@@ -82,6 +82,12 @@ REGISTERED_EVENTS = frozenset({
     # one digest event per barrier check inside a capture window, one
     # mismatch event per divergence witness raised at a barrier
     'commsan_digest', 'commsan_mismatch',
+    # SLO-aware serving overload layer (serving/batcher.py +
+    # serving/pool.py, design §23): throttled per-shed evidence, the
+    # per-class admission ledger at close, replica
+    # quarantine/failover, and the degraded-mode watermark crossings
+    'serve_shed', 'serve_admission', 'serve_replica_quarantined',
+    'serve_failover', 'serve_degraded_enter', 'serve_degraded_exit',
 })
 
 _lock = threading.Lock()

@@ -69,6 +69,22 @@ def main():
                       help='serving hot-cache coverage target '
                       '(0 disables the cache)')
   parser.add_argument('--hot_budget_mb', type=float, default=512.0)
+  parser.add_argument('--overload_qps', type=float, default=None,
+                      help='arm the overload A/B (design §23): offer '
+                      'this open-loop rate to a ServingEnginePool '
+                      '(0 = one unpaced burst) and print the healthy '
+                      'vs shedding vs degraded rows — per-class '
+                      'p50/p99/p99.9, the shed ledger and the '
+                      'degraded-mode crossings.  Default: off')
+  parser.add_argument('--priority_mix', type=float, default=0.5,
+                      help='high-priority fraction of the overload '
+                      'traffic (error-diffusion interleave)')
+  parser.add_argument('--replicas', type=int, default=2,
+                      help='replica engines behind the overload pool; '
+                      '>1 quarantines replica 0 mid-burst (failover '
+                      'drill)')
+  parser.add_argument('--deadline_ms', type=float, default=50.0,
+                      help='per-request deadline in the overload arm')
   parser.add_argument('--trace', default=None, metavar='PATH',
                       help='arm the observability layer (obs/, design '
                       '§15) and write the Chrome-trace JSON of the '
@@ -175,6 +191,48 @@ def main():
           f"{stats['serve_pipeline_overlap_pct']} "
           f"(merge+demux {stats['serve_pipeline_merge_demux_ms']} ms, "
           f"consumer blocked {stats['serve_pipeline_blocked_ms']} ms)")
+    if args.overload_qps is not None:
+      # overload A/B (design §23): the same engine weights behind a
+      # replica pool, offered more than it can serve — healthy is the
+      # closed-loop headline above; shedding and degraded are what the
+      # SLO layer did about the difference
+      replicas = max(1, int(args.replicas))
+      pool_engines = [engine] + [
+          serving.ServingEngine(configs, weights, batch_size=batch,
+                                buckets=buckets, hot_sets=hot_sets)
+          for _ in range(replicas - 1)]
+      over = serving.measure_overload(
+          pool_engines, requests, max_delay_ms=args.max_delay_ms,
+          deadline_ms=args.deadline_ms, priority_mix=args.priority_mix,
+          offered_qps=args.overload_qps or None,
+          failover_after=(len(requests) // 2 if replicas > 1 else None))
+      stats.update(over)
+      print('A/B  healthy    : '
+            f"p50 {stats['serve_p50_ms']} ms  "
+            f"p99 {stats['serve_p99_ms']} ms  "
+            f"p99.9 {stats['serve_p999_ms']} ms  "
+            f"qps {stats['serve_qps']} (closed-loop, no sheds)")
+      print('A/B  shedding   : high '
+            f"p50 {over['serve_over_high_p50_ms']} ms  "
+            f"p99 {over['serve_over_high_p99_ms']} ms  "
+            f"p99.9 {over['serve_over_high_p999_ms']} ms  "
+            f"shed {over['serve_over_high_shed']} | low "
+            f"p50 {over['serve_over_low_p50_ms']} ms  "
+            f"p99 {over['serve_over_low_p99_ms']} ms  "
+            f"shed {over['serve_over_low_shed']} "
+            f"(offered {over['serve_over_offered_qps']} qps, served "
+            f"{over['serve_over_qps']} qps, shed rate "
+            f"{over['serve_over_shed_rate']}; by reason: deadline "
+            f"{over['serve_over_shed_deadline']}, queue_full "
+            f"{over['serve_over_shed_queue_full']})")
+      print('A/B  degraded   : '
+            f"{over['serve_over_degraded_served']} low-priority "
+            'request(s) served hot-cache-only across '
+            f"{over['serve_over_degraded_enters']} enter(s) / "
+            f"{over['serve_over_degraded_exits']} exit(s); failover: "
+            f"{over['serve_over_quarantined']} replica(s) quarantined, "
+            f"{over['serve_over_failovers']} request retry(ies), "
+            'zero accepted requests lost')
     print(json.dumps(stats))
     if args.trace:
       from distributed_embeddings_tpu.obs import trace as obs_trace

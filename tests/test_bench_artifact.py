@@ -427,6 +427,59 @@ def test_serving_artifact_keys():
   assert 0.0 <= rate <= 1.0
 
 
+def test_overload_artifact_keys():
+  """The ISSUE-19 journaled proof: the overload A/B block bench folds
+  into the artifact carries the pinned serve_over_* keys (per-class
+  p50/p99/p99.9, shed counts by class and reason, degraded-mode
+  crossings, failover/quarantine counts — design.md §23) plus the
+  serve_p999_ms tail the healthy arm gained, so a future change that
+  silently drops the overload measurement (or renames its keys) fails
+  tier-1 here."""
+  import jax
+  import numpy as np
+  from distributed_embeddings_tpu import serving
+  from distributed_embeddings_tpu.parallel import TableConfig, create_mesh
+
+  cfgs = [TableConfig(64, 8, 'sum'), TableConfig(40, 8, 'sum')]
+  rng = np.random.default_rng(1)
+  weights = [(rng.normal(size=(c.input_dim, c.output_dim)) * 0.1)
+             .astype(np.float32) for c in cfgs]
+  engine = serving.ServingEngine(
+      cfgs, weights, batch_size=16,
+      mesh=create_mesh(jax.devices()[:1]))
+  cats = [rng.integers(0, c.input_dim, size=(32,)).astype(np.int32)
+          for c in cfgs]
+  requests = serving.split_requests(cats, sizes=(1, 2, 4), limit=16)
+  st = serving.measure_serving(engine, requests, max_delay_ms=1.0,
+                               concurrency=3)
+  assert st['serve_p999_ms'] >= st['serve_p99_ms'] > 0
+  over = serving.measure_overload([engine], requests, max_delay_ms=1.0,
+                                  deadline_ms=2000.0, queue_depth=64,
+                                  priority_mix=0.5)
+  for key in ('serve_over_requests', 'serve_over_served',
+              'serve_over_shed', 'serve_over_shed_rate',
+              'serve_over_offered_qps', 'serve_over_qps',
+              'serve_over_deadline_ms', 'serve_over_priority_mix',
+              'serve_over_replicas'):
+    assert key in over, key
+  for key in ('serve_over_high_p50_ms', 'serve_over_high_p99_ms',
+              'serve_over_high_p999_ms', 'serve_over_low_p50_ms',
+              'serve_over_low_p99_ms', 'serve_over_low_p999_ms',
+              'serve_over_high_shed', 'serve_over_low_shed',
+              'serve_over_shed_deadline', 'serve_over_shed_queue_full'):
+    assert key in over, key
+  for key in ('serve_over_degraded_served', 'serve_over_degraded_enters',
+              'serve_over_degraded_exits', 'serve_over_failovers',
+              'serve_over_quarantined'):
+    assert key in over, key
+  assert over['serve_over_requests'] == len(requests)
+  assert over['serve_over_served'] + over['serve_over_shed'] \
+      == len(requests)
+  assert over['serve_over_replicas'] == 1
+  assert 0.0 <= over['serve_over_shed_rate'] <= 1.0
+  assert over['serve_over_failovers'] == 0
+
+
 def test_obs_artifact_keys(bench):
   """The ISSUE-11 journaled proof, library-level: the obs block bench
   folds into the artifact carries the pinned keys, the direct-measured

@@ -1,0 +1,457 @@
+"""SLO-aware serving under overload (design §23): priority admission,
+load shedding, replica-pool failover, and the journaled degraded mode.
+
+The load-bearing claims pinned here:
+
+- typed outcomes: ``ServeFuture.result`` raises ``DeadlineExceededError``
+  (a ``TimeoutError``) on a caller timeout; sheds resolve with
+  ``RequestSheddedError`` (a ``RuntimeError``) carrying a machine-usable
+  ``reason``; a fully-quarantined pool refuses with ``ReplicaLostError``;
+- the admission split: low-priority requests shed ``queue_full`` at a
+  bounded depth while high keeps blocking backpressure; past-deadline
+  requests shed at DISPATCH and are never executed;
+- per-class accounting: ``stats()`` carries ``p999_ms``, the ``classes``
+  block and the per-reason ``shed`` ledger, every key registered in
+  ``obs.metrics.REGISTERED_STATS_KEYS``;
+- the pool failure contract: a faulting replica quarantines, its
+  requests retry on a survivor BIT-EXACT vs the survivor's direct
+  forward, and both crossings journal;
+- degraded mode enters on sustained over-watermark pressure, serves low
+  traffic hot-cache-only at a counted accuracy cost, and EXITS once
+  pressure drains — both journaled;
+- shutdown under overload: ``close()`` with saturated queues and a
+  quarantined replica resolves EVERY outstanding future promptly, with
+  the lock graph acyclic under the locksan capture;
+- ``measure_overload`` emits the full ``serve_over_*`` artifact block.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_embeddings_tpu import serving
+from distributed_embeddings_tpu.obs import metrics as obs_metrics
+from distributed_embeddings_tpu.parallel import TableConfig, create_mesh
+from distributed_embeddings_tpu.parallel.hotcache import HotSet
+from distributed_embeddings_tpu.serving import (DeadlineExceededError,
+                                                DynamicBatcher,
+                                                ReplicaLostError,
+                                                RequestSheddedError,
+                                                ServingEnginePool)
+from distributed_embeddings_tpu.serving.batcher import ServeFuture
+from distributed_embeddings_tpu.utils import resilience
+
+CONFIGS = [TableConfig(32, 4, 'sum'), TableConfig(24, 4, 'sum')]
+HOT = {0: HotSet(0, np.arange(8)), 1: HotSet(1, np.arange(6))}
+BATCH = 8
+
+
+def _weights():
+  rng = np.random.default_rng(3)
+  return [(rng.normal(size=(c.input_dim, c.output_dim)) * 0.1)
+          .astype(np.float32) for c in CONFIGS]
+
+
+def _engine(dev=0, hot=True, weights=None):
+  return serving.ServingEngine(
+      CONFIGS, weights if weights is not None else _weights(),
+      batch_size=BATCH, mesh=create_mesh(jax.devices()[dev:dev + 1]),
+      hot_sets=HOT if hot else None)
+
+
+def _req(rng, n=2):
+  return [rng.integers(0, c.input_dim, size=(n,)).astype(np.int32)
+          for c in CONFIGS]
+
+
+def _same(a, b):
+  return all(np.array_equal(x, y) for x, y in zip(a, b))
+
+
+# ------------------------------------------------------------ exceptions
+
+
+class TestTypedExceptions:
+
+  def test_result_timeout_is_deadline_exceeded(self):
+    f = ServeFuture()
+    with pytest.raises(DeadlineExceededError):
+      f.result(timeout=0.01)
+    # and it still answers to the legacy TimeoutError pin
+    with pytest.raises(TimeoutError):
+      f.result(timeout=0.01)
+
+  def test_shed_error_carries_reason(self):
+    e = RequestSheddedError('shed', reason='queue_full')
+    assert isinstance(e, RuntimeError)
+    assert e.reason == 'queue_full'
+    assert RequestSheddedError('x').reason == 'closed'
+
+  def test_replica_lost_is_runtime_error(self):
+    assert issubclass(ReplicaLostError, RuntimeError)
+
+  def test_submit_validates_priority_and_deadline(self):
+    eng = _engine()
+    with DynamicBatcher(eng, max_delay_ms=1.0) as bat:
+      rng = np.random.default_rng(0)
+      with pytest.raises(ValueError, match='priority'):
+        bat.submit(_req(rng), priority='mid')
+      with pytest.raises(ValueError, match='deadline_ms'):
+        bat.submit(_req(rng), deadline_ms=-5.0)
+    pool = ServingEnginePool([eng])
+    try:
+      with pytest.raises(ValueError, match='priority'):
+        pool.submit(_req(rng), priority='urgent')
+    finally:
+      pool.close()
+
+
+# ------------------------------------------------------------- admission
+
+
+class TestAdmission:
+
+  def test_low_bound_sheds_queue_full_high_keeps_backpressure(self):
+    resilience.clear_recent()
+    eng = _engine()
+    eng.warmup()
+    gate, entered = threading.Event(), threading.Event()
+    orig = eng.lookup
+
+    def gated(cats, samples=None):
+      entered.set()
+      gate.wait(timeout=30.0)
+      return orig(cats, samples=samples)
+
+    eng.lookup = gated
+    rng = np.random.default_rng(1)
+    bat = DynamicBatcher(eng, max_delay_ms=1.0, pipeline=False,
+                         queue_depth=16, low_queue_depth=2)
+    try:
+      # pin the (non-pipelined) dispatcher inside a high batch so every
+      # later submit stays QUEUED — depths are then deterministic
+      fut_hi = bat.submit(_req(rng), priority='high')
+      assert entered.wait(timeout=30.0)
+      reqs = [_req(rng) for _ in range(4)]
+      futs = [bat.submit(r, priority='low') for r in reqs]
+      # low sheds RESOLVE (typed), they never raise at submit
+      shed = [f for f in futs if f.error() is not None]
+      assert len(shed) == 2
+      for f in shed:
+        with pytest.raises(RequestSheddedError) as ei:
+          f.result(timeout=1.0)
+        assert ei.value.reason == 'queue_full'
+        assert 'design.md' in str(ei.value)  # actionable, documented
+      gate.set()
+      assert len(fut_hi.result(timeout=60.0)) == len(CONFIGS)
+      served = [f for f in futs if f not in shed]
+      for f in served:
+        f.result(timeout=60.0)
+      st = bat.stats()
+    finally:
+      gate.set()
+      bat.close()
+    assert st['low_queue_depth'] == 2
+    assert st['classes']['low']['shed'] == 2
+    assert st['classes']['low']['served'] == 2
+    assert st['classes']['high']['shed'] == 0
+    assert st['shed']['queue_full'] == 2
+    events = resilience.recent('serve_shed')
+    assert events and events[0]['reason'] == 'queue_full'
+    assert events[0]['priority'] == 'low'
+
+  def test_deadline_sheds_at_dispatch_and_never_executes(self):
+    resilience.clear_recent()
+    eng = _engine()
+    eng.warmup()
+    gate, entered = threading.Event(), threading.Event()
+    calls = []
+    orig = eng.lookup
+
+    def gated(cats, samples=None):
+      calls.append(samples)
+      entered.set()
+      gate.wait(timeout=30.0)
+      return orig(cats, samples=samples)
+
+    eng.lookup = gated
+    rng = np.random.default_rng(2)
+    bat = DynamicBatcher(eng, max_delay_ms=1.0, pipeline=False)
+    try:
+      fut_hi = bat.submit(_req(rng), priority='high')
+      assert entered.wait(timeout=30.0)
+      fut_lo = bat.submit(_req(rng), priority='low', deadline_ms=5.0)
+      time.sleep(0.03)  # the deadline lapses while the request queues
+      gate.set()
+      fut_hi.result(timeout=60.0)
+      with pytest.raises(RequestSheddedError) as ei:
+        fut_lo.result(timeout=60.0)
+      st = bat.stats()
+    finally:
+      gate.set()
+      bat.close()
+    assert ei.value.reason == 'deadline'
+    assert len(calls) == 1, 'a past-deadline request must NEVER execute'
+    assert st['shed']['deadline'] == 1
+    assert st['classes']['low']['shed'] == 1
+
+  def test_close_sheds_resolve_typed(self):
+    eng = _engine()
+    eng.warmup()
+    gate, entered = threading.Event(), threading.Event()
+    orig = eng.lookup
+
+    def gated(cats, samples=None):
+      entered.set()
+      gate.wait(timeout=30.0)
+      return orig(cats, samples=samples)
+
+    eng.lookup = gated
+    rng = np.random.default_rng(3)
+    bat = DynamicBatcher(eng, max_delay_ms=1.0, pipeline=False)
+    bat.submit(_req(rng))
+    assert entered.wait(timeout=30.0)
+    stranded = bat.submit(_req(rng))
+    closer = threading.Thread(target=bat.close)
+    closer.start()
+    gate.set()
+    closer.join(timeout=60.0)
+    assert not closer.is_alive()
+    with pytest.raises(RequestSheddedError) as ei:
+      stranded.result(timeout=1.0)
+    assert ei.value.reason == 'closed'
+    # the pre-§23 pin: a closed-shed still reads as RuntimeError(closed)
+    with pytest.raises(RuntimeError, match='closed'):
+      stranded.result(timeout=1.0)
+
+
+# ----------------------------------------------------------------- stats
+
+
+def _str_keys(d):
+  out = set()
+  if isinstance(d, dict):
+    for k, v in d.items():
+      if isinstance(k, str):
+        out.add(k)
+      out |= _str_keys(v)
+  return out
+
+
+class TestStats:
+
+  def test_p999_and_class_block(self):
+    eng = _engine()
+    rng = np.random.default_rng(4)
+    with DynamicBatcher(eng, max_delay_ms=1.0) as bat:
+      for _ in range(6):
+        bat.submit(_req(rng), priority='high').result(timeout=60.0)
+      bat.submit(_req(rng), priority='low').result(timeout=60.0)
+      st = bat.stats()
+    assert st['p999_ms'] >= st['p99_ms'] >= st['p50_ms'] > 0
+    assert st['classes']['high']['served'] == 6
+    assert st['classes']['low']['served'] == 1
+    assert st['classes']['high']['p999_ms'] > 0
+    assert st['shed'] == {'queue_full': 0, 'deadline': 0, 'closed': 0}
+
+  def test_every_stats_key_registered(self):
+    eng = _engine()
+    rng = np.random.default_rng(5)
+    pool = ServingEnginePool([eng])
+    try:
+      pool.submit(_req(rng)).result(timeout=60.0)
+      keys = _str_keys(pool.stats())
+      keys |= _str_keys(pool.batchers[0].stats())
+    finally:
+      pool.close()
+    missing = {k for k in keys
+               if k not in obs_metrics.REGISTERED_STATS_KEYS}
+    assert not missing, f'unregistered stats keys: {sorted(missing)}'
+
+  def test_overload_metrics_registered(self):
+    for name, kind in (('serve.shed', 'counter'),
+                       ('serve.degraded', 'counter'),
+                       ('serve.failover', 'counter'),
+                       ('serve.failover_ms', 'histogram'),
+                       ('serve.latency_high_ms', 'histogram'),
+                       ('serve.latency_low_ms', 'histogram'),
+                       ('serve.pool_depth', 'gauge')):
+      assert obs_metrics.METRIC_TYPES.get(name) == kind, name
+
+
+# ------------------------------------------------------------------ pool
+
+
+class TestPool:
+
+  def test_routing_failover_bitexact(self):
+    resilience.clear_recent()
+    w = _weights()
+    eng0, eng1 = _engine(0, weights=w), _engine(1, weights=w)
+    for e in (eng0, eng1):
+      e.warmup()
+
+    def failing(cats, samples=None):
+      raise RuntimeError('injected replica fault')
+
+    eng0.lookup = failing  # every batch on replica 0 now faults
+    rng = np.random.default_rng(6)
+    pool = ServingEnginePool([eng0, eng1], max_delay_ms=1.0)
+    try:
+      reqs = [_req(rng, 1 + i % 3) for i in range(12)]
+      futs = [pool.submit(r) for r in reqs]
+      outs = [f.result(timeout=120.0) for f in futs]
+      st = pool.stats()
+    finally:
+      pool.close()
+    # zero accepted-request loss, retried demux bit-exact vs the
+    # SURVIVOR's direct forward (replicas hold identical weights)
+    for r, out in zip(reqs, outs):
+      assert _same(eng1.lookup_padded(r), out)
+    assert st['quarantined'] == 1 and st['live_replicas'] == 1
+    assert st['failovers'] >= 1
+    assert st['classes']['high']['served'] == 12
+    q = resilience.recent('serve_replica_quarantined')
+    assert q and q[0]['replica'] == 0 and q[0]['live_replicas'] == 1
+    assert resilience.recent('serve_failover')
+
+  def test_all_replicas_lost_refuses(self):
+    eng = _engine()
+    pool = ServingEnginePool([eng])
+    try:
+      pool.fail_replica(0)
+      with pytest.raises(ReplicaLostError):
+        pool.submit(_req(np.random.default_rng(7)))
+      st = pool.stats()
+      assert st['live_replicas'] == 0 and st['quarantined'] == 1
+    finally:
+      pool.close()
+
+  def test_degraded_enters_serves_hot_only_and_exits(self):
+    resilience.clear_recent()
+    eng = _engine()
+    eng.warmup()
+    orig = eng.lookup
+
+    def slow(cats, samples=None):
+      time.sleep(0.008)  # hold pressure over the watermark
+      return orig(cats, samples=samples)
+
+    eng.lookup = slow
+    rng = np.random.default_rng(8)
+    pool = ServingEnginePool([eng], max_delay_ms=1.0, queue_depth=64,
+                             degrade_high_watermark=3,
+                             degrade_low_watermark=1, degrade_patience=1)
+    try:
+      highs = [pool.submit(_req(rng), priority='high',
+                           deadline_ms=60000.0) for _ in range(8)]
+      assert pool.stats()['degraded'], \
+          'sustained over-watermark pressure must enter degraded mode'
+      lows = [_req(rng, 3) for _ in range(3)]
+      low_futs = [pool.submit(r, priority='low', deadline_ms=60000.0)
+                  for r in lows]
+      for f in highs + low_futs:
+        f.result(timeout=120.0)
+      st = pool.stats()
+      del eng.lookup  # restore the direct forward for the references
+      # low served hot-cache-only: bit-exact vs the hot-filtered twin
+      for r, f in zip(lows, low_futs):
+        fc, dropped, total = eng.hot_only_filter(r)
+        assert total > 0
+        assert _same(eng.lookup_padded(fc), f.result(timeout=1.0))
+    finally:
+      pool.close()
+    assert st['degraded_enters'] >= 1
+    assert st['degraded_served'] == 3
+    assert st['degraded_drop_pct'] is not None
+    # pressure drained below the low watermark: the mode EXITED
+    assert not st['degraded'] and st['degraded_exits'] >= 1
+    assert resilience.recent('serve_degraded_enter')
+    exits = resilience.recent('serve_degraded_exit')
+    assert exits and exits[-1]['pressure'] <= 1
+
+  def test_shutdown_under_overload_resolves_everything(self):
+    """Satellite (d): close() while queues are saturated and one
+    replica is quarantined must resolve EVERY outstanding future
+    within the deadline — under the locksan capture."""
+    from distributed_embeddings_tpu.analysis import locksan
+    resilience.clear_recent()
+    w = _weights()
+    with locksan.capture('pool-shutdown-overload') as cap:
+      eng0, eng1 = _engine(0, weights=w), _engine(1, weights=w)
+      for e in (eng0, eng1):
+        e.warmup()
+      orig1 = eng1.lookup
+
+      def failing(cats, samples=None):
+        raise RuntimeError('injected replica fault')
+
+      def slow(cats, samples=None):
+        time.sleep(0.03)
+        return orig1(cats, samples=samples)
+
+      eng0.lookup = failing
+      eng1.lookup = slow
+      rng = np.random.default_rng(9)
+      pool = ServingEnginePool([eng0, eng1], max_delay_ms=1.0,
+                               queue_depth=32, low_queue_depth=2)
+      futs = [pool.submit(_req(rng), priority='high' if i % 2 == 0
+                          else 'low', deadline_ms=60000.0)
+              for i in range(24)]
+      pool.close()  # mid-overload: queues saturated, replica 0 dying
+      t0 = time.monotonic()
+      outcomes = {'served': 0, 'shed': 0, 'lost_replica': 0}
+      for f in futs:
+        try:
+          f.result(timeout=30.0)
+          outcomes['served'] += 1
+        except RequestSheddedError:
+          outcomes['shed'] += 1
+        except ReplicaLostError:
+          outcomes['lost_replica'] += 1
+      wall = time.monotonic() - t0
+    assert sum(outcomes.values()) == 24, outcomes
+    assert wall < 30.0, f'shutdown drain took {wall:.1f}s'
+    assert cap.locks_created > 0
+    cap.assert_acyclic()
+    with pytest.raises(RuntimeError, match='closed'):
+      pool.submit(_req(rng))
+
+
+# ----------------------------------------------------------------- bench
+
+
+class TestMeasureOverload:
+
+  def test_overload_block(self):
+    eng = _engine()
+    rng = np.random.default_rng(10)
+    cats = [rng.integers(0, c.input_dim, size=(48,)).astype(np.int32)
+            for c in CONFIGS]
+    requests = serving.split_requests(cats, sizes=(1, 2, 4), limit=24)
+    st = serving.measure_overload([eng], requests, max_delay_ms=1.0,
+                                  deadline_ms=2000.0, queue_depth=64,
+                                  priority_mix=0.5)
+    assert st['serve_over_requests'] == len(requests)
+    assert st['serve_over_served'] + st['serve_over_shed'] \
+        == len(requests)
+    assert st['serve_over_replicas'] == 1
+    assert st['serve_over_priority_mix'] == 0.5
+    assert st['serve_over_deadline_ms'] == 2000.0
+    assert st['serve_over_offered_qps'] > 0
+    assert 0.0 <= st['serve_over_shed_rate'] <= 1.0
+    # generous deadline + deep queue on an idle host: everything serves
+    assert st['serve_over_high_p50_ms'] > 0
+    assert st['serve_over_high_p999_ms'] >= st['serve_over_high_p99_ms']
+    assert st['serve_over_failovers'] == 0
+    assert st['serve_over_quarantined'] == 0
+
+  def test_priority_mix_validated(self):
+    eng = _engine()
+    with pytest.raises(ValueError, match='priority_mix'):
+      serving.measure_overload(
+          [eng], [_req(np.random.default_rng(11))], priority_mix=1.5)

@@ -56,7 +56,8 @@ from distributed_embeddings_tpu.utils import resilience  # noqa: E402
 
 # lower-is-better millisecond keys compared when BOTH sides carry them;
 # 'value' (the headline ms/step) is always compared
-DEFAULT_KEYS = ('value', 'serve_p50_ms', 'serve_p99_ms')
+DEFAULT_KEYS = ('value', 'serve_p50_ms', 'serve_p99_ms',
+                'serve_p999_ms', 'serve_over_high_p99_ms')
 
 
 class ArtifactError(ValueError):
