@@ -432,6 +432,18 @@ def main():
                       'at most once per slice.  The HEADLINE number is '
                       'untouched.  Default: on for the sparse trainer '
                       'off the sparsecore path with >= 4 devices')
+  parser.add_argument('--wire_ab', action=argparse.BooleanOptionalAction,
+                      default=None,
+                      help='wire-dtype compression A/B (design §24): '
+                      'run twin forward passes with the fused-exchange '
+                      'wire codec off vs on (bf16 arm and, on int8 '
+                      'tables, the payload+po2-scale passthrough arm) '
+                      'and journal the measured per-leg wire bytes, the '
+                      'off/on byte ratios and the forward parity drift '
+                      '(the passthrough arm must be bit-exact, drift '
+                      '0.0).  The HEADLINE number is untouched.  '
+                      'Default: on for the sparse trainer off the '
+                      'sparsecore path with >= 2 devices')
   parser.add_argument('--hot_coverage', type=float, default=0.85,
                       help='per-table occurrence coverage target for the '
                       'hot set (0.85 measured: 8.5x fewer exchanged '
@@ -679,6 +691,20 @@ def main():
     if len(devices) < 4 or len(devices) % 2:
       raise SystemExit('--dcn_ab needs an even device count >= 4 '
                        '(the A/B mesh is (2, n/2); design §20)')
+  use_wire_ab = args.wire_ab
+  if use_wire_ab is None:
+    use_wire_ab = (args.trainer == 'sparse'
+                   and args.lookup_impl != 'sparsecore'
+                   and len(devices) >= 2)
+  elif use_wire_ab:
+    # explicit --wire_ab: fail fast (same discipline as --dcn_ab)
+    if args.trainer != 'sparse':
+      raise SystemExit('--wire_ab requires --trainer sparse (the wire '
+                       'codec lives in the sparse fused exchange; '
+                       'design §24)')
+    if len(devices) < 2:
+      raise SystemExit('--wire_ab needs >= 2 devices (a single-device '
+                       'mesh has no exchange legs to compress)')
   quant_dtype = args.table_dtype
   if quant_dtype is None:
     # default: journal the int8 storage A/B for every sparse power-law
@@ -1219,6 +1245,107 @@ def main():
       dcn_stats = dcn_stats or {}
       dcn_stats['dcn_ab_error'] = f'{type(e).__name__}: {e}'
 
+  # Wire-dtype compression A/B (parallel/dist_embedding.py wire_dtype,
+  # design §24; ISSUE 20).  Four twin layers over the SAME wide tables
+  # + hot sets + id streams, so the only delta per pair is the wire
+  # codec: the int8 pair (stored int8, wire off vs 'table' passthrough
+  # — payload + po2 scale on a packed uint8 wire, bit-exact by the §12
+  # po2 identity) and the f32 pair (wire off vs 'bfloat16').  Bytes
+  # are read off the traced LookupPlan legs — the codec encodes BEFORE
+  # fuse_layout records the leg, so leg.nbytes IS the on-wire size and
+  # leg.payload_nbytes the compute-dtype counterfactual.  Ratios are
+  # over the codec-targeted row legs (id legs never narrow and ride
+  # unchanged in every arm).  The HEADLINE number is untouched.  Never
+  # fatal.
+  wire_stats = None
+  if use_wire_ab:
+    try:
+      from distributed_embeddings_tpu.parallel import (
+          DistributedEmbedding, TableConfig, set_weights)
+      from distributed_embeddings_tpu.parallel.hotcache import HotSet
+      from distributed_embeddings_tpu.utils import resilience
+
+      # one table per worker: with fewer tables the auto-slicer would
+      # shred them into narrow column slices to feed every worker, and
+      # the q8 wire pays its 2-byte scale exponent PER SLICE-ROW —
+      # diluting the ratio to ~3.0x at width-4 slices.  Tables >= world
+      # keeps rows full-width (the representative case for many-table
+      # models) so the A/B measures the codec, not the slicer; fusion
+      # folds same-width tables back into one group per signature
+      # (docs/design.md §24).
+      w_world = len(mesh.devices.flat)
+      w_configs = [
+          TableConfig(1024 * (1 + t % 2), 16 * (1 + t % 2), 'sum')
+          for t in range(max(w_world, 2))]
+      w_rng = np.random.default_rng(0)
+      w_weights = [
+          (w_rng.normal(size=(c.input_dim, c.output_dim)) * 0.05)
+          .astype(np.float32) for c in w_configs]
+      w_hot = {t: HotSet(t, np.sort(w_rng.choice(
+          c.input_dim, 64, replace=False)).astype(np.int64))
+               for t, c in enumerate(w_configs)}
+      w_batch = 8 * w_world
+      w_ids = [jnp.asarray(
+          w_rng.integers(0, c.input_dim, size=(w_batch, 4)),
+          dtype=jnp.int32) for c in w_configs]
+
+      def _wire_arm(table_dtype, wire):
+        d = DistributedEmbedding(w_configs, mesh=mesh, dp_input=True,
+                                 hot_cache=dict(w_hot),
+                                 table_dtype=table_dtype,
+                                 wire_dtype=wire)
+        out = [np.asarray(o) for o in d.apply(set_weights(d, w_weights),
+                                              w_ids)]
+        legs = [leg for lp in d._lookup_plans.values()
+                for leg in lp.legs]
+        return out, legs
+
+      def _wire_leg_bytes(legs):
+        # codec-targeted legs only: on a wire-on arm those carry
+        # wire != None; their payload_nbytes is the f32-wire
+        # counterfactual the off arm ships for the same legs
+        on = sum(int(l.nbytes) for l in legs if l.wire)
+        off = sum(int(l.payload_bytes) for l in legs if l.wire)
+        return off, on
+
+      out_i_off, _ = _wire_arm('int8', None)
+      out_i_on, legs_i = _wire_arm('int8', 'table')
+      out_f_off, _ = _wire_arm(None, None)
+      out_f_on, legs_f = _wire_arm(None, 'bfloat16')
+      drift_i = max(float(np.max(np.abs(a - b)))
+                    for a, b in zip(out_i_off, out_i_on))
+      for a, b in zip(out_i_off, out_i_on):
+        # int8 table on the int8 wire is bit-exact BY CONTRACT — a
+        # nonzero delta is a codec bug, not noise; refuse to journal it
+        # as a mere drift number
+        np.testing.assert_array_equal(a, b)
+      # drift scaled by each output's max magnitude (the §24 pinned-
+      # bound definition the parity tests use) — an elementwise
+      # relative error would blow up on near-zero combined sums and
+      # journal noise, not codec truth
+      drift_f = max(
+          float(np.max(np.abs(a - b)) / max(float(np.max(np.abs(a))),
+                                            1e-6))
+          for a, b in zip(out_f_off, out_f_on))
+      off_i, on_i = _wire_leg_bytes(legs_i)
+      off_f, on_f = _wire_leg_bytes(legs_f)
+      if off_i != off_f:
+        raise AssertionError(
+            f'wire_ab arms disagree on the f32-wire baseline bytes '
+            f'({off_i} vs {off_f}) — the twin id streams diverged')
+      wire_stats = {
+          'wire_ab_bytes_off': int(off_i),
+          'wire_ab_bytes_int8': int(on_i),
+          'wire_ab_bytes_bf16': int(on_f),
+          'wire_ab_ratio_int8': round(off_i / max(on_i, 1), 3),
+          'wire_ab_ratio_bf16': round(off_f / max(on_f, 1), 3),
+          'wire_ab_drift_int8': drift_i,
+          'wire_ab_drift_bf16': round(drift_f, 6),
+      }
+      resilience.journal('wire_ab', **wire_stats)
+    except Exception as e:
+      wire_stats = {'wire_ab_error': f'{type(e).__name__}: {e}'}
+
   # Quantized table storage A/B (parallel/quantization.py, design §12;
   # ISSUE 7).  The OFF arm is the headline step (unquantized, program-
   # identical to pre-PR); the ON arm re-measures the same model with
@@ -1713,6 +1840,8 @@ def main():
     result.update(a2a_stats)
   if dcn_stats:
     result.update(dcn_stats)
+  if wire_stats:
+    result.update(wire_stats)
   if quant_stats:
     result.update(quant_stats)
   if tier_stats:

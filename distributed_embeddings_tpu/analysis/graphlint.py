@@ -869,6 +869,39 @@ def build_programs(tier: str = 'flagship') -> List[Program]:
                             parity='bwd-fuse',
                             plan_expect=plan_expectation(d_m, ('bwd',))))
 
+  # ---- wire-dtype twins (design §24) --------------------------------
+  # Same tables + id streams per pair; the only delta is the wire
+  # codec.  The parity pass compares the COLLAPSED (primitive, axis)
+  # schedule — dtype-blind by design — so each off/on pair shares a
+  # parity group: the codec must narrow payloads without adding or
+  # reordering a single collective.  The raw ledger rows DO carry
+  # dtype, so the checked-in ledger is the dtype assertion: the
+  # wire-on forward's cold-row leg must show uint8 (int8 payload +
+  # packed po2 scale) and the wire-on backward's cotangent leg
+  # bfloat16, where the off twins show float32.
+  hs_w = {0: hotcache.HotSet(0, np.array([0, 1, 2])),
+          1: hotcache.HotSet(1, np.array([1, 5, 9]))}
+  w_wq = [rng.normal(size=(c.input_dim, c.output_dim))
+          .astype(np.float32) * 0.1 for c in cfg_m]
+  for wire, name in ((None, 'lookup/wire-off'),
+                     ('table', 'lookup/wire-on')):
+    d_w = DistributedEmbedding(cfg_m, mesh=mesh, dp_input=True,
+                               table_dtype='int8', hot_cache=dict(hs_w),
+                               wire_dtype=wire)
+    forward_program(name, d_w, set_weights(d_w, w_wq), cats_m,
+                    parity='wire-fwd', fetch={})
+  for wire, bname in ((None, 'bwd/wire-off'),
+                      ('bfloat16', 'bwd/wire-on')):
+    d_b = DistributedEmbedding(cfg_m, mesh=mesh, dp_input=True,
+                               wire_dtype=wire)
+    p_b = set_weights(d_b, w_m)
+    outs_b, _, (gb_b, hot_b) = d_b.forward_with_residuals(p_b, cats_m)
+    bwd_b = d_b._build_backward(gb_b, hot_b)
+    traced_wb = bwd_b.trace(*[jnp.ones_like(o) for o in outs_b])
+    programs.append(Program(bname, jaxpr=traced_wb.jaxpr,
+                            parity='wire-bwd',
+                            plan_expect=plan_expectation(d_b, ('bwd',))))
+
   if tier == 'full':
     d_sc = DistributedEmbedding(cfg2, mesh=mesh,
                                 lookup_impl='sparsecore')

@@ -127,6 +127,11 @@ class DLRM:
       §21), forwarded to ``DistributedEmbedding``.  True (default)
       is the fused schedule; False keeps the legacy per-group one —
       the A/B escape hatch, bit-exact either way.
+    wire_dtype: per-leg wire format of the fused exchange
+      (docs/design.md §24), forwarded to ``DistributedEmbedding``:
+      ``'bfloat16'`` casts the row/gradient legs on the wire,
+      ``'table'`` ships a quantized table's stored payload + scale
+      directly (bit-exact; requires ``table_dtype``).
   """
   table_sizes: Sequence[int]
   embedding_dim: int = 128
@@ -147,6 +152,7 @@ class DLRM:
   device_hbm_budget: Optional[int] = None
   cold_fetch_rows: Any = None
   fused_exchange: bool = True
+  wire_dtype: Optional[str] = None
 
   def __post_init__(self):
     if self.bottom_mlp_dims[-1] != self.embedding_dim:
@@ -180,7 +186,8 @@ class DLRM:
         cold_tier=self.cold_tier,
         device_hbm_budget=self.device_hbm_budget,
         cold_fetch_rows=self.cold_fetch_rows,
-        fused_exchange=self.fused_exchange)
+        fused_exchange=self.fused_exchange,
+        wire_dtype=self.wire_dtype)
 
   @property
   def num_interaction_features(self) -> int:

@@ -145,6 +145,7 @@ REGISTERED_STATS_KEYS = frozenset({
     # ServingEngine (serving/engine.py)
     'batches_served', 'samples_served', 'batch_size', 'world_size',
     'hot_cache', 'cold_tier', 'table_dtype', 'fused_exchange',
+    'wire_dtype',
 })
 
 # Bench-artifact key schema: the keys tests/test_bench_artifact.py pins
@@ -223,6 +224,20 @@ REGISTERED_ARTIFACT_KEYS = frozenset({
     'exchange_collectives_bwd', 'exchange_collectives_bwd_pergroup',
     'fused_exchange_bytes', 'fused_leg_bytes',
     'cold_exchange_leg_bytes',
+    # wire-dtype compression counters (parallel/hotcache.py,
+    # coldtier.py; design §24): the traced schedule's on-wire totals,
+    # the compute-dtype counterfactual, their ratio, and the per-leg
+    # dtype ledgers that prove which legs narrowed
+    'wire_bytes', 'wire_payload_bytes', 'wire_compression_ratio',
+    'wire_leg_dtypes', 'cold_exchange_leg_dtypes', 'wire_dtype',
+    # off/bf16/int8-passthrough wire A/B (bench.py --wire_ab, design
+    # §24): measured wire bytes over the codec-targeted row legs per
+    # arm, the off/on ratios the acceptance bars gate, the forward
+    # parity drift per arm (int8 passthrough must be 0.0) and the
+    # never-fatal error tag
+    'wire_ab_bytes_off', 'wire_ab_bytes_bf16', 'wire_ab_bytes_int8',
+    'wire_ab_ratio_bf16', 'wire_ab_ratio_int8', 'wire_ab_drift_bf16',
+    'wire_ab_drift_int8', 'wire_ab_error',
     # artifact schema + host-pressure gauges (bench.py; design §19 —
     # the perf sentinel's comparability/noise inputs)
     'schema_version', 'available_mem_mb',

@@ -541,10 +541,20 @@ def fetch_stats(dist, fetch: ColdFetch) -> dict:
   # fetched rows above feed exactly these fused buffers (the cold-tier
   # fetch is the gather stage of the same plan)
   cold_leg_bytes = {}
+  cold_leg_dtypes = {}
   for lp in getattr(dist, '_lookup_plans', {}).values():
     for leg in lp.legs:
       if 'cold' in leg.name or leg.name.startswith('dcn/'):
-        cold_leg_bytes[f'{lp.path}:{leg.name}'] = int(leg.nbytes)
+        key = f'{lp.path}:{leg.name}'
+        cold_leg_bytes[key] = int(leg.nbytes)
+        # §24 wire ledger for the cold legs: the cold row legs are the
+        # passthrough candidates (pre-combine rows ship the stored
+        # int8/fp8 payload + po2 scale on a 'q8' wire), so the dtype
+        # row is the evidence the narrowing actually happened
+        cold_leg_dtypes[key] = {'dtype': leg.dtype,
+                                'wire': leg.wire,
+                                'nbytes': int(leg.nbytes),
+                                'payload_nbytes': int(leg.payload_bytes)}
   return {
       'cold_tier_fetch_rows': int(total_rows),
       'cold_tier_fetch_bytes': int(total_bytes),
@@ -552,6 +562,7 @@ def fetch_stats(dist, fetch: ColdFetch) -> dict:
       'cold_tier_fetch_rows_per_group': per_group_rows,
       'cold_tier_row_bytes_per_group': per_group_row_bytes,
       'cold_exchange_leg_bytes': cold_leg_bytes,
+      'cold_exchange_leg_dtypes': cold_leg_dtypes,
   }
 
 

@@ -194,6 +194,27 @@ class DistributedEmbedding:
       the fused-vs-per-group graphlint parity groups pin this.
       ``False`` keeps one collective per subgroup buffer (the
       historical program; the A/B arm examples/dlrm compares against).
+    wire_dtype: per-leg wire format of the exchange (docs/design.md
+      §24): ``None`` (default — every leg crosses at its compute
+      dtype, the historical wire) | ``'bfloat16'`` | ``'table'``.
+      Encoding happens just before and decoding just after each
+      ``all_to_all`` inside ``_exchange``, so every path variant
+      (flat, hot-cache cold, chunked, DCN-hierarchical, cold-tier,
+      serving) inherits the narrow wire from the one seam; collective
+      COUNT never changes — the same legs, narrower.  ``'bfloat16'``
+      casts row and gradient legs to bf16 on the wire (id legs never
+      narrow) and decodes back after the split — drift is bounded by
+      one bf16 round per crossing (pinned by
+      tests/test_wire_compression.py); on quantized plans the
+      pre-combine row legs take the exact payload+scale passthrough
+      instead (narrower AND bit-exact).  ``'table'`` (quantized plans
+      only) ships ONLY the exact passthrough: pre-combine cold/DCN row
+      legs cross as the stored int8/fp8 payload + po2-scale exponent
+      (uint8, ``w*itemsize + 2`` bytes vs ``4w`` — dequant moves to
+      the consumer side, bit-exact by the §12 po2 identity), every
+      other leg stays at compute dtype — the fully bit-exact wire.
+      Refusal matrix (§24): ``'table'`` without ``table_dtype``
+      raises (there is no stored payload to pass through).
   """
 
   def __init__(self,
@@ -219,7 +240,8 @@ class DistributedEmbedding:
                device_hbm_budget: Optional[int] = None,
                cold_fetch_rows=None,
                dcn_sharding: bool = False,
-               fused_exchange: bool = True):
+               fused_exchange: bool = True,
+               wire_dtype: Optional[str] = None):
     if row_slice is not None and (isinstance(row_slice, bool)
                                   or not isinstance(row_slice,
                                                     (int, np.integer))):
@@ -394,6 +416,22 @@ class DistributedEmbedding:
             'the Pallas gather kernel does not implement; running '
             'the XLA path under the pallas label would be a silent '
             "masquerade (design §7). Use lookup_impl='auto'.")
+    # ---- wire-dtype compression refusal matrix (design §24) ----
+    if wire_dtype == 'bf16':  # accept the common short alias
+      wire_dtype = 'bfloat16'
+    if wire_dtype not in (None, 'bfloat16', 'table'):
+      raise ValueError(
+          f'Unknown wire_dtype {wire_dtype!r}: expected None (compute-'
+          "dtype wire), 'bfloat16' (cast row/grad legs to bf16 on the "
+          "wire) or 'table' (quantized payload+scale passthrough on "
+          'pre-combine row legs — bit-exact; docs/design.md §24)')
+    if wire_dtype == 'table' and table_spec is None:
+      raise ValueError(
+          "wire_dtype='table' requires table_dtype ('int8' or "
+          "'float8_e4m3'): the table wire ships the STORED quantized "
+          'payload + po2 scale across the exchange, so an unquantized '
+          'table has no payload to pass through (docs/design.md §24). '
+          "Use wire_dtype='bfloat16' for f32/bf16 tables.")
     self.plan = ShardingPlan(self.table_configs,
                              world_size=self.world_size,
                              strategy=strategy,
@@ -426,6 +464,9 @@ class DistributedEmbedding:
     # collective coalescing (design §21): constructor-pinned so every
     # traced signature of this layer runs the same exchange program
     self.fused_exchange = bool(fused_exchange)
+    # wire format (design §24): constructor-pinned for the same reason —
+    # the on-wire dtype is part of every traced signature's schedule
+    self.wire_dtype = wire_dtype
     if self.num_slices > 1:
       # price this plan's exchange under the per-axis cost model and
       # journal the assumption (event 'exchange_cost_model', one per
@@ -436,7 +477,8 @@ class DistributedEmbedding:
       price_exchange(self.plan, 8 * self.num_slices * self.world_size,
                      [1] * len(self.plan.input_table_map),
                      num_slices=self.num_slices,
-                     hierarchical=self.dcn_sharding)
+                     hierarchical=self.dcn_sharding,
+                     wire_dtype=self.wire_dtype)
     # quantized storage: the payload dtype tables (and hot buffers)
     # physically store at; scales live in scale_group_{gi} leaves
     self.quant = self.plan.table_spec
@@ -1347,6 +1389,53 @@ class DistributedEmbedding:
           pieces, axis=-1))
     return tuple(outs)
 
+  # Wire applicability by exchange phase (design §24).  Pre-combine
+  # phases ship DEDUPLICATED SINGLE rows — on quantized plans those are
+  # exact grid values (payload * po2 scale), so the passthrough
+  # re-quantization reproduces the stored bits (§12 identity) and the
+  # wire is bit-exact.  Combined phases carry post-sum values (NOT grid
+  # values), so only the lossy bf16 cast may narrow them.  Id phases
+  # ('fwd/ids', 'fwd/cold_ids', 'dcn/ids') never narrow.
+  _WIRE_PRECOMBINE_ROW_PHASES = frozenset({'fwd/cold_rows', 'dcn/rows'})
+  _WIRE_CAST_PHASES = frozenset(
+      {'fwd/rows', 'bwd/cotangent', 'bwd/cold_grads'})
+
+  def _wire_codec(self, name: str) -> Optional[str]:
+    """Codec of one exchange phase under ``self.wire_dtype``: ``'q8'``
+    (payload + scale-exponent passthrough, exact), ``'bf16'`` (cast
+    wire, one bf16 round per crossing) or ``None`` (compute-dtype
+    wire).  Pure function of constructor-pinned state, so every traced
+    signature of the layer agrees."""
+    if self.wire_dtype is None:
+      return None
+    if name in self._WIRE_PRECOMBINE_ROW_PHASES:
+      if self.quant is not None:
+        return 'q8'
+      return 'bf16' if self.wire_dtype == 'bfloat16' else None
+    if self.wire_dtype == 'bfloat16' and name in self._WIRE_CAST_PHASES:
+      return 'bf16'
+    return None
+
+  def _wire_encode(self, b, codec: str):
+    """Encode one exchange buffer for the wire; returns ``(wire_buf,
+    decode_fn)`` with ``decode_fn`` restoring the original dtype (and,
+    for 'q8', the original ``[..., w]`` shape)."""
+    if codec == 'bf16':
+      orig = b.dtype
+      return b.astype(jnp.bfloat16), (
+          lambda x, orig=orig: x.astype(orig))
+    assert codec == 'q8', codec
+    orig = b.dtype
+    w = int(b.shape[-1])
+    wb = quantization.wire_encode_rows_jnp(
+        b.astype(jnp.float32), self.quant)
+
+    def dec(x, w=w, orig=orig):
+      return quantization.wire_decode_rows_jnp(
+          x, self.quant, w).astype(orig)
+
+    return wb, dec
+
   def _exchange(self, bufs, name, plan=None, axis=None):
     """The EXCHANGE stage of the lookup pipeline (docs/design.md §21).
 
@@ -1364,6 +1453,15 @@ class DistributedEmbedding:
     subgroups whose every slot left via psum_scatter; chunk rounds a
     subgroup's slot axis has run out of).  Issued legs are recorded
     into ``plan`` (a ``LookupPlan``) at trace time.
+
+    Wire compression (design §24) lives HERE and nowhere else: when
+    ``wire_dtype`` maps this phase to a codec (``_wire_codec``), every
+    live buffer encodes just before the concat and decodes just after
+    the split-back — so each path variant, both mesh axes and both
+    directions inherit the narrow wire from this one seam, the
+    recorded legs carry the ON-WIRE dtype/shape (plan bytes, graphlint
+    ledger rows and commlint emission all report wire truth by
+    construction), and the collective count is untouched.
     """
     axis = axis or self.axis_name
     D = self.mesh.shape[axis]
@@ -1371,9 +1469,22 @@ class DistributedEmbedding:
     live = [(i, b) for i, b in enumerate(bufs) if b is not None]
     if not live or D == 1:
       return out
+    codec = self._wire_codec(name)
+    decode = {}
+    orig_nbytes = {}
+    payload_nbytes = None
+    if codec is not None:
+      wired = []
+      for i, b in live:
+        orig_nbytes[i] = int(np.prod(b.shape)) * np.dtype(b.dtype).itemsize
+        wb, decode[i] = self._wire_encode(b, codec)
+        wired.append((i, wb))
+      live = wired
+      payload_nbytes = sum(orig_nbytes.values())
     if self.fused_exchange and len(live) > 1:
       legs = fuse_layout(name, [(f'g{i}', b.shape, b.dtype)
-                                for i, b in live], axis=axis)
+                                for i, b in live], axis=axis,
+                         wire=codec, payload_nbytes=payload_nbytes)
       by_label = {f'g{i}': (i, b) for i, b in live}
       for leg in legs:
         members = [by_label[s.label] for s in leg.segments]
@@ -1387,8 +1498,14 @@ class DistributedEmbedding:
       legs = []
       for i, b in live:
         legs += fuse_layout(f'{name}/g{i}', [(f'g{i}', b.shape, b.dtype)],
-                            axis=axis)
+                            axis=axis, wire=codec,
+                            payload_nbytes=orig_nbytes.get(i))
         out[i] = jax.lax.all_to_all(b, axis, 0, 0)
+    if codec is not None:
+      # consumer-side decode (§24): bit-exact bitcast+po2 dequant for
+      # the 'q8' passthrough, one bf16 round for the cast wire
+      for i, dec in decode.items():
+        out[i] = dec(out[i])
     if plan is not None:
       plan.record(legs)
     # trace-time rendezvous journal (commsan, design §22): the legs a

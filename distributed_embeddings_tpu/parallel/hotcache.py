@@ -357,6 +357,15 @@ def measure_exchange_counters(dist, cats,
   ``exchange.ici_rows`` / ``exchange.dcn_dedup_ratio`` are set when the
   registry is armed.
 
+  Wire-dtype compression counters (design §24): ``wire_bytes`` sums
+  every traced leg's on-wire size, ``wire_payload_bytes`` what the same
+  legs would ship at compute dtype, ``wire_compression_ratio`` their
+  quotient (1.0 with the codec off), and ``wire_leg_dtypes`` the
+  per-leg ledger (``{path:leg: {dtype, wire, nbytes, payload_nbytes}}``)
+  naming which legs narrowed and to what.  Because the codec encodes
+  BEFORE ``fuse_layout`` records the leg, these report on-wire truth by
+  construction.
+
   ``hot_sets`` defaults to the plan's own
   (``dist.plan.hot_sets``); pass ``{}`` to compute the off-path
   counters for a cache-less layer.
@@ -613,16 +622,44 @@ def measure_exchange_counters(dist, cats,
   # byte size so the counter artifact names the fused buffers the row
   # counts above travel in (empty before any traced launch)
   fused_leg_bytes = {}
+  wire_leg_dtypes = {}
+  wire_bytes = 0
+  wire_payload_bytes = 0
   for lp in getattr(dist, '_lookup_plans', {}).values():
     for leg in lp.legs:
       # most recent trace of each (path, leg) wins: re-traces at a new
       # batch signature describe the same wire at the new shape
-      fused_leg_bytes[f'{lp.path}:{leg.name}'] = int(leg.nbytes)
+      key = f'{lp.path}:{leg.name}'
+      fused_leg_bytes[key] = int(leg.nbytes)
+      # per-leg dtype ledger + wire totals (design §24): ``nbytes`` is
+      # what crosses the wire (post-encode), ``payload_nbytes`` the
+      # compute-dtype bytes the same leg would ship uncompressed, so
+      # the ratio is the realized §24 win over the traced schedule
+      wire_leg_dtypes[key] = {'dtype': leg.dtype,
+                              'wire': leg.wire,
+                              'nbytes': int(leg.nbytes),
+                              'payload_nbytes': int(leg.payload_bytes)}
+      wire_bytes += int(leg.nbytes)
+      wire_payload_bytes += int(leg.payload_bytes)
+  if fused_leg_bytes:
+    # priced-vs-counted reconciliation (design §24): put the §20 cost
+    # model's static capacity bytes next to the traced legs' counted
+    # wire bytes in the journal, in the same pass that reports them
+    from distributed_embeddings_tpu.parallel import planner as _planner
+    _planner.reconcile_exchange(dist)
 
   return {
       'alltoall_rows_sent_off': int(sent_off),
       'alltoall_rows_sent': int(sent_on),
       'fused_leg_bytes': fused_leg_bytes,
+      # wire-dtype compression counters (design §24): totals over every
+      # traced leg, with the per-leg dtype ledger behind them
+      'wire_dtype': getattr(dist, 'wire_dtype', None),
+      'wire_bytes': int(wire_bytes),
+      'wire_payload_bytes': int(wire_payload_bytes),
+      'wire_compression_ratio': round(wire_payload_bytes
+                                      / max(wire_bytes, 1), 4),
+      'wire_leg_dtypes': wire_leg_dtypes,
       'unique_cold_rows': int(sent_on),
       'hot_hit_rate': round(total_hot / total_valid, 4) if total_valid
                       else 0.0,

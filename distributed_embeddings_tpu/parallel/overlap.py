@@ -135,6 +135,7 @@ def build_exchange_program(dist, cats, chunks: Optional[int] = None,
   from jax.sharding import PartitionSpec as P
 
   from distributed_embeddings_tpu.parallel import dist_embedding as de
+  from distributed_embeddings_tpu.parallel import quantization
 
   cats = [jnp.asarray(c) for c in cats]
   inputs, global_batch, hotness = dist._prepare_inputs(cats)
@@ -150,6 +151,20 @@ def build_exchange_program(dist, cats, chunks: Optional[int] = None,
   S = dist.num_slices
   hier_dcn = (bool(getattr(dist, 'dcn_sharding', False)) and S > 1
               and dcn_leg)
+
+  def _wire_rows(vals, phase, w):
+    # ship this synthetic row leg at the layer's §24 wire dtype/shape
+    # so the measured bytes (and the devprof lane walls derived from
+    # this program) match the runtime collective: 'q8' legs become the
+    # packed uint8 payload+scale width, 'bf16' legs cross at bfloat16
+    codec = dist._wire_codec(phase)
+    if codec == 'q8':
+      ww = quantization.wire_bytes_per_row(w, dist.quant)
+      return jnp.broadcast_to(vals[..., None].astype(jnp.uint8),
+                              vals.shape + (ww,))
+    dt = jnp.bfloat16 if codec == 'bf16' else jnp.float32
+    return jnp.broadcast_to(vals[..., None].astype(dt),
+                            vals.shape + (w,))
 
   def local_fn(*inputs):
     total = jnp.zeros((), jnp.float32)
@@ -172,11 +187,10 @@ def build_exchange_program(dist, cats, chunks: Optional[int] = None,
       for lo, hi in chunk_bounds(sub.n_cap, req):
         part = send[:, lo:hi]
         if rows_only:
-          # cotangent-shaped leg alone: width-w f32 rows through ONE
-          # a2a per chunk (the _build_backward exchange shape)
-          rows = jnp.broadcast_to(
-              part[:, :, :, 0, None].astype(jnp.float32),
-              (D, hi - lo, local_batch, w))
+          # cotangent-shaped leg alone: width-w rows (at the §24 wire
+          # dtype) through ONE a2a per chunk (the _build_backward
+          # exchange shape)
+          rows = _wire_rows(part[:, :, :, 0], 'bwd/cotangent', w)
           if D > 1:
             rows = jax.lax.all_to_all(rows, dist.axis_name, 0, 0)
           if hier_dcn:
@@ -200,17 +214,15 @@ def build_exchange_program(dist, cats, chunks: Optional[int] = None,
           hsend = jnp.broadcast_to(ids[None, :, :, 0],
                                    (S, hi - lo, slice_batch))
           hrecv = jax.lax.all_to_all(hsend, dist.dcn_axis, 0, 0)
-          hrows = jnp.broadcast_to(
-              hrecv[..., None].astype(jnp.float32),
-              (S, hi - lo, slice_batch, w))
+          hrows = _wire_rows(hrecv, 'dcn/rows', w)
           hback = jax.lax.all_to_all(hrows, dist.dcn_axis, 0, 0)
           total = total + jnp.sum(hback)
-        # return leg: the received ids broadcast to the row width —
-        # real data-dependent bytes, so the collective cannot fold away
-        rows = jnp.broadcast_to(
-            ids[:, :, 0, None].astype(jnp.float32),
-            (hi - lo, slice_batch, w))
-        back = rows.reshape(hi - lo, D, local_batch, w).transpose(1, 0, 2, 3)
+        # return leg: the received ids broadcast to the row width (at
+        # the §24 wire dtype) — real data-dependent bytes, so the
+        # collective cannot fold away
+        rows = _wire_rows(ids[:, :, 0], 'fwd/rows', w)
+        back = rows.reshape(hi - lo, D, local_batch,
+                            rows.shape[-1]).transpose(1, 0, 2, 3)
         if D > 1:
           back = jax.lax.all_to_all(back, dist.axis_name, 0, 0)
         total = total + jnp.sum(back)

@@ -51,7 +51,7 @@ import numpy as np
 
 from distributed_embeddings_tpu.parallel.hotcache import HotSet
 from distributed_embeddings_tpu.parallel.quantization import (
-    SCALE_BYTES, resolve_table_dtype)
+    SCALE_BYTES, resolve_table_dtype, wire_bytes_per_row)
 
 
 @dataclasses.dataclass
@@ -1347,7 +1347,8 @@ class ExchangeCostModel:
 def exchange_bytes(plan: 'ShardingPlan', global_batch: int,
                    hotness: Sequence[int], num_slices: int = 1,
                    hierarchical: bool = False,
-                   itemsize: int = 4) -> Dict[str, int]:
+                   itemsize: int = 4,
+                   wire_dtype: Optional[str] = None) -> Dict[str, int]:
   """Static per-device exchange capacity bytes, split per axis.
 
   Prices the STATIC buffers the collectives actually ship (all_to_all
@@ -1361,6 +1362,11 @@ def exchange_bytes(plan: 'ShardingPlan', global_batch: int,
     across slices; hierarchical pays the per-slot deduplicated id/row
     all_to_alls plus its (identically shaped) apply exchange.
 
+  ``wire_dtype`` prices the §24 wire format: combined row legs at bf16
+  under ``'bfloat16'``; the hierarchical pre-combine DCN row leg at the
+  payload+scale passthrough (``wire_bytes_per_row``) when the plan is
+  quantized, else bf16.  Id legs and the apply stream never narrow.
+
   Capacities are per-request upper bounds (per-slot unique caps), so a
   priced claim is conservative; ``num_slices == 1`` has zero DCN bytes
   on either path.
@@ -1368,24 +1374,36 @@ def exchange_bytes(plan: 'ShardingPlan', global_batch: int,
   D = plan.world_size
   S = max(1, int(num_slices))
   slice_batch = global_batch // S
+  spec = getattr(plan, 'table_spec', None)
+  # combined (post-sum) rows never take the passthrough — sums are not
+  # grid values — so only the bf16 cast wire narrows them
+  comb_itemsize = 2 if wire_dtype == 'bfloat16' else itemsize
   ici = 0
   dcn = 0
   for g in plan.groups:
     w = g.width
     n_req = 0
     occ = 0   # id occurrences arriving at owners, summed over slots
+    # pre-combine DCN rows: exact passthrough on quantized plans (any
+    # wire mode), bf16 cast otherwise
+    if wire_dtype is not None and spec is not None:
+      dcn_row_bytes = wire_bytes_per_row(w, spec)
+    elif wire_dtype == 'bfloat16':
+      dcn_row_bytes = w * 2
+    else:
+      dcn_row_bytes = w * itemsize
     for dev in range(D):
       for r in g.requests[dev]:
         h = hotness[r.input_id]
         n_req += 1
         occ += slice_batch * h
         # ICI legs: ids out (int32) + combined rows back, per slot
-        ici += slice_batch * h * 4 + slice_batch * w * itemsize
+        ici += slice_batch * h * 4 + slice_batch * w * comb_itemsize
     if S > 1:
       if hierarchical:
         # per-slot dedup caps the DCN id leg at the slot's occurrence
-        # count; fused rows return at width w (f32 when dequantized)
-        dcn += occ * 4 + occ * w * itemsize
+        # count; fused rows return at the wire row format
+        dcn += occ * 4 + occ * dcn_row_bytes
       # sparse-apply update stream crosses DCN on both paths: each
       # device receives (S-1) foreign compacted streams of up to
       # rows_cap + 2 rows x (id + w grad columns)
@@ -1398,20 +1416,73 @@ def price_exchange(plan: 'ShardingPlan', global_batch: int,
                    hotness: Sequence[int], num_slices: int = 1,
                    hierarchical: bool = False,
                    model: Optional[ExchangeCostModel] = None,
-                   journal: bool = True) -> Dict[str, Any]:
+                   journal: bool = True,
+                   wire_dtype: Optional[str] = None) -> Dict[str, Any]:
   """Price one step's exchange under the per-axis model and (by
   default) journal the assumption alongside the priced split."""
   model = model or ExchangeCostModel()
   split = exchange_bytes(plan, global_batch, hotness,
-                         num_slices=num_slices, hierarchical=hierarchical)
+                         num_slices=num_slices, hierarchical=hierarchical,
+                         wire_dtype=wire_dtype)
   out = dict(split)
   out['exchange_cost_us'] = round(
       model.cost_us(split['ici_bytes'], split['dcn_bytes']), 3)
   out['hierarchical'] = bool(hierarchical)
+  out['wire_dtype'] = wire_dtype
   if journal:
     # model.journal supplies the rate/ratio fields itself
     model.journal(**out)
   out['dcn_ici_ratio'] = model.dcn_ici_ratio
+  return out
+
+
+def reconcile_exchange(dist, journal: bool = True) -> Dict[str, Any]:
+  """Priced-vs-counted exchange reconciliation (design §24).
+
+  ``price_exchange`` prices static CAPACITY bytes from the plan alone;
+  the traced ``LookupPlan`` legs count what the collectives actually
+  ship.  This puts both derivations of the wire bytes side by side —
+  per axis, at the layer's wire dtype — and journals the comparison
+  (event ``exchange_reconciliation``) so a pricing/runtime divergence
+  (a leg the pricer forgot, a codec the runtime dropped) leaves
+  evidence in the same stream as the priced claims it would corrupt.
+
+  Counted bytes sum the most recent FORWARD plan's legs per axis
+  (capacity pricing covers the forward id/row legs); the ratio is
+  counted/priced.  Returns the journaled record; empty counted sides
+  (no traced forward yet) journal with ``counted_*`` of 0.
+  """
+  lplan = None
+  for lp in dist._lookup_plans.values():
+    if lp.path in ('dp', 'mp', 'hot'):
+      lplan = lp
+  counted = {'ici': 0, 'dcn': 0}
+  wire_legs = {}
+  if lplan is not None:
+    for leg in lplan.legs:
+      counted['dcn' if leg.axis == dist.dcn_axis else 'ici'] += leg.nbytes
+    wire_legs = lplan.wire_ledger()
+  priced = price_exchange(
+      dist.plan, lplan.global_batch if lplan else 0,
+      lplan.hotness if lplan else (), num_slices=dist.num_slices,
+      hierarchical=bool(getattr(dist, 'dcn_sharding', False)),
+      journal=False, wire_dtype=dist.wire_dtype)
+  out = {
+      'wire_dtype': dist.wire_dtype,
+      'path': lplan.path if lplan else None,
+      'priced_ici_bytes': priced['ici_bytes'],
+      'priced_dcn_bytes': priced['dcn_bytes'],
+      'counted_ici_bytes': int(counted['ici']),
+      'counted_dcn_bytes': int(counted['dcn']),
+      'counted_payload_bytes': int(lplan.payload_bytes()) if lplan else 0,
+      'counted_wire_bytes': int(lplan.fused_bytes()) if lplan else 0,
+      'counted_over_priced_ici': round(
+          counted['ici'] / max(priced['ici_bytes'], 1), 4),
+      'wire_legs': {k: dict(v) for k, v in wire_legs.items()},
+  }
+  if journal:
+    from distributed_embeddings_tpu.utils import resilience
+    resilience.journal('exchange_reconciliation', **out)
   return out
 
 
@@ -1476,12 +1547,23 @@ class LegLayout:
   """The offset table of ONE fused collective: every segment shares the
   leg's dtype (mixed-dtype phases fuse into one leg per dtype class —
   id legs are int32, row legs the compute dtype, so a phase is almost
-  always exactly one leg)."""
+  always exactly one leg).
+
+  ``dtype``/``shape`` are ON-WIRE truth: when a wire codec narrowed the
+  phase (design §24), the recorded leg carries the encoded dtype and
+  sizes — so ``nbytes``, ``expected_collectives`` and every byte
+  counter derived from the plan report what the collective actually
+  ships.  ``wire`` names the codec (``'bf16'`` cast wire, ``'q8'``
+  payload+scale passthrough; ``None`` = historical compute-dtype wire)
+  and ``payload_nbytes`` keeps the pre-encode (compute-dtype) bytes so
+  the compression ratio is one division away."""
   name: str
   axis: str            # mesh axis the collective rides ('data'/'dcn')
   dtype: str
   lead: int            # leading (split/concat) dim — never fused
   segments: Tuple[Segment, ...]
+  wire: Optional[str] = None
+  payload_nbytes: Optional[int] = None
 
   @property
   def total(self) -> int:
@@ -1492,15 +1574,25 @@ class LegLayout:
   def nbytes(self) -> int:
     return self.lead * self.total * np.dtype(self.dtype).itemsize
 
+  @property
+  def payload_bytes(self) -> int:
+    """Bytes this leg's buffers occupy at their compute dtype — the f32
+    wire counterfactual (equals ``nbytes`` on an un-encoded leg)."""
+    return self.nbytes if self.payload_nbytes is None else int(
+        self.payload_nbytes)
+
   def as_dict(self) -> Dict[str, Any]:
     return {'name': self.name, 'axis': self.axis, 'dtype': self.dtype,
             'lead': self.lead, 'total': self.total, 'nbytes': self.nbytes,
+            'wire': self.wire, 'payload_nbytes': self.payload_bytes,
             'segments': [s.as_dict() for s in self.segments]}
 
 
 def fuse_layout(name: str, entries: Sequence[Tuple[str, Sequence[int],
                                                    Any]],
-                axis: str = 'data') -> List[LegLayout]:
+                axis: str = 'data',
+                wire: Optional[str] = None,
+                payload_nbytes: Optional[int] = None) -> List[LegLayout]:
   """The ONE fused-buffer offset rule (design §21): group ``(label,
   shape, dtype)`` entries by dtype class (first-appearance order) and
   lay each class out contiguously in entry order.
@@ -1510,6 +1602,13 @@ def fuse_layout(name: str, entries: Sequence[Tuple[str, Sequence[int],
   concatenates a routed buffer into a fused exchange (runtime,
   LookupPlan ledger, bench byte accounting) derives offsets from here,
   so they can never disagree.
+
+  ``wire``/``payload_nbytes`` tag a wire-encoded phase (design §24):
+  entries then describe the ENCODED buffers (the on-wire truth), and
+  the pre-encode compute-dtype bytes ride along for ratio accounting.
+  A wire phase is one dtype class by construction — the codec maps
+  every buffer of the phase to the same encoded dtype — so a mixed
+  class under ``wire`` is a caller bug and raises.
   """
   by_dtype: Dict[str, List[Tuple[str, Tuple[int, ...]]]] = {}
   leads: Dict[str, int] = {}
@@ -1523,6 +1622,11 @@ def fuse_layout(name: str, entries: Sequence[Tuple[str, Sequence[int],
           f'fused leg {name!r}: leading (split) dims disagree '
           f'({shape[0]} vs {lead} at {label!r}) — every buffer of one '
           'exchange phase must split over the same device axis')
+  if wire is not None and len(by_dtype) > 1:
+    raise ValueError(
+        f'fused leg {name!r}: wire codec {wire!r} over mixed dtype '
+        f'classes {sorted(by_dtype)} — a wire-encoded phase must map '
+        'every buffer to ONE encoded dtype (design §24)')
   legs: List[LegLayout] = []
   for dt, items in by_dtype.items():
     segs: List[Segment] = []
@@ -1534,7 +1638,8 @@ def fuse_layout(name: str, entries: Sequence[Tuple[str, Sequence[int],
       off += size
     suffix = '' if len(by_dtype) == 1 else f'/{dt}'
     legs.append(LegLayout(name=name + suffix, axis=axis, dtype=dt,
-                          lead=leads[dt], segments=tuple(segs)))
+                          lead=leads[dt], segments=tuple(segs),
+                          wire=wire, payload_nbytes=payload_nbytes))
   return legs
 
 
@@ -1613,8 +1718,26 @@ class LookupPlan:
     return sum(1 for l in self.legs if axis is None or l.axis == axis)
 
   def fused_bytes(self) -> int:
-    """Total bytes crossing the interconnect through recorded legs."""
+    """Total ON-WIRE bytes crossing the interconnect through recorded
+    legs (wire-encoded legs count their encoded size — design §24)."""
     return sum(l.nbytes for l in self.legs)
+
+  def payload_bytes(self) -> int:
+    """The same legs' compute-dtype bytes — the f32-wire counterfactual
+    ``fused_bytes`` is compared against for the compression ratio."""
+    return sum(l.payload_bytes for l in self.legs)
+
+  def wire_ledger(self) -> Dict[str, Dict[str, Any]]:
+    """Per-leg on-wire dtype ledger: ``{leg: {dtype, wire, nbytes,
+    payload_nbytes}}`` in recorded order (chunk rounds repeat a name;
+    bytes accumulate so the ledger sums to ``fused_bytes``)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for l in self.legs:
+      row = out.setdefault(l.name, {'dtype': l.dtype, 'wire': l.wire,
+                                    'nbytes': 0, 'payload_nbytes': 0})
+      row['nbytes'] += l.nbytes
+      row['payload_nbytes'] += l.payload_bytes
+    return out
 
   def as_dict(self) -> Dict[str, Any]:
     return {
@@ -1623,5 +1746,6 @@ class LookupPlan:
         'chunks': self.chunks, 'stages': list(self.stages),
         'collectives': self.collective_count(),
         'fused_bytes': self.fused_bytes(),
+        'payload_bytes': self.payload_bytes(),
         'legs': [l.as_dict() for l in self.legs],
     }

@@ -232,6 +232,82 @@ def dequantize_jnp(payload, scale):
 
 
 # ---------------------------------------------------------------------------
+# wire codec (docs/design.md §24): ship the stored payload + po2 scale
+# across the exchange as ONE uint8 buffer — payload bytes bitcast in
+# place, the scale carried as its int16 frexp exponent in two trailing
+# bytes.  One dtype class means the fused exchange stays ONE collective;
+# int16 covers every finite f32 exponent (frexp e in [-148, 128]), so
+# the exponent round-trips unconditionally and decode(encode(rows)) is
+# bit-exact on quantized-grid rows (the §12 quant∘dequant identity).
+# ---------------------------------------------------------------------------
+
+WIRE_EXP_BYTES = 2  # trailing int16 frexp exponent of the po2 row scale
+
+
+def wire_bytes_per_row(width: int, spec: QuantSpec) -> int:
+  """On-wire bytes of one encoded row: payload bytes + the 2-byte scale
+  exponent (vs ``width * 4`` on the f32 wire)."""
+  return width * spec.itemsize + WIRE_EXP_BYTES
+
+
+def wire_encode_rows_np(rows: np.ndarray, spec: QuantSpec) -> np.ndarray:
+  """Encode ``[..., w]`` f32 rows into the ``[..., w*itemsize + 2]``
+  uint8 wire format: ``quantize_np`` payload bitcast to bytes, po2
+  scale as its int16 frexp exponent.  Bitwise-identical to
+  ``wire_encode_rows_jnp`` (pinned by tests/test_wire_compression.py)."""
+  payload, scale = quantize_np(np.asarray(rows, np.float32), spec)
+  pb = np.ascontiguousarray(payload).view(np.uint8)
+  _, e = np.frexp(scale)  # scale = 0.5 * 2**e exactly (po2 contract)
+  eb = np.ascontiguousarray(e.astype(np.int16)).view(np.uint8)
+  return np.concatenate([pb, eb], axis=-1)
+
+
+def wire_decode_rows_np(wire: np.ndarray, spec: QuantSpec,
+                        width: int) -> np.ndarray:
+  """Exact inverse of ``wire_encode_rows_np``: ``[..., w]`` f32 rows."""
+  wire = np.asarray(wire, np.uint8)
+  payload = np.ascontiguousarray(wire[..., :width * spec.itemsize]).view(
+      spec.dtype)
+  e = np.ascontiguousarray(
+      wire[..., width * spec.itemsize:]).view(np.int16).astype(np.int32)
+  scale = np.ldexp(np.float32(0.5), e).astype(np.float32)
+  return dequantize_np(payload, scale)
+
+
+def wire_encode_rows_jnp(rows, spec: QuantSpec):
+  """``wire_encode_rows_np`` traced — same quantizer, same exponent
+  arithmetic, byte-identical output (the consumer may decode on either
+  side of a checkpoint boundary)."""
+  import jax
+  import jax.numpy as jnp
+  payload, scale = quantize_jnp(rows, spec)
+  pb = jax.lax.bitcast_convert_type(payload, jnp.uint8)
+  if spec.itemsize != 1:  # pragma: no cover - current specs are 1-byte
+    pb = pb.reshape(pb.shape[:-2] + (pb.shape[-2] * pb.shape[-1],))
+  _, e = jnp.frexp(scale)
+  eb = jax.lax.bitcast_convert_type(e.astype(jnp.int16), jnp.uint8)
+  eb = eb.reshape(eb.shape[:-2] + (WIRE_EXP_BYTES,))
+  return jnp.concatenate([pb, eb], axis=-1)
+
+
+def wire_decode_rows_jnp(wire, spec: QuantSpec, width: int):
+  """``wire_decode_rows_np`` traced (the consumer-side dequant of the
+  §24 wire contract): ``[..., w]`` f32 rows, bit-exact vs the owner-side
+  dequant the f32 wire ships."""
+  import jax
+  import jax.numpy as jnp
+  pb = wire[..., :width * spec.itemsize]
+  if spec.itemsize != 1:  # pragma: no cover - current specs are 1-byte
+    pb = pb.reshape(pb.shape[:-1] + (width, spec.itemsize))
+  payload = jax.lax.bitcast_convert_type(pb, jnp.dtype(spec.dtype))
+  eb = wire[..., width * spec.itemsize:]
+  e = jax.lax.bitcast_convert_type(
+      eb.reshape(eb.shape[:-1] + (1, WIRE_EXP_BYTES)), jnp.int16)
+  scale = jnp.ldexp(jnp.float32(0.5), e.astype(jnp.int32))
+  return dequantize_jnp(payload, scale)
+
+
+# ---------------------------------------------------------------------------
 # bytes accounting (the journaled counters; docs/design.md §12)
 # ---------------------------------------------------------------------------
 

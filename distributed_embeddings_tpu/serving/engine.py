@@ -120,6 +120,12 @@ class ServingEngine:
       serving ``compile_lookup`` program is a stage implementation
       over the same ``LookupPlan`` as training, so ``lookup_plan()``
       exposes each rung's traced fused schedule.
+    wire_dtype: per-leg wire compression for the fused exchange
+      (design §24) — ``None`` (default, f32 wire), ``'bfloat16'``
+      (rows cross at bf16; quantized pre-combine rows ship their
+      stored payload + po2 scale, bit-exact), or ``'table'``
+      (passthrough only — fully bit-exact serving at the narrow
+      wire; requires a quantized ``table_dtype``).
     compute_dtype / lookup_impl / strategy / column_slice_threshold /
       row_slice: as in ``DistributedEmbedding``.
 
@@ -145,6 +151,7 @@ class ServingEngine:
                device_hbm_budget: Optional[int] = None,
                cold_fetch_rows=None,
                fused_exchange: bool = True,
+               wire_dtype: Optional[str] = None,
                verify_tier_digests: bool = True,
                bundle_meta: Optional[dict] = None):
     weights = list(weights)
@@ -166,7 +173,8 @@ class ServingEngine:
         cold_tier=cold_tier,
         device_hbm_budget=device_hbm_budget,
         cold_fetch_rows=cold_fetch_rows,
-        fused_exchange=fused_exchange)
+        fused_exchange=fused_exchange,
+        wire_dtype=wire_dtype)
     denom = self.dist.world_size * self.dist.num_slices
     batch_size = int(batch_size)
     if batch_size < 1 or batch_size % denom:
@@ -475,6 +483,7 @@ class ServingEngine:
           'hot_cache': bool(self.dist.hot_enabled),
           'cold_tier': self.dist.cold_tier is not None,
           'fused_exchange': bool(self.dist.fused_exchange),
+          'wire_dtype': self.dist.wire_dtype,
           'table_dtype': (self.dist.quant.name
                           if self.dist.quant else None),
       }

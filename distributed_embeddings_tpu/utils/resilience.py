@@ -78,6 +78,12 @@ REGISTERED_EVENTS = frozenset({
     # ExchangeCostModel, design §20): one event per planning run with
     # the priced per-axis exchange bytes and the DCN:ICI ratio used
     'exchange_cost_model',
+    # wire-dtype compression (parallel/planner.py reconcile_exchange,
+    # design §24): priced capacity bytes vs the traced plan's counted
+    # on-wire leg bytes, per axis, at the layer's wire dtype; and the
+    # bench/dryrun off-vs-on wire A/B with measured bytes + parity
+    # drift (bench.py --wire_ab)
+    'exchange_reconciliation', 'wire_ab',
     # runtime rendezvous sanitizer (analysis/commsan.py, design §22):
     # one digest event per barrier check inside a capture window, one
     # mismatch event per divergence witness raised at a barrier

@@ -129,6 +129,30 @@ if [ "$BUDGET" = 1 ]; then
     --table_dtype int8 \
     --max_steps 40
 
+  # cheap wire-compression A/B (design §24): the passthrough narrows
+  # the PRE-COMBINE cold-row legs, so both arms run hot_cache + int8 —
+  # off ships the cold rows as dequantized f32, on ships the stored
+  # int8 payload + po2 scale directly (bit-exact, ~4x fewer row
+  # bytes).  Compare the steady-state samples/s pair and the printed
+  # wire_dtype bytes line.
+  python examples/dlrm/main.py \
+    --dataset_path "$DATA" \
+    --batch_size "$BATCH" \
+    --dp_input \
+    --fast_compile \
+    --hot_cache \
+    --table_dtype int8 \
+    --max_steps 40
+  python examples/dlrm/main.py \
+    --dataset_path "$DATA" \
+    --batch_size "$BATCH" \
+    --dp_input \
+    --fast_compile \
+    --hot_cache \
+    --table_dtype int8 \
+    --wire_dtype table \
+    --max_steps 40
+
   # cheap audit off/on A/B (design §13): the plain --max_steps 40 row
   # above is the audit-off arm (byte-identical program); this arm runs
   # the state-integrity auditor every 10 steps — compare the two
@@ -218,6 +242,34 @@ python examples/dlrm/main.py \
   --batch_size "$BATCH" \
   --dp_input \
   --table_dtype int8 \
+  --max_steps 40
+
+# wire-compression A/B (design §24): the bf16 wire vs the plain row
+# above (float row/gradient legs cast on the wire, pinned drift
+# bound), then the int8 payload+scale passthrough off/on pair under
+# hot_cache — the passthrough narrows the PRE-COMBINE cold-row legs,
+# bit-exact between its arms.  Each on arm prints the on-wire vs
+# compute-dtype byte ratio next to its steady-state samples/s line.
+python examples/dlrm/main.py \
+  --dataset_path "$DATA" \
+  --batch_size "$BATCH" \
+  --dp_input \
+  --wire_dtype bfloat16 \
+  --max_steps 40
+python examples/dlrm/main.py \
+  --dataset_path "$DATA" \
+  --batch_size "$BATCH" \
+  --dp_input \
+  --hot_cache \
+  --table_dtype int8 \
+  --max_steps 40
+python examples/dlrm/main.py \
+  --dataset_path "$DATA" \
+  --batch_size "$BATCH" \
+  --dp_input \
+  --hot_cache \
+  --table_dtype int8 \
+  --wire_dtype table \
   --max_steps 40
 
 # audit off/on A/B (design §13): the plain --max_steps 40 row above is

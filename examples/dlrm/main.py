@@ -64,6 +64,19 @@ def parse_args():
                       'Default on; --no-fused_exchange keeps the '
                       'legacy one-collective-per-group schedule '
                       '(bit-exact either way — the A/B lever)')
+  parser.add_argument('--wire_dtype', default='none',
+                      choices=['none', 'bfloat16', 'table'],
+                      help='wire format of the fused-exchange row/'
+                      'gradient legs (docs/design.md §24): bfloat16 '
+                      'casts the float legs on the wire (~2x fewer '
+                      'row bytes, pinned drift bound); table ships a '
+                      'quantized table\'s stored int8/fp8 payload + '
+                      'scale directly (bit-exact, ~4x fewer bytes; '
+                      'requires --table_dtype).  The passthrough '
+                      'narrows the PRE-COMBINE legs — pair it with '
+                      '--hot_cache (cold rows) or a DCN mesh; combined '
+                      'row sums are not grid values and stay float.  '
+                      'Requires --fused_exchange and --trainer sparse')
   parser.add_argument('--hot_coverage', type=float, default=0.8,
                       help='per-table occurrence-coverage target for the '
                       'hot set calibration')
@@ -249,6 +262,20 @@ def main():
       raise SystemExit('--table_dtype requires --param_dtype float32 '
                        '(the per-row scale carries the dynamic range; '
                        'design §12 refusal matrix)')
+  if args.wire_dtype != 'none':
+    if not args.fused_exchange:
+      raise SystemExit('--wire_dtype requires --fused_exchange: the '
+                       'codec lives at the fused-leg seam '
+                       '(docs/design.md §24)')
+    if args.trainer != 'sparse':
+      raise SystemExit('--wire_dtype pairs with --trainer sparse (the '
+                       'gradient legs it narrows ride the sparse '
+                       'row-wise backward)')
+    if args.wire_dtype == 'table' and args.table_dtype == 'none':
+      raise SystemExit("--wire_dtype table requires --table_dtype "
+                       "(int8/float8_e4m3): the passthrough ships the "
+                       "stored quantized payload; use --wire_dtype "
+                       "bfloat16 for f32 tables")
   if args.cold_tier_budget_mb is not None:
     if not args.dp_input or not args.hot_cache:
       raise SystemExit('--cold_tier_budget_mb requires --dp_input and '
@@ -329,6 +356,8 @@ def main():
                hot_cache=hot_sets,
                overlap_chunks=args.overlap_chunks,
                fused_exchange=args.fused_exchange,
+               wire_dtype=(None if args.wire_dtype == 'none'
+                           else args.wire_dtype),
                table_dtype=(None if args.table_dtype == 'none'
                             else args.table_dtype),
                cold_tier=args.cold_tier_budget_mb is not None,
@@ -713,6 +742,20 @@ def main():
     print(f'steady-state: {(samples - s0) / dt:,.0f} samples/s '
           f'({(samples - s0)} samples after warmup; reference DLRM '
           f'8xA100 TF32: 9,158,000 samples/s){fc}')
+
+  if args.wire_dtype != 'none':
+    # the traced plan's leg ledger is ground truth for what the
+    # collectives shipped (design §24) — print the on-wire vs
+    # compute-dtype bytes so the chip A/B rows carry the ratio
+    from distributed_embeddings_tpu.parallel import planner
+    rec = planner.reconcile_exchange(dist, journal=False)
+    wb = rec['counted_wire_bytes']
+    pb = rec['counted_payload_bytes']
+    wired = sorted(k for k, v in rec['wire_legs'].items() if v.get('wire'))
+    print(f'wire_dtype {args.wire_dtype}: narrowed leg(s) '
+          f'{wired or "none"}; forward exchange ships {wb:,} bytes on '
+          f'the wire vs {pb:,} at compute dtype '
+          f'({pb / max(wb, 1):.2f}x fewer)')
 
   if args.eval:
     auc = run_eval(int(state.step))

@@ -54,10 +54,15 @@ import _cli  # noqa: E402
 
 from distributed_embeddings_tpu.utils import resilience  # noqa: E402
 
-# lower-is-better millisecond keys compared when BOTH sides carry them;
-# 'value' (the headline ms/step) is always compared
+# lower-is-better keys compared when BOTH sides carry them; 'value'
+# (the headline ms/step) is always compared.  The wire_* keys guard
+# the §24 wire-compression A/B: bytes creeping back up (a leg that
+# silently fell off the codec) or bf16 parity drift widening is a
+# regression exactly like a slower step.
 DEFAULT_KEYS = ('value', 'serve_p50_ms', 'serve_p99_ms',
-                'serve_p999_ms', 'serve_over_high_p99_ms')
+                'serve_p999_ms', 'serve_over_high_p99_ms',
+                'wire_ab_bytes_bf16', 'wire_ab_bytes_int8',
+                'wire_ab_drift_bf16', 'wire_ab_drift_int8')
 
 
 class ArtifactError(ValueError):
